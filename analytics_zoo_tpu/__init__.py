@@ -1,0 +1,42 @@
+"""analytics_zoo_tpu — a TPU-native rebuild of Analytics Zoo.
+
+A unified analytics + AI framework with the capabilities of Analytics Zoo
+(Intel's Spark/BigDL platform: see SURVEY.md), re-designed from scratch for
+TPU hardware on JAX/XLA:
+
+- ``common``   — context bootstrap (the ``init_orca_context`` analog: builds a
+                 `jax.sharding.Mesh` over TPU devices instead of a
+                 SparkContext over executors), config tree, logging.
+- ``parallel`` — mesh specs, partition rules, collectives; ring attention for
+                 sequence parallelism (no reference counterpart; TPU-first).
+- ``data``     — ``XShards``-style sharded data layer with host->HBM prefetch
+                 (replaces orca.data / FeatureSet / ImageSet / TextSet).
+- ``learn``    — Estimator API (``fit/evaluate/predict``) compiling to a
+                 single pjit train step (replaces BigDL DistriOptimizer +
+                 Orca's TF/torch/horovod backends).
+- ``models``   — built-in model zoo (NCF, Wide&Deep, BERT, forecasters, ...).
+- ``zouwu``    — time-series toolkit (forecasters + AutoTS).
+- ``automl``   — HPO engine (replaces Ray-Tune-based search).
+- ``serving``  — continuous-batching inference server + queue clients
+                 (replaces Flink/Redis Cluster Serving).
+- ``frames``   — DataFrame-style NNEstimator/NNModel (replaces NNFrames).
+
+Reference parity map: SURVEY.md §2 component inventory.
+"""
+
+from analytics_zoo_tpu.version import __version__
+
+from analytics_zoo_tpu.common.context import (
+    init_context,
+    init_orca_context,
+    stop_orca_context,
+    OrcaContext,
+)
+
+__all__ = [
+    "__version__",
+    "init_context",
+    "init_orca_context",
+    "stop_orca_context",
+    "OrcaContext",
+]

@@ -1,0 +1,17 @@
+from analytics_zoo_tpu.common.context import (
+    init_context,
+    init_orca_context,
+    stop_orca_context,
+    OrcaContext,
+    ZooContext,
+)
+from analytics_zoo_tpu.common.config import ZooConfig
+
+__all__ = [
+    "init_context",
+    "init_orca_context",
+    "stop_orca_context",
+    "OrcaContext",
+    "ZooContext",
+    "ZooConfig",
+]

@@ -1,0 +1,119 @@
+"""Config tree for analytics_zoo_tpu.
+
+The reference has no central flag library — configuration is layered across
+SparkConf keys, the ``OrcaContext`` python singleton, per-Estimator ``config``
+dicts, and Cluster Serving's ``config.yaml`` (SURVEY.md §5 "Config/flag
+system", ref: pyzoo/zoo/orca/common.py, serving ClusterServingHelper).
+
+Here that collapses into one dataclass tree, YAML-loadable for serving
+parity.  Everything is plain-python (no jax imports) so configs can be built
+before device initialisation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh layout.
+
+    ``axes`` maps axis name -> size; -1 means "fill with remaining devices".
+    Axis-name conventions (used by partition rules across the codebase):
+
+    - ``dp``: data parallel (batch dim)
+    - ``fsdp``: fully-sharded data parallel (params sharded over this too)
+    - ``tp``: tensor/model parallel
+    - ``sp``: sequence/context parallel (ring attention)
+    - ``ep``: expert parallel
+    - ``pp``: pipeline parallel
+    """
+
+    axes: Dict[str, int] = field(default_factory=lambda: {"dp": -1})
+    allow_split_physical_axes: bool = False
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.axes.keys())
+
+
+@dataclass
+class DataConfig:
+    """Input-pipeline knobs (the FeatureSet/DRAM-vs-PMEM tier analog)."""
+
+    batch_size: int = 32  # global batch size
+    shuffle_buffer: int = 10_000
+    prefetch_depth: int = 2  # double-buffered HBM staging by default
+    drop_remainder: bool = True
+    num_host_threads: int = 4
+    use_native_reader: bool = False  # C++ data plane (native/)
+
+
+@dataclass
+class TrainConfig:
+    """Estimator training knobs."""
+
+    epochs: int = 1
+    log_every_steps: int = 50
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_steps: int = 0  # 0 = only at epoch end when dir set
+    keep_checkpoints: int = 3
+    seed: int = 0
+    dtype: str = "bfloat16"  # compute dtype on the MXU
+    param_dtype: str = "float32"
+    remat: bool = False  # jax.checkpoint the model apply
+    donate_state: bool = True
+
+
+@dataclass
+class ServingConfig:
+    """Cluster-Serving-parity config (config.yaml analog)."""
+
+    model_path: str = ""
+    queue_host: str = "localhost"
+    queue_port: int = 6379
+    batch_size: int = 32  # max micro-batch
+    batch_timeout_ms: float = 5.0
+    bucket_sizes: Sequence[int] = (1, 2, 4, 8, 16, 32)  # padded-shape buckets
+    num_threads: int = 4
+
+
+@dataclass
+class ZooConfig:
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ZooConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        sub = {"mesh": MeshConfig, "data": DataConfig, "train": TrainConfig,
+               "serving": ServingConfig}
+        kwargs: Dict[str, Any] = {}
+        for k, v in d.items():
+            if k in sub and isinstance(v, dict):
+                kwargs[k] = sub[k](**v)
+            elif k == "extra" and isinstance(v, dict):
+                kwargs.setdefault("extra", {})
+                kwargs["extra"].update(v)
+            elif k in known:
+                kwargs[k] = v
+            else:
+                kwargs.setdefault("extra", {})
+                kwargs["extra"][k] = v
+        return cls(**kwargs)
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ZooConfig":
+        import yaml  # pyyaml is in the base image
+
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

@@ -1,0 +1,158 @@
+"""Context bootstrap — the ``init_orca_context`` analog.
+
+Reference behavior (SURVEY.md §3.1, ref: pyzoo/zoo/orca/common.py,
+pyzoo/zoo/common/nncontext.py, pyzoo/zoo/ray/raycontext.py): one call builds
+the whole cluster substrate — SparkContext with BigDL engine config, plus
+optionally a Ray cluster bootstrapped inside the Spark executors.
+
+TPU-native inversion: there is no JVM and no subprocess zoo.  One call
+
+- (multihost) runs ``jax.distributed.initialize`` so all TPU-VM hosts join a
+  coordinator (this replaces spark-submit + RayOnSpark barrier launch), and
+- builds the global device `Mesh` (this replaces executor allocation),
+- installs a process-wide ``OrcaContext`` singleton carrying config, mesh and
+  RNG seed (this replaces the ZooContext/OrcaContext config singletons).
+
+`cluster_mode` parity:
+  reference: local | yarn-client | yarn-cluster | k8s | standalone | spark-submit
+  here:      local (this process's devices) | multihost (TPU pod slice)
+Other reference modes are provisioning concerns that do not exist on TPU VMs;
+they raise with a pointer to `multihost`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from analytics_zoo_tpu.common.config import MeshConfig, ZooConfig
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class ZooContext:
+    """Process-wide state: config, mesh, seed.  Created by `init_context`."""
+
+    def __init__(self, config: ZooConfig, mesh: Mesh):
+        self.config = config
+        self.mesh = mesh
+        self.seed = config.train.seed
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_processes(self) -> int:
+        return jax.process_count()
+
+    def __repr__(self):
+        return (f"ZooContext(mesh={dict(self.mesh.shape)}, "
+                f"devices={self.num_devices}, "
+                f"process={self.process_index}/{self.num_processes})")
+
+
+class _OrcaContextMeta(type):
+    """Config singleton with attribute-style access, matching the reference's
+    ``OrcaContext`` (ref: pyzoo/zoo/orca/common.py OrcaContextMeta):
+    ``OrcaContext.pandas_read_backend``-style global knobs."""
+
+    _ctx: Optional[ZooContext] = None
+    _lock = threading.Lock()
+    # reference-parity global knobs
+    pandas_read_backend: str = "pandas"
+    serialize_data_creator: bool = False
+    log_output: bool = True
+
+    def get_context(cls) -> ZooContext:
+        if cls._ctx is None:
+            raise RuntimeError(
+                "No context initialised — call init_orca_context() first")
+        return cls._ctx
+
+
+class OrcaContext(metaclass=_OrcaContextMeta):
+    pass
+
+
+def init_context(
+    cluster_mode: str = "local",
+    *,
+    config: Optional[ZooConfig] = None,
+    mesh_axes: Optional[Dict[str, int]] = None,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    seed: Optional[int] = None,
+    **extra: Any,
+) -> ZooContext:
+    """Initialise the framework context. Returns a :class:`ZooContext`.
+
+    Args:
+      cluster_mode: "local" (devices visible to this process) or "multihost"
+        (join/initialise a jax.distributed coordinator across TPU-VM hosts
+        first — the RayOnSpark-launch analog).
+      mesh_axes: e.g. ``{"dp": -1}`` (default), ``{"dp": -1, "tp": 4}``.
+      coordinator_address/num_processes/process_id: multihost bootstrap; when
+        omitted, jax auto-detects from the TPU metadata server.
+    """
+    import copy
+
+    cfg = copy.deepcopy(config) if config is not None else ZooConfig()
+    if mesh_axes is not None:
+        cfg.mesh = MeshConfig(axes=dict(mesh_axes))
+    if seed is not None:
+        cfg.train.seed = seed
+    cfg.extra.update(extra)
+
+    if cluster_mode in ("multihost", "tpu-pod", "distributed"):
+        # Replaces: conda-pack + spark-submit + barrier-mode `ray start`
+        # (SURVEY.md §3.1). One collective handshake, no subprocesses.
+        kwargs: Dict[str, Any] = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        try:
+            jax.distributed.initialize(**kwargs)
+        except RuntimeError as e:  # already initialised is fine
+            if "already" not in str(e).lower():
+                raise
+    elif cluster_mode != "local":
+        raise ValueError(
+            f"cluster_mode={cluster_mode!r}: Spark-era modes (yarn/k8s/"
+            f"standalone) have no TPU equivalent; use 'local' or 'multihost'")
+
+    m = mesh_lib.make_mesh(cfg.mesh)
+    ctx = ZooContext(cfg, m)
+    with _OrcaContextMeta._lock:
+        _OrcaContextMeta._ctx = ctx
+    logger.info("initialised %r", ctx)
+    return ctx
+
+
+def init_orca_context(cluster_mode: str = "local", **kwargs) -> ZooContext:
+    """Reference-parity alias (ref: zoo.orca.init_orca_context)."""
+    return init_context(cluster_mode, **kwargs)
+
+
+def stop_orca_context() -> None:
+    """Tear down the context (ref: zoo.orca.stop_orca_context).
+
+    On TPU there are no executor processes to kill; we just drop the
+    singleton and (if we initialised it) leave jax.distributed running —
+    shutting it down mid-process is unsafe for later re-init.
+    """
+    with _OrcaContextMeta._lock:
+        _OrcaContextMeta._ctx = None

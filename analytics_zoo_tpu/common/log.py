@@ -1,0 +1,85 @@
+"""Logging + metrics sinks.
+
+Reference observability (SURVEY.md §5): BigDL TrainSummary/ValidationSummary
+to TensorBoard, per-iteration "records/sec" throughput logs, per-epoch stats
+dicts from Orca runners.  Here: a MetricLogger that fans out step records to
+stderr logging, a JSONL file, and (if `tensorboardX`/`tf` available) an
+event-file writer — plus a `jax.profiler` trace toggle, which the reference
+never had.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("analytics_zoo_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(
+        "[%(asctime)s %(name)s %(levelname)s] %(message)s", "%H:%M:%S"))
+    logger.addHandler(_h)
+    logger.setLevel(os.environ.get("ZOO_TPU_LOGLEVEL", "INFO"))
+
+
+class MetricLogger:
+    """Fans out {step, **metrics} records; tracks throughput."""
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 tensorboard_dir: Optional[str] = None,
+                 log_every: int = 50):
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter  # cpu torch in image
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception:
+                logger.warning("tensorboard writer unavailable; skipping")
+        self.log_every = max(1, log_every)
+        self._t0 = time.perf_counter()
+        self._samples_since = 0
+        self._step_of_last_log = 0
+
+    def log(self, step: int, metrics: Dict[str, Any],
+            n_samples: int = 0) -> None:
+        self._samples_since += n_samples
+        rec = {"step": step}
+        rec.update({k: float(v) for k, v in metrics.items()})
+        if self._jsonl:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        if self._tb:
+            for k, v in rec.items():
+                if k != "step":
+                    self._tb.add_scalar(k, v, step)
+        if step - self._step_of_last_log >= self.log_every:
+            dt = time.perf_counter() - self._t0
+            tput = self._samples_since / dt if dt > 0 else 0.0
+            msg = " ".join(f"{k}={v:.5g}" for k, v in rec.items() if k != "step")
+            logger.info("step %d %s samples/sec=%.1f", step, msg, tput)
+            self._t0 = time.perf_counter()
+            self._samples_since = 0
+            self._step_of_last_log = step
+
+    def close(self):
+        if self._jsonl:
+            self._jsonl.close()
+        if self._tb:
+            self._tb.close()
+
+
+def start_profiler_trace(logdir: str) -> None:
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profiler_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()

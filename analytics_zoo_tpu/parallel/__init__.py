@@ -1,0 +1,27 @@
+from analytics_zoo_tpu.parallel.mesh import (
+    make_mesh,
+    single_device_mesh,
+    resolve_axis_sizes,
+    batch_axes,
+    mesh_batch_size,
+    CANONICAL_AXES,
+)
+from analytics_zoo_tpu.parallel.partition import (
+    match_partition_rules,
+    data_sharding,
+    state_sharding,
+    with_sharding_constraint,
+)
+
+__all__ = [
+    "make_mesh",
+    "single_device_mesh",
+    "resolve_axis_sizes",
+    "batch_axes",
+    "mesh_batch_size",
+    "CANONICAL_AXES",
+    "match_partition_rules",
+    "data_sharding",
+    "state_sharding",
+    "with_sharding_constraint",
+]

@@ -1,0 +1,116 @@
+"""Partition rules: regex path -> PartitionSpec, plus sharding helpers.
+
+This is the single place parallelism strategy lives.  The reference encoded
+its (only) strategy — block-partitioned data-parallel all-reduce — deep in
+BigDL's AllReduceParameter (SURVEY.md §2.3); here a model ships a list of
+``(param-path-regex, PartitionSpec)`` rules and XLA compiles the matching
+collectives.  Data-parallel is the default (params replicated, batch sharded
+over dp/fsdp axes); tensor-parallel models add rules for their weight dims.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PartitionRules = Sequence[Tuple[str, P]]
+
+# Rules for plain data-parallel: every param replicated.
+DP_RULES: PartitionRules = ((".*", P()),)
+
+
+def _param_path(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _valid_spec(spec: P, leaf: Any, mesh: Optional[Mesh]) -> P:
+    """Drop spec entries that don't divide the leaf's shape (or exceed rank).
+
+    Lets one rule set serve many layer sizes: a ('tp'-sharded) rule applied
+    to a tensor whose dim isn't divisible by the tp size falls back to
+    replication on that dim rather than erroring at jit time.
+    """
+    shape = getattr(leaf, "shape", ())
+    if len(spec) > len(shape):
+        spec = P(*spec[: len(shape)])
+    if mesh is None:
+        return spec
+    out = []
+    for dim, entry in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if entry is None:
+            out.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if any(n not in mesh.shape for n in names):
+            out.append(None)  # rule references an axis this mesh lacks
+            continue
+        size = int(np.prod([mesh.shape[n] for n in names]))
+        out.append(entry if dim % size == 0 else None)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def match_partition_rules(
+    rules: PartitionRules,
+    tree: Any,
+    mesh: Optional[Mesh] = None,
+) -> Any:
+    """Map a pytree of arrays to a pytree of PartitionSpec by regex rules.
+
+    Scalars are always replicated.  First matching rule wins; a tree leaf
+    matching no rule is replicated (unlike the reference snippet pattern which
+    errors — replication is always correct, just maybe slow).
+    """
+
+    def spec_for(path, leaf) -> P:
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            return P()
+        name = _param_path(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return _valid_spec(spec, leaf, mesh)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def data_sharding(mesh: Mesh, *, extra_batch_axes: Sequence[str] = ()) -> NamedSharding:
+    """Sharding for a host batch: leading dim split over all dp-like axes."""
+    from analytics_zoo_tpu.parallel.mesh import batch_axes
+
+    axes = tuple(batch_axes(mesh))
+    axes += tuple(a for a in extra_batch_axes
+                  if a in mesh.axis_names and a not in axes)
+    return NamedSharding(mesh, P(axes if axes else None))
+
+
+def state_sharding(mesh: Mesh, state: Any,
+                   rules: PartitionRules = DP_RULES) -> Any:
+    """NamedSharding pytree for a TrainState/params pytree under `rules`."""
+    specs = match_partition_rules(rules, state, mesh)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def with_sharding_constraint(x: Any, spec: P) -> Any:
+    """`lax.with_sharding_constraint` that is a no-op outside jit/mesh."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x

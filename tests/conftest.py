@@ -1,0 +1,37 @@
+"""Test harness: 8 virtual CPU devices so multi-chip sharding logic runs on
+one box — the TPU analog of the reference's `local[4]` Spark contexts and
+local-Ray multi-worker tests (SURVEY.md §4)."""
+
+import os
+
+# The environment presets JAX_PLATFORMS=axon (real TPU tunnel) and a
+# sitecustomize.py imports jax at interpreter startup, so env-var overrides
+# are too late; use jax.config instead.  Tests always run on the virtual CPU
+# mesh; XLA_FLAGS is still read at first backend init.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    ds = jax.devices()
+    assert len(ds) == 8, f"expected 8 virtual cpu devices, got {len(ds)}"
+    return ds
+
+
+@pytest.fixture()
+def ctx8():
+    """A fresh dp=8 context."""
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+
+    ctx = init_orca_context("local", mesh_axes={"dp": -1})
+    yield ctx
+    stop_orca_context()

@@ -1,0 +1,83 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context, OrcaContext
+from analytics_zoo_tpu.common.config import ZooConfig, MeshConfig
+from analytics_zoo_tpu.parallel import (
+    make_mesh, resolve_axis_sizes, match_partition_rules, data_sharding,
+    mesh_batch_size,
+)
+
+
+def test_init_local_default_mesh(devices):
+    ctx = init_orca_context("local")
+    assert ctx.num_devices == 8
+    assert dict(ctx.mesh.shape) == {"dp": 8}
+    assert OrcaContext.get_context() is ctx
+    stop_orca_context()
+    with pytest.raises(RuntimeError):
+        OrcaContext.get_context()
+
+
+def test_mesh_axes_resolution():
+    assert resolve_axis_sizes({"dp": -1, "tp": 2}, 8) == {"dp": 4, "tp": 2}
+    assert resolve_axis_sizes({"dp": 8}, 8) == {"dp": 8}
+    with pytest.raises(ValueError):
+        resolve_axis_sizes({"dp": 3}, 8)
+    with pytest.raises(ValueError):
+        resolve_axis_sizes({"dp": -1, "tp": -1}, 8)
+
+
+def test_mesh_axis_order_canonical(devices):
+    m = make_mesh(axes={"tp": 2, "dp": -1})
+    assert m.axis_names == ("dp", "tp")  # dp outermost
+    assert dict(m.shape) == {"dp": 4, "tp": 2}
+
+
+def test_spark_modes_rejected():
+    with pytest.raises(ValueError, match="multihost"):
+        init_orca_context("yarn-client")
+
+
+def test_partition_rules_and_fallback(devices):
+    mesh = make_mesh(axes={"dp": 4, "tp": 2})
+    tree = {
+        "dense": {"kernel": np.zeros((16, 8)), "bias": np.zeros((8,))},
+        "emb": {"embedding": np.zeros((100, 7))},  # 7 % tp!=0 -> replicate dim
+        "scalar": np.float32(3.0),
+    }
+    rules = (
+        (r"emb/embedding", P(None, "tp")),
+        (r"kernel", P(None, "tp")),
+        (r".*", P()),
+    )
+    specs = match_partition_rules(rules, tree, mesh)
+    assert specs["dense"]["kernel"] == P(None, "tp")
+    assert specs["dense"]["bias"] == P()
+    assert specs["emb"]["embedding"] == P()  # invalid tp dim dropped
+    assert specs["scalar"] == P()
+
+
+def test_data_sharding_puts_batch_on_dp(devices):
+    mesh = make_mesh(axes={"dp": 4, "tp": 2})
+    assert mesh_batch_size(mesh) == 4
+    sh = data_sharding(mesh)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    y = jax.device_put(x, sh)
+    np.testing.assert_array_equal(np.asarray(y), x)
+    assert len(y.sharding.device_set) == 8  # replicated over tp, split over dp
+
+
+def test_config_yaml_roundtrip(tmp_path):
+    cfg = ZooConfig.from_dict(
+        {"mesh": {"axes": {"dp": 2}}, "train": {"epochs": 3}, "foo": 1})
+    assert cfg.mesh.axes == {"dp": 2}
+    assert cfg.train.epochs == 3
+    assert cfg.extra["foo"] == 1
+    import yaml
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml.safe_dump(cfg.to_dict()))
+    cfg2 = ZooConfig.from_yaml(str(p))
+    assert cfg2.train.epochs == 3
