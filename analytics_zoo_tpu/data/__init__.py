@@ -1,0 +1,16 @@
+from analytics_zoo_tpu.data.shards import XShards, SparkXShards, shard_len
+from analytics_zoo_tpu.data.readers import (
+    read_csv, read_json, read_parquet, from_ndarrays)
+from analytics_zoo_tpu.data.loader import (
+    NumpyBatchIterator, shards_to_iterator, make_global_batch,
+    device_prefetch, DataCreator)
+
+# reference-parity namespace: zoo.orca.data.pandas.read_csv
+from analytics_zoo_tpu.data import readers as pandas  # noqa: F401
+
+__all__ = [
+    "XShards", "SparkXShards", "shard_len",
+    "read_csv", "read_json", "read_parquet", "from_ndarrays",
+    "NumpyBatchIterator", "shards_to_iterator", "make_global_batch",
+    "device_prefetch", "DataCreator", "pandas",
+]

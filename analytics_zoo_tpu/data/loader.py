@@ -1,0 +1,149 @@
+"""Host->HBM batch pipeline: shuffle, batch, shard, prefetch.
+
+Replaces the reference's FeatureSet/DataSet minibatch stream and the
+per-backend loader glue (SURVEY.md §2.2: Scala feature/dataset/ DRAM/PMEM
+tiers; pyzoo/zoo/tfpark/tf_dataset.py; orca data-creator contract).
+
+TPU shape of the problem: the hot loop consumes one *globally-sharded* batch
+per step.  Each host materialises only its local rows (its XShards), and
+`jax.make_array_from_process_local_data` assembles the global jax.Array over
+the mesh's batch axes.  A small prefetch deque overlaps host-side batch
+assembly + H2D transfer with device compute (the DRAM->HBM double-buffer
+analog of FeatureSet's memory tiers).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from analytics_zoo_tpu.data.shards import XShards, shard_len
+from analytics_zoo_tpu.parallel.partition import data_sharding
+
+
+class NumpyBatchIterator:
+    """Epoch iterator over a dict of host-local ndarrays.
+
+    Yields dicts of ndarrays with leading dim = per-host batch size.
+    Shuffles with a per-epoch seed (deterministic-data-order mode is then
+    just a fixed seed — the reference's implicit Spark-partition order was
+    not even reproducible; SURVEY.md §5 race-detection notes).
+    """
+
+    def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int, *,
+                 shuffle: bool = True, drop_remainder: bool = True,
+                 seed: int = 0):
+        if not arrays:
+            raise ValueError("empty arrays dict")
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        lens = {k: len(v) for k, v in arrays.items()}
+        if len(set(lens.values())) != 1:
+            raise ValueError(f"ragged arrays: {lens}")
+        self.arrays = arrays
+        self.n = next(iter(lens.values()))
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+        self.seed = seed
+        self.epoch = 0
+        if batch_size > self.n:
+            raise ValueError(
+                f"per-host batch {batch_size} > host rows {self.n}")
+
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            return self.n // self.batch_size
+        return -(-self.n // self.batch_size)
+
+    def epoch_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        idx = np.arange(self.n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        end = (self.n // self.batch_size) * self.batch_size \
+            if self.drop_remainder else self.n
+        for lo in range(0, end, self.batch_size):
+            sel = idx[lo:lo + self.batch_size]
+            yield {k: v[sel] for k, v in self.arrays.items()}
+        self.epoch += 1
+
+
+def shards_to_iterator(shards: XShards, per_host_batch: int,
+                       **kw) -> NumpyBatchIterator:
+    return NumpyBatchIterator(shards.to_numpy_dict(), per_host_batch, **kw)
+
+
+def make_global_batch(mesh: Mesh, batch: Dict[str, np.ndarray],
+                      sharding: Optional[NamedSharding] = None
+                      ) -> Dict[str, jax.Array]:
+    """Host-local batch dict -> globally-sharded jax.Array dict."""
+    sh = sharding or data_sharding(mesh)
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sh) for k, v in batch.items()}
+    return {k: jax.make_array_from_process_local_data(sh, v)
+            for k, v in batch.items()}
+
+
+def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], mesh: Mesh, *,
+                    depth: int = 2,
+                    sharding: Optional[NamedSharding] = None
+                    ) -> Iterator[Dict[str, jax.Array]]:
+    """Overlap H2D transfer with compute: keep `depth` batches in flight.
+
+    device_put is async — enqueueing the next transfer before the consumer
+    blocks on the current batch double-buffers HBM staging.
+    """
+    sh = sharding or data_sharding(mesh)
+    buf: collections.deque = collections.deque()
+    for b in batches:
+        buf.append(make_global_batch(mesh, b, sh))
+        if len(buf) > depth:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
+class DataCreator:
+    """The reference's data-creator contract (SURVEY.md §2.2: estimators
+    accept ``data_creator(config) -> loader``).  Anything acceptable to
+    `Estimator.fit` normalises through here: XShards, dict of ndarrays,
+    (x, y) tuples, or a callable(config) returning one of those."""
+
+    @staticmethod
+    def to_arrays(data: Any, config: Optional[dict] = None,
+                  feature_cols: Optional[Sequence[str]] = None,
+                  label_cols: Optional[Sequence[str]] = None
+                  ) -> Dict[str, np.ndarray]:
+        if callable(data):
+            data = data(config or {})
+        if isinstance(data, XShards):
+            d = data.to_numpy_dict()
+        elif isinstance(data, dict):
+            d = {k: np.asarray(v) for k, v in data.items()}
+        elif isinstance(data, (tuple, list)) and len(data) == 2:
+            x, y = data
+            d = {}
+            if isinstance(x, dict):
+                d.update({k: np.asarray(v) for k, v in x.items()})
+            else:
+                d["x"] = np.asarray(x)
+            if isinstance(y, dict):
+                d.update({k: np.asarray(v) for k, v in y.items()})
+            else:
+                d["y"] = np.asarray(y)
+        else:
+            raise TypeError(f"unsupported data type {type(data)}")
+        if feature_cols or label_cols:
+            sel = {}
+            for c in list(feature_cols or []) + list(label_cols or []):
+                if c not in d:
+                    raise KeyError(f"column {c!r} not in data "
+                                   f"(have {sorted(d)})")
+                sel[c] = d[c]
+            d = sel
+        return d

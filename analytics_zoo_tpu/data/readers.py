@@ -1,0 +1,95 @@
+"""File readers -> XShards.
+
+Reference (SURVEY.md §2.2, ref: pyzoo/zoo/orca/data/pandas/preprocessing.py):
+``zoo.orca.data.pandas.read_csv/read_json`` load file globs into
+SparkXShards of pandas DataFrames, partitioned across Spark executors.
+
+Here files are partitioned across TPU-VM *hosts* (deterministic round-robin
+by sorted path so every host sees a disjoint set), then each host reads its
+files into local shards — one shard per file, or `shards_per_host` re-split.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Callable, List, Optional
+
+import jax
+
+from analytics_zoo_tpu.data.shards import XShards
+
+
+def _expand(path_or_glob) -> List[str]:
+    if isinstance(path_or_glob, (list, tuple)):
+        out: List[str] = []
+        for p in path_or_glob:
+            out.extend(_expand(p))
+        return sorted(set(out))
+    if os.path.isdir(path_or_glob):
+        return sorted(
+            os.path.join(path_or_glob, f) for f in os.listdir(path_or_glob)
+            if not f.startswith(("_", ".")))
+    matches = sorted(glob.glob(path_or_glob))
+    if not matches and os.path.exists(path_or_glob):
+        matches = [path_or_glob]
+    if not matches:
+        raise FileNotFoundError(f"no files match {path_or_glob!r}")
+    return matches
+
+
+def _host_slice(files: List[str], host_index: Optional[int],
+                num_hosts: Optional[int]) -> List[str]:
+    hi = jax.process_index() if host_index is None else host_index
+    nh = jax.process_count() if num_hosts is None else num_hosts
+    # Hosts beyond len(files) naturally get an empty list — never duplicate
+    # a file across hosts.
+    return files[hi::nh]
+
+
+def _read_files(reader: Callable, path, shards_per_host, host_index,
+                num_hosts, **kwargs) -> XShards:
+    files = _expand(path)
+    mine = _host_slice(files, host_index, num_hosts)
+    shards = [reader(f, **kwargs) for f in mine]
+    xs = XShards(
+        shards,
+        num_hosts=jax.process_count() if num_hosts is None else num_hosts,
+        host_index=jax.process_index() if host_index is None else host_index)
+    if shards_per_host and shards:
+        xs = xs.repartition(shards_per_host)
+    return xs
+
+
+def read_csv(path, shards_per_host: Optional[int] = None, *,
+             host_index: Optional[int] = None,
+             num_hosts: Optional[int] = None, **pandas_kwargs) -> XShards:
+    """ref-parity: zoo.orca.data.pandas.read_csv."""
+    import pandas as pd
+
+    return _read_files(pd.read_csv, path, shards_per_host, host_index,
+                       num_hosts, **pandas_kwargs)
+
+
+def read_json(path, shards_per_host: Optional[int] = None, *,
+              host_index: Optional[int] = None,
+              num_hosts: Optional[int] = None, **pandas_kwargs) -> XShards:
+    """ref-parity: zoo.orca.data.pandas.read_json."""
+    import pandas as pd
+
+    return _read_files(pd.read_json, path, shards_per_host, host_index,
+                       num_hosts, **pandas_kwargs)
+
+
+def read_parquet(path, shards_per_host: Optional[int] = None, *,
+                 host_index: Optional[int] = None,
+                 num_hosts: Optional[int] = None, **pandas_kwargs) -> XShards:
+    import pandas as pd
+
+    return _read_files(pd.read_parquet, path, shards_per_host, host_index,
+                       num_hosts, **pandas_kwargs)
+
+
+def from_ndarrays(data, num_shards: int = 1) -> XShards:
+    """In-memory ndarray/dict/tuple -> XShards (ref: XShards.partition)."""
+    return XShards.partition(data, num_shards)

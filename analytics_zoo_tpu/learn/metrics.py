@@ -1,0 +1,102 @@
+"""Validation metrics (reference parity: BigDL ValidationMethods surfaced as
+zoo metrics — Top1Accuracy, Top5Accuracy, Loss, MAE, MSE, AUC;
+ref: pyzoo/zoo/pipeline/api/keras/metrics.py, SURVEY.md §5).
+
+A metric is a pure fn ``(preds, targets) -> scalar`` plus a name; epoch
+aggregation is a sample-weighted mean on host.  AUC is host-side (sorting
+doesn't belong in the train step).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def top1_accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+
+def top5_accuracy(logits, labels):
+    top5 = jnp.argsort(logits, -1)[..., -5:]
+    hit = jnp.any(top5 == labels[..., None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+def binary_accuracy(logits, targets):
+    preds = (logits > 0).astype(jnp.int32)
+    return jnp.mean((preds == targets.astype(jnp.int32)).astype(jnp.float32))
+
+
+def mae_metric(preds, targets):
+    return jnp.mean(jnp.abs(preds - targets))
+
+
+def mse_metric(preds, targets):
+    return jnp.mean(jnp.square(preds - targets))
+
+
+_METRICS: Dict[str, Callable] = {
+    "accuracy": top1_accuracy,
+    "top1accuracy": top1_accuracy,
+    "top5accuracy": top5_accuracy,
+    "binary_accuracy": binary_accuracy,
+    "mae": mae_metric,
+    "mse": mse_metric,
+}
+
+
+def get_metric(m: Union[str, Callable]):
+    if callable(m):
+        return getattr(m, "__name__", "metric"), m
+    key = str(m).lower().replace(" ", "")
+    if key not in _METRICS:
+        raise ValueError(f"unknown metric {m!r}; known: {sorted(_METRICS)}")
+    return key, _METRICS[key]
+
+
+def resolve_metrics(ms: Sequence[Union[str, Callable]]):
+    return [get_metric(m) for m in (ms or [])]
+
+
+class EpochAccumulator:
+    """Sample-weighted running means for a dict of per-batch scalars."""
+
+    def __init__(self):
+        self._sums: Dict[str, float] = {}
+        self._n = 0
+
+    def add(self, scalars: Dict[str, float], n_samples: int):
+        for k, v in scalars.items():
+            self._sums[k] = self._sums.get(k, 0.0) + float(v) * n_samples
+        self._n += n_samples
+
+    def result(self) -> Dict[str, float]:
+        if self._n == 0:
+            return {}
+        return {k: v / self._n for k, v in self._sums.items()}
+
+
+def auc(scores: np.ndarray, labels: np.ndarray) -> float:
+    """Host-side ROC-AUC via rank statistic (ties get midranks)."""
+    scores = np.asarray(scores).ravel()
+    labels = np.asarray(labels).ravel().astype(bool)
+    n_pos = int(labels.sum())
+    n_neg = labels.size - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(sorted_scores):
+        j = i
+        while j + 1 < len(sorted_scores) and \
+                sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i:j + 1]] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    return float((ranks[labels].sum() - n_pos * (n_pos + 1) / 2)
+                 / (n_pos * n_neg))

@@ -1,0 +1,67 @@
+"""Loss functions (reference parity: Keras-API objectives,
+ref: zoo/pipeline/api/keras/objectives/ + pyzoo mirrors).
+
+All losses take ``(preds, targets)`` and return a scalar mean loss; all are
+pure jnp so they fuse into the train step.  String names accepted by
+Estimators resolve through ``get_loss``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax.numpy as jnp
+import optax
+
+LossFn = Callable[..., jnp.ndarray]
+
+
+def mean_squared_error(preds, targets):
+    return jnp.mean(jnp.square(preds - targets))
+
+
+def mean_absolute_error(preds, targets):
+    return jnp.mean(jnp.abs(preds - targets))
+
+
+def binary_crossentropy(logits, targets):
+    """Targets in {0,1}; preds are logits (pre-sigmoid)."""
+    return jnp.mean(
+        optax.sigmoid_binary_cross_entropy(logits, targets.astype(jnp.float32)))
+
+
+def sparse_categorical_crossentropy(logits, labels):
+    """Integer labels; logits pre-softmax."""
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels.astype(jnp.int32)))
+
+
+def categorical_crossentropy(logits, onehot):
+    return jnp.mean(optax.softmax_cross_entropy(logits, onehot))
+
+
+def huber(preds, targets, delta: float = 1.0):
+    return jnp.mean(optax.huber_loss(preds, targets, delta))
+
+
+_LOSSES = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "bce": binary_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "huber": huber,
+}
+
+
+def get_loss(loss: Union[str, LossFn]) -> LossFn:
+    if callable(loss):
+        return loss
+    key = str(loss).lower()
+    if key not in _LOSSES:
+        raise ValueError(f"unknown loss {loss!r}; known: {sorted(_LOSSES)}")
+    return _LOSSES[key]

@@ -1,0 +1,43 @@
+"""TrainState — the unit of training state the whole framework moves around.
+
+Replaces the reference's scattered state (BigDL Module weights inside
+AllReduceParameter blocks + optimizer snapshots; torch/TF runner state dicts;
+SURVEY.md §2.3): one pytree holding params, optimizer state, step, RNG and
+(optionally) batch statistics, shardable by partition rules and checkpointed
+as a unit by Orbax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from flax import struct
+from flax.training import train_state
+
+
+class ZooTrainState(train_state.TrainState):
+    """flax TrainState + mutable batch_stats (BatchNorm) + base RNG key."""
+
+    batch_stats: Optional[Any] = None
+    rng: Optional[jax.Array] = struct.field(default=None)
+
+    def step_rng(self) -> jax.Array:
+        """Per-step dropout key: fold the step counter into the base key —
+        deterministic given seed, distinct per step, no host round-trip."""
+        return jax.random.fold_in(self.rng, self.step)
+
+
+def create_train_state(
+    rng: jax.Array,
+    apply_fn: Callable,
+    variables: dict,
+    tx,
+) -> ZooTrainState:
+    return ZooTrainState.create(
+        apply_fn=apply_fn,
+        params=variables["params"],
+        tx=tx,
+        batch_stats=variables.get("batch_stats"),
+        rng=rng,
+    )

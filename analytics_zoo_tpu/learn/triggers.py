@@ -1,0 +1,88 @@
+"""Triggers — checkpoint/validation cadence control.
+
+Reference parity (ref: BigDL Trigger zoo surfaced via
+pyzoo/zoo/pipeline/api/keras/optimizers + Estimator.set_checkpoint;
+SURVEY.md §5 checkpoint/resume): EveryEpoch, SeveralIteration, MaxEpoch,
+MaxIteration, MinLoss, MaxScore, And/Or combinators.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class Trigger:
+    def __call__(self, state: Dict) -> bool:  # state: step/epoch/metrics
+        raise NotImplementedError
+
+    def __and__(self, other):
+        return _And(self, other)
+
+    def __or__(self, other):
+        return _Or(self, other)
+
+
+class EveryEpoch(Trigger):
+    """Fires at each epoch boundary (state['epoch_end'] flag)."""
+
+    def __call__(self, s):
+        return bool(s.get("epoch_end"))
+
+
+class SeveralIteration(Trigger):
+    def __init__(self, interval: int):
+        self.interval = max(1, interval)
+
+    def __call__(self, s):
+        step = s.get("step", 0)
+        return step > 0 and step % self.interval == 0
+
+
+class MaxIteration(Trigger):
+    def __init__(self, n: int):
+        self.n = n
+
+    def __call__(self, s):
+        return s.get("step", 0) >= self.n
+
+
+class MaxEpoch(Trigger):
+    def __init__(self, n: int):
+        self.n = n
+
+    def __call__(self, s):
+        return s.get("epoch", 0) >= self.n
+
+
+class MinLoss(Trigger):
+    def __init__(self, min_loss: float):
+        self.min_loss = min_loss
+
+    def __call__(self, s):
+        loss = s.get("metrics", {}).get("loss")
+        return loss is not None and loss < self.min_loss
+
+
+class MaxScore(Trigger):
+    def __init__(self, metric: str, max_score: float):
+        self.metric, self.max_score = metric, max_score
+
+    def __call__(self, s):
+        v = s.get("metrics", {}).get(self.metric)
+        return v is not None and v > self.max_score
+
+
+class _And(Trigger):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def __call__(self, s):
+        return self.a(s) and self.b(s)
+
+
+class _Or(Trigger):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def __call__(self, s):
+        return self.a(s) or self.b(s)

@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.models.ncf import NeuralCF, NCF_PARTITION_RULES
+
+__all__ = ["NeuralCF", "NCF_PARTITION_RULES"]

@@ -1,0 +1,62 @@
+"""NeuralCF — neural collaborative filtering (flagship config #1).
+
+Reference surface (SURVEY.md §2.5, ref: pyzoo/zoo/models/recommendation/
+neuralcf.py + Scala models/recommendation/NeuralCF.scala): dual-branch
+GMF (elementwise product of user/item embeddings) + MLP tower, merged into
+a rating/classification head; ``include_mf``/``mf_embed`` knobs.
+
+TPU-first notes: embedding lookups are gathers that XLA lays out in HBM —
+large tables shard over the ``tp`` axis on their vocab dim (partition
+rules below); the dense tower runs in bfloat16 on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# vocab-dim sharding for the embedding tables; replicated dense tower.
+NCF_PARTITION_RULES = (
+    (r"embedding", P("tp", None)),
+    (r".*", P()),
+)
+
+
+class NeuralCF(nn.Module):
+    """ref-parity ctor args: user_count, item_count, class_num, user_embed,
+    item_embed, hidden_layers, include_mf, mf_embed."""
+
+    user_count: int
+    item_count: int
+    class_num: int = 2  # 2 -> implicit feedback (binary logit pair)
+    user_embed: int = 20
+    item_embed: int = 20
+    hidden_layers: Sequence[int] = (40, 20, 10)
+    include_mf: bool = True
+    mf_embed: int = 20
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, user_ids, item_ids, train: bool = False):
+        # ids are 1-based in the reference (MovieLens); allocate +1 rows so
+        # both conventions work without an off-by-one trap.
+        u_mlp = nn.Embed(self.user_count + 1, self.user_embed,
+                         name="mlp_user_embedding")(user_ids)
+        i_mlp = nn.Embed(self.item_count + 1, self.item_embed,
+                         name="mlp_item_embedding")(item_ids)
+        x = jnp.concatenate([u_mlp, i_mlp], -1).astype(self.dtype)
+        for h in self.hidden_layers:
+            x = nn.relu(nn.Dense(h, dtype=self.dtype)(x))
+        if self.include_mf:
+            u_mf = nn.Embed(self.user_count + 1, self.mf_embed,
+                            name="mf_user_embedding")(user_ids)
+            i_mf = nn.Embed(self.item_count + 1, self.mf_embed,
+                            name="mf_item_embedding")(item_ids)
+            mf = (u_mf * i_mf).astype(self.dtype)
+            x = jnp.concatenate([x, mf], -1)
+        logits = nn.Dense(self.class_num, dtype=jnp.float32,
+                          name="head")(x)
+        return logits

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Benchmark: NCF training throughput (config #1 in BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Runs the flagship NCF train step on the real TPU chip via the same
+Estimator path users take.  ``vs_baseline`` compares against the same
+training loop run on this host's CPU via a subprocess (the reference stack
+is CPU-only — Xeon/MKL — so TPU-vs-host-CPU is the honest
+capability-parity ratio we can measure in this environment; BASELINE.md:
+no published reference numbers exist).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+N_USERS, N_ITEMS = 6040, 3706      # MovieLens-1M cardinalities
+GLOBAL_BATCH = 8192
+WARMUP_STEPS, BENCH_STEPS = 5, 50
+CPU_BENCH_STEPS = 10
+
+
+def run_bench(platform: str):
+    if platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import numpy as np
+    import optax
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.data.loader import make_global_batch
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models import NeuralCF, NCF_PARTITION_RULES
+
+    ctx = init_orca_context("local")
+    rng = np.random.default_rng(0)
+    n = GLOBAL_BATCH * 4
+    data = {
+        "user": rng.integers(1, N_USERS + 1, n).astype(np.int32),
+        "item": rng.integers(1, N_ITEMS + 1, n).astype(np.int32),
+        "label": rng.integers(0, 2, n).astype(np.int32),
+    }
+    est = Estimator.from_flax(
+        model=NeuralCF(user_count=N_USERS, item_count=N_ITEMS,
+                       user_embed=64, item_embed=64, mf_embed=64,
+                       hidden_layers=(128, 64, 32)),
+        loss="sparse_categorical_crossentropy",
+        optimizer=optax.adam(1e-3),
+        feature_cols=("user", "item"), label_cols=("label",),
+        partition_rules=NCF_PARTITION_RULES)
+    est._ensure_state(data)
+    est._build_jits()
+    batch = {k: v[:GLOBAL_BATCH] for k, v in data.items()}
+    gbatch = make_global_batch(ctx.mesh, batch, est._data_sharding)
+    # warmup (compile)
+    state = est.state
+    for _ in range(WARMUP_STEPS):
+        state, mets = est._jit_train_step(state, gbatch)
+    jax.block_until_ready(mets["loss"])
+    steps = BENCH_STEPS if platform != "cpu" else CPU_BENCH_STEPS
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, mets = est._jit_train_step(state, gbatch)
+    jax.block_until_ready(mets["loss"])
+    dt = time.perf_counter() - t0
+    return steps * GLOBAL_BATCH / dt
+
+
+def main():
+    if "--cpu-baseline" in sys.argv:
+        print(json.dumps({"cpu_samples_per_sec": run_bench("cpu")}))
+        return
+    tpu_sps = run_bench("tpu")
+    cpu_sps = None
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-baseline"],
+            capture_output=True, text=True, timeout=900,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        for line in out.stdout.splitlines():
+            if line.startswith("{"):
+                cpu_sps = json.loads(line)["cpu_samples_per_sec"]
+    except Exception as e:
+        print(f"cpu baseline failed: {e!r}", file=sys.stderr)
+    # vs_baseline is null (not 1.0) when the CPU baseline could not be
+    # measured — 1.0 would read as "exactly at parity".
+    print(json.dumps({
+        "metric": "ncf_train_samples_per_sec_per_chip",
+        "value": round(tpu_sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(tpu_sps / cpu_sps, 2) if cpu_sps else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.data import (
+    XShards, read_csv, from_ndarrays, shards_to_iterator, device_prefetch,
+    DataCreator, NumpyBatchIterator,
+)
+from analytics_zoo_tpu.parallel import make_mesh
+
+
+def test_partition_and_collect():
+    xs = XShards.partition({"x": np.arange(10), "y": np.arange(10) * 2},
+                           num_shards=3)
+    assert xs.num_partitions() == 3
+    assert xs.row_count() == 10
+    got = np.concatenate([s["x"] for s in xs.collect()])
+    np.testing.assert_array_equal(np.sort(got), np.arange(10))
+
+
+def test_transform_and_repartition():
+    xs = from_ndarrays(np.arange(12.0), num_shards=4)
+    xs2 = xs.transform_shard(lambda a: a + 1)
+    assert xs2.num_partitions() == 4
+    xs3 = xs2.repartition(2)
+    assert xs3.num_partitions() == 2
+    np.testing.assert_array_equal(
+        xs3.to_numpy_dict()["x"], np.arange(12.0) + 1)
+
+
+def test_split_is_row_partition():
+    xs = from_ndarrays(np.arange(1000), num_shards=2)
+    tr, va = xs.split([0.8, 0.2], seed=1)
+    assert tr.row_count() + va.row_count() == 1000
+    assert 700 < tr.row_count() < 900
+    merged = np.sort(np.concatenate(
+        [tr.to_numpy_dict()["x"], va.to_numpy_dict()["x"]]))
+    np.testing.assert_array_equal(merged, np.arange(1000))
+
+
+def test_read_csv_multi_host_disjoint(tmp_path):
+    for i in range(4):
+        pd.DataFrame({"a": np.arange(5) + i * 5,
+                      "b": np.arange(5.0)}).to_csv(
+            tmp_path / f"part-{i}.csv", index=False)
+    seen = []
+    for host in range(2):
+        xs = read_csv(str(tmp_path / "*.csv"), host_index=host, num_hosts=2)
+        assert xs.num_partitions() == 2
+        seen.append(xs.to_numpy_dict()["a"])
+    allv = np.sort(np.concatenate(seen))
+    np.testing.assert_array_equal(allv, np.arange(20))
+    # more hosts than files -> later hosts get nothing, no duplicates
+    xs = read_csv(str(tmp_path / "part-0.csv"), host_index=1, num_hosts=2)
+    assert xs.row_count() == 0
+
+
+def test_read_csv_missing():
+    with pytest.raises(FileNotFoundError):
+        read_csv("/nonexistent/*.csv")
+
+
+def test_batch_iterator_determinism_and_shapes():
+    it = NumpyBatchIterator({"x": np.arange(10)}, 4, shuffle=True, seed=7)
+    assert it.steps_per_epoch() == 2
+    e0 = [b["x"].copy() for b in it.epoch_batches()]
+    assert all(b.shape == (4,) for b in e0)
+    e1 = [b["x"].copy() for b in it.epoch_batches()]
+    assert not all(np.array_equal(a, b) for a, b in zip(e0, e1))  # reshuffled
+    it2 = NumpyBatchIterator({"x": np.arange(10)}, 4, shuffle=True, seed=7)
+    e0b = [b["x"].copy() for b in it2.epoch_batches()]
+    assert all(np.array_equal(a, b) for a, b in zip(e0, e0b))  # same seed
+
+
+def test_ragged_and_oversized_batch_rejected():
+    with pytest.raises(ValueError, match="ragged"):
+        NumpyBatchIterator({"x": np.arange(5), "y": np.arange(4)}, 2)
+    with pytest.raises(ValueError, match="> host rows"):
+        NumpyBatchIterator({"x": np.arange(3)}, 8)
+
+
+def test_device_prefetch_shards_batch(devices):
+    mesh = make_mesh(axes={"dp": 8})
+    it = NumpyBatchIterator(
+        {"x": np.arange(64, dtype=np.float32).reshape(32, 2),
+         "y": np.arange(32, dtype=np.int32)}, 16, shuffle=False)
+    out = list(device_prefetch(it.epoch_batches(), mesh))
+    assert len(out) == 2
+    b0 = out[0]
+    assert b0["x"].shape == (16, 2)
+    assert len(b0["x"].sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(b0["y"]), np.arange(16))
+
+
+def test_data_creator_normalisation():
+    d = DataCreator.to_arrays((np.zeros((4, 2)), np.ones(4)))
+    assert set(d) == {"x", "y"}
+    d2 = DataCreator.to_arrays(lambda cfg: {"a": np.zeros(3), "b": np.ones(3)},
+                               feature_cols=["a"], label_cols=["b"])
+    assert set(d2) == {"a", "b"}
+    with pytest.raises(KeyError):
+        DataCreator.to_arrays({"a": np.zeros(3)}, feature_cols=["missing"])
+    df = pd.DataFrame({"u": [1, 2], "v": [3.0, 4.0]})
+    xs = XShards([df])
+    d3 = DataCreator.to_arrays(xs)
+    assert set(d3) == {"u", "v"}
