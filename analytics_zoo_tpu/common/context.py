@@ -92,6 +92,7 @@ def init_context(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    num_devices: Optional[int] = None,
     seed: Optional[int] = None,
     **extra: Any,
 ) -> ZooContext:
@@ -134,7 +135,15 @@ def init_context(
             f"cluster_mode={cluster_mode!r}: Spark-era modes (yarn/k8s/"
             f"standalone) have no TPU equivalent; use 'local' or 'multihost'")
 
-    m = mesh_lib.make_mesh(cfg.mesh)
+    devices = None
+    if num_devices is not None:
+        avail = jax.devices()
+        if num_devices > len(avail):
+            raise ValueError(
+                f"num_devices={num_devices} but only {len(avail)} devices "
+                f"are available")
+        devices = avail[:num_devices]
+    m = mesh_lib.make_mesh(cfg.mesh, devices=devices)
     ctx = ZooContext(cfg, m)
     with _OrcaContextMeta._lock:
         _OrcaContextMeta._ctx = ctx

@@ -219,7 +219,21 @@ class FlaxEstimator:
         seed = self.config.seed
         root = jax.random.key(seed)
         init_rng, train_rng = jax.random.split(root)
-        feats = [jnp.asarray(sample_batch[c][:1]) for c in self.feature_cols]
+        # Init batch must divide the mesh's batch axes (shard_map paths are
+        # strict about divisibility), so tile the sample up to one row per
+        # batch-mesh slice instead of using a single row.
+        from analytics_zoo_tpu.parallel.mesh import mesh_batch_size
+
+        nb = max(1, mesh_batch_size(self.mesh))
+
+        def rows(c):
+            v = np.asarray(sample_batch[c])
+            if len(v) >= nb:
+                return v[:nb]
+            reps = -(-nb // max(1, len(v)))
+            return np.tile(v, (reps,) + (1,) * (v.ndim - 1))[:nb]
+
+        feats = [jnp.asarray(rows(c)) for c in self.feature_cols]
         kw = self._apply_kwargs(train=False)
 
         def init_fn():

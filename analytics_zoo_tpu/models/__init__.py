@@ -1,3 +1,11 @@
 from analytics_zoo_tpu.models.ncf import NeuralCF, NCF_PARTITION_RULES
+from analytics_zoo_tpu.models.transformer import (
+    BERT, BERTForSequenceClassification, BERTForQuestionAnswering,
+    TransformerLayer, MultiHeadAttention, BERT_PARTITION_RULES, qa_loss)
 
-__all__ = ["NeuralCF", "NCF_PARTITION_RULES"]
+__all__ = [
+    "NeuralCF", "NCF_PARTITION_RULES",
+    "BERT", "BERTForSequenceClassification", "BERTForQuestionAnswering",
+    "TransformerLayer", "MultiHeadAttention", "BERT_PARTITION_RULES",
+    "qa_loss",
+]

@@ -1,0 +1,73 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.parallel import make_mesh
+from analytics_zoo_tpu.parallel.ring_attention import (
+    full_attention, ring_self_attention)
+
+
+def _qkv(B=2, T=32, H=4, D=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.normal(size=(B, T, H, D)).astype(np.float32)
+    return jnp.asarray(mk()), jnp.asarray(mk()), jnp.asarray(mk())
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_sp8(devices, causal):
+    mesh = make_mesh(axes={"sp": 8})
+    q, k, v = _qkv()
+    ref = full_attention(q, k, v, causal=causal)
+    out = ring_self_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_mixed_mesh(devices, causal):
+    """dp x sp x tp all at once: B over dp, T over sp, heads over tp."""
+    mesh = make_mesh(axes={"dp": 2, "sp": 2, "tp": 2})
+    q, k, v = _qkv(B=4, T=16, H=4, D=8, seed=3)
+    ref = full_attention(q, k, v, causal=causal)
+    out = ring_self_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_no_sp_axis_falls_back(devices):
+    mesh = make_mesh(axes={"dp": 8})
+    q, k, v = _qkv()
+    ref = full_attention(q, k, v, causal=True)
+    out = ring_self_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_padding_mask_matches_full(devices):
+    mesh = make_mesh(axes={"sp": 8})
+    q, k, v = _qkv(B=2, T=32)
+    rng = np.random.default_rng(5)
+    kv_mask = jnp.asarray(rng.random((2, 32)) > 0.3)
+    ref = full_attention(q, k, v, kv_mask, causal=True)
+    out = ring_self_attention(q, k, v, mesh, kv_mask, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_grads_flow(devices):
+    """Backward pass through the ring (scan + ppermute) is differentiable."""
+    mesh = make_mesh(axes={"dp": 2, "sp": 4})
+    q, k, v = _qkv(T=16)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_self_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
