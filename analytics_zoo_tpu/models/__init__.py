@@ -1,11 +1,34 @@
+"""Built-in model zoo (SURVEY.md §2.5 — ref: pyzoo/zoo/models/ + Scala
+models/): recommendation, text, anomaly detection, seq2seq, image
+classification, transformer/BERT, plus the forecasting nets Zouwu wraps."""
+
 from analytics_zoo_tpu.models.ncf import NeuralCF, NCF_PARTITION_RULES
 from analytics_zoo_tpu.models.transformer import (
     BERT, BERTForSequenceClassification, BERTForQuestionAnswering,
     TransformerLayer, MultiHeadAttention, BERT_PARTITION_RULES, qa_loss)
+from analytics_zoo_tpu.models.recommendation import (
+    ColumnFeatureInfo, WideAndDeep, SessionRecommender, WND_PARTITION_RULES)
+from analytics_zoo_tpu.models.text import TextClassifier, KNRM
+from analytics_zoo_tpu.models.anomaly import (
+    AnomalyDetector, unroll, detect_anomalies)
+from analytics_zoo_tpu.models.seq2seq import Seq2Seq, greedy_generate
+from analytics_zoo_tpu.models.image import (
+    ResNet, SimpleCNN, ImageClassifier, resnet18, resnet34)
+from analytics_zoo_tpu.models.forecast import (
+    LSTMNet, TCN, MTNet, Seq2SeqTS)
+from analytics_zoo_tpu.models.rnn import RNNStack
 
 __all__ = [
     "NeuralCF", "NCF_PARTITION_RULES",
     "BERT", "BERTForSequenceClassification", "BERTForQuestionAnswering",
     "TransformerLayer", "MultiHeadAttention", "BERT_PARTITION_RULES",
     "qa_loss",
+    "ColumnFeatureInfo", "WideAndDeep", "SessionRecommender",
+    "WND_PARTITION_RULES",
+    "TextClassifier", "KNRM",
+    "AnomalyDetector", "unroll", "detect_anomalies",
+    "Seq2Seq", "greedy_generate",
+    "ResNet", "SimpleCNN", "ImageClassifier", "resnet18", "resnet34",
+    "LSTMNet", "TCN", "MTNet", "Seq2SeqTS",
+    "RNNStack",
 ]

@@ -1,0 +1,62 @@
+"""Anomaly detection — stacked-LSTM next-step regressor + detectors.
+
+Reference surface (SURVEY.md §2.5; ref: pyzoo/zoo/models/anomalydetection/
+anomaly_detector.py + Scala models/anomalydetection/): ``AnomalyDetector(
+feature_shape, hidden_layers, dropouts)`` — LSTM stack → Dense(1) trained
+on sliding windows; ``detect_anomalies(y_true, y_pred, anomaly_size)``
+ranks absolute prediction error.
+
+TPU-first: the LSTM stack is one lax.scan (models/rnn.py); detection is a
+host-side numpy ranking (sorting has no business on the MXU).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.rnn import RNNStack
+
+
+class AnomalyDetector(nn.Module):
+    """ref-parity ctor: feature_shape=(unroll_length, n_features),
+    hidden_layers, dropouts."""
+
+    feature_shape: Tuple[int, int]
+    hidden_layers: Sequence[int] = (8, 32, 15)
+    dropouts: Sequence[float] = (0.2, 0.2, 0.2)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = RNNStack(self.hidden_layers, rnn_type="lstm",
+                     dropouts=self.dropouts, dtype=self.dtype,
+                     name="lstm_stack")(x.astype(self.dtype), train)
+        return nn.Dense(1, dtype=jnp.float32, name="head")(h)[:, 0]
+
+
+def unroll(data: np.ndarray, unroll_length: int, predict_step: int = 1):
+    """Sliding windows (ref: AnomalyDetector.unroll): returns
+    (x [N, unroll_length, F], y [N]) where y is the first feature
+    ``predict_step`` after each window."""
+    data = np.asarray(data, np.float32)
+    if data.ndim == 1:
+        data = data[:, None]
+    n = len(data) - unroll_length - predict_step + 1
+    if n <= 0:
+        raise ValueError("series shorter than unroll_length+predict_step")
+    idx = np.arange(unroll_length)[None, :] + np.arange(n)[:, None]
+    x = data[idx]
+    y = data[np.arange(n) + unroll_length + predict_step - 1, 0]
+    return x, y
+
+
+def detect_anomalies(y_true: np.ndarray, y_pred: np.ndarray,
+                     anomaly_size: int = 5) -> np.ndarray:
+    """Indices of the ``anomaly_size`` largest |error| points
+    (ref: AnomalyDetector.detect_anomalies)."""
+    err = np.abs(np.asarray(y_true).ravel() - np.asarray(y_pred).ravel())
+    return np.argsort(err)[::-1][:anomaly_size]

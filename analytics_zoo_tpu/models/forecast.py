@@ -1,0 +1,189 @@
+"""Forecasting networks backing the Zouwu toolkit.
+
+Reference surface (SURVEY.md §2.5; ref: pyzoo/zoo/zouwu/model/forecast.py —
+``LSTMForecaster``, ``MTNetForecaster``, ``TCNForecaster``,
+``Seq2SeqForecaster`` wrap Keras/TF nets from pyzoo/zoo/automl/model/):
+these are the bare networks; the user-facing wrappers live in
+``analytics_zoo_tpu.zouwu``.
+
+All take [B, T, F] windows and emit [B, horizon, target_dim]
+(squeezed to [B, target_dim] when horizon == 1 at the wrapper level).
+
+TPU-first: TCN is dilated 1-D convs (pure MXU, no recurrence — the
+preferred TPU forecaster); LSTM/Seq2Seq compile to lax.scans; MTNet's
+memory attention is batched matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.rnn import RNNStack, make_cell
+
+
+class LSTMNet(nn.Module):
+    """ref: automl/model/VanillaLSTM — LSTM stack → dense head."""
+
+    output_dim: int = 1
+    horizon: int = 1
+    hidden_sizes: Sequence[int] = (32, 32)
+    dropouts: Sequence[float] = (0.2, 0.2)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = RNNStack(self.hidden_sizes, rnn_type="lstm",
+                     dropouts=self.dropouts, dtype=self.dtype,
+                     name="lstm")(x.astype(self.dtype), train)
+        out = nn.Dense(self.horizon * self.output_dim, dtype=jnp.float32,
+                       name="head")(h)
+        return out.reshape((x.shape[0], self.horizon, self.output_dim))
+
+
+class TCNBlock(nn.Module):
+    channels: int
+    kernel_size: int
+    dilation: int
+    dropout: float
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # causal padding: pad left only so step t sees <= t.
+        pad = (self.kernel_size - 1) * self.dilation
+        y = x
+        for i in range(2):
+            y = jnp.pad(y, ((0, 0), (pad, 0), (0, 0)))
+            y = nn.Conv(self.channels, (self.kernel_size,),
+                        kernel_dilation=(self.dilation,), padding="VALID",
+                        dtype=self.dtype, name=f"conv{i}")(y)
+            y = nn.relu(y)
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1,), dtype=self.dtype,
+                        name="proj")(x)
+        return nn.relu(x + y)
+
+
+class TCN(nn.Module):
+    """ref: zouwu TCNForecaster net — stacked dilated causal conv blocks."""
+
+    output_dim: int = 1
+    horizon: int = 1
+    channels: Sequence[int] = (32, 32, 32)
+    kernel_size: int = 3
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = x.astype(self.dtype)
+        for i, c in enumerate(self.channels):
+            y = TCNBlock(c, self.kernel_size, 2 ** i, self.dropout,
+                         self.dtype, name=f"block{i}")(y, train)
+        out = nn.Dense(self.horizon * self.output_dim, dtype=jnp.float32,
+                       name="head")(y[:, -1])
+        return out.reshape((x.shape[0], self.horizon, self.output_dim))
+
+
+class MTNet(nn.Module):
+    """ref: zouwu MTNetForecaster (MTNet, Chang et al.) — long-term memory
+    blocks encoded by CNN+GRU, attention against the short-term encoding,
+    plus an autoregressive highway on the last ``ar_window`` steps.
+
+    Input [B, (long_num+1)*series_length, F]: the first ``long_num``
+    chunks are the memory; the last chunk is the current window.
+    """
+
+    output_dim: int = 1
+    horizon: int = 1
+    long_num: int = 4
+    series_length: int = 8
+    ar_window: int = 4
+    cnn_filters: int = 32
+    cnn_kernel: int = 3
+    rnn_hidden: int = 32
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T, F = x.shape
+        L, q = self.long_num, self.series_length
+        if T != (L + 1) * q:
+            raise ValueError(f"expected T={(L + 1) * q}, got {T}")
+        xf = x.astype(self.dtype)
+        mem = xf[:, : L * q].reshape(B, L, q, F)
+        cur = xf[:, L * q:]                           # [B, q, F]
+
+        conv = nn.Conv(self.cnn_filters, (self.cnn_kernel,),
+                       dtype=self.dtype, name="encoder_conv")
+        gru = make_cell("gru", self.rnn_hidden, dtype=self.dtype)
+
+        def encode(seq, rnn_name):
+            h = nn.relu(conv(seq))                    # [.., q, filters]
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+            return nn.RNN(gru, name=rnn_name)(h)[:, -1]  # [.., hidden]
+
+        m = encode(mem.reshape(B * L, q, F),
+                   "encoder_rnn").reshape(B, L, self.rnn_hidden)
+        u = encode(cur, "encoder_rnn_cur")            # [B, hidden]
+
+        # attention over memory blocks.
+        att = jnp.einsum("blh,bh->bl", m, u) / jnp.sqrt(
+            jnp.asarray(self.rnn_hidden, self.dtype))
+        w = nn.softmax(att.astype(jnp.float32), axis=-1).astype(self.dtype)
+        ctx = jnp.einsum("bl,blh->bh", w, m)
+        h = jnp.concatenate([u, ctx], axis=-1)
+        nn_out = nn.Dense(self.horizon * self.output_dim,
+                          dtype=jnp.float32, name="head")(h)
+        nn_out = nn_out.reshape(B, self.horizon, self.output_dim)
+
+        # AR highway over the raw last ar_window steps of the targets
+        # (first output_dim features by convention).
+        ar_in = x[:, -self.ar_window:, : self.output_dim]  # [B, w, D]
+        ar = nn.Dense(self.horizon, dtype=jnp.float32, name="ar")(
+            ar_in.transpose(0, 2, 1))                 # [B, D, horizon]
+        return nn_out + ar.transpose(0, 2, 1)
+
+
+class Seq2SeqTS(nn.Module):
+    """ref: zouwu Seq2SeqForecaster net — LSTM encoder-decoder over
+    continuous features; decoder is teacher-free (feeds its own output)."""
+
+    output_dim: int = 1
+    horizon: int = 1
+    hidden_size: int = 64
+    num_layers: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B = x.shape[0]
+        h = x.astype(self.dtype)
+        carries = []
+        for i in range(self.num_layers):
+            cell = make_cell("lstm", self.hidden_size, dtype=self.dtype)
+            carry, h = nn.RNN(cell, return_carry=True,
+                              name=f"enc_{i}")(h)
+            carries.append(carry)
+        # decoder: unroll horizon steps feeding back the projection.
+        dec_cells = [make_cell("lstm", self.hidden_size, dtype=self.dtype)
+                     for _ in range(self.num_layers)]
+        head = nn.Dense(self.output_dim, dtype=jnp.float32, name="head")
+        prev = jnp.zeros((B, self.output_dim), self.dtype)
+        outs = []
+        for _ in range(self.horizon):  # static horizon: unrolled by trace
+            z = prev
+            new_carries = []
+            for cell, c in zip(dec_cells, carries):
+                c2, z = cell(c, z)
+                new_carries.append(c2)
+            carries = new_carries
+            y = head(z.astype(jnp.float32))
+            outs.append(y)
+            prev = y.astype(self.dtype)
+        return jnp.stack(outs, axis=1)  # [B, horizon, output_dim]

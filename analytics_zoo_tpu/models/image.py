@@ -1,0 +1,118 @@
+"""Image models — ResNet family + ImageClassifier wrapper.
+
+Reference surface (SURVEY.md §2.5; ref: pyzoo/zoo/models/image/
+imageclassification/image_classifier.py, objectdetection/): the reference
+ships *loaders* for pretrained BigDL/Caffe/TF image models plus the
+ImageSet preprocessing chain. Here the classifier is a native flax ResNet
+(trainable from scratch or loadable from an orbax export via
+``Estimator.load`` / ``InferenceModel``).
+
+TPU-first: NHWC layout (XLA:TPU's native conv layout), bfloat16 convs on
+the MXU, f32 batch-norm statistics, stride-2 convs instead of pooling where
+the reference's imported models used LRN/maxpool variants.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda name: nn.BatchNorm(
+            use_running_average=not train, dtype=jnp.float32, name=name)
+        y = nn.Conv(self.filters, (3, 3), strides=(self.stride,) * 2,
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        y = nn.relu(norm("bn1")(y).astype(self.dtype))
+        y = nn.Conv(self.filters, (3, 3), use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = norm("bn2")(y).astype(self.dtype)
+        if x.shape[-1] != self.filters or self.stride != 1:
+            x = nn.Conv(self.filters, (1, 1), strides=(self.stride,) * 2,
+                        use_bias=False, dtype=self.dtype, name="proj")(x)
+            x = norm("bn_proj")(x).astype(self.dtype)
+        return nn.relu(x + y)
+
+
+class ResNet(nn.Module):
+    """Basic-block ResNet (18/34-style) for NHWC inputs."""
+
+    num_classes: int
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    width: int = 64
+    small_inputs: bool = False  # True: 3x3 stem for CIFAR-size images
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = nn.Conv(self.width, (3, 3), use_bias=False,
+                        dtype=self.dtype, name="stem")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                        dtype=self.dtype, name="stem")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 dtype=jnp.float32,
+                                 name="stem_bn")(x).astype(self.dtype))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                stride = 2 if (i > 0 and j == 0) else 1
+                x = ResNetBlock(self.width * (2 ** i), stride,
+                                dtype=self.dtype,
+                                name=f"stage{i}_block{j}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
+
+
+def resnet18(num_classes: int, **kw) -> ResNet:
+    return ResNet(num_classes, stage_sizes=(2, 2, 2, 2), **kw)
+
+
+def resnet34(num_classes: int, **kw) -> ResNet:
+    return ResNet(num_classes, stage_sizes=(3, 4, 6, 3), **kw)
+
+
+class SimpleCNN(nn.Module):
+    """Small conv net (LeNet-class; the reference examples' starter model)."""
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for f in (32, 64):
+            x = nn.relu(nn.Conv(f, (3, 3), dtype=self.dtype)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+_BACKBONES = {
+    "simple": lambda n, **kw: SimpleCNN(n, **kw),
+    "resnet18": resnet18,
+    "resnet34": resnet34,
+}
+
+
+def ImageClassifier(num_classes: int, backbone: str = "resnet18",
+                    **kw) -> nn.Module:
+    """ref-parity entry (ImageClassifier.load_model analog): named backbone
+    -> flax module; weights restore via Estimator.load / InferenceModel."""
+    if backbone not in _BACKBONES:
+        raise ValueError(
+            f"unknown backbone {backbone!r}; known: {sorted(_BACKBONES)}")
+    return _BACKBONES[backbone](num_classes, **kw)

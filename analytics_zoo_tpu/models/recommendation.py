@@ -1,0 +1,196 @@
+"""Recommendation models — WideAndDeep, SessionRecommender.
+
+Reference surface (SURVEY.md §2.5; ref: pyzoo/zoo/models/recommendation/
+wide_and_deep.py, session_recommender.py + Scala models/recommendation/):
+
+- ``WideAndDeep(class_num, column_info, model_type, hidden_layers)`` — wide
+  (cross-product sparse logistic) + deep (embeddings → MLP) branches over a
+  ``ColumnFeatureInfo`` schema; model_type in {wide, deep, wide_n_deep}.
+- ``SessionRecommender(item_count, item_embed, rnn_hidden_layers,
+  session_length, include_history, mlp_hidden_layers, history_length)`` —
+  GRU over the current session + optional MLP over history, softmax over
+  the item catalog.
+
+TPU-first notes: the wide branch is a sparse multi-hot logistic layer —
+implemented as an embedding-gather sum (one HBM gather, no scipy CSR as in
+the reference, which shipped SparseTensor through the JVM); deep embeddings
+shard over ``tp`` on the vocab dim; towers run bfloat16 on the MXU; session
+GRU compiles to one lax.scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.models.rnn import RNNStack
+
+WND_PARTITION_RULES = (
+    (r"embedding", P("tp", None)),
+    (r".*", P()),
+)
+
+
+@dataclasses.dataclass
+class ColumnFeatureInfo:
+    """Schema for WideAndDeep inputs (ref-parity field names).
+
+    Batch keys expected by the model:
+      - ``wide_cols``:  int [B, n_wide]  — multi-hot bucket ids, already
+        offset per-column (use ``wide_offsets()``; pad with 0 = no-op id).
+      - ``indicator_cols``: int [B, n_ind] — one id per indicator column.
+      - ``embed_cols``: int [B, n_embed] — one id per embedding column.
+      - ``continuous_cols``: float [B, n_cont].
+    """
+
+    wide_base_cols: Sequence[str] = ()
+    wide_base_dims: Sequence[int] = ()
+    wide_cross_cols: Sequence[str] = ()
+    wide_cross_dims: Sequence[int] = ()
+    indicator_cols: Sequence[str] = ()
+    indicator_dims: Sequence[int] = ()
+    embed_cols: Sequence[str] = ()
+    embed_in_dims: Sequence[int] = ()
+    embed_out_dims: Sequence[int] = ()
+    continuous_cols: Sequence[str] = ()
+
+    @property
+    def wide_dims(self) -> Sequence[int]:
+        return tuple(self.wide_base_dims) + tuple(self.wide_cross_dims)
+
+    @property
+    def wide_dim_total(self) -> int:
+        return int(sum(self.wide_dims))
+
+    def wide_offsets(self):
+        """Per-column offsets into the flattened wide id space (id 0 of the
+        flattened space is reserved as padding/no-op)."""
+        offs, acc = [], 1
+        for d in self.wide_dims:
+            offs.append(acc)
+            acc += int(d)
+        return offs
+
+
+class WideAndDeep(nn.Module):
+    """ref-parity ctor: class_num, column_info, model_type, hidden_layers."""
+
+    class_num: int
+    column_info: ColumnFeatureInfo
+    model_type: str = "wide_n_deep"
+    hidden_layers: Sequence[int] = (40, 20, 10)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def feature_groups(self):
+        """Input groups this schema actually uses, in positional order —
+        the estimator's ``feature_cols`` should name batch keys in this
+        order (absent groups are skipped, so schemas without e.g.
+        indicator columns don't misalign positional features)."""
+        info = self.column_info
+        groups = []
+        if self.model_type in ("wide", "wide_n_deep") and info.wide_dims:
+            groups.append("wide_cols")
+        if self.model_type in ("deep", "wide_n_deep"):
+            if info.indicator_cols:
+                groups.append("indicator_cols")
+            if info.embed_cols:
+                groups.append("embed_cols")
+            if info.continuous_cols:
+                groups.append("continuous_cols")
+        return groups
+
+    @nn.compact
+    def __call__(self, *cols, train: bool = False, **named):
+        info = self.column_info
+        groups = self.feature_groups()
+        feats = dict(zip(groups, cols))
+        feats.update({k: v for k, v in named.items() if v is not None})
+        missing = [g for g in groups if g not in feats]
+        if missing:
+            raise ValueError(f"WideAndDeep missing inputs {missing}; "
+                             f"expected positional order {groups}")
+        wide_cols = feats.get("wide_cols")
+        indicator_cols = feats.get("indicator_cols")
+        embed_cols = feats.get("embed_cols")
+        continuous_cols = feats.get("continuous_cols")
+        logits = []
+        if self.model_type in ("wide", "wide_n_deep") and \
+                wide_cols is not None:
+            # Sparse logistic regression as a gather-sum: id 0 is the
+            # padding no-op — its gathered rows are masked to zero so the
+            # row never trains and padding count cannot shift the logits.
+            table = nn.Embed(info.wide_dim_total + 1, self.class_num,
+                             embedding_init=nn.initializers.zeros,
+                             name="wide_embedding")
+            valid = (wide_cols > 0).astype(jnp.float32)[..., None]
+            w = (table(wide_cols) * valid).sum(axis=1)  # [B, class_num]
+            logits.append(w)
+        if self.model_type in ("deep", "wide_n_deep"):
+            parts = []
+            if info.indicator_cols:
+                # indicator = one-hot passthrough; as embeddings with
+                # identity-sized output this is the same gather.
+                for j, (name, d) in enumerate(
+                        zip(info.indicator_cols, info.indicator_dims)):
+                    oh = jnp.take(
+                        jnp.eye(int(d) + 1, dtype=self.dtype),
+                        indicator_cols[:, j], axis=0)
+                    parts.append(oh)
+            for j, (name, din, dout) in enumerate(
+                    zip(info.embed_cols, info.embed_in_dims,
+                        info.embed_out_dims)):
+                e = nn.Embed(int(din) + 1, int(dout),
+                             name=f"deep_embedding_{name}")(embed_cols[:, j])
+                parts.append(e.astype(self.dtype))
+            if info.continuous_cols:
+                parts.append(continuous_cols.astype(self.dtype))
+            x = jnp.concatenate(parts, axis=-1)
+            for h in self.hidden_layers:
+                x = nn.relu(nn.Dense(int(h), dtype=self.dtype)(x))
+            logits.append(nn.Dense(self.class_num, dtype=jnp.float32,
+                                   name="deep_head")(x))
+        out = logits[0] if len(logits) == 1 else logits[0] + logits[1]
+        return out.astype(jnp.float32)
+
+
+class SessionRecommender(nn.Module):
+    """ref-parity ctor: item_count, item_embed, rnn_hidden_layers,
+    session_length, include_history, mlp_hidden_layers, history_length.
+
+    Inputs: ``session`` int [B, session_length] (0 = padding) and, when
+    ``include_history``, ``history`` int [B, history_length]. Output:
+    logits over the item catalog [B, item_count + 1].
+    """
+
+    item_count: int
+    item_embed: int = 100
+    rnn_hidden_layers: Sequence[int] = (40, 20)
+    session_length: int = 0
+    include_history: bool = False
+    mlp_hidden_layers: Sequence[int] = (40, 20)
+    history_length: int = 0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, session, history=None, train: bool = False):
+        embed = nn.Embed(self.item_count + 1, self.item_embed,
+                         name="item_embedding")
+        x = embed(session).astype(self.dtype)
+        x = RNNStack(self.rnn_hidden_layers, rnn_type="gru",
+                     dtype=self.dtype, name="session_gru")(x, train)
+        if self.include_history:
+            if history is None:
+                raise ValueError("include_history=True needs `history`")
+            # mean-pool history embeddings (mask padding id 0), then MLP.
+            h = embed(history).astype(self.dtype)
+            mask = (history > 0).astype(self.dtype)[..., None]
+            h = (h * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+            for u in self.mlp_hidden_layers:
+                h = nn.relu(nn.Dense(int(u), dtype=self.dtype)(h))
+            x = jnp.concatenate([x, h], axis=-1)
+        return nn.Dense(self.item_count + 1, dtype=jnp.float32,
+                        name="head")(x)

@@ -1,0 +1,56 @@
+"""Recurrent building blocks shared by the model zoo + zouwu forecasters.
+
+Reference (SURVEY.md §2.4/§2.5): the Keras-API LSTM/GRU layers
+(ref: pipeline/api/keras/layers/recurrent.py) used by SessionRecommender,
+AnomalyDetector, Zouwu forecasters and Seq2Seq.
+
+TPU-first notes: recurrence compiles to one ``lax.scan`` (flax ``nn.RNN``),
+so the whole unrolled sequence is a single XLA while-loop with static
+shapes — no per-step Python. Cell matmuls run in the requested dtype
+(bfloat16 by default) on the MXU; carries stay f32 for stability.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def make_cell(rnn_type: str, features: int, dtype=None) -> nn.RNNCellBase:
+    t = rnn_type.lower()
+    if t == "lstm":
+        return nn.LSTMCell(features, dtype=dtype)
+    if t == "gru":
+        return nn.GRUCell(features, dtype=dtype)
+    if t in ("rnn", "simplernn"):
+        return nn.SimpleCell(features, dtype=dtype)
+    raise ValueError(f"unknown rnn_type {rnn_type!r} (lstm|gru|simplernn)")
+
+
+class RNNStack(nn.Module):
+    """Stacked recurrent layers over [B, T, F].
+
+    Returns the full sequence [B, T, H] if ``return_sequences`` else the
+    last step [B, H]. Dropout applies between layers (reference Keras
+    semantics).
+    """
+
+    hidden_sizes: Sequence[int]
+    rnn_type: str = "lstm"
+    dropouts: Sequence[float] = ()
+    return_sequences: bool = False
+    dtype: Optional[jnp.dtype] = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        drops = list(self.dropouts) or [0.0] * len(self.hidden_sizes)
+        if len(drops) != len(self.hidden_sizes):
+            raise ValueError("dropouts must match hidden_sizes")
+        for i, (h, d) in enumerate(zip(self.hidden_sizes, drops)):
+            cell = make_cell(self.rnn_type, h, dtype=self.dtype)
+            x = nn.RNN(cell, name=f"{self.rnn_type}_{i}")(x)
+            if d:
+                x = nn.Dropout(d, deterministic=not train)(x)
+        return x if self.return_sequences else x[:, -1]

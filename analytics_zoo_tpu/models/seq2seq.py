@@ -1,0 +1,118 @@
+"""Seq2Seq — RNN encoder/decoder (chatbot / translation family).
+
+Reference surface (SURVEY.md §2.5; ref: pyzoo/zoo/models/seq2seq/ + Scala
+models/seq2seq/Seq2seq.scala): ``Seq2seq(encoder, decoder, input_shape,
+output_shape, bridge, generator)`` — stacked RNN encoder, bridge mapping
+final encoder states into decoder initial states, teacher-forced training
+and step-wise ``infer``.
+
+TPU-first: training is two lax.scans (encoder + teacher-forced decoder) —
+one fused XLA program, no per-step Python. Greedy generation wraps the
+single-step decoder in an outer ``lax.scan`` over ``model.apply`` (pure),
+so inference is also one compiled program with static max_len.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.rnn import make_cell
+
+
+class Seq2Seq(nn.Module):
+    """ref-parity ctor (re-shaped): rnn_type, hidden_sizes, vocab_size,
+    embed_dim, bridge (copy|dense), tied decoder vocab."""
+
+    vocab_size: int
+    embed_dim: int = 128
+    hidden_sizes: Sequence[int] = (128,)
+    rnn_type: str = "gru"
+    bridge: str = "copy"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        self.embedding = nn.Embed(self.vocab_size, self.embed_dim,
+                                  name="embedding")
+        # The same nn.RNN modules serve full-sequence (training) and
+        # length-1 (greedy step) calls, so params are shared by scope.
+        self.enc_rnns = [
+            nn.RNN(make_cell(self.rnn_type, h, dtype=self.dtype),
+                   return_carry=True, name=f"enc_rnn_{i}")
+            for i, h in enumerate(self.hidden_sizes)]
+        self.dec_rnns = [
+            nn.RNN(make_cell(self.rnn_type, h, dtype=self.dtype),
+                   return_carry=True, name=f"dec_rnn_{i}")
+            for i, h in enumerate(self.hidden_sizes)]
+        if self.bridge == "dense":
+            self.bridges = [nn.Dense(h, name=f"bridge_{i}")
+                            for i, h in enumerate(self.hidden_sizes)]
+        self.head = nn.Dense(self.vocab_size, dtype=jnp.float32,
+                             name="generator")
+
+    # ---- pieces ------------------------------------------------------
+
+    def _bridge(self, carries):
+        if self.bridge == "copy":
+            return carries
+        out = []
+        for i, c in enumerate(carries):
+            out.append(jax.tree.map(lambda t: self.bridges[i](t), c))
+        return out
+
+    def encode(self, enc_tokens):
+        """Returns decoder initial carries (post-bridge)."""
+        x = self.embedding(enc_tokens).astype(self.dtype)
+        carries = []
+        for rnn in self.enc_rnns:
+            carry, x = rnn(x)
+            carries.append(carry)
+        return self._bridge(carries)
+
+    def decode_step(self, tok, carries):
+        """One greedy step: tok [B] -> (logits [B, V], new carries)."""
+        x = self.embedding(tok)[:, None].astype(self.dtype)  # len-1 seq
+        new = []
+        for rnn, c in zip(self.dec_rnns, carries):
+            c2, x = rnn(x, initial_carry=c)
+            new.append(c2)
+        return self.head(x[:, 0].astype(jnp.float32)), new
+
+    # ---- training forward -------------------------------------------
+
+    def __call__(self, enc_tokens, dec_tokens, train: bool = False):
+        """Teacher-forced: logits [B, T_dec, vocab] for next-token CE."""
+        carries = self.encode(enc_tokens)
+        x = self.embedding(dec_tokens).astype(self.dtype)
+        for rnn, c in zip(self.dec_rnns, carries):
+            _, x = rnn(x, initial_carry=c)
+        return self.head(x.astype(jnp.float32))
+
+
+def greedy_generate(model: Seq2Seq, variables, enc_tokens,
+                    max_len: int, bos_id: int = 1,
+                    eos_id: Optional[int] = None) -> jax.Array:
+    """Greedy decode as one lax.scan over the pure apply fn
+    (ref-parity: Seq2seq.infer). Returns [B, max_len] token ids; positions
+    after eos are frozen at eos."""
+    carries = model.apply(variables, enc_tokens, method=Seq2Seq.encode)
+    B = enc_tokens.shape[0]
+    tok0 = jnp.full((B,), bos_id, jnp.int32)
+    done0 = jnp.zeros((B,), bool)
+
+    def step(state, _):
+        tok, carries, done = state
+        logits, new_carries = model.apply(
+            variables, tok, carries, method=Seq2Seq.decode_step)
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, new_carries, done), nxt
+
+    _, toks = jax.lax.scan(step, (tok0, carries, done0), None,
+                           length=max_len)
+    return toks.T  # [B, max_len]

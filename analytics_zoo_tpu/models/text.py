@@ -1,0 +1,138 @@
+"""Text models — TextClassifier and KNRM text matching.
+
+Reference surface (SURVEY.md §2.5; ref: pyzoo/zoo/models/textclassification/
+text_classifier.py, pyzoo/zoo/models/textmatching/knrm.py + Scala mirrors):
+
+- ``TextClassifier(class_num, embedding, sequence_length, encoder,
+  encoder_output_dim)`` — token embedding → CNN / LSTM / GRU encoder →
+  softmax head.
+- ``KNRM(text1_length, text2_length, embedding, kernel_num, sigma,
+  exact_sigma, target_mode)`` — kernel-pooled soft-TF matching: cosine
+  interaction matrix → RBF kernel pooling → dense.
+
+TPU-first notes: both are embarrassingly MXU-friendly — the CNN encoder is
+one conv + max-pool, KNRM's interaction matrix is a batched matmul
+[B,T1,E]x[B,E,T2] and the kernel pooling is a broadcasted elementwise
+reduce that XLA fuses. Pretrained GloVe rows load as frozen or trainable
+embedding tables via ``embed_weights``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.rnn import RNNStack
+
+
+def _embedding(vocab_size: int, embed_dim: int,
+               weights: Optional[np.ndarray], name: str) -> nn.Embed:
+    if weights is None:
+        init = nn.initializers.normal(0.02)
+    else:
+        def init(key, shape, dtype=jnp.float32):
+            w = jnp.asarray(weights, dtype)
+            if w.shape != tuple(shape):
+                raise ValueError(
+                    f"pretrained embedding shape {w.shape} != expected "
+                    f"{tuple(shape)} (vocab_size x embed_dim)")
+            return w
+    return nn.Embed(vocab_size, embed_dim, embedding_init=init, name=name)
+
+
+class TextClassifier(nn.Module):
+    """ref-parity ctor: class_num, token_length(=embed dim),
+    sequence_length, encoder (cnn|lstm|gru), encoder_output_dim."""
+
+    class_num: int
+    vocab_size: int
+    token_length: int = 200
+    sequence_length: int = 500
+    encoder: str = "cnn"
+    encoder_output_dim: int = 256
+    embed_weights: Optional[np.ndarray] = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = _embedding(self.vocab_size, self.token_length,
+                       self.embed_weights, "word_embedding")(tokens)
+        x = x.astype(self.dtype)
+        enc = self.encoder.lower()
+        if enc == "cnn":
+            # reference: Conv1D(k=5) + global max pool.
+            h = nn.Conv(self.encoder_output_dim, kernel_size=(5,),
+                        dtype=self.dtype, name="conv")(x)
+            h = nn.relu(h)
+            h = jnp.max(h, axis=1)
+        elif enc in ("lstm", "gru"):
+            h = RNNStack([self.encoder_output_dim], rnn_type=enc,
+                         dtype=self.dtype, name="rnn")(x, train)
+        else:
+            raise ValueError(f"unknown encoder {self.encoder!r}")
+        h = nn.Dropout(0.2, deterministic=not train)(h)
+        h = nn.relu(nn.Dense(128, dtype=self.dtype)(h))
+        return nn.Dense(self.class_num, dtype=jnp.float32, name="head")(h)
+
+
+class KNRM(nn.Module):
+    """ref-parity ctor: text1_length, text2_length, kernel_num, sigma,
+    exact_sigma, target_mode (ranking|classification).
+
+    Inputs: ``text1`` int [B, T1] (query), ``text2`` int [B, T2] (doc),
+    id 0 = padding. Output: [B, 1] ranking score (sigmoid-able logit) or
+    [B, 2] classification logits.
+    """
+
+    vocab_size: int
+    text1_length: int = 10
+    text2_length: int = 40
+    embed_dim: int = 300
+    kernel_num: int = 21
+    sigma: float = 0.1
+    exact_sigma: float = 0.001
+    target_mode: str = "ranking"
+    embed_weights: Optional[np.ndarray] = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, text1, text2, train: bool = False):
+        embed = _embedding(self.vocab_size, self.embed_dim,
+                           self.embed_weights, "word_embedding")
+        q = embed(text1)                       # [B, T1, E]
+        d = embed(text2)                       # [B, T2, E]
+
+        def l2norm(x):
+            return x / jnp.maximum(
+                jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-6)
+
+        # cosine interaction matrix — one batched MXU matmul.
+        inter = jnp.einsum("bqe,bde->bqd", l2norm(q).astype(self.dtype),
+                           l2norm(d).astype(self.dtype)).astype(jnp.float32)
+        qmask = (text1 > 0).astype(jnp.float32)[:, :, None]   # [B,T1,1]
+        dmask = (text2 > 0).astype(jnp.float32)[:, None, :]   # [B,1,T2]
+        pair_mask = qmask * dmask
+
+        # kernel centers: mu_k spaced over [-1, 1], last kernel = exact
+        # match (mu=1, tight sigma) — reference KNRM layout.
+        K = self.kernel_num
+        mus = [1.0]
+        sigmas = [self.exact_sigma]
+        if K > 1:
+            step = 2.0 / (K - 1)
+            mus += [1.0 - step / 2 - i * step for i in range(K - 1)]
+            sigmas += [self.sigma] * (K - 1)
+        mu = jnp.asarray(mus)[None, None, None, :]       # [1,1,1,K]
+        sg = jnp.asarray(sigmas)[None, None, None, :]
+
+        # RBF pooling: sum over doc dim, log, sum over query dim.
+        kv = jnp.exp(-jnp.square(inter[..., None] - mu) / (2 * sg * sg))
+        kv = (kv * pair_mask[..., None]).sum(axis=2)     # [B, T1, K]
+        phi = (jnp.log1p(kv) * qmask).sum(axis=1)        # [B, K]
+
+        if self.target_mode == "classification":
+            return nn.Dense(2, name="head")(phi)
+        return nn.Dense(1, name="head")(phi)

@@ -45,7 +45,8 @@ def _constrain_seq(x, mesh: Optional[Mesh]):
     """hidden states: [B, T, E] -> shard B over dp(+fsdp), T over sp."""
     if mesh is None:
         return x
-    batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+    from analytics_zoo_tpu.parallel.mesh import batch_axes
+    batch = batch_axes(mesh) or None
     seq = "sp" if "sp" in mesh.axis_names else None
     return with_sharding_constraint(x, P(batch, seq, None))
 
@@ -68,7 +69,9 @@ class MultiHeadAttention(nn.Module):
         mesh = self.mesh
         if mesh is not None and "sp" in mesh.axis_names and \
                 mesh.shape["sp"] > 1:
-            o = ring_self_attention(q, k, v, mesh, kv_mask, causal=False)
+            from analytics_zoo_tpu.parallel.mesh import batch_axes
+            o = ring_self_attention(q, k, v, mesh, kv_mask, causal=False,
+                                    batch_axes=batch_axes(mesh))
         else:
             o = full_attention(q, k, v, kv_mask, causal=False)
         o = nn.DenseGeneral(E, axis=(-2, -1), dtype=self.dtype,
