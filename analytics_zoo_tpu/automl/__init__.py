@@ -1,0 +1,9 @@
+"""AutoML — hyper-parameter search without Ray (SURVEY.md §2.5:
+replaces pyzoo/zoo/automl's RayTuneSearchEngine + orca.automl)."""
+
+from analytics_zoo_tpu.automl import hp
+from analytics_zoo_tpu.automl.search import (
+    MedianStopper, SearchEngine, Trial)
+from analytics_zoo_tpu.automl.auto_estimator import AutoEstimator
+
+__all__ = ["hp", "SearchEngine", "MedianStopper", "Trial", "AutoEstimator"]

@@ -1,0 +1,112 @@
+"""AutoEstimator — HPO-driven Estimator construction.
+
+Reference surface (SURVEY.md §2.5; ref: pyzoo/zoo/orca/automl/
+auto_estimator.py — ``AutoEstimator.from_torch/from_keras(model_creator)``
+→ ``.fit(data, search_space, n_sampling, metric)`` over Ray Tune →
+``get_best_model()`` / ``get_best_config()``).
+
+Each trial builds a fresh ``FlaxEstimator`` from ``model_creator(config)``,
+trains on the (shared, host-resident) data, evaluates on validation data,
+and reports the metric; the engine handles sampling/pruning. Trials run
+sequentially on the chip — XLA's compile cache makes same-shape trials
+cheap after the first.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import optax
+
+from analytics_zoo_tpu.automl.search import MedianStopper, SearchEngine
+from analytics_zoo_tpu.learn.estimator import Estimator, FlaxEstimator
+
+
+def _default_optimizer_creator(config: Dict):
+    return optax.adam(float(config.get("lr", 1e-3)))
+
+
+class AutoEstimator:
+    def __init__(self, model_creator: Callable[[Dict], Any], *,
+                 loss: Any = "mse",
+                 optimizer_creator: Callable[[Dict], Any] = None,
+                 feature_cols=("x",), label_cols=("y",),
+                 metrics=(), name: str = "auto_estimator"):
+        self.model_creator = model_creator
+        self.loss = loss
+        self.optimizer_creator = optimizer_creator or \
+            _default_optimizer_creator
+        self.feature_cols = tuple(feature_cols)
+        self.label_cols = tuple(label_cols)
+        self.metrics = metrics
+        self.name = name
+        self.best_estimator: Optional[FlaxEstimator] = None
+        self.best_config: Optional[Dict] = None
+        self.best_trial = None
+
+    @staticmethod
+    def from_flax(model_creator, **kw) -> "AutoEstimator":
+        return AutoEstimator(model_creator, **kw)
+
+    # reference entry-point names
+    from_keras = from_flax
+    from_torch = from_flax
+
+    def _build(self, config: Dict) -> FlaxEstimator:
+        return Estimator.from_flax(
+            model=self.model_creator(config), loss=self.loss,
+            optimizer=self.optimizer_creator(config),
+            feature_cols=self.feature_cols, label_cols=self.label_cols,
+            metrics=self.metrics)
+
+    def fit(self, data, validation_data=None, *, search_space: Dict,
+            n_sampling: int = 4, epochs: int = 1, metric: str = "loss",
+            mode: str = "min", batch_size: int = 32,
+            early_stop: bool = True, seed: int = 0) -> "AutoEstimator":
+        """Search, then retain the best estimator (already trained).
+
+        ``batch_size``/``epochs`` may also live in the search space under
+        the same names; config values win.
+        """
+        val = validation_data if validation_data is not None else data
+
+        def trainable(config: Dict, report):
+            est = self._build(config)
+            bs = int(config.get("batch_size", batch_size))
+            n_ep = int(config.get("epochs", epochs))
+            for ep in range(n_ep):
+                est.fit(data, epochs=1, batch_size=bs)
+                stats = est.evaluate(val, batch_size=bs)
+                report(ep, float(stats[metric]))
+            stats = est.evaluate(val, batch_size=bs)
+            # stash so the winning trial's estimator can be retained
+            trainable._last = (est, config)
+            return {k: float(v) for k, v in stats.items()}
+
+        scheduler = MedianStopper(mode=mode) if early_stop else None
+        engine = SearchEngine(trainable, search_space, metric=metric,
+                              mode=mode, n_sampling=n_sampling, seed=seed,
+                              scheduler=scheduler)
+        best = engine.run()
+        self.best_trial = best
+        self.best_config = best.config
+        # retrain the winner if its estimator isn't the last one stashed
+        # (later trials overwrote the stash).
+        est, cfg = getattr(trainable, "_last", (None, None))
+        if cfg is not best.config:
+            est = self._build(best.config)
+            est.fit(data, epochs=int(best.config.get("epochs", epochs)),
+                    batch_size=int(best.config.get("batch_size",
+                                                   batch_size)))
+        self.best_estimator = est
+        return self
+
+    def get_best_model(self):
+        if self.best_estimator is None:
+            raise RuntimeError("call fit first")
+        return self.best_estimator
+
+    def get_best_config(self) -> Dict:
+        if self.best_config is None:
+            raise RuntimeError("call fit first")
+        return self.best_config

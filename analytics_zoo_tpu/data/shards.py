@@ -80,6 +80,21 @@ class XShards:
         return XShards([take(data, bounds[i], bounds[i + 1])
                         for i in range(n)])
 
+    @staticmethod
+    def from_list(records: Sequence[Any],
+                  num_shards: Optional[int] = None) -> "XShards":
+        """Split a flat sequence of arbitrary records (rows) into shards.
+
+        Unlike ``partition`` — which treats list/tuple payloads as
+        *columns* — this slices the sequence row-wise, for payloads like
+        [(path, label), ...] or [TextFeature, ...].
+        """
+        records = list(records)
+        n = max(1, min(num_shards or 1, len(records) or 1))
+        bounds = np.linspace(0, len(records), n + 1).astype(int)
+        return XShards([records[bounds[i]:bounds[i + 1]]
+                        for i in range(n)])
+
     # ---- core ops -----------------------------------------------------
 
     def transform_shard(self, fn: Callable, *args) -> "XShards":

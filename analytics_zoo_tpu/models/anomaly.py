@@ -41,17 +41,13 @@ class AnomalyDetector(nn.Module):
 def unroll(data: np.ndarray, unroll_length: int, predict_step: int = 1):
     """Sliding windows (ref: AnomalyDetector.unroll): returns
     (x [N, unroll_length, F], y [N]) where y is the first feature
-    ``predict_step`` after each window."""
-    data = np.asarray(data, np.float32)
-    if data.ndim == 1:
-        data = data[:, None]
-    n = len(data) - unroll_length - predict_step + 1
-    if n <= 0:
-        raise ValueError("series shorter than unroll_length+predict_step")
-    idx = np.arange(unroll_length)[None, :] + np.arange(n)[:, None]
-    x = data[idx]
-    y = data[np.arange(n) + unroll_length + predict_step - 1, 0]
-    return x, y
+    ``predict_step`` after each window.  Delegates to the canonical
+    window generator in zouwu.preprocessing."""
+    from analytics_zoo_tpu.zouwu.preprocessing import roll
+
+    x, y = roll(data, unroll_length, horizon=predict_step,
+                target_cols=[0])
+    return x, y[:, -1, 0]
 
 
 def detect_anomalies(y_true: np.ndarray, y_pred: np.ndarray,

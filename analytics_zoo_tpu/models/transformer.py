@@ -69,9 +69,7 @@ class MultiHeadAttention(nn.Module):
         mesh = self.mesh
         if mesh is not None and "sp" in mesh.axis_names and \
                 mesh.shape["sp"] > 1:
-            from analytics_zoo_tpu.parallel.mesh import batch_axes
-            o = ring_self_attention(q, k, v, mesh, kv_mask, causal=False,
-                                    batch_axes=batch_axes(mesh))
+            o = ring_self_attention(q, k, v, mesh, kv_mask, causal=False)
         else:
             o = full_attention(q, k, v, kv_mask, causal=False)
         o = nn.DenseGeneral(E, axis=(-2, -1), dtype=self.dtype,

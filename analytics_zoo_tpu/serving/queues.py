@@ -1,0 +1,102 @@
+"""Cluster Serving client queues — InputQueue / OutputQueue.
+
+Reference surface (SURVEY.md §2.6, §3.5; ref: pyzoo/zoo/serving/client.py):
+``InputQueue.enqueue(uri, **data)`` Arrow-encodes + base64s ndarrays and
+XADDs to the ``serving_stream``; ``OutputQueue.query(uri)`` /
+``dequeue()`` read base64 ndarrays from result hashes.
+
+Parity choices: the stream/hash keys and the enqueue/query/dequeue call
+shapes match the reference; the tensor encoding is base64(npy) instead of
+base64(Arrow) — self-describing, numpy-native, and decodes to the same
+ndarray on any client.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import time
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.resp import RespClient
+
+INPUT_STREAM = "serving_stream"
+RESULT_PREFIX = "result:"
+
+
+def encode_ndarray(a: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(a), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def decode_ndarray(s) -> np.ndarray:
+    raw = base64.b64decode(s)
+    return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+class InputQueue:
+    """ref-parity: InputQueue(host, port).enqueue(uri, key=ndarray, ...)"""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 stream: str = INPUT_STREAM):
+        self.client = RespClient(host, port)
+        self.stream = stream
+
+    def enqueue(self, uri: Optional[str] = None, **data) -> str:
+        """Enqueue one request; returns its uri (generated when omitted).
+        `data` values are ndarrays (or scalars) keyed by input name."""
+        uri = uri or str(uuid.uuid4())
+        fields = ["uri", uri]
+        for k, v in data.items():
+            fields += [k, encode_ndarray(np.asarray(v))]
+        self.client.execute("XADD", self.stream, "MAXLEN", 10000, "*",
+                            *fields)
+        return uri
+
+    def close(self):
+        self.client.close()
+
+
+class OutputQueue:
+    """ref-parity: OutputQueue().query(uri) / dequeue()."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379):
+        self.client = RespClient(host, port)
+
+    def query(self, uri: str, timeout: float = 30.0,
+              poll_interval: float = 0.01) -> Optional[np.ndarray]:
+        """Block until the result for `uri` lands (or timeout -> None)."""
+        deadline = time.monotonic() + timeout
+        key = RESULT_PREFIX + uri
+        while time.monotonic() < deadline:
+            h = self.client.execute("HGETALL", key)
+            if h:
+                fields = {h[i].decode(): h[i + 1]
+                          for i in range(0, len(h), 2)}
+                self.client.execute("DEL", key)
+                return decode_ndarray(fields["value"])
+            time.sleep(poll_interval)
+        return None
+
+    def dequeue(self) -> Dict[str, np.ndarray]:
+        """Drain every available result (ref: OutputQueue.dequeue)."""
+        out: Dict[str, np.ndarray] = {}
+        keys = self.client.execute("GET", "__result_keys__")
+        # results are stored under result:<uri>; the server also keeps an
+        # index set for dequeue-all. Fall back to nothing if unset.
+        if not keys:
+            return out
+        for uri in keys.decode().split(","):
+            if not uri:
+                continue
+            v = self.query(uri, timeout=0.05)
+            if v is not None:
+                out[uri] = v
+        return out
+
+    def close(self):
+        self.client.close()

@@ -1,0 +1,321 @@
+"""Minimal Redis-protocol (RESP2) server + client.
+
+Reference context (SURVEY.md §2.6): Cluster Serving's data plane is Redis
+streams — clients XADD to an input stream, the serving job XREADGROUPs
+batches, results land in output hashes (ref: serving/FlinkRedisSource.scala,
+FlinkRedisSink.scala, pyzoo/zoo/serving/client.py).
+
+The rebuild keeps Redis as the WIRE PROTOCOL for client parity but ships
+its own in-process broker: a tiny RESP2 server (thread-per-connection —
+the command set is tiny and the TPU forward pass dominates) implementing
+the command subset Cluster Serving uses: PING, XADD/XLEN/XREAD/XRANGE/
+XDEL/XTRIM, HSET/HGETALL/DEL, GET/SET, FLUSHDB.  A real ``redis-server``
+can be dropped in unchanged — the client speaks standard RESP.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# RESP2 encoding
+# ---------------------------------------------------------------------------
+
+def encode(obj) -> bytes:
+    """Python -> RESP2: bytes/str -> bulk, int -> integer, list -> array,
+    None -> null bulk, Exception -> error, bool ok-marker via _OK."""
+    if obj is None:
+        return b"$-1\r\n"
+    if isinstance(obj, _OK):
+        return b"+" + obj.msg.encode() + b"\r\n"
+    if isinstance(obj, Exception):
+        return b"-ERR " + str(obj).encode() + b"\r\n"
+    if isinstance(obj, bool):
+        return encode(int(obj))
+    if isinstance(obj, int):
+        return b":" + str(obj).encode() + b"\r\n"
+    if isinstance(obj, str):
+        obj = obj.encode()
+    if isinstance(obj, (bytes, bytearray)):
+        return b"$" + str(len(obj)).encode() + b"\r\n" + bytes(obj) + b"\r\n"
+    if isinstance(obj, (list, tuple)):
+        out = b"*" + str(len(obj)).encode() + b"\r\n"
+        return out + b"".join(encode(x) for x in obj)
+    raise TypeError(f"cannot RESP-encode {type(obj)}")
+
+
+class _OK:
+    def __init__(self, msg: str = "OK"):
+        self.msg = msg
+
+
+class _Reader:
+    """Buffered RESP2 parser over a socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = b""
+
+    def _read_until(self, n: Optional[int] = None) -> bytes:
+        if n is None:  # read a \r\n-terminated line
+            while b"\r\n" not in self.buf:
+                chunk = self.sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("peer closed")
+                self.buf += chunk
+            line, self.buf = self.buf.split(b"\r\n", 1)
+            return line
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+    def read(self):
+        line = self._read_until()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise RedisError(rest.decode())
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            return None if n == -1 else self._read_until(n)
+        if t == b"*":
+            n = int(rest)
+            return None if n == -1 else [self.read() for _ in range(n)]
+        raise ValueError(f"bad RESP type byte {t!r}")
+
+
+class RedisError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class Stream:
+    def __init__(self):
+        self.entries: List[Tuple[bytes, List[bytes]]] = []  # (id, kv flat)
+        self.seq = itertools.count(1)
+        self.cond = threading.Condition()
+
+    def add(self, fields: List[bytes]) -> bytes:
+        eid = f"{int(time.time() * 1000)}-{next(self.seq)}".encode()
+        with self.cond:
+            self.entries.append((eid, fields))
+            self.cond.notify_all()
+        return eid
+
+
+def _id_after(eid: bytes, last: bytes) -> bool:
+    def parse(x: bytes):
+        a, _, b = x.partition(b"-")
+        return (int(a), int(b or 0))
+    return parse(eid) > parse(last)
+
+
+class RespServer:
+    """In-process broker. start() binds 127.0.0.1:port (0 = ephemeral)."""
+
+    def __init__(self, port: int = 0):
+        self.port = port
+        self.streams: Dict[bytes, Stream] = {}
+        self.hashes: Dict[bytes, Dict[bytes, bytes]] = {}
+        self.kv: Dict[bytes, bytes] = {}
+        self.lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self) -> "RespServer":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(64)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        reader = _Reader(conn)
+        try:
+            while not self._stop.is_set():
+                req = reader.read()
+                if req is None:
+                    return
+                try:
+                    resp = self._dispatch([bytes(x) if isinstance(
+                        x, (bytes, bytearray)) else str(x).encode()
+                        for x in req])
+                except RedisError as e:
+                    resp = e
+                except Exception as e:  # command bug -> error reply
+                    resp = RedisError(str(e))
+                conn.sendall(encode(resp))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # ---- commands -----------------------------------------------------
+
+    def _stream(self, key: bytes) -> Stream:
+        with self.lock:
+            if key not in self.streams:
+                self.streams[key] = Stream()
+            return self.streams[key]
+
+    def _dispatch(self, args: List[bytes]):
+        cmd = args[0].upper()
+        if cmd == b"PING":
+            return _OK("PONG")
+        if cmd == b"FLUSHDB":
+            with self.lock:
+                self.streams.clear()
+                self.hashes.clear()
+                self.kv.clear()
+            return _OK()
+        if cmd == b"SET":
+            self.kv[args[1]] = args[2]
+            return _OK()
+        if cmd == b"GET":
+            return self.kv.get(args[1])
+        if cmd == b"DEL":
+            n = 0
+            with self.lock:
+                for k in args[1:]:
+                    n += (self.kv.pop(k, None) is not None) + \
+                        (self.hashes.pop(k, None) is not None) + \
+                        (self.streams.pop(k, None) is not None)
+            return n
+        if cmd == b"HSET":
+            h = self.hashes.setdefault(args[1], {})
+            kvs = args[2:]
+            added = 0
+            for i in range(0, len(kvs), 2):
+                added += kvs[i] not in h
+                h[kvs[i]] = kvs[i + 1]
+            return added
+        if cmd == b"HGETALL":
+            h = self.hashes.get(args[1], {})
+            out: List[bytes] = []
+            for k, v in h.items():
+                out.extend([k, v])
+            return out
+        if cmd == b"XADD":
+            # XADD key [MAXLEN n] id field value ...
+            i = 2
+            if args[i].upper() == b"MAXLEN":
+                i += 2
+            i += 1  # the id (we always auto-assign '*' semantics)
+            return self._stream(args[1]).add(list(args[i:]))
+        if cmd == b"XLEN":
+            return len(self._stream(args[1]).entries)
+        if cmd == b"XRANGE":
+            s = self._stream(args[1])
+            return [[eid, fv] for eid, fv in s.entries]
+        if cmd == b"XDEL":
+            s = self._stream(args[1])
+            ids = set(args[2:])
+            with s.cond:
+                before = len(s.entries)
+                s.entries = [e for e in s.entries if e[0] not in ids]
+                return before - len(s.entries)
+        if cmd == b"XTRIM":
+            s = self._stream(args[1])
+            # XTRIM key MAXLEN n
+            n = int(args[3])
+            with s.cond:
+                cut = max(0, len(s.entries) - n)
+                s.entries = s.entries[cut:]
+                return cut
+        if cmd == b"XREAD":
+            # XREAD [COUNT c] [BLOCK ms] STREAMS key id
+            count, block_ms = None, None
+            i = 1
+            while args[i].upper() != b"STREAMS":
+                if args[i].upper() == b"COUNT":
+                    count = int(args[i + 1])
+                elif args[i].upper() == b"BLOCK":
+                    block_ms = int(args[i + 1])
+                i += 2
+            key, last = args[i + 1], args[i + 2]
+            if last == b"$":
+                s = self._stream(key)
+                last = s.entries[-1][0] if s.entries else b"0-0"
+            s = self._stream(key)
+            deadline = None if block_ms is None else \
+                time.monotonic() + block_ms / 1000.0
+            while True:
+                with s.cond:
+                    fresh = [e for e in s.entries
+                             if _id_after(e[0], last)]
+                    if fresh:
+                        if count:
+                            fresh = fresh[:count]
+                        return [[key, [[eid, fv] for eid, fv in fresh]]]
+                    if deadline is None:
+                        return None
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    s.cond.wait(remaining)
+        raise RedisError(f"unknown command {cmd.decode()}")
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class RespClient:
+    """Tiny RESP2 client (drop-in for redis-py's execute_command subset);
+    thread-safe via a per-call lock."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.reader = _Reader(self.sock)
+        self.lock = threading.Lock()
+
+    def execute(self, *args):
+        payload = encode([a if isinstance(a, (bytes, bytearray))
+                          else str(a).encode() for a in args])
+        with self.lock:
+            self.sock.sendall(payload)
+            return self.reader.read()
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,185 @@
+"""Cluster Serving — continuous-batching TPU inference service.
+
+Reference surface (SURVEY.md §2.6, §3.5; ref: serving/ClusterServing.scala,
+serving/engine/ClusterServingInference.scala, ClusterServingHelper.scala):
+a Flink job XREADGROUPs the Redis input stream, micro-batches by size/
+timeout, runs InferenceModel, XADDs results; config.yaml drives model path,
+batch size, redis address.
+
+TPU re-design: no Flink — ONE host thread owns the serving loop (queue →
+micro-batcher → bucketed-pad → jitted forward → result hashes). The TPU's
+own pipelining replaces Flink operator parallelism: while step N computes
+on device, step N+1 is being batched on host. Backpressure = stream length
+(the reference's de-facto backlog metric, SURVEY §5); fixed jit shapes come
+from InferenceModel's bucket cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.common.log import logger
+from analytics_zoo_tpu.learn.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.queues import (
+    INPUT_STREAM, RESULT_PREFIX, decode_ndarray, encode_ndarray)
+from analytics_zoo_tpu.serving.resp import RespClient, RespServer
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """config.yaml parity (ref: ClusterServingHelper field names)."""
+
+    model_path: str = ""
+    redis_host: str = "127.0.0.1"
+    redis_port: int = 6379
+    batch_size: int = 32            # micro-batch cap
+    batch_timeout_ms: float = 5.0   # flush partial batch after this wait
+    input_cols: Optional[List[str]] = None  # None: infer from request
+
+    @staticmethod
+    def from_yaml(path: str) -> "ServingConfig":
+        import yaml
+
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        params = raw.get("params", {})
+        redis = (raw.get("redis") or
+                 {}).get("src", raw.get("redis", {}).get("url", ""))
+        cfg = ServingConfig()
+        model = raw.get("model", {})
+        if isinstance(model, dict):
+            cfg.model_path = model.get("path", "")
+        if isinstance(redis, str) and ":" in redis:
+            host, port = redis.rsplit(":", 1)
+            cfg.redis_host, cfg.redis_port = host, int(port)
+        cfg.batch_size = int(params.get("core_number",
+                                        params.get("batch_size", 32)))
+        return cfg
+
+
+class ClusterServing:
+    """The serving job. Optionally owns an embedded RESP broker.
+
+    Usage:
+      serving = ClusterServing(model, config, embedded_broker=True).start()
+      InputQueue(port=serving.port).enqueue(...)
+    """
+
+    def __init__(self, inference_model: InferenceModel,
+                 config: Optional[ServingConfig] = None,
+                 embedded_broker: bool = False):
+        self.model = inference_model
+        self.config = config or ServingConfig()
+        self.broker: Optional[RespServer] = None
+        if embedded_broker:
+            self.broker = RespServer(port=0).start()
+            self.config.redis_host = "127.0.0.1"
+            self.config.redis_port = self.broker.port
+        self.port = self.config.redis_port
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_id = b"0-0"
+        self.stats = {"requests": 0, "batches": 0, "batch_fill": 0.0,
+                      "predict_ms": 0.0}
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self) -> "ClusterServing":
+        self.client = RespClient(self.config.redis_host,
+                                 self.config.redis_port)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        logger.info("ClusterServing up (redis %s:%d, batch<=%d)",
+                    self.config.redis_host, self.config.redis_port,
+                    self.config.batch_size)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.broker is not None:
+            self.broker.stop()
+
+    # ---- serving loop -------------------------------------------------
+
+    def _read_batch(self) -> List[Dict[str, bytes]]:
+        """Micro-batch: block for the first request, then grab whatever
+        else is queued up to batch_size within batch_timeout_ms."""
+        cfg = self.config
+        first = self.client.execute(
+            "XREAD", "COUNT", cfg.batch_size, "BLOCK", 200, "STREAMS",
+            INPUT_STREAM, self._last_id)
+        if not first:
+            return []
+        entries = first[0][1]
+        deadline = time.monotonic() + cfg.batch_timeout_ms / 1000.0
+        while len(entries) < cfg.batch_size:
+            wait_ms = int(max(0, (deadline - time.monotonic()) * 1000))
+            if wait_ms <= 0:
+                break
+            more = self.client.execute(
+                "XREAD", "COUNT", cfg.batch_size - len(entries), "BLOCK",
+                wait_ms, "STREAMS", INPUT_STREAM, entries[-1][0])
+            if not more:
+                break
+            entries.extend(more[0][1])
+        self._last_id = entries[-1][0]
+        out = []
+        for eid, flat in entries:
+            fields = {flat[i].decode(): flat[i + 1]
+                      for i in range(0, len(flat), 2)}
+            out.append(fields)
+        self.client.execute("XTRIM", INPUT_STREAM, "MAXLEN", 10000)
+        return out
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                requests = self._read_batch()
+            except (ConnectionError, OSError):
+                if self._stop.is_set():
+                    return
+                time.sleep(0.05)
+                continue
+            if not requests:
+                continue
+            try:
+                self._serve_batch(requests)
+            except Exception:
+                logger.exception("serving batch failed")
+
+    def _serve_batch(self, requests: List[Dict[str, bytes]]):
+        cols = self.config.input_cols or \
+            [k for k in requests[0] if k != "uri"]
+        arrays = []
+        for c in cols:
+            arrays.append(np.stack([decode_ndarray(r[c])
+                                    for r in requests]))
+        t0 = time.perf_counter()
+        preds = self.model.predict(*arrays)
+        preds = np.asarray(preds)
+        dt = (time.perf_counter() - t0) * 1000
+        uris = [r["uri"].decode() for r in requests]
+        for uri, p in zip(uris, preds):
+            self.client.execute("HSET", RESULT_PREFIX + uri,
+                                "value", encode_ndarray(p))
+        # maintain the dequeue-all index (client OutputQueue.dequeue)
+        existing = self.client.execute("GET", "__result_keys__")
+        known = existing.decode().split(",") if existing else []
+        self.client.execute("SET", "__result_keys__",
+                            ",".join([k for k in known if k] + uris))
+        self.stats["requests"] += len(requests)
+        self.stats["batches"] += 1
+        self.stats["batch_fill"] = len(requests) / self.config.batch_size
+        self.stats["predict_ms"] = dt
+
+    # ---- observability (SURVEY §5: queue depth = backlog metric) ------
+
+    def backlog(self) -> int:
+        return int(self.client.execute("XLEN", INPUT_STREAM))

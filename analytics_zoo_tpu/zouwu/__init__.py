@@ -1,0 +1,18 @@
+"""Zouwu — time-series toolkit (SURVEY.md §2.5: forecasters + AutoTS;
+ref: pyzoo/zoo/zouwu/)."""
+
+from analytics_zoo_tpu.zouwu.forecaster import (
+    Forecaster, LSTMForecaster, MTNetForecaster, Seq2SeqForecaster,
+    TCNForecaster)
+from analytics_zoo_tpu.zouwu.preprocessing import (
+    MinMaxScaler, StandardScaler, TimeSequenceFeatureTransformer,
+    datetime_features, roll, train_val_test_split)
+from analytics_zoo_tpu.zouwu.autots import AutoTSTrainer, TSPipeline
+
+__all__ = [
+    "Forecaster", "LSTMForecaster", "TCNForecaster", "MTNetForecaster",
+    "Seq2SeqForecaster",
+    "roll", "train_val_test_split", "StandardScaler", "MinMaxScaler",
+    "datetime_features", "TimeSequenceFeatureTransformer",
+    "AutoTSTrainer", "TSPipeline",
+]

@@ -1,0 +1,177 @@
+"""AutoML engine + Zouwu toolkit tests (SURVEY.md §4: single-box trials,
+small synthetic series)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.automl import hp, AutoEstimator, SearchEngine
+from analytics_zoo_tpu.automl.search import MedianStopper
+from analytics_zoo_tpu.zouwu import (
+    AutoTSTrainer, LSTMForecaster, StandardScaler, TCNForecaster,
+    TimeSequenceFeatureTransformer, TSPipeline, roll,
+    train_val_test_split)
+
+
+def test_hp_sampling_and_grid():
+    space = {"lr": hp.loguniform(1e-4, 1e-2),
+             "units": hp.choice([8, 16]),
+             "layers": hp.grid_search([1, 2, 3]),
+             "nested": {"q": hp.quniform(0, 10, 2)},
+             "const": 7}
+    rng = np.random.default_rng(0)
+    cfg = hp.sample_config(space, rng)
+    assert 1e-4 <= cfg["lr"] <= 1e-2
+    assert cfg["units"] in (8, 16)
+    assert cfg["nested"]["q"] % 2 == 0
+    assert cfg["const"] == 7 and "layers" not in cfg
+    grids = hp.grid_configs(space)
+    assert [g["layers"] for g in grids] == [1, 2, 3]
+
+
+def test_search_engine_finds_minimum():
+    # quadratic bowl: best lr near 0.3
+    def trainable(config, report):
+        return (config["lr"] - 0.3) ** 2
+
+    eng = SearchEngine(trainable, {"lr": hp.uniform(0.0, 1.0)},
+                       n_sampling=30, seed=1)
+    best = eng.run()
+    assert abs(best.config["lr"] - 0.3) < 0.15
+    assert best.status == "done"
+
+
+def test_search_engine_grid_and_errors():
+    def trainable(config, report):
+        if config["x"] == 2:
+            raise RuntimeError("boom")
+        return float(config["x"])
+
+    eng = SearchEngine(trainable, {"x": hp.grid_search([1, 2, 3])})
+    best = eng.run()
+    assert best.config["x"] == 1
+    statuses = {t.config["x"]: t.status for t in eng.trials}
+    assert statuses[2] == "error"
+
+
+def test_median_stopper_prunes():
+    calls = []
+
+    def trainable(config, report):
+        for ep in range(5):
+            report(ep, config["v"])
+            calls.append((config["v"], ep))
+        return config["v"]
+
+    eng = SearchEngine(
+        trainable, {"v": hp.grid_search([1., 1., 1., 1., 50.])},
+        scheduler=MedianStopper(grace_epochs=1))
+    best = eng.run()
+    assert best.metric == 1.0
+    pruned = [t for t in eng.trials if t.status == "pruned"]
+    assert len(pruned) == 1 and pruned[0].config["v"] == 50.0
+    # pruned trial stopped early: fewer than 5 epochs recorded
+    assert len([c for c in calls if c[0] == 50.0]) < 5
+
+
+def test_roll_and_split_and_scaler():
+    data = np.arange(20, dtype=np.float32)
+    x, y = roll(data, lookback=4, horizon=2)
+    assert x.shape == (15, 4, 1) and y.shape == (15, 2, 1)
+    np.testing.assert_allclose(x[0, :, 0], [0, 1, 2, 3])
+    np.testing.assert_allclose(y[0, :, 0], [4, 5])
+
+    tr, va, te = train_val_test_split(data, 0.2, 0.2)
+    assert len(tr) == 12 and len(va) == 4 and len(te) == 4
+    assert tr[-1] < va[0] < te[0]  # chronological
+
+    sc = StandardScaler()
+    mat = np.random.default_rng(0).normal(5, 3, (100, 2))
+    z = sc.fit_transform(mat)
+    assert abs(z.mean()) < 1e-5 and abs(z.std() - 1) < 1e-2
+    back = sc.inverse_transform(z)
+    np.testing.assert_allclose(back, mat, rtol=1e-4)
+
+
+def _series_df(n=200):
+    t = np.arange(n)
+    return pd.DataFrame({
+        "datetime": pd.date_range("2026-01-01", periods=n, freq="h"),
+        "value": np.sin(t / 8).astype(np.float32) + 0.1})
+
+
+def test_feature_transformer_roundtrip():
+    df = _series_df(100)
+    tf = TimeSequenceFeatureTransformer(lookback=12, horizon=2)
+    x, y = tf.fit_transform(df)
+    assert x.shape[1:] == (12, 6)  # value + 5 calendar features
+    assert y.shape[1:] == (2, 1)
+    # inverse undoes target scaling
+    orig = tf.inverse(y[..., 0])
+    np.testing.assert_allclose(
+        orig[0], df["value"].to_numpy()[12:14], rtol=1e-4)
+    # state roundtrip
+    tf2 = TimeSequenceFeatureTransformer.from_state(tf.state())
+    x2, y2 = tf2.transform(df)
+    np.testing.assert_allclose(x, x2, rtol=1e-5)
+
+
+def test_forecaster_fit_predict(tmp_path):
+    x, y = roll(np.sin(np.arange(300) / 5).astype(np.float32),
+                lookback=16, horizon=1)
+    f = TCNForecaster(channels=(8, 8), lr=3e-3)
+    stats = f.fit(x, y, epochs=4, batch_size=32)
+    assert stats["loss"] < 0.5
+    preds = f.predict(x[:10])
+    assert preds.shape == (10, 1, 1)
+    ev = f.evaluate(x, y, metrics=("mse", "smape"))
+    assert ev["mse"] < 0.5
+    # save/restore roundtrip
+    p = str(tmp_path / "fc")
+    f.save(p)
+    g = TCNForecaster(channels=(8, 8))
+    g.restore(p, sample_x=x[:2])
+    np.testing.assert_allclose(np.asarray(g.predict(x[:10])),
+                               np.asarray(preds), rtol=1e-4)
+
+
+def test_lstm_forecaster_y_shapes():
+    x, y = roll(np.sin(np.arange(100) / 5).astype(np.float32),
+                lookback=8, horizon=1)
+    f = LSTMForecaster(lstm_units=(8,), dropouts=(0.0,))
+    f.fit(x, y[:, 0, 0], epochs=1, batch_size=16)  # [N] y auto-expanded
+    assert f.predict(x[:4]).shape == (4, 1, 1)
+
+
+def test_autots_end_to_end(tmp_path):
+    df = _series_df(220)
+    trainer = AutoTSTrainer(horizon=1, lookback=12, search_space={
+        "model": "tcn", "units": hp.choice([8]), "layers": 1,
+        "lr": hp.loguniform(1e-3, 1e-2), "batch_size": 32})
+    pipe = trainer.fit(df, n_sampling=2, epochs=2)
+    ev = pipe.evaluate(df, metrics=("mse", "mae"))
+    assert ev["mse"] < 1.0  # original units; sine amplitude 1
+    preds = pipe.predict(df)
+    assert preds.shape[1] == 1
+
+    p = str(tmp_path / "pipe")
+    pipe.save(p)
+    pipe2 = TSPipeline.load(p)
+    np.testing.assert_allclose(pipe2.predict(df), preds, rtol=1e-4)
+    # incremental fit keeps working
+    pipe2.fit(df, epochs=1, batch_size=32)
+
+
+def test_tspipeline_predicts_true_future(tmp_path):
+    """predict() must work on a df with exactly `lookback` rows — the
+    normal forecasting case (no future rows available)."""
+    df = _series_df(220)
+    trainer = AutoTSTrainer(horizon=1, lookback=12, search_space={
+        "model": "tcn", "units": 8, "layers": 1, "lr": 3e-3,
+        "batch_size": 32})
+    pipe = trainer.fit(df, n_sampling=1, epochs=1)
+    tail = df.tail(12)
+    preds = pipe.predict(tail)
+    assert preds.shape == (1, 1)  # one window -> one forecast
+    # longer df: one prediction per window incl. the end-of-series one
+    assert len(pipe.predict(df)) == len(df) - 12 + 1

@@ -1,0 +1,96 @@
+"""ImageSet / TextSet feature-layer tests (SURVEY.md §4: tiny checked-in
+style fixtures, generated on the fly)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.image import (
+    ImageCenterCrop, ImageChannelNormalize, ImageHFlip, ImageMatToTensor,
+    ImageRandomCrop, ImageResize, ImageSet)
+from analytics_zoo_tpu.data.text import (
+    TextSet, load_glove, normalize, tokenize)
+
+
+@pytest.fixture()
+def image_dir(tmp_path):
+    from PIL import Image
+
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            arr = np.full((20 + i, 24, 3), 10 * (i + 1), np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+    return str(tmp_path)
+
+
+def test_imageset_read_transform(image_dir):
+    iset = ImageSet.read(image_dir, num_shards=2, with_label=True)
+    assert iset.class_names == ["cat", "dog"]
+    chain = (ImageResize(16, 16) >> ImageCenterCrop(8, 8) >>
+             ImageChannelNormalize(128, 128, 128, 64, 64, 64) >>
+             ImageMatToTensor())
+    out = iset.transform(chain).to_numpy_dict()
+    assert out["x"].shape == (6, 8, 8, 3)
+    assert out["x"].dtype == np.float32
+    assert set(out["y"]) == {0, 1}
+
+
+def test_image_transforms_direct():
+    img = np.arange(6 * 8 * 3, dtype=np.uint8).reshape(6, 8, 3)
+    assert ImageResize(3, 4)(img).shape == (3, 4, 3)
+    assert ImageCenterCrop(4, 4)(img).shape == (4, 4, 3)
+    assert ImageRandomCrop(4, 4)(img).shape == (4, 4, 3)
+    flipped = ImageHFlip(prob=1.0)(img)
+    np.testing.assert_array_equal(flipped, img[:, ::-1])
+    norm = ImageChannelNormalize(1.0, 2.0, 3.0)(img.astype(np.float32))
+    np.testing.assert_allclose(norm[..., 0], img[..., 0] - 1.0)
+    chw = ImageMatToTensor(to_chw=True)(img)
+    assert chw.shape == (3, 6, 8)
+
+
+def test_tokenize_normalize():
+    toks = normalize(tokenize("Hello, World! it's GREAT—really."))
+    assert toks == ["hello", "world", "it's", "great", "really"]
+
+
+def test_textset_pipeline():
+    texts = ["the cat sat on the mat", "the dog ate the cat food",
+             "a bird", ""]
+    ts = TextSet.from_texts(texts, labels=[0, 1, 0, 1], num_shards=2)
+    ts = ts.tokenize().word2idx().shape_sequence(5)
+    out = ts.to_numpy_dict()
+    assert out["tokens"].shape == (4, 5)
+    assert out["tokens"].dtype == np.int32
+    # "the" is most frequent -> id 2
+    assert ts.word_index["the"] == 2
+    # empty text -> all padding
+    np.testing.assert_array_equal(out["tokens"][3], np.zeros(5, np.int32))
+    assert ts.vocab_size() == 2 + len(ts.word_index)
+
+    # max_words_num caps the vocab; rare words become OOV(1)
+    ts2 = TextSet.from_texts(texts).tokenize().word2idx(max_words_num=3) \
+        .shape_sequence(5)
+    assert len(ts2.word_index) == 3
+    assert (ts2.to_numpy_dict()["tokens"] == 1).any()
+
+
+def test_word2idx_existing_index_and_truncation():
+    ts = TextSet.from_texts(["x y z w v u t s"]).tokenize() \
+        .word2idx(existing_index={"x": 2, "y": 3}).shape_sequence(
+            3, trunc_mode="pre")
+    row = ts.to_numpy_dict()["tokens"][0]
+    assert row.shape == (3,)  # kept the LAST 3 tokens
+    assert list(row) == [1, 1, 1]  # u t s are OOV under tiny index
+
+
+def test_load_glove(tmp_path):
+    p = tmp_path / "glove.txt"
+    p.write_text("cat 1.0 2.0 3.0\ndog 4.0 5.0 6.0\nzzz 7.0 8.0 9.0\n")
+    wi = {"cat": 2, "dog": 3, "bird": 4}
+    w, hits = load_glove(str(p), wi, embed_dim=3)
+    assert w.shape == (5, 3) and hits == 2
+    np.testing.assert_allclose(w[2], [1, 2, 3])
+    np.testing.assert_allclose(w[0], 0.0)  # pad row zero
