@@ -1,0 +1,278 @@
+"""Define-by-expression autograd API.
+
+Reference parity: zoo/pipeline/api/autograd/ + pyzoo/zoo/pipeline/api/
+autograd.py — `Variable` expressions (abs, mean, clip, mm, ...) composed
+into `CustomLoss` / custom layers, which the reference lowered to a BigDL
+graph.  Here a Variable composes a pure jnp function, so a CustomLoss is
+just a jittable `(preds, targets) -> scalar` that fuses into the Estimator's
+train step, and a CustomLayer is a flax module — JAX *is* the autograd, so
+this module is only the expression-building surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Variable", "Parameter", "CustomLoss", "CustomLayer",
+    "abs", "mean", "sum", "clip", "square", "sqrt", "exp", "log", "pow",
+    "maximum", "minimum", "mm", "dot", "stack", "expand_dims", "squeeze",
+    "softmax", "softsign", "softplus", "l2_normalize", "epsilon",
+]
+
+_py_abs, _py_sum, _py_pow = abs, sum, pow
+
+
+class Variable:
+    """A symbolic array expression: composes a pure function env -> jnp."""
+
+    def __init__(self, fn: Callable[[Dict[int, Any]], Any],
+                 params: Tuple["Parameter", ...] = (),
+                 name: Optional[str] = None):
+        self._fn = fn
+        self._params = tuple(params)
+        self.name = name
+
+    @staticmethod
+    def placeholder(name: Optional[str] = None) -> "Variable":
+        v = Variable(None, name=name)
+        v._fn = lambda env: env[id(v)]
+        return v
+
+    # -- evaluation ------------------------------------------------------
+
+    def eval(self, env: Dict["Variable", Any]) -> jnp.ndarray:
+        return self._fn({id(k): val for k, val in env.items()})
+
+    def _lower(self, env_by_id):
+        return self._fn(env_by_id)
+
+    # -- operator algebra ------------------------------------------------
+
+    @staticmethod
+    def _lift(other) -> Callable:
+        if isinstance(other, Variable):
+            return other._fn, other._params
+        return (lambda env: other), ()
+
+    def _binop(self, other, op) -> "Variable":
+        ofn, op_params = Variable._lift(other)
+        return Variable(lambda env: op(self._fn(env), ofn(env)),
+                        self._params + tuple(op_params))
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: b / a)
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a ** b)
+
+    def __neg__(self):
+        return Variable(lambda env: -self._fn(env), self._params)
+
+    def __getitem__(self, idx):
+        return Variable(lambda env: self._fn(env)[idx], self._params)
+
+    def _unary(self, op) -> "Variable":
+        return Variable(lambda env: op(self._fn(env)), self._params)
+
+
+class Parameter(Variable):
+    """A trainable weight usable inside an expression (ref: autograd
+    Parameter).  Materializes as a flax param when the expression is wrapped
+    in a :class:`CustomLayer`."""
+
+    _count = 0
+
+    def __init__(self, shape: Sequence[int], init_weight=None,
+                 init: Callable = None, name: Optional[str] = None):
+        Parameter._count += 1
+        self.shape = tuple(shape)
+        self.init_weight = init_weight
+        self.initializer = init or nn.initializers.lecun_normal() \
+            if len(shape) >= 2 else (init or nn.initializers.zeros)
+        pname = name or f"parameter_{Parameter._count}"
+        super().__init__(None, name=pname)
+        self._params = (self,)
+        self._fn = lambda env: env[id(self)]
+
+
+# ---------------------------------------------------------------------------
+# expression functions (module-level, numpy axis semantics)
+# ---------------------------------------------------------------------------
+
+
+def _wrap_unary(op):
+    def f(v: Variable, *args, **kw):
+        if not isinstance(v, Variable):
+            return op(v, *args, **kw)
+        return Variable(lambda env: op(v._fn(env), *args, **kw), v._params)
+    return f
+
+
+abs = _wrap_unary(jnp.abs)                      # noqa: A001
+square = _wrap_unary(jnp.square)
+sqrt = _wrap_unary(jnp.sqrt)
+exp = _wrap_unary(jnp.exp)
+log = _wrap_unary(jnp.log)
+softmax = _wrap_unary(jax.nn.softmax)
+softsign = _wrap_unary(jax.nn.soft_sign)
+softplus = _wrap_unary(jax.nn.softplus)
+expand_dims = _wrap_unary(jnp.expand_dims)
+squeeze = _wrap_unary(jnp.squeeze)
+
+
+def mean(v: Variable, axis=None, keepdims: bool = False) -> Variable:
+    return v._unary(lambda a: jnp.mean(a, axis=axis, keepdims=keepdims))
+
+
+def sum(v: Variable, axis=None, keepdims: bool = False) -> Variable:  # noqa: A001
+    return v._unary(lambda a: jnp.sum(a, axis=axis, keepdims=keepdims))
+
+
+def clip(v: Variable, min_value, max_value) -> Variable:
+    return v._unary(lambda a: jnp.clip(a, min_value, max_value))
+
+
+def pow(v: Variable, p) -> Variable:  # noqa: A001
+    return v._unary(lambda a: a ** p)
+
+
+def maximum(a: Variable, b) -> Variable:
+    return a._binop(b, jnp.maximum)
+
+
+def minimum(a: Variable, b) -> Variable:
+    return a._binop(b, jnp.minimum)
+
+
+def mm(a: Variable, b: Variable, axes: Optional[Sequence[int]] = None) \
+        -> Variable:
+    """Batched matmul (ref: autograd.mm).  `axes` follows the reference's
+    batch-dot convention; default contracts last axis of a with first
+    non-batch axis of b."""
+    if axes is not None:
+        def op(x, y):
+            return jax.lax.batch_matmul(
+                jnp.moveaxis(x, axes[0], -1), jnp.moveaxis(y, axes[1], -2))
+    else:
+        def op(x, y):
+            return x @ y
+    return a._binop(b, op)
+
+
+def dot(a: Variable, b: Variable, axes=None) -> Variable:
+    return mm(a, b, axes)
+
+
+def stack(vs: Sequence[Variable], axis: int = 1) -> Variable:
+    params: List[Parameter] = []
+    for v in vs:
+        params.extend(v._params)
+    return Variable(
+        lambda env: jnp.stack([v._fn(env) for v in vs], axis=axis),
+        tuple(params))
+
+
+def l2_normalize(v: Variable, axis: int = -1) -> Variable:
+    return v._unary(
+        lambda a: a / (jnp.linalg.norm(a, axis=axis, keepdims=True) + 1e-12))
+
+
+def epsilon() -> float:
+    return 1e-7
+
+
+# ---------------------------------------------------------------------------
+# CustomLoss / CustomLayer
+# ---------------------------------------------------------------------------
+
+
+class CustomLoss:
+    """Loss from a Variable expression (ref: autograd.CustomLoss).
+
+    Two constructions:
+      * ``CustomLoss(loss_var, y_true=..., y_pred=...)`` — a prebuilt
+        expression over two placeholders;
+      * ``CustomLoss.from_function(fn)`` — ``fn(y_true, y_pred) -> Variable``.
+
+    Instances are callable ``(preds, targets) -> scalar`` — the signature
+    every Estimator/keras ``compile`` accepts — and reduce with a mean over
+    any non-scalar result (reference semantics: per-sample loss averaged).
+    """
+
+    def __init__(self, loss_var: Variable, y_true: Variable,
+                 y_pred: Variable):
+        self.loss_var = loss_var
+        self.y_true = y_true
+        self.y_pred = y_pred
+
+    @staticmethod
+    def from_function(fn: Callable[[Variable, Variable], Variable]) \
+            -> "CustomLoss":
+        yt, yp = Variable.placeholder("y_true"), Variable.placeholder("y_pred")
+        return CustomLoss(fn(yt, yp), yt, yp)
+
+    def __call__(self, preds, targets):
+        out = self.loss_var.eval({self.y_true: targets, self.y_pred: preds})
+        return jnp.mean(out)
+
+
+def custom_loss(fn: Callable[[Variable, Variable], Variable]) -> CustomLoss:
+    """Decorator/helper: `loss = custom_loss(lambda yt, yp: mean(abs(yt-yp)))`."""
+    return CustomLoss.from_function(fn)
+
+
+class CustomLayer(nn.Module):
+    """Layer from a Variable expression with :class:`Parameter` weights
+    (ref: autograd CustomLayer/Lambda-with-Parameter).  Usable inside
+    keras Sequential/Model like any other layer."""
+
+    out_var: Variable = None
+    in_vars: Tuple[Variable, ...] = ()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        if len(xs) != len(self.in_vars):
+            raise ValueError(
+                f"CustomLayer takes {len(self.in_vars)} inputs, got {len(xs)}")
+        env = {id(v): a for v, a in zip(self.in_vars, xs)}
+        # dedupe: a Parameter used twice in the expression must register once
+        unique = {id(p): p for p in self.out_var._params}
+        for p in unique.values():
+            if p.init_weight is not None:
+                w = self.param(p.name,
+                               lambda rng, sw=p.init_weight: jnp.asarray(sw))
+            else:
+                w = self.param(p.name, p.initializer, p.shape)
+            env[id(p)] = w
+        return self.out_var._lower(env)
+
+
+# register CustomLayer for keras symbolic dispatch
+from analytics_zoo_tpu.keras.engine import symbolic as _symbolic  # noqa: E402
+
+CustomLayer = _symbolic(CustomLayer)

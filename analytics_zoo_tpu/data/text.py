@@ -161,7 +161,10 @@ def load_glove(path: str, word_index: Dict[str, int],
     (ref: WordEmbedding loading). Rows 0 (pad) and 1 (oov) are zero /
     mean-init; OOV words get small random vectors. Returns (weights,
     n_hits)."""
-    vocab_rows = TextSet.FIRST_WORD_ID + len(word_index)
+    # size by the max id, not len(): a user-supplied index may be sparse
+    vocab_rows = max(max(word_index.values(),
+                         default=TextSet.FIRST_WORD_ID - 1) + 1,
+                     TextSet.FIRST_WORD_ID)
     rng = np.random.default_rng(0)
     weights = rng.normal(0, 0.1, (vocab_rows, embed_dim)).astype(np.float32)
     weights[TextSet.PAD_ID] = 0.0

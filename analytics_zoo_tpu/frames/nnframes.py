@@ -127,8 +127,9 @@ class NNEstimator:
         if isinstance(df, XShards):
             import pandas as pd
 
-            df = pd.concat(df.collect(), ignore_index=True) \
-                if _is_df(df.collect()[0]) else df.to_numpy_dict()
+            shards = df.collect()
+            df = pd.concat(shards, ignore_index=True) \
+                if _is_df(shards[0]) else df.to_numpy_dict()
         if _is_df(df):
             return df_to_arrays(df, self.feature_cols, self.label_cols,
                                 self.feature_preprocessing)

@@ -1,0 +1,20 @@
+"""Keras-1.2-compatible API on flax/JAX.
+
+Reference parity: zoo/pipeline/api/keras/{layers,models,objectives,metrics}
+and pyzoo/zoo/pipeline/api/keras — the reference reimplements the Keras 1.2.2
+surface over BigDL tensors; here the same surface is a thin, tpu-idiomatic
+adapter over flax modules compiled by the shared Estimator (one pjit'd train
+step; XLA emits the collectives).
+"""
+
+from analytics_zoo_tpu.keras.engine import (Input, KerasNet, Model,
+                                            Sequential, merge)
+from analytics_zoo_tpu.keras import layers  # noqa: F401
+from analytics_zoo_tpu.keras.layers import *  # noqa: F401,F403
+from analytics_zoo_tpu.keras.optimizers import get_optimizer
+from analytics_zoo_tpu.keras.regularizers import l1, l1l2, l2
+
+__all__ = [
+    "Input", "KerasNet", "Model", "Sequential", "merge",
+    "get_optimizer", "l1", "l2", "l1l2", "layers",
+] + layers.__all__

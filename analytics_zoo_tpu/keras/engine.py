@@ -1,0 +1,457 @@
+"""Keras engine: symbolic tensors, Sequential / functional Model, KerasNet.
+
+Reference parity: zoo/pipeline/api/keras/models (Sequential, Model),
+KerasNet.compile/fit/evaluate/predict driving the zoo Estimator
+(pyzoo/zoo/pipeline/api/keras/engine/topology.py).  Here the topology is a
+flax module and compile/fit lower onto the shared pjit Estimator — the whole
+model executes as ONE XLA program per step; there is no per-layer dispatch at
+runtime.
+
+The functional API (`y = Dense(4)(x); Model(x, y)`) is built by symbolic
+dispatch: calling a layer on a :class:`KTensor` records a graph node instead
+of executing flax (flax forbids calling unbound modules), and ``Model``
+replays the recorded graph inside one compact ``__call__``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import itertools
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras.regularizers import Regularizer
+
+__all__ = ["KTensor", "Input", "Sequential", "Model", "KerasNet",
+           "symbolic", "merge"]
+
+
+# ---------------------------------------------------------------------------
+# symbolic graph
+# ---------------------------------------------------------------------------
+
+_ids = itertools.count()
+
+
+class KTensor:
+    """Symbolic tensor: a node output in a functional-API graph."""
+
+    def __init__(self, layer: Optional[nn.Module], inputs: Sequence["KTensor"],
+                 shape: Optional[Tuple[Optional[int], ...]] = None,
+                 call_kwargs: Optional[dict] = None):
+        self.layer = layer            # None for placeholders (Input)
+        self.inputs = tuple(inputs)
+        self.shape = shape
+        self.call_kwargs = dict(call_kwargs or {})
+        self.uid = next(_ids)
+
+    def __repr__(self):
+        who = type(self.layer).__name__ if self.layer is not None else "Input"
+        return f"KTensor<{who}#{self.uid}>"
+
+
+def Input(shape: Sequence[Optional[int]], name: Optional[str] = None,
+          dtype=None) -> KTensor:
+    """Placeholder for a functional-API input. `shape` EXCLUDES the batch
+    dim (keras semantics)."""
+    kt = KTensor(None, (), shape=tuple(shape))
+    kt.name = name
+    kt.dtype = dtype or jnp.float32
+    return kt
+
+
+def _contains_ktensor(x) -> bool:
+    if isinstance(x, KTensor):
+        return True
+    if isinstance(x, (list, tuple)):
+        return any(isinstance(e, KTensor) for e in x)
+    return False
+
+
+def symbolic(cls):
+    """Class decorator: make `layer(ktensor)` record a graph node.
+
+    flax's metaclass has already wrapped ``__call__`` for scope management;
+    we interpose a plain dispatcher ABOVE it so symbolic calls never reach
+    flax (which would raise on unbound modules), while concrete calls fall
+    through to the original wrapped method untouched.
+    """
+    orig = cls.__call__
+
+    def dispatch(self, *args, **kwargs):
+        if args and _contains_ktensor(args[0]):
+            ins = args[0] if isinstance(args[0], (list, tuple)) else [args[0]]
+            return KTensor(self, ins, call_kwargs=kwargs)
+        return orig(self, *args, **kwargs)
+
+    dispatch.inner_fn = getattr(orig, "inner_fn", orig)
+    cls.__call__ = dispatch
+    return cls
+
+
+def _toposort(outputs: Sequence[KTensor]) -> List[KTensor]:
+    order, seen = [], set()
+
+    def visit(t: KTensor):
+        if t.uid in seen:
+            return
+        seen.add(t.uid)
+        for i in t.inputs:
+            visit(i)
+        order.append(t)
+
+    for o in outputs:
+        visit(o)
+    return order
+
+
+# ---------------------------------------------------------------------------
+# regularization collection
+# ---------------------------------------------------------------------------
+
+_KERNEL_NAMES = ("kernel", "embedding")
+
+
+def _layer_penalty(layer: nn.Module, subtree) -> jnp.ndarray:
+    pen = jnp.zeros((), jnp.float32)
+    w_reg = getattr(layer, "W_regularizer", None)
+    b_reg = getattr(layer, "b_regularizer", None)
+    if not isinstance(w_reg, Regularizer):
+        w_reg = None
+    if not isinstance(b_reg, Regularizer):
+        b_reg = None
+    if w_reg is None and b_reg is None:
+        return pen
+    flat = jax.tree_util.tree_flatten_with_path(subtree)[0]
+    for path, leaf in flat:
+        name = str(path[-1].key) if path else ""
+        if w_reg is not None and name in _KERNEL_NAMES:
+            pen = pen + w_reg(leaf)
+        if b_reg is not None and name == "bias":
+            pen = pen + b_reg(leaf)
+    return pen
+
+
+def collect_penalty(net: "KerasNet", params) -> jnp.ndarray:
+    """Sum of L1/L2 penalties declared by any layer of `net` (recursing into
+    nested Sequential/Model)."""
+    pen = jnp.zeros((), jnp.float32)
+    for field, layer in net._child_layers():
+        sub = params.get(field) if isinstance(params, dict) else None
+        if sub is None:
+            continue
+        if isinstance(layer, KerasNet):
+            pen = pen + collect_penalty(layer, sub)
+        else:
+            pen = pen + _layer_penalty(layer, sub)
+    return pen
+
+
+# ---------------------------------------------------------------------------
+# KerasNet: compile/fit/evaluate/predict mixin
+# ---------------------------------------------------------------------------
+
+
+class KerasNet(nn.Module):
+    """Base for Sequential/Model: keras-style training surface lowered onto
+    the shared :class:`~analytics_zoo_tpu.learn.estimator.FlaxEstimator`
+    (ref: KerasNet.compile/fit in pyzoo keras engine/topology.py)."""
+
+    def _child_layers(self) -> List[Tuple[str, nn.Module]]:
+        raise NotImplementedError
+
+    @property
+    def n_inputs(self) -> int:
+        return 1
+
+    # -- training surface ------------------------------------------------
+
+    def compile(self, optimizer="sgd", loss="mse", metrics=None, lr=None):
+        """Record the training config; the Estimator is built lazily at
+        first fit/evaluate (needs sample data for shape inference).  The raw
+        spec (not the optax object) is stored so compiled models pickle."""
+        object.__setattr__(self, "_compile_cfg", {
+            "optimizer": optimizer,
+            "lr": lr,
+            "loss": loss,
+            "metrics": list(metrics or []),
+        })
+        object.__setattr__(self, "_estimator", None)
+        return self
+
+    def _feature_cols(self, n: int) -> Tuple[str, ...]:
+        return tuple(f"x{i}" for i in range(n))
+
+    def _as_dict(self, x, y=None) -> Dict[str, np.ndarray]:
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        d = {f"x{i}": np.asarray(a) for i, a in enumerate(xs)}
+        if y is not None:
+            d["y"] = np.asarray(y)
+        return d
+
+    def _get_estimator(self, n_feats: int):
+        if getattr(self, "_estimator", None) is not None:
+            return self._estimator
+        if not hasattr(self, "_compile_cfg"):
+            raise RuntimeError("call compile(...) before fit/evaluate")
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        from analytics_zoo_tpu.keras.objectives import get_loss
+
+        from analytics_zoo_tpu.keras.optimizers import get_optimizer
+        cfg = self._compile_cfg
+        est = Estimator.from_flax(
+            model=self,
+            loss=get_loss(cfg["loss"]),
+            optimizer=get_optimizer(cfg["optimizer"], cfg.get("lr")),
+            metrics=cfg["metrics"],
+            feature_cols=self._feature_cols(n_feats),
+            label_cols=("y",),
+            param_loss=lambda params: collect_penalty(self, params),
+        )
+        object.__setattr__(self, "_estimator", est)
+        return est
+
+    def fit(self, x, y, batch_size: int = 32, nb_epoch: int = 1,
+            epochs: Optional[int] = None, validation_data=None, **kw):
+        data = self._as_dict(x, y)
+        est = self._get_estimator(len(data) - 1)
+        val = None
+        if validation_data is not None:
+            val = self._as_dict(*validation_data)
+        return est.fit(data, epochs=epochs or nb_epoch,
+                       batch_size=batch_size, validation_data=val, **kw)
+
+    def evaluate(self, x, y, batch_size: int = 32) -> Dict[str, float]:
+        data = self._as_dict(x, y)
+        return self._get_estimator(len(data) - 1).evaluate(
+            data, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32,
+                distributed: bool = False) -> np.ndarray:
+        data = self._as_dict(x)
+        return self._get_estimator(len(data)).predict(
+            data, batch_size=batch_size)
+
+    def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
+        return np.argmax(self.predict(x, batch_size), axis=-1)
+
+    # -- weights ---------------------------------------------------------
+
+    def get_weights(self) -> List[np.ndarray]:
+        est = getattr(self, "_estimator", None)
+        if est is None or est.state is None:
+            raise RuntimeError("model has no weights yet (fit/build first)")
+        return [np.asarray(w) for w in jax.tree.leaves(est.state.params)]
+
+    def set_weights(self, weights: Sequence[np.ndarray]):
+        est = getattr(self, "_estimator", None)
+        if est is None or est.state is None:
+            raise RuntimeError("model has no weights yet (fit/build first)")
+        tdef = jax.tree.structure(est.state.params)
+        leaves = jax.tree.leaves(est.state.params)
+        if len(weights) != len(leaves):
+            raise ValueError(f"expected {len(leaves)} arrays, got "
+                             f"{len(weights)}")
+        new = [jnp.asarray(w).reshape(l.shape)
+               for w, l in zip(weights, leaves)]
+        est.state = est.state.replace(params=jax.tree.unflatten(tdef, new))
+
+    def summary(self) -> str:
+        lines = [f"{type(self).__name__}"]
+        for field, layer in self._child_layers():
+            lines.append(f"  {field}: {type(layer).__name__}")
+        s = "\n".join(lines)
+        print(s)
+        return s
+
+    # -- persistence (ref: KerasNet.save/Net.load) -----------------------
+
+    def save(self, path: str):
+        import os
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "topology.pkl"), "wb") as f:
+            pickle.dump(self, f)
+        est = getattr(self, "_estimator", None)
+        if est is not None and est.state is not None:
+            import flax.serialization as ser
+            with open(os.path.join(path, "weights.msgpack"), "wb") as f:
+                f.write(ser.to_bytes({"params": est.state.params}))
+
+    @staticmethod
+    def load(path: str, sample_x=None) -> "KerasNet":
+        import os
+        with open(os.path.join(path, "topology.pkl"), "rb") as f:
+            net: KerasNet = pickle.load(f)
+        wpath = os.path.join(path, "weights.msgpack")
+        if os.path.exists(wpath) and sample_x is not None:
+            import flax.serialization as ser
+            est = net._get_estimator(
+                len(sample_x) if isinstance(sample_x, (list, tuple)) else 1)
+            est._ensure_state(net._as_dict(sample_x))
+            with open(wpath, "rb") as f:
+                restored = ser.from_bytes(
+                    {"params": est.state.params}, f.read())
+            est.state = est.state.replace(params=restored["params"])
+        return net
+
+
+# pickling: drop the estimator (holds jitted fns / device arrays) and any
+# compile spec that isn't plain data (custom optax objects / lambdas)
+def _kerasnet_getstate(self):
+    d = dict(self.__dict__)
+    d.pop("_estimator", None)
+    cfg = d.get("_compile_cfg")
+    if cfg is not None and not (isinstance(cfg["optimizer"], str)
+                                and isinstance(cfg["loss"], str)
+                                and all(isinstance(m, str)
+                                        for m in cfg["metrics"])):
+        d.pop("_compile_cfg", None)
+    return d
+
+
+KerasNet.__getstate__ = _kerasnet_getstate
+
+
+# ---------------------------------------------------------------------------
+# Sequential
+# ---------------------------------------------------------------------------
+
+
+@symbolic
+class Sequential(KerasNet):
+    """Linear layer stack (ref: keras-API Sequential,
+    zoo/pipeline/api/keras/models/Topology.scala Sequential)."""
+
+    layers: Tuple[nn.Module, ...] = ()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for layer in self.layers:
+            x = _call_layer(layer, x, train)
+        return x
+
+    def add(self, layer: nn.Module) -> "Sequential":
+        # flax dataclasses are frozen; Sequential is mutated only BEFORE
+        # binding (keras .add build phase), so object.__setattr__ is safe.
+        object.__setattr__(self, "layers", tuple(self.layers) + (layer,))
+        return self
+
+    def _child_layers(self):
+        return [(f"layers_{i}", l) for i, l in enumerate(self.layers)]
+
+
+def _call_layer(layer, x, train: bool, extra_kwargs: Optional[dict] = None):
+    """Invoke a child layer, passing `train` only if accepted.
+    `extra_kwargs` replays kwargs recorded at symbolic-call time."""
+    fn = getattr(type(layer), "__call__", None)
+    inner = getattr(fn, "inner_fn", fn)
+    try:
+        params = inspect.signature(inner).parameters
+        takes_train = "train" in params
+    except (TypeError, ValueError):
+        takes_train = False
+    kw = dict(extra_kwargs or {})
+    kw.pop("train", None)
+    if takes_train:
+        kw["train"] = train
+    if isinstance(x, (list, tuple)) and getattr(
+            layer, "_takes_list", False):
+        return layer(list(x), **kw)
+    return layer(x, **kw)
+
+
+# ---------------------------------------------------------------------------
+# functional Model
+# ---------------------------------------------------------------------------
+
+
+@symbolic
+class Model(KerasNet):
+    """Functional-API graph model (ref: keras Model / zoo GraphNet).
+
+    Built from Input placeholders and symbolic layer calls; executes the
+    recorded DAG inside one compact call so XLA sees a single program.
+    """
+
+    graph_inputs: Tuple[KTensor, ...] = ()
+    graph_outputs: Tuple[KTensor, ...] = ()
+    ops: Tuple[nn.Module, ...] = ()          # derived; topological order
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.graph_inputs:
+            # Re-derived on every init (flax .clone() re-runs __post_init__
+            # with `ops` already set — the non-field attrs must come back).
+            order = [t for t in _toposort(self.graph_outputs)
+                     if t.layer is not None]
+            # dedupe shared layers (keras layer reuse => shared params)
+            seen, ops = {}, []
+            for t in order:
+                if id(t.layer) not in seen:
+                    seen[id(t.layer)] = len(ops)
+                    ops.append(t.layer)
+            if not self.ops:
+                object.__setattr__(self, "ops", tuple(ops))
+            object.__setattr__(self, "_op_index", seen)
+            object.__setattr__(self, "_order", order)
+
+    @classmethod
+    def from_io(cls, input, output) -> "Model":
+        ins = tuple(input) if isinstance(input, (list, tuple)) else (input,)
+        outs = tuple(output) if isinstance(output, (list, tuple)) else (output,)
+        return cls(graph_inputs=ins, graph_outputs=outs)
+
+    @property
+    def n_inputs(self) -> int:
+        return len(self.graph_inputs)
+
+    @nn.compact
+    def __call__(self, *xs, train: bool = False):
+        if len(xs) != len(self.graph_inputs):
+            raise ValueError(f"model takes {len(self.graph_inputs)} inputs, "
+                             f"got {len(xs)}")
+        env: Dict[int, Any] = {t.uid: x
+                               for t, x in zip(self.graph_inputs, xs)}
+        for t in self._order:
+            ins = [env[i.uid] for i in t.inputs]
+            layer = self.ops[self._op_index[id(t.layer)]]
+            arg = ins[0] if len(ins) == 1 else list(ins)
+            env[t.uid] = _call_layer(layer, arg, train,
+                                     extra_kwargs=t.call_kwargs)
+        outs = tuple(env[t.uid] for t in self.graph_outputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    def _child_layers(self):
+        return [(f"ops_{i}", l) for i, l in enumerate(self.ops)]
+
+
+def _model_new(input, output):
+    return Model.from_io(input, output)
+
+
+# keras spelling: Model(input=..., output=...)
+_real_model_init = Model.__init__
+
+
+def _model_init(self, *args, input=None, output=None, **kwargs):
+    if input is not None or output is not None:
+        m = Model.from_io(input, output)
+        _real_model_init(self, graph_inputs=m.graph_inputs,
+                         graph_outputs=m.graph_outputs)
+        return
+    _real_model_init(self, *args, **kwargs)
+
+
+Model.__init__ = _model_init
+
+
+def merge(inputs: Sequence[KTensor], mode: str = "sum",
+          concat_axis: int = -1) -> KTensor:
+    """Functional merge of symbolic tensors (ref: keras `merge`)."""
+    from analytics_zoo_tpu.keras.layers import Merge
+    return Merge(mode=mode, concat_axis=concat_axis)(list(inputs))

@@ -1,0 +1,43 @@
+"""Keras init strings → jax initializers (ref: keras-API `init=` arg,
+zoo/pipeline/api/keras layers accept "glorot_uniform", "one", ...)."""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax.numpy as jnp
+from jax.nn import initializers as ji
+
+
+_INITS = {
+    "glorot_uniform": lambda: ji.glorot_uniform(),
+    "glorot_normal": lambda: ji.glorot_normal(),
+    "he_uniform": lambda: ji.he_uniform(),
+    "he_normal": lambda: ji.he_normal(),
+    "lecun_uniform": lambda: ji.lecun_uniform(),
+    "lecun_normal": lambda: ji.lecun_normal(),
+    "uniform": lambda: ji.uniform(scale=0.05),
+    "normal": lambda: ji.normal(stddev=0.05),
+    "zero": lambda: ji.zeros,
+    "zeros": lambda: ji.zeros,
+    "one": lambda: ji.ones,
+    "ones": lambda: ji.ones,
+    "orthogonal": lambda: ji.orthogonal(),
+}
+
+
+def get_initializer(init: Union[str, Callable, None], default="glorot_uniform"):
+    if init is None:
+        init = default
+    if callable(init):
+        return init
+    try:
+        return _INITS[init.lower()]()
+    except KeyError:
+        raise ValueError(f"unknown initializer {init!r}; one of {sorted(_INITS)}")
+
+
+def constant_init(value: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+    return init

@@ -1,0 +1,925 @@
+"""Keras-1.2-compatible layer catalog on flax.
+
+Reference parity: zoo/pipeline/api/keras/layers/ (~100 Keras 1.2.2 layers
+reimplemented over BigDL) + pyzoo/zoo/pipeline/api/keras/layers mirrors.
+Here each layer is a thin flax module with keras-style constructor args
+(`output_dim`, `init`, `activation`, `border_mode`, `subsample`,
+`W_regularizer`, ...).  Layout is channels-LAST (NHWC) — the TPU/XLA-native
+layout — where the reference (BigDL) defaulted to NCHW; `dim_ordering`
+arguments are accepted for API compatibility and must be "tf"/default.
+
+Keras-2 spellings (Conv2D, MaxPool2D, ...) are exported as aliases
+(ref: zoo/pipeline/api/keras2/).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from analytics_zoo_tpu.keras.activations import get_activation
+from analytics_zoo_tpu.keras.engine import symbolic
+from analytics_zoo_tpu.keras.initializers import constant_init, get_initializer
+from analytics_zoo_tpu.keras.regularizers import Regularizer
+
+__all__ = [
+    # core
+    "Dense", "Activation", "Dropout", "Flatten", "Reshape", "Permute",
+    "RepeatVector", "Merge", "Highway", "MaxoutDense", "Masking", "Lambda",
+    # advanced activations
+    "LeakyReLU", "ELU", "PReLU", "ThresholdedReLU",
+    # noise / regularization
+    "GaussianNoise", "GaussianDropout", "SpatialDropout1D", "SpatialDropout2D",
+    "SpatialDropout3D",
+    # embeddings & norm
+    "Embedding", "BatchNormalization", "LayerNormalization",
+    # conv
+    "Convolution1D", "Convolution2D", "Convolution3D", "AtrousConvolution1D",
+    "AtrousConvolution2D", "SeparableConvolution2D", "Deconvolution2D",
+    "Cropping1D", "Cropping2D", "Cropping3D", "UpSampling1D", "UpSampling2D",
+    "UpSampling3D", "ZeroPadding1D", "ZeroPadding2D", "ZeroPadding3D",
+    "LocallyConnected1D", "LocallyConnected2D",
+    # pooling
+    "MaxPooling1D", "MaxPooling2D", "MaxPooling3D", "AveragePooling1D",
+    "AveragePooling2D", "AveragePooling3D", "GlobalMaxPooling1D",
+    "GlobalMaxPooling2D", "GlobalMaxPooling3D", "GlobalAveragePooling1D",
+    "GlobalAveragePooling2D", "GlobalAveragePooling3D",
+    # recurrent
+    "SimpleRNN", "LSTM", "GRU", "ConvLSTM2D", "Bidirectional",
+    "TimeDistributed",
+    # keras2 aliases
+    "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "SeparableConv2D",
+    "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+    "AvgPool3D",
+]
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _check_tf_ordering(dim_ordering):
+    if dim_ordering not in (None, "tf", "default", "channels_last"):
+        raise ValueError(
+            "only channels-last ('tf') layout is supported on TPU; got "
+            f"dim_ordering={dim_ordering!r}")
+
+
+# ---------------------------------------------------------------------------
+# core
+# ---------------------------------------------------------------------------
+
+
+@symbolic
+class Dense(nn.Module):
+    """ref: keras layers/core Dense (zoo keras-API Dense)."""
+    output_dim: int
+    init: Any = "glorot_uniform"
+    activation: Any = None
+    W_regularizer: Optional[Regularizer] = None
+    b_regularizer: Optional[Regularizer] = None
+    bias: bool = True
+    input_shape: Optional[Tuple[int, ...]] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.Dense(self.output_dim, use_bias=self.bias,
+                     kernel_init=get_initializer(self.init))(x)
+        return get_activation(self.activation)(y)
+
+
+@symbolic
+class Activation(nn.Module):
+    activation: Any = "linear"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return get_activation(self.activation)(x)
+
+
+@symbolic
+class Dropout(nn.Module):
+    """ref: keras Dropout. `p` is the DROP rate (keras-1.2 spelling)."""
+    p: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dropout(rate=self.p, deterministic=not train)(x)
+
+
+@symbolic
+class Flatten(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return x.reshape((x.shape[0], -1))
+
+
+@symbolic
+class Reshape(nn.Module):
+    target_shape: Tuple[int, ...] = ()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return x.reshape((x.shape[0],) + tuple(self.target_shape))
+
+
+@symbolic
+class Permute(nn.Module):
+    """dims are 1-indexed over non-batch axes (keras semantics)."""
+    dims: Tuple[int, ...] = ()
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jnp.transpose(x, (0,) + tuple(d for d in self.dims))
+
+
+@symbolic
+class RepeatVector(nn.Module):
+    n: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jnp.repeat(x[:, None, :], self.n, axis=1)
+
+
+@symbolic
+class Masking(nn.Module):
+    """Zeroes timesteps whose features all equal mask_value (keras Masking;
+    downstream layers see zeros — explicit masks are not propagated, which
+    matches the reference's BigDL lowering of padded sequences)."""
+    mask_value: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0)
+
+
+@symbolic
+class Lambda(nn.Module):
+    """Arbitrary jnp expression as a layer (ref: keras Lambda; the zoo
+    autograd CustomLoss machinery covers the loss-side equivalent)."""
+    function: Callable = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return self.function(x)
+
+
+@symbolic
+class Merge(nn.Module):
+    """ref: keras Merge (mode: sum/mul/concat/ave/max/min/dot/cos)."""
+    mode: str = "sum"
+    concat_axis: int = -1
+    _takes_list: bool = True
+
+    @nn.compact
+    def __call__(self, xs, train: bool = False):
+        if not isinstance(xs, (list, tuple)):
+            raise ValueError("Merge expects a list of inputs")
+        m = self.mode
+        if m == "sum":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if m == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if m == "ave":
+            return sum(xs) / len(xs)
+        if m == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if m == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if m == "concat":
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if m == "dot":
+            a, b = xs
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        if m == "cos":
+            a, b = xs
+            na = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-8)
+            nb = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-8)
+            return jnp.sum(na * nb, axis=-1, keepdims=True)
+        raise ValueError(f"unknown merge mode {m!r}")
+
+
+@symbolic
+class Highway(nn.Module):
+    """ref: keras Highway — y = t*h(x) + (1-t)*x."""
+    activation: Any = "tanh"
+    init: Any = "glorot_uniform"
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = x.shape[-1]
+        h = get_activation(self.activation)(
+            nn.Dense(d, use_bias=self.bias,
+                     kernel_init=get_initializer(self.init))(x))
+        t = jax.nn.sigmoid(
+            nn.Dense(d, use_bias=self.bias,
+                     bias_init=constant_init(-2.0))(x))
+        return t * h + (1 - t) * x
+
+
+@symbolic
+class MaxoutDense(nn.Module):
+    """ref: keras MaxoutDense — max over nb_feature linear maps."""
+    output_dim: int
+    nb_feature: int = 4
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.Dense(self.output_dim * self.nb_feature, use_bias=self.bias)(x)
+        y = y.reshape(y.shape[:-1] + (self.nb_feature, self.output_dim))
+        return jnp.max(y, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# advanced activations
+# ---------------------------------------------------------------------------
+
+
+@symbolic
+class LeakyReLU(nn.Module):
+    alpha: float = 0.3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jax.nn.leaky_relu(x, self.alpha)
+
+
+@symbolic
+class ELU(nn.Module):
+    alpha: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jax.nn.elu(x, self.alpha)
+
+
+@symbolic
+class PReLU(nn.Module):
+    """Learnable per-channel negative slope."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        alpha = self.param("alpha", constant_init(0.25), (x.shape[-1],))
+        return jnp.where(x >= 0, x, alpha * x)
+
+
+@symbolic
+class ThresholdedReLU(nn.Module):
+    theta: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jnp.where(x > self.theta, x, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# noise
+# ---------------------------------------------------------------------------
+
+
+@symbolic
+class GaussianNoise(nn.Module):
+    sigma: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train:
+            return x
+        rng = self.make_rng("dropout")
+        return x + self.sigma * jax.random.normal(rng, x.shape, x.dtype)
+
+
+@symbolic
+class GaussianDropout(nn.Module):
+    p: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if not train or self.p <= 0:
+            return x
+        rng = self.make_rng("dropout")
+        std = np.sqrt(self.p / (1.0 - self.p))
+        return x * (1 + std * jax.random.normal(rng, x.shape, x.dtype))
+
+
+def _spatial_dropout(ndim_broadcast):
+    dims = tuple(ndim_broadcast)
+
+    @symbolic
+    class _SD(nn.Module):
+        p: float = 0.5
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dropout(rate=self.p, broadcast_dims=dims,
+                              deterministic=not train)(x)
+
+    return _SD
+
+
+SpatialDropout1D = _spatial_dropout((1,))        # (B, T, C): drop whole C
+SpatialDropout2D = _spatial_dropout((1, 2))      # (B, H, W, C)
+SpatialDropout3D = _spatial_dropout((1, 2, 3))
+SpatialDropout1D.__name__ = "SpatialDropout1D"
+SpatialDropout2D.__name__ = "SpatialDropout2D"
+SpatialDropout3D.__name__ = "SpatialDropout3D"
+
+
+# ---------------------------------------------------------------------------
+# embeddings & normalization
+# ---------------------------------------------------------------------------
+
+
+@symbolic
+class Embedding(nn.Module):
+    """ref: keras Embedding (zoo keras-API Embedding)."""
+    input_dim: int
+    output_dim: int
+    init: Any = "uniform"
+    W_regularizer: Optional[Regularizer] = None
+    input_length: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Embed(self.input_dim, self.output_dim,
+                        embedding_init=get_initializer(self.init))(
+                            x.astype(jnp.int32))
+
+
+@symbolic
+class BatchNormalization(nn.Module):
+    """ref: keras BatchNormalization. Running stats live in the
+    `batch_stats` collection and update during training via the Estimator's
+    mutable pass."""
+    epsilon: float = 1e-3
+    momentum: float = 0.99
+    axis: int = -1
+    beta_init: Any = "zero"
+    gamma_init: Any = "one"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.BatchNorm(
+            use_running_average=not train, axis=self.axis,
+            momentum=self.momentum, epsilon=self.epsilon,
+            bias_init=get_initializer(self.beta_init, "zeros"),
+            scale_init=get_initializer(self.gamma_init, "ones"))(x)
+
+
+@symbolic
+class LayerNormalization(nn.Module):
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.LayerNorm(epsilon=self.epsilon)(x)
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _conv_padding(border_mode: str):
+    if border_mode in ("valid", "same"):
+        return border_mode.upper()
+    raise ValueError(f"border_mode must be valid|same, got {border_mode!r}")
+
+
+@symbolic
+class Convolution1D(nn.Module):
+    """ref: keras Convolution1D. Input (B, steps, C)."""
+    nb_filter: int
+    filter_length: int
+    init: Any = "glorot_uniform"
+    activation: Any = None
+    border_mode: str = "valid"
+    subsample_length: int = 1
+    dilation_rate: int = 1
+    W_regularizer: Optional[Regularizer] = None
+    b_regularizer: Optional[Regularizer] = None
+    bias: bool = True
+    input_shape: Optional[Tuple[int, ...]] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.Conv(self.nb_filter, (self.filter_length,),
+                    strides=(self.subsample_length,),
+                    kernel_dilation=(self.dilation_rate,),
+                    padding=_conv_padding(self.border_mode),
+                    use_bias=self.bias,
+                    kernel_init=get_initializer(self.init))(x)
+        return get_activation(self.activation)(y)
+
+
+@symbolic
+class Convolution2D(nn.Module):
+    """ref: keras Convolution2D. Input (B, H, W, C) — channels-last."""
+    nb_filter: int
+    nb_row: int
+    nb_col: int
+    init: Any = "glorot_uniform"
+    activation: Any = None
+    border_mode: str = "valid"
+    subsample: Tuple[int, int] = (1, 1)
+    dilation_rate: Tuple[int, int] = (1, 1)
+    W_regularizer: Optional[Regularizer] = None
+    b_regularizer: Optional[Regularizer] = None
+    bias: bool = True
+    dim_ordering: Optional[str] = None
+    input_shape: Optional[Tuple[int, ...]] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        _check_tf_ordering(self.dim_ordering)
+        y = nn.Conv(self.nb_filter, (self.nb_row, self.nb_col),
+                    strides=_pair(self.subsample),
+                    kernel_dilation=_pair(self.dilation_rate),
+                    padding=_conv_padding(self.border_mode),
+                    use_bias=self.bias,
+                    kernel_init=get_initializer(self.init))(x)
+        return get_activation(self.activation)(y)
+
+
+@symbolic
+class Convolution3D(nn.Module):
+    nb_filter: int
+    kernel_dim1: int
+    kernel_dim2: int
+    kernel_dim3: int
+    activation: Any = None
+    border_mode: str = "valid"
+    subsample: Tuple[int, int, int] = (1, 1, 1)
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.Conv(self.nb_filter,
+                    (self.kernel_dim1, self.kernel_dim2, self.kernel_dim3),
+                    strides=_pair(self.subsample, 3),
+                    padding=_conv_padding(self.border_mode),
+                    use_bias=self.bias)(x)
+        return get_activation(self.activation)(y)
+
+
+def AtrousConvolution1D(nb_filter, filter_length, atrous_rate=1, **kw):
+    """ref: keras AtrousConvolution1D → dilated Conv1D."""
+    return Convolution1D(nb_filter, filter_length,
+                         dilation_rate=atrous_rate, **kw)
+
+
+def AtrousConvolution2D(nb_filter, nb_row, nb_col, atrous_rate=(1, 1), **kw):
+    return Convolution2D(nb_filter, nb_row, nb_col,
+                         dilation_rate=_pair(atrous_rate), **kw)
+
+
+@symbolic
+class SeparableConvolution2D(nn.Module):
+    """Depthwise + pointwise (ref: keras SeparableConvolution2D)."""
+    nb_filter: int
+    nb_row: int
+    nb_col: int
+    activation: Any = None
+    border_mode: str = "valid"
+    subsample: Tuple[int, int] = (1, 1)
+    depth_multiplier: int = 1
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = x.shape[-1]
+        y = nn.Conv(c * self.depth_multiplier, (self.nb_row, self.nb_col),
+                    strides=_pair(self.subsample),
+                    padding=_conv_padding(self.border_mode),
+                    feature_group_count=c, use_bias=False)(x)
+        y = nn.Conv(self.nb_filter, (1, 1), use_bias=self.bias)(y)
+        return get_activation(self.activation)(y)
+
+
+@symbolic
+class Deconvolution2D(nn.Module):
+    """Transposed conv (ref: keras Deconvolution2D)."""
+    nb_filter: int
+    nb_row: int
+    nb_col: int
+    activation: Any = None
+    border_mode: str = "valid"
+    subsample: Tuple[int, int] = (1, 1)
+    bias: bool = True
+    output_shape: Optional[Tuple[int, ...]] = None   # accepted, inferred
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.ConvTranspose(self.nb_filter, (self.nb_row, self.nb_col),
+                             strides=_pair(self.subsample),
+                             padding=_conv_padding(self.border_mode),
+                             use_bias=self.bias)(x)
+        return get_activation(self.activation)(y)
+
+
+@symbolic
+class LocallyConnected1D(nn.Module):
+    """Unshared conv (ref: keras LocallyConnected1D): per-position weights.
+    Lowered to patch extraction + one einsum so the MXU sees a single
+    batched contraction."""
+    nb_filter: int
+    filter_length: int
+    activation: Any = None
+    subsample_length: int = 1
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        patches = lax.conv_general_dilated_patches(
+            x, (self.filter_length,), (self.subsample_length,), "VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        # patches: (B, L_out, C*filter_length)
+        L = patches.shape[1]
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (L, patches.shape[-1], self.nb_filter))
+        y = jnp.einsum("blp,lpf->blf", patches, w)
+        if self.bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (L, self.nb_filter))
+            y = y + b
+        return get_activation(self.activation)(y)
+
+
+@symbolic
+class LocallyConnected2D(nn.Module):
+    nb_filter: int
+    nb_row: int
+    nb_col: int
+    activation: Any = None
+    subsample: Tuple[int, int] = (1, 1)
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        patches = lax.conv_general_dilated_patches(
+            x, (self.nb_row, self.nb_col), _pair(self.subsample), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        B, H, W, P = patches.shape
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (H, W, P, self.nb_filter))
+        y = jnp.einsum("bhwp,hwpf->bhwf", patches, w)
+        if self.bias:
+            b = self.param("bias", nn.initializers.zeros,
+                           (H, W, self.nb_filter))
+            y = y + b
+        return get_activation(self.activation)(y)
+
+
+def _crop(x, crops):
+    slices = [slice(None)]
+    for (lo, hi) in crops:
+        slices.append(slice(lo, x.shape[len(slices)] - hi))
+    slices.append(slice(None))
+    return x[tuple(slices)]
+
+
+@symbolic
+class Cropping1D(nn.Module):
+    cropping: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return _crop(x, [self.cropping])
+
+
+@symbolic
+class Cropping2D(nn.Module):
+    cropping: Tuple[Tuple[int, int], Tuple[int, int]] = ((0, 0), (0, 0))
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return _crop(x, list(self.cropping))
+
+
+@symbolic
+class Cropping3D(nn.Module):
+    cropping: Tuple = ((1, 1), (1, 1), (1, 1))
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return _crop(x, list(self.cropping))
+
+
+@symbolic
+class UpSampling1D(nn.Module):
+    length: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jnp.repeat(x, self.length, axis=1)
+
+
+@symbolic
+class UpSampling2D(nn.Module):
+    size: Tuple[int, int] = (2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        s = _pair(self.size)
+        return jnp.repeat(jnp.repeat(x, s[0], axis=1), s[1], axis=2)
+
+
+@symbolic
+class UpSampling3D(nn.Module):
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        s = _pair(self.size, 3)
+        y = jnp.repeat(x, s[0], axis=1)
+        y = jnp.repeat(y, s[1], axis=2)
+        return jnp.repeat(y, s[2], axis=3)
+
+
+@symbolic
+class ZeroPadding1D(nn.Module):
+    padding: Union[int, Tuple[int, int]] = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = _pair(self.padding)
+        return jnp.pad(x, ((0, 0), p, (0, 0)))
+
+
+@symbolic
+class ZeroPadding2D(nn.Module):
+    padding: Union[int, Tuple[int, int]] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        ph, pw = _pair(self.padding)
+        return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+@symbolic
+class ZeroPadding3D(nn.Module):
+    padding: Tuple[int, int, int] = (1, 1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = _pair(self.padding, 3)
+        return jnp.pad(x, ((0, 0), (p[0], p[0]), (p[1], p[1]),
+                           (p[2], p[2]), (0, 0)))
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def _pool_layer(name, ndim, reducer):
+    @symbolic
+    class _Pool(nn.Module):
+        pool_size: Any = 2
+        strides: Any = None
+        border_mode: str = "valid"
+        pool_length: Any = None      # keras-1.2 1D spelling
+        stride: Any = None
+
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            size = self.pool_length if self.pool_length is not None \
+                else self.pool_size
+            window = _pair(size, ndim)
+            st = self.stride if self.stride is not None else self.strides
+            strides = _pair(st, ndim) if st is not None else window
+            pad = _conv_padding(self.border_mode)
+            if reducer == "max":
+                return nn.max_pool(x, window, strides=strides, padding=pad)
+            return nn.avg_pool(x, window, strides=strides, padding=pad)
+
+    _Pool.__name__ = name
+    return _Pool
+
+
+MaxPooling1D = _pool_layer("MaxPooling1D", 1, "max")
+MaxPooling2D = _pool_layer("MaxPooling2D", 2, "max")
+MaxPooling3D = _pool_layer("MaxPooling3D", 3, "max")
+AveragePooling1D = _pool_layer("AveragePooling1D", 1, "avg")
+AveragePooling2D = _pool_layer("AveragePooling2D", 2, "avg")
+AveragePooling3D = _pool_layer("AveragePooling3D", 3, "avg")
+
+
+def _global_pool(name, axes, reducer):
+    @symbolic
+    class _GPool(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            fn = jnp.max if reducer == "max" else jnp.mean
+            return fn(x, axis=axes)
+
+    _GPool.__name__ = name
+    return _GPool
+
+
+GlobalMaxPooling1D = _global_pool("GlobalMaxPooling1D", (1,), "max")
+GlobalMaxPooling2D = _global_pool("GlobalMaxPooling2D", (1, 2), "max")
+GlobalMaxPooling3D = _global_pool("GlobalMaxPooling3D", (1, 2, 3), "max")
+GlobalAveragePooling1D = _global_pool("GlobalAveragePooling1D", (1,), "avg")
+GlobalAveragePooling2D = _global_pool("GlobalAveragePooling2D", (1, 2), "avg")
+GlobalAveragePooling3D = _global_pool("GlobalAveragePooling3D", (1, 2, 3),
+                                      "avg")
+
+
+# ---------------------------------------------------------------------------
+# recurrent
+# ---------------------------------------------------------------------------
+
+
+def _carry_hidden(cell_kind: str, carry):
+    if cell_kind == "lstm":
+        return carry[1]     # (c, h) → h
+    return carry
+
+
+class _RecurrentBase(nn.Module):
+    """Shared RNN scaffolding: lax.scan via nn.RNN (XLA-friendly — no
+    per-timestep python)."""
+    output_dim: int = 0
+    activation: Any = "tanh"
+    return_sequences: bool = False
+    go_backwards: bool = False
+    dropout: float = 0.0          # input dropout (keras dropout_W)
+    input_shape: Optional[Tuple[int, ...]] = None
+
+    _cell_kind = "simple"
+
+    def _make_cell(self):
+        if self._cell_kind == "lstm":
+            return nn.OptimizedLSTMCell(self.output_dim)
+        if self._cell_kind == "gru":
+            return nn.GRUCell(self.output_dim)
+        return nn.SimpleCell(
+            self.output_dim, activation_fn=get_activation(self.activation))
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.dropout:
+            x = nn.Dropout(rate=self.dropout, deterministic=not train)(x)
+        rnn = nn.RNN(self._make_cell(), return_carry=True,
+                     reverse=self.go_backwards, keep_order=True)
+        carry, seq = rnn(x)
+        if self.return_sequences:
+            return seq
+        return _carry_hidden(self._cell_kind, carry)
+
+
+@symbolic
+class SimpleRNN(_RecurrentBase):
+    """ref: keras SimpleRNN."""
+    _cell_kind = "simple"
+
+
+@symbolic
+class LSTM(_RecurrentBase):
+    """ref: keras LSTM (zoo keras-API LSTM)."""
+    _cell_kind = "lstm"
+
+
+@symbolic
+class GRU(_RecurrentBase):
+    """ref: keras GRU."""
+    _cell_kind = "gru"
+
+
+@symbolic
+class ConvLSTM2D(nn.Module):
+    """ref: keras ConvLSTM2D. Input (B, T, H, W, C)."""
+    nb_filter: int
+    nb_row: int = 3
+    nb_col: int = 3
+    border_mode: str = "same"
+    return_sequences: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cell = nn.ConvLSTMCell(self.nb_filter, (self.nb_row, self.nb_col),
+                               padding=_conv_padding(self.border_mode))
+        carry, seq = nn.RNN(cell, return_carry=True)(x)
+        return seq if self.return_sequences else carry[1]
+
+
+@symbolic
+class Bidirectional(nn.Module):
+    """ref: keras Bidirectional wrapper. `layer` must be one of our
+    recurrent layers; params are NOT shared between directions."""
+    layer: nn.Module = None
+    merge_mode: str = "concat"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # clone() defaults to parent=None (unbound); re-parent explicitly so
+        # the per-direction copies bind under this module's scope
+        fwd = self.layer.clone(go_backwards=False, name="forward",
+                               parent=self)
+        bwd = self.layer.clone(go_backwards=True, name="backward",
+                               parent=self)
+        a = fwd(x, train=train)
+        b = bwd(x, train=train)
+        if self.merge_mode == "concat":
+            return jnp.concatenate([a, b], axis=-1)
+        if self.merge_mode == "sum":
+            return a + b
+        if self.merge_mode == "mul":
+            return a * b
+        if self.merge_mode == "ave":
+            return (a + b) / 2
+        raise ValueError(f"unknown merge_mode {self.merge_mode!r}")
+
+
+@symbolic
+class TimeDistributed(nn.Module):
+    """Apply `layer` to every timestep of (B, T, ...) — lowered to one
+    reshaped call so XLA sees a single big batch (no per-step loop)."""
+    layer: nn.Module = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T = x.shape[0], x.shape[1]
+        flat = x.reshape((B * T,) + x.shape[2:])
+        from analytics_zoo_tpu.keras.engine import _call_layer
+        y = _call_layer(self.layer, flat, train)
+        return y.reshape((B, T) + y.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# keras-2 aliases (ref: zoo/pipeline/api/keras2)
+# ---------------------------------------------------------------------------
+
+
+def Conv1D(filters, kernel_size, strides=1, padding="valid", activation=None,
+           dilation_rate=1, use_bias=True, **kw):
+    return Convolution1D(filters, kernel_size, activation=activation,
+                         border_mode=padding, subsample_length=strides,
+                         dilation_rate=dilation_rate, bias=use_bias, **kw)
+
+
+def Conv2D(filters, kernel_size, strides=(1, 1), padding="valid",
+           activation=None, dilation_rate=(1, 1), use_bias=True, **kw):
+    kh, kw_ = _pair(kernel_size)
+    return Convolution2D(filters, kh, kw_, activation=activation,
+                         border_mode=padding, subsample=_pair(strides),
+                         dilation_rate=_pair(dilation_rate), bias=use_bias,
+                         **kw)
+
+
+def Conv3D(filters, kernel_size, strides=(1, 1, 1), padding="valid",
+           activation=None, use_bias=True, **kw):
+    k = _pair(kernel_size, 3)
+    return Convolution3D(filters, k[0], k[1], k[2], activation=activation,
+                         border_mode=padding, subsample=_pair(strides, 3),
+                         bias=use_bias, **kw)
+
+
+def Conv2DTranspose(filters, kernel_size, strides=(1, 1), padding="valid",
+                    activation=None, use_bias=True, **kw):
+    kh, kw_ = _pair(kernel_size)
+    return Deconvolution2D(filters, kh, kw_, activation=activation,
+                           border_mode=padding, subsample=_pair(strides),
+                           bias=use_bias, **kw)
+
+
+def SeparableConv2D(filters, kernel_size, strides=(1, 1), padding="valid",
+                    activation=None, depth_multiplier=1, use_bias=True, **kw):
+    kh, kw_ = _pair(kernel_size)
+    return SeparableConvolution2D(filters, kh, kw_, activation=activation,
+                                  border_mode=padding,
+                                  subsample=_pair(strides),
+                                  depth_multiplier=depth_multiplier,
+                                  bias=use_bias, **kw)
+
+
+MaxPool1D = MaxPooling1D
+MaxPool2D = MaxPooling2D
+MaxPool3D = MaxPooling3D
+AvgPool1D = AveragePooling1D
+AvgPool2D = AveragePooling2D
+AvgPool3D = AveragePooling3D

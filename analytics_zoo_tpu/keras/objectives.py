@@ -1,0 +1,80 @@
+"""Keras objective catalog (ref: zoo/pipeline/api/keras/objectives/ —
+MeanSquaredError, KullbackLeiblerDivergence, Poisson, CosineProximity,
+Hinge, SquaredHinge, MSLE, MAPE, ...).  Extends the shared Estimator loss
+registry; all are pure jnp `(preds, targets) -> scalar` so they fuse into
+the train step."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.learn.objectives import (  # noqa: F401
+    LossFn, binary_crossentropy, categorical_crossentropy, huber,
+    mean_absolute_error, mean_squared_error,
+    sparse_categorical_crossentropy)
+from analytics_zoo_tpu.learn import objectives as _base
+
+__all__ = [
+    "get_loss", "kullback_leibler_divergence", "poisson",
+    "cosine_proximity", "hinge", "squared_hinge",
+    "mean_squared_logarithmic_error", "mean_absolute_percentage_error",
+]
+
+_EPS = 1e-7
+
+
+def kullback_leibler_divergence(preds, targets):
+    """Targets and preds are probability distributions over the last axis."""
+    p = jnp.clip(targets, _EPS, 1.0)
+    q = jnp.clip(preds, _EPS, 1.0)
+    return jnp.mean(jnp.sum(p * jnp.log(p / q), axis=-1))
+
+
+def poisson(preds, targets):
+    return jnp.mean(preds - targets * jnp.log(preds + _EPS))
+
+
+def cosine_proximity(preds, targets):
+    p = preds / (jnp.linalg.norm(preds, axis=-1, keepdims=True) + _EPS)
+    t = targets / (jnp.linalg.norm(targets, axis=-1, keepdims=True) + _EPS)
+    return -jnp.mean(jnp.sum(p * t, axis=-1))
+
+
+def hinge(preds, targets):
+    """Targets in {-1, 1}."""
+    return jnp.mean(jax.nn.relu(1.0 - targets * preds))
+
+
+def squared_hinge(preds, targets):
+    return jnp.mean(jnp.square(jax.nn.relu(1.0 - targets * preds)))
+
+
+def mean_squared_logarithmic_error(preds, targets):
+    a = jnp.log(jnp.clip(preds, _EPS, None) + 1.0)
+    b = jnp.log(jnp.clip(targets, _EPS, None) + 1.0)
+    return jnp.mean(jnp.square(a - b))
+
+
+def mean_absolute_percentage_error(preds, targets):
+    return 100.0 * jnp.mean(
+        jnp.abs((targets - preds) / jnp.clip(jnp.abs(targets), _EPS, None)))
+
+
+_EXTRA = {
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "cosine": cosine_proximity,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+}
+
+# one registry: keras names resolve everywhere Estimators resolve losses
+_base._LOSSES.update(_EXTRA)
+get_loss = _base.get_loss

@@ -1,0 +1,35 @@
+"""Optimizer strings → optax (ref: keras-API `compile(optimizer="adam")`;
+the reference lowers these to BigDL OptimMethods — here they lower to optax
+gradient transforms applied inside the single pjit'd train step)."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import optax
+
+
+_FACTORIES = {
+    "sgd": lambda lr: optax.sgd(lr if lr is not None else 0.01),
+    "momentum": lambda lr: optax.sgd(lr if lr is not None else 0.01,
+                                     momentum=0.9),
+    "adam": lambda lr: optax.adam(lr if lr is not None else 1e-3),
+    "adamw": lambda lr: optax.adamw(lr if lr is not None else 1e-3),
+    "adamax": lambda lr: optax.adamax(lr if lr is not None else 2e-3),
+    "nadam": lambda lr: optax.nadam(lr if lr is not None else 1e-3),
+    "adagrad": lambda lr: optax.adagrad(lr if lr is not None else 1e-2),
+    "adadelta": lambda lr: optax.adadelta(lr if lr is not None else 1.0),
+    "rmsprop": lambda lr: optax.rmsprop(lr if lr is not None else 1e-3),
+    "lamb": lambda lr: optax.lamb(lr if lr is not None else 1e-3),
+}
+
+
+def get_optimizer(opt: Union[str, optax.GradientTransformation],
+                  lr: float = None) -> optax.GradientTransformation:
+    if isinstance(opt, str):
+        try:
+            return _FACTORIES[opt.lower()](lr)
+        except KeyError:
+            raise ValueError(
+                f"unknown optimizer {opt!r}; one of {sorted(_FACTORIES)}")
+    return opt

@@ -1,0 +1,38 @@
+"""L1/L2 weight regularizers (ref: keras-API `W_regularizer=l2(...)`,
+zoo/pipeline/api/keras — BigDL L1L2Regularizer).
+
+A regularizer is a spec object; the penalty is computed by ``KerasNet`` by
+walking the param tree at loss time, so it fuses into the jitted train step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Regularizer:
+    l1: float = 0.0
+    l2: float = 0.0
+
+    def __call__(self, w: jnp.ndarray) -> jnp.ndarray:
+        pen = jnp.zeros((), dtype=jnp.float32)
+        if self.l1:
+            pen = pen + self.l1 * jnp.sum(jnp.abs(w))
+        if self.l2:
+            pen = pen + self.l2 * jnp.sum(jnp.square(w))
+        return pen
+
+
+def l1(v: float = 0.01) -> Regularizer:
+    return Regularizer(l1=v)
+
+
+def l2(v: float = 0.01) -> Regularizer:
+    return Regularizer(l2=v)
+
+
+def l1l2(l1_v: float = 0.01, l2_v: float = 0.01) -> Regularizer:
+    return Regularizer(l1=l1_v, l2=l2_v)

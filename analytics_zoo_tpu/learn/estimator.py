@@ -80,8 +80,12 @@ class FlaxEstimator:
         mesh=None,
         config: Optional[TrainConfig] = None,
         model_dir: Optional[str] = None,
+        param_loss: Optional[Callable] = None,
     ):
         self.model = model
+        # Optional penalty over the param tree (keras-API W_regularizer
+        # lowering) added to the training loss inside the jitted step.
+        self.param_loss = param_loss
         self.loss_fn = get_loss(loss)
         if isinstance(optimizer, (int, float)):
             optimizer = optax.adam(float(optimizer))
@@ -150,7 +154,10 @@ class FlaxEstimator:
         def loss_of(params):
             preds, new_bs = self._forward(
                 params, state.batch_stats, batch, rng, train=True)
-            return self.loss_fn(preds, self._labels(batch)), (preds, new_bs)
+            loss = self.loss_fn(preds, self._labels(batch))
+            if self.param_loss is not None:
+                loss = loss + self.param_loss(params)
+            return loss, (preds, new_bs)
 
         (loss, (preds, new_bs)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(state.params)
@@ -177,8 +184,12 @@ class FlaxEstimator:
 
         w = weights.astype(jnp.float32)
         denom = jnp.maximum(w.sum(), 1.0)
-        mets = {"loss": (per_sample(self.loss_fn)(preds, labels) * w).sum()
-                / denom}
+        loss = (per_sample(self.loss_fn)(preds, labels) * w).sum() / denom
+        if self.param_loss is not None:
+            # keep eval loss comparable to the training loss (keras includes
+            # regularization penalties in evaluate)
+            loss = loss + self.param_loss(state.params)
+        mets = {"loss": loss}
         for name, fn in self.metric_fns:
             mets[name] = (per_sample(fn)(preds, labels) * w).sum() / denom
         return mets

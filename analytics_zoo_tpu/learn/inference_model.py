@@ -47,7 +47,7 @@ class InferenceModel:
         self._apply_fn: Optional[Callable] = None
         self._variables = None
         self._buckets = tuple(sorted(batch_buckets))
-        self._jitted: Dict[int, Callable] = {}
+        self._jit: Optional[Callable] = None
         self._compile_lock = threading.Lock()
         self._sem = threading.Semaphore(max(1, concurrent_num))
         self._takes_train: Optional[str] = None
@@ -78,6 +78,7 @@ class InferenceModel:
             return model.apply(variables, *feats, **kw)
 
         self._apply_fn = apply_fn
+        self._jit = None        # new model -> stale compiled wrapper
         return self
 
     def load(self, path: str, model) -> "InferenceModel":
@@ -97,11 +98,12 @@ class InferenceModel:
     # ---- predict -----------------------------------------------------
 
     def _compiled(self, bucket: int, n_feats: int) -> Callable:
-        key = (bucket, n_feats)
+        # one jit wrapper; jax's own per-shape trace cache (driven by the
+        # bucket padding in predict) bounds compilations
         with self._compile_lock:
-            if key not in self._jitted:
-                self._jitted[key] = jax.jit(self._apply_fn)
-            return self._jitted[key]
+            if self._jit is None:
+                self._jit = jax.jit(self._apply_fn)
+            return self._jit
 
     def predict(self, *inputs: np.ndarray) -> np.ndarray:
         """Batched forward; inputs are [N, ...] host arrays. N is padded

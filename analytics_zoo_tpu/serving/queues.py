@@ -72,30 +72,28 @@ class OutputQueue:
         """Block until the result for `uri` lands (or timeout -> None)."""
         deadline = time.monotonic() + timeout
         key = RESULT_PREFIX + uri
-        while time.monotonic() < deadline:
+        while True:
             h = self.client.execute("HGETALL", key)
             if h:
                 fields = {h[i].decode(): h[i + 1]
                           for i in range(0, len(h), 2)}
                 self.client.execute("DEL", key)
+                self.client.execute("SREM", "__result_keys__", uri)
                 return decode_ndarray(fields["value"])
+            if time.monotonic() >= deadline:
+                return None
             time.sleep(poll_interval)
-        return None
 
     def dequeue(self) -> Dict[str, np.ndarray]:
-        """Drain every available result (ref: OutputQueue.dequeue)."""
+        """Drain every available result (ref: OutputQueue.dequeue).
+        Results are stored under result:<uri>; the server keeps a set index
+        of unread uris, which `query` prunes as results are consumed."""
         out: Dict[str, np.ndarray] = {}
-        keys = self.client.execute("GET", "__result_keys__")
-        # results are stored under result:<uri>; the server also keeps an
-        # index set for dequeue-all. Fall back to nothing if unset.
-        if not keys:
-            return out
-        for uri in keys.decode().split(","):
-            if not uri:
-                continue
-            v = self.query(uri, timeout=0.05)
+        keys = self.client.execute("SMEMBERS", "__result_keys__") or []
+        for uri in keys:
+            v = self.query(uri.decode(), timeout=0.05)
             if v is not None:
-                out[uri] = v
+                out[uri.decode()] = v
         return out
 
     def close(self):

@@ -133,6 +133,7 @@ class RespServer:
         self.streams: Dict[bytes, Stream] = {}
         self.hashes: Dict[bytes, Dict[bytes, bytes]] = {}
         self.kv: Dict[bytes, bytes] = {}
+        self.sets: Dict[bytes, set] = {}
         self.lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -203,6 +204,7 @@ class RespServer:
                 self.streams.clear()
                 self.hashes.clear()
                 self.kv.clear()
+                self.sets.clear()
             return _OK()
         if cmd == b"SET":
             self.kv[args[1]] = args[2]
@@ -215,8 +217,27 @@ class RespServer:
                 for k in args[1:]:
                     n += (self.kv.pop(k, None) is not None) + \
                         (self.hashes.pop(k, None) is not None) + \
-                        (self.streams.pop(k, None) is not None)
+                        (self.streams.pop(k, None) is not None) + \
+                        (self.sets.pop(k, None) is not None)
             return n
+        if cmd == b"SADD":
+            with self.lock:
+                s = self.sets.setdefault(args[1], set())
+                before = len(s)
+                s.update(args[2:])
+                return len(s) - before
+        if cmd == b"SREM":
+            with self.lock:
+                s = self.sets.get(args[1], set())
+                before = len(s)
+                s.difference_update(args[2:])
+                return before - len(s)
+        if cmd == b"SMEMBERS":
+            with self.lock:
+                return sorted(self.sets.get(args[1], set()))
+        if cmd == b"SCARD":
+            with self.lock:
+                return len(self.sets.get(args[1], set()))
         if cmd == b"HSET":
             h = self.hashes.setdefault(args[1], {})
             kvs = args[2:]

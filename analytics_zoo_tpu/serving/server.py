@@ -47,9 +47,9 @@ class ServingConfig:
 
         with open(path) as f:
             raw = yaml.safe_load(f) or {}
-        params = raw.get("params", {})
-        redis = (raw.get("redis") or
-                 {}).get("src", raw.get("redis", {}).get("url", ""))
+        params = raw.get("params") or {}
+        redis_raw = raw.get("redis") or {}
+        redis = redis_raw.get("src", redis_raw.get("url", ""))
         cfg = ServingConfig()
         model = raw.get("model", {})
         if isinstance(model, dict):
@@ -169,11 +169,10 @@ class ClusterServing:
         for uri, p in zip(uris, preds):
             self.client.execute("HSET", RESULT_PREFIX + uri,
                                 "value", encode_ndarray(p))
-        # maintain the dequeue-all index (client OutputQueue.dequeue)
-        existing = self.client.execute("GET", "__result_keys__")
-        known = existing.decode().split(",") if existing else []
-        self.client.execute("SET", "__result_keys__",
-                            ",".join([k for k in known if k] + uris))
+        # maintain the dequeue-all index (client OutputQueue.dequeue);
+        # a set, pruned by the client on consume, so it stays bounded by
+        # the number of UNREAD results rather than total requests served
+        self.client.execute("SADD", "__result_keys__", *uris)
         self.stats["requests"] += len(requests)
         self.stats["batches"] += 1
         self.stats["batch_fill"] = len(requests) / self.config.batch_size

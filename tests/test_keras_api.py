@@ -1,0 +1,296 @@
+"""Keras-API layer + engine tests.
+
+Mirrors the reference's test strategy (SURVEY.md §4): keras layers are
+numerically checked against a golden framework — the reference compared
+BigDL-keras vs real Keras; we compare flax-keras vs torch CPU — plus
+topology/training/persistence round-trips.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu import keras as zk
+from analytics_zoo_tpu.keras import layers as L
+import analytics_zoo_tpu.autograd as A
+
+
+def _init_apply(model, *xs, rngs=None, train=False):
+    v = model.init({"params": jax.random.key(0), **(rngs or {})}, *xs,
+                   train=train)
+    return v, model.apply(v, *xs, train=train)
+
+
+# ---------------------------------------------------------------------------
+# numerics vs torch CPU (golden-framework checks)
+# ---------------------------------------------------------------------------
+
+
+class TestNumericsVsTorch:
+    def test_dense_matches_torch_linear(self):
+        import torch
+        x = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+        m = zk.Sequential().add(zk.Dense(3))
+        v, _ = _init_apply(m, jnp.asarray(x))
+        k = v["params"]["layers_0"]["Dense_0"]
+        tl = torch.nn.Linear(5, 3)
+        with torch.no_grad():
+            tl.weight.copy_(torch.tensor(np.asarray(k["kernel"]).T))
+            tl.bias.copy_(torch.tensor(np.asarray(k["bias"])))
+        ours = np.asarray(m.apply(v, jnp.asarray(x), train=False))
+        theirs = tl(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-5)
+
+    def test_conv2d_matches_torch(self):
+        import torch
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+        m = zk.Sequential().add(L.Convolution2D(4, 3, 3))
+        v, _ = _init_apply(m, jnp.asarray(x))
+        k = np.asarray(v["params"]["layers_0"]["Conv_0"]["kernel"])  # HWIO
+        b = np.asarray(v["params"]["layers_0"]["Conv_0"]["bias"])
+        tc = torch.nn.Conv2d(3, 4, 3)
+        with torch.no_grad():
+            tc.weight.copy_(torch.tensor(k.transpose(3, 2, 0, 1)))  # OIHW
+            tc.bias.copy_(torch.tensor(b))
+        ours = np.asarray(m.apply(v, jnp.asarray(x), train=False))
+        theirs = tc(torch.tensor(x.transpose(0, 3, 1, 2))) \
+            .detach().numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_maxpool_matches_torch(self):
+        import torch
+        x = np.random.default_rng(2).normal(size=(2, 6, 6, 3)) \
+            .astype(np.float32)
+        m = zk.Sequential().add(L.MaxPooling2D(pool_size=2))
+        v, ours = _init_apply(m, jnp.asarray(x))
+        theirs = torch.nn.functional.max_pool2d(
+            torch.tensor(x.transpose(0, 3, 1, 2)), 2) \
+            .numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-6)
+
+    def test_batchnorm_inference_matches_torch(self):
+        import torch
+        x = np.random.default_rng(3).normal(size=(8, 5)).astype(np.float32)
+        m = zk.Sequential().add(L.BatchNormalization(epsilon=1e-5))
+        v, ours = _init_apply(m, jnp.asarray(x))
+        tb = torch.nn.BatchNorm1d(5, eps=1e-5).eval()
+        theirs = tb(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(np.asarray(ours), theirs,
+                                   rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# layer shapes / behaviors
+# ---------------------------------------------------------------------------
+
+
+class TestLayerShapes:
+    @pytest.mark.parametrize("layer,in_shape,out_shape", [
+        (L.Flatten(), (2, 3, 4), (2, 12)),
+        (L.Reshape(target_shape=(4, 3)), (2, 3, 4), (2, 4, 3)),
+        (L.Permute(dims=(2, 1)), (2, 3, 4), (2, 4, 3)),
+        (L.RepeatVector(n=5), (2, 3), (2, 5, 3)),
+        (L.UpSampling1D(length=2), (2, 3, 4), (2, 6, 4)),
+        (L.UpSampling2D(size=(2, 2)), (2, 3, 3, 1), (2, 6, 6, 1)),
+        (L.ZeroPadding1D(padding=1), (2, 3, 4), (2, 5, 4)),
+        (L.ZeroPadding2D(padding=(1, 2)), (2, 3, 3, 1), (2, 5, 7, 1)),
+        (L.Cropping1D(cropping=(1, 1)), (2, 5, 4), (2, 3, 4)),
+        (L.Cropping2D(cropping=((1, 1), (0, 1))), (2, 5, 5, 1), (2, 3, 4, 1)),
+        (L.GlobalMaxPooling1D(), (2, 5, 4), (2, 4)),
+        (L.GlobalAveragePooling2D(), (2, 5, 5, 3), (2, 3)),
+        (L.MaxoutDense(output_dim=6, nb_feature=3), (2, 4), (2, 6)),
+        (L.Highway(), (2, 4), (2, 4)),
+        (L.PReLU(), (2, 4), (2, 4)),
+        (L.LeakyReLU(), (2, 4), (2, 4)),
+        (L.LocallyConnected1D(nb_filter=3, filter_length=2), (2, 5, 4),
+         (2, 4, 3)),
+        (L.LocallyConnected2D(nb_filter=3, nb_row=2, nb_col=2), (2, 4, 4, 2),
+         (2, 3, 3, 3)),
+        (L.SeparableConvolution2D(nb_filter=4, nb_row=3, nb_col=3),
+         (2, 6, 6, 2), (2, 4, 4, 4)),
+        (L.Deconvolution2D(nb_filter=2, nb_row=3, nb_col=3, subsample=(2, 2)),
+         (2, 4, 4, 3), (2, 9, 9, 2)),
+        (L.Convolution3D(2, 2, 2, 2), (1, 4, 4, 4, 1), (1, 3, 3, 3, 2)),
+        (L.MaxPooling3D(pool_size=2), (1, 4, 4, 4, 2), (1, 2, 2, 2, 2)),
+    ])
+    def test_shape(self, layer, in_shape, out_shape):
+        x = jnp.ones(in_shape)
+        m = zk.Sequential().add(layer)
+        _, out = _init_apply(m, x)
+        assert out.shape == out_shape, type(layer).__name__
+
+    def test_rnn_shapes(self):
+        x = jnp.ones((2, 7, 5))
+        for cls in (L.SimpleRNN, L.LSTM, L.GRU):
+            m = zk.Sequential().add(cls(output_dim=6))
+            _, out = _init_apply(m, x)
+            assert out.shape == (2, 6), cls.__name__
+            m2 = zk.Sequential().add(cls(output_dim=6, return_sequences=True))
+            _, seq = _init_apply(m2, x)
+            assert seq.shape == (2, 7, 6), cls.__name__
+
+    def test_bidirectional_and_timedistributed(self):
+        x = jnp.ones((2, 7, 5))
+        m = zk.Sequential().add(
+            L.Bidirectional(layer=L.LSTM(output_dim=4,
+                                         return_sequences=True)))
+        _, out = _init_apply(m, x)
+        assert out.shape == (2, 7, 8)
+        m2 = zk.Sequential().add(L.TimeDistributed(layer=zk.Dense(3)))
+        _, out2 = _init_apply(m2, x)
+        assert out2.shape == (2, 7, 3)
+
+    def test_convlstm2d(self):
+        x = jnp.ones((2, 3, 6, 6, 2))
+        m = zk.Sequential().add(L.ConvLSTM2D(nb_filter=4))
+        _, out = _init_apply(m, x)
+        assert out.shape == (2, 6, 6, 4)
+
+    def test_embedding(self):
+        x = jnp.array([[1, 2], [3, 0]])
+        m = zk.Sequential().add(L.Embedding(input_dim=10, output_dim=4))
+        _, out = _init_apply(m, x)
+        assert out.shape == (2, 2, 4)
+
+    def test_dropout_train_vs_eval(self):
+        x = jnp.ones((64, 32))
+        m = zk.Sequential().add(L.Dropout(p=0.5))
+        v = m.init({"params": jax.random.key(0)}, x, train=False)
+        eval_out = m.apply(v, x, train=False)
+        np.testing.assert_allclose(np.asarray(eval_out), np.ones((64, 32)))
+        train_out = m.apply(v, x, train=True,
+                            rngs={"dropout": jax.random.key(1)})
+        assert np.asarray(train_out).min() == 0.0  # some dropped
+
+    def test_masking(self):
+        x = jnp.array([[[0., 0.], [1., 2.]]])
+        m = zk.Sequential().add(L.Masking(mask_value=0.0))
+        _, out = _init_apply(m, x)
+        np.testing.assert_allclose(np.asarray(out)[0, 0], [0., 0.])
+        np.testing.assert_allclose(np.asarray(out)[0, 1], [1., 2.])
+
+    def test_merge_modes(self):
+        a, b = jnp.ones((2, 3)), 2 * jnp.ones((2, 3))
+        for mode, expect in [("sum", 3.0), ("mul", 2.0), ("ave", 1.5),
+                             ("max", 2.0), ("min", 1.0)]:
+            m = L.Merge(mode=mode)
+            out = zk.Sequential().add(m)
+            v, y = _init_apply(out, [a, b])
+            assert float(np.asarray(y)[0, 0]) == expect, mode
+
+
+# ---------------------------------------------------------------------------
+# topology engine
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_functional_shared_layer_params(self):
+        a, b = zk.Input(shape=(5,)), zk.Input(shape=(5,))
+        shared = zk.Dense(6)
+        y = zk.merge([shared(a), shared(b)], mode="sum")
+        net = zk.Model(input=[a, b], output=y)
+        x = jnp.ones((3, 5))
+        v = net.init({"params": jax.random.key(0)}, x, x, train=False)
+        # one shared Dense -> exactly one param subtree
+        assert list(v["params"].keys()) == ["ops_0"]
+        out = net.apply(v, x, x, train=False)
+        assert out.shape == (3, 6)
+
+    def test_nested_sequential_in_model(self):
+        a = zk.Input(shape=(4,))
+        tower = zk.Sequential().add(zk.Dense(8, activation="relu")) \
+                               .add(zk.Dense(2))
+        net = zk.Model(input=a, output=tower(a))
+        x = jnp.ones((2, 4))
+        v = net.init({"params": jax.random.key(0)}, x, train=False)
+        assert net.apply(v, x, train=False).shape == (2, 2)
+
+    def test_sequential_fit_learns(self, ctx8):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(256, 10)).astype(np.float32)
+        Y = (X @ rng.normal(size=(10,)) > 0).astype(np.int32)
+        m = zk.Sequential().add(zk.Dense(16, activation="relu")) \
+                           .add(zk.Dense(2))
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], lr=1e-2)
+        hist = m.fit(X, Y, batch_size=64, nb_epoch=5)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        assert hist[-1]["accuracy"] > 0.7
+        ev = m.evaluate(X, Y, batch_size=64)
+        assert "accuracy" in ev
+        assert m.predict_classes(X[:8]).shape == (8,)
+
+    def test_regularizer_penalty(self):
+        from analytics_zoo_tpu.keras.engine import collect_penalty
+        m = zk.Sequential().add(zk.Dense(4, W_regularizer=zk.l2(0.1)))
+        v, _ = _init_apply(m, jnp.ones((2, 3)))
+        pen = collect_penalty(m, v["params"])
+        k = v["params"]["layers_0"]["Dense_0"]["kernel"]
+        np.testing.assert_allclose(
+            float(pen), 0.1 * float(jnp.sum(jnp.square(k))), rtol=1e-5)
+
+    def test_save_load_roundtrip(self, tmp_path, ctx8):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 6)).astype(np.float32)
+        Y = rng.normal(size=(64, 1)).astype(np.float32)
+        m = zk.Sequential().add(zk.Dense(8, activation="tanh")) \
+                           .add(zk.Dense(1))
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(X, Y, batch_size=32, nb_epoch=1)
+        pred = m.predict(X[:10])
+        m.save(str(tmp_path / "model"))
+        m2 = zk.KerasNet.load(str(tmp_path / "model"), sample_x=X[:4])
+        np.testing.assert_allclose(m2.predict(X[:10]), pred, atol=1e-5)
+
+    def test_get_set_weights(self, ctx8):
+        X = np.ones((32, 4), np.float32)
+        Y = np.zeros((32, 1), np.float32)
+        m = zk.Sequential().add(zk.Dense(3)).add(zk.Dense(1))
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(X, Y, batch_size=32, nb_epoch=1)
+        ws = m.get_weights()
+        zeroed = [np.zeros_like(w) for w in ws]
+        m.set_weights(zeroed)
+        np.testing.assert_allclose(m.predict(X[:4]), 0.0, atol=1e-6)
+        m.set_weights(ws)
+
+
+# ---------------------------------------------------------------------------
+# autograd
+# ---------------------------------------------------------------------------
+
+
+class TestAutograd:
+    def test_custom_loss_numeric(self):
+        loss = A.custom_loss(lambda yt, yp: A.mean(A.abs(yt - yp), axis=-1))
+        p = np.array([[1., 2.], [3., 4.]], np.float32)
+        t = np.array([[0., 2.], [4., 4.]], np.float32)
+        np.testing.assert_allclose(
+            float(loss(p, t)), np.mean(np.abs(p - t)), rtol=1e-6)
+
+    def test_operators(self):
+        x = A.Variable.placeholder("x")
+        expr = A.clip(A.square(x) + 2 * x - 1, -10, 10)
+        val = expr.eval({x: jnp.array([1.0, 2.0])})
+        np.testing.assert_allclose(np.asarray(val), [2.0, 7.0])
+
+    def test_custom_layer_with_parameter(self):
+        x = A.Variable.placeholder("x")
+        w = A.Parameter((3, 2), init_weight=np.ones((3, 2), np.float32))
+        layer = A.CustomLayer(out_var=A.mm(x, w), in_vars=(x,))
+        v = layer.init({"params": jax.random.key(0)}, jnp.ones((4, 3)))
+        out = layer.apply(v, jnp.ones((4, 3)))
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+
+    def test_custom_loss_in_fit(self, ctx8):
+        loss = A.custom_loss(lambda yt, yp: A.mean(A.square(yt - yp)))
+        X = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+        Y = np.zeros((64, 1), np.float32)
+        m = zk.Sequential().add(zk.Dense(1))
+        m.compile(optimizer="adam", loss=loss, lr=1e-2)
+        hist = m.fit(X, Y, batch_size=32, nb_epoch=3)
+        assert hist[-1]["loss"] < hist[0]["loss"]
