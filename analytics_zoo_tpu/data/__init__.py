@@ -4,6 +4,7 @@ from analytics_zoo_tpu.data.readers import (
 from analytics_zoo_tpu.data.loader import (
     NumpyBatchIterator, shards_to_iterator, make_global_batch,
     device_prefetch, DataCreator)
+from analytics_zoo_tpu.data.feature_set import FeatureSet, DiskFeatureSet
 
 # reference-parity namespace: zoo.orca.data.pandas.read_csv
 from analytics_zoo_tpu.data import readers as pandas  # noqa: F401
@@ -13,4 +14,5 @@ __all__ = [
     "read_csv", "read_json", "read_parquet", "from_ndarrays",
     "NumpyBatchIterator", "shards_to_iterator", "make_global_batch",
     "device_prefetch", "DataCreator", "pandas",
+    "FeatureSet", "DiskFeatureSet",
 ]

@@ -1,0 +1,194 @@
+"""FeatureSet: cached training sets with pluggable memory tiers.
+
+Reference (SURVEY.md §2.2, ref: zoo feature/dataset/ — FeatureSet,
+DRAMFeatureSet, PmemFeatureSet over memkind JNI, DiskFeatureSet): the Scala
+side caches the training set in a chosen memory tier and exposes a minibatch
+stream to the optimizer.
+
+TPU rebuild: the tiers become
+  * DRAM    — host-RAM dict of ndarrays (the default; analog of
+              DRAMFeatureSet),
+  * DISK    — a ZREC record file of packed row-blocks read by the native
+              C++ prefetch thread through a ring buffer (analog of
+              PmemFeatureSet/DiskFeatureSet: capacity beyond RAM at
+              near-sequential-IO speed, with the copy loop off the GIL).
+
+Both tiers yield per-host batch dicts; `device_stream` composes with
+`loader.device_prefetch` for the HBM double-buffer stage.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.data.loader import NumpyBatchIterator, device_prefetch
+from analytics_zoo_tpu.data.shards import XShards
+
+BLOCK_ROWS_DEFAULT = 4096
+
+
+class FeatureSet:
+    """DRAM-tier feature set (ref: FeatureSet.rdd / DRAMFeatureSet)."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        lens = {k: len(v) for k, v in arrays.items()}
+        if len(set(lens.values())) > 1:
+            raise ValueError(f"ragged arrays: {lens}")
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_arrays(arrays: Dict[str, np.ndarray]) -> "FeatureSet":
+        return FeatureSet(arrays)
+
+    @staticmethod
+    def from_shards(shards: XShards) -> "FeatureSet":
+        return FeatureSet(shards.to_numpy_dict())
+
+    # -- API ---------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(next(iter(self.arrays.values()))) if self.arrays else 0
+
+    def batches(self, batch_size: int, *, shuffle: bool = True,
+                drop_remainder: bool = True, seed: int = 0, epoch: int = 0
+                ) -> Iterator[Dict[str, np.ndarray]]:
+        it = NumpyBatchIterator(self.arrays, batch_size, shuffle=shuffle,
+                                drop_remainder=drop_remainder, seed=seed)
+        it.epoch = epoch
+        return it.epoch_batches()
+
+    def device_stream(self, mesh, batch_size: int, *, depth: int = 2,
+                      sharding=None, **kw):
+        return device_prefetch(self.batches(batch_size, **kw), mesh,
+                               depth=depth, sharding=sharding)
+
+    def to_disk(self, path: Optional[str] = None,
+                block_rows: int = BLOCK_ROWS_DEFAULT) -> "DiskFeatureSet":
+        """Spill to the DISK tier: write row-blocks to a ZREC record file."""
+        from analytics_zoo_tpu import native
+
+        if path is None:
+            fd, path = tempfile.mkstemp(suffix=".zrec")
+            os.close(fd)
+        n = len(self)
+        with native.RecordWriter(path) as w:
+            for lo in range(0, n, block_rows):
+                block = {k: v[lo:lo + block_rows]
+                         for k, v in self.arrays.items()}
+                w.write(native.pack_batch(block))
+        return DiskFeatureSet(path)
+
+
+class DiskFeatureSet:
+    """DISK-tier feature set over a ZREC file (ref: DiskFeatureSet /
+    PmemFeatureSet — memory tier beyond DRAM, zoo feature/pmem/).
+
+    Row-blocks are streamed by a *native* reader thread into a ring buffer
+    (file IO + memcpy run in C++ while JAX computes), then re-batched to the
+    requested batch size in numpy.  Block order is shuffled per epoch;
+    intra-block order is preserved (the reference's PMEM path likewise
+    shuffles at the chunk level).
+    """
+
+    def __init__(self, path: str, *, ring_mb: int = 128):
+        from analytics_zoo_tpu import native
+
+        self.path = path
+        self._native = native
+        self.reader = native.RecordReader(path)
+        self.ring_bytes = ring_mb << 20
+        meta = native.unpack_batch(self.reader.get(0)) if len(self.reader) \
+            else {}
+        self.colnames = sorted(meta)
+        self._block_rows = len(next(iter(meta.values()))) if meta else 0
+        # total rows: full blocks + (possibly short) last block
+        nblocks = len(self.reader)
+        if nblocks:
+            last = native.unpack_batch(self.reader.get(nblocks - 1))
+            self._n = (nblocks - 1) * self._block_rows \
+                + len(next(iter(last.values())))
+        else:
+            self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def batches(self, batch_size: int, *, shuffle: bool = True,
+                drop_remainder: bool = True, seed: int = 0, epoch: int = 0
+                ) -> Iterator[Dict[str, np.ndarray]]:
+        if batch_size > self._n:
+            # match the DRAM tier's NumpyBatchIterator contract — a silent
+            # zero-batch epoch would look like training while doing nothing
+            raise ValueError(
+                f"per-host batch {batch_size} > host rows {self._n}")
+        native = self._native
+        nblocks = len(self.reader)
+        order = np.arange(nblocks)
+        if shuffle:
+            np.random.default_rng(seed + epoch).shuffle(order)
+        ring = native.RingBuffer(self.ring_bytes)
+        pf = native.Prefetcher(self.reader, ring, order.tolist(), loop=False)
+        try:
+            # Deque of blocks + a row cursor into the head block: each output
+            # batch concatenates exactly the slices it needs (linear copies —
+            # no re-concatenation of the whole pending buffer per batch).
+            import collections
+
+            pend: collections.deque = collections.deque()
+            head_off = 0
+            pend_rows = 0
+
+            def emit(n):
+                nonlocal head_off, pend_rows
+                pieces: Dict[str, list] = {}
+                need = n
+                while need:
+                    block = pend[0]
+                    blen = len(next(iter(block.values()))) - head_off
+                    take = min(need, blen)
+                    for k, v in block.items():
+                        pieces.setdefault(k, []).append(
+                            v[head_off:head_off + take])
+                    need -= take
+                    if take == blen:
+                        pend.popleft()
+                        head_off = 0
+                    else:
+                        head_off += take
+                pend_rows -= n
+                return {k: np.concatenate(v) if len(v) > 1 else v[0]
+                        for k, v in pieces.items()}
+
+            while True:
+                blob = ring.pop()
+                if blob is None:
+                    break
+                block = native.unpack_batch(blob)
+                pend.append(block)
+                pend_rows += len(next(iter(block.values())))
+                while pend_rows >= batch_size:
+                    yield emit(batch_size)
+            if pend_rows and not drop_remainder:
+                yield emit(pend_rows)
+        finally:
+            ring.close()
+            pf.stop()
+
+    def device_stream(self, mesh, batch_size: int, *, depth: int = 2,
+                      sharding=None, **kw):
+        return device_prefetch(self.batches(batch_size, **kw), mesh,
+                               depth=depth, sharding=sharding)
+
+    def to_dram(self) -> FeatureSet:
+        cols: Dict[str, list] = {}
+        for i in range(len(self.reader)):
+            for k, v in self._native.unpack_batch(self.reader.get(i)).items():
+                cols.setdefault(k, []).append(v)
+        return FeatureSet({k: np.concatenate(v) for k, v in cols.items()})
+
+    def close(self):
+        self.reader.close()

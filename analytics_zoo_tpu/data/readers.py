@@ -61,14 +61,38 @@ def _read_files(reader: Callable, path, shards_per_host, host_index,
     return xs
 
 
-def read_csv(path, shards_per_host: Optional[int] = None, *,
-             host_index: Optional[int] = None,
-             num_hosts: Optional[int] = None, **pandas_kwargs) -> XShards:
-    """ref-parity: zoo.orca.data.pandas.read_csv."""
+def _read_csv_one(path, backend: str = "auto", **pandas_kwargs):
+    """One CSV file -> pandas DataFrame.
+
+    backend="native" uses the C++ multithreaded parser (numeric CSVs only;
+    the Spark-parallel-ingest replacement — SURVEY.md §2.2); "pandas" always
+    uses pandas; "auto" tries native and falls back on non-numeric content,
+    pandas-specific kwargs, or a missing toolchain.
+    """
     import pandas as pd
 
-    return _read_files(pd.read_csv, path, shards_per_host, host_index,
-                       num_hosts, **pandas_kwargs)
+    if backend == "native" and pandas_kwargs:
+        raise ValueError(
+            f"backend='native' does not accept pandas kwargs "
+            f"{sorted(pandas_kwargs)}; use backend='pandas' or 'auto'")
+    if backend != "pandas" and not pandas_kwargs:
+        try:
+            from analytics_zoo_tpu import native
+
+            return pd.DataFrame(native.read_csv_native(path))
+        except Exception:
+            if backend == "native":
+                raise
+    return pd.read_csv(path, **pandas_kwargs)
+
+
+def read_csv(path, shards_per_host: Optional[int] = None, *,
+             host_index: Optional[int] = None,
+             num_hosts: Optional[int] = None, backend: str = "auto",
+             **pandas_kwargs) -> XShards:
+    """ref-parity: zoo.orca.data.pandas.read_csv."""
+    return _read_files(_read_csv_one, path, shards_per_host, host_index,
+                       num_hosts, backend=backend, **pandas_kwargs)
 
 
 def read_json(path, shards_per_host: Optional[int] = None, *,

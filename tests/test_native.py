@@ -1,0 +1,304 @@
+"""Native data plane: ring buffer, CSV parser, ZREC store, FeatureSet tiers.
+
+Mirrors the reference's feature/dataset + feature/pmem test surface
+(SURVEY.md §2.2/§4): tier round-trips, minibatch stream correctness, and
+parallel-ingest parity against pandas.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+
+# -- ring buffer ------------------------------------------------------------
+
+def test_ring_buffer_fifo():
+    rb = native.RingBuffer(1 << 20)
+    rb.push(b"a" * 10)
+    rb.push(b"bb")
+    assert rb.depth() == 2 and rb.nbytes() == 12
+    assert rb.pop() == b"a" * 10
+    assert rb.pop() == b"bb"
+    rb.close()
+    assert rb.pop() is None
+
+
+def test_ring_buffer_blocks_producer_until_consumed():
+    rb = native.RingBuffer(capacity_bytes=100)
+    rb.push(b"x" * 80)
+    assert not rb.push(b"y" * 80, timeout=0.05)  # full -> times out
+    got = []
+
+    def consumer():
+        for _ in range(2):
+            got.append(rb.pop())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    assert rb.push(b"y" * 80, timeout=5)  # unblocks once consumer drains
+    t.join(timeout=5)
+    assert got == [b"x" * 80, b"y" * 80]
+
+
+def test_ring_buffer_threaded_roundtrip():
+    rb = native.RingBuffer(1 << 16)  # small ring forces backpressure
+    items = [os.urandom(np.random.default_rng(i).integers(1, 2000))
+             for i in range(200)]
+
+    def producer():
+        for it in items:
+            rb.push(it)
+        rb.close()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    out = []
+    while (x := rb.pop()) is not None:
+        out.append(x)
+    t.join()
+    assert out == items
+
+
+def test_ring_buffer_oversized_item_rejected():
+    rb = native.RingBuffer(capacity_bytes=10)
+    with pytest.raises(ValueError):
+        rb.push(b"z" * 11)
+
+
+# -- CSV --------------------------------------------------------------------
+
+def test_native_csv_matches_pandas(tmp_path):
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "user": rng.integers(0, 1000, 5000),
+        "item": rng.integers(0, 500, 5000),
+        "rating": rng.random(5000).round(3),
+        "neg": -rng.random(5000) * 1e6,
+    })
+    p = tmp_path / "t.csv"
+    df.to_csv(p, index=False)
+    cols = native.read_csv_native(str(p))
+    assert list(cols) == list(df.columns)
+    for c in df.columns:
+        np.testing.assert_allclose(cols[c], df[c].to_numpy(), rtol=1e-12)
+
+
+def test_native_csv_empty_fields_and_crlf(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_bytes(b"a,b\r\n1,\r\n,2\r\n")
+    cols = native.read_csv_native(str(p))
+    np.testing.assert_equal(cols["a"], [1, np.nan])
+    np.testing.assert_equal(cols["b"], [np.nan, 2])
+
+
+def test_native_csv_dtype_parity_with_pandas(tmp_path):
+    """int literals -> int64 (lossless), floats/empties -> float64."""
+    p = tmp_path / "t.csv"
+    big = 9007199254740995  # > 2^53: corrupted by a double round-trip
+    p.write_text(f"i,f,m\n1,1.5,{big}\n-2,2,{big + 1}\n")
+    cols = native.read_csv_native(str(p))
+    ref = pd.read_csv(p)
+    assert cols["i"].dtype == ref["i"].dtype == np.int64
+    assert cols["f"].dtype == ref["f"].dtype == np.float64
+    assert cols["m"].tolist() == ref["m"].tolist() == [big, big + 1]
+
+
+def test_native_csv_rejects_out_of_range_int_and_hex(tmp_path):
+    """Values pandas keeps exact/as-strings must not silently degrade."""
+    p = tmp_path / "big.csv"
+    p.write_text("a\n18446744073709551615\n")  # uint64 max > int64 max
+    with pytest.raises(ValueError):
+        native.read_csv_native(str(p))
+    p2 = tmp_path / "hex.csv"
+    p2.write_text("a\n0x1A\n")  # strtod would parse this as 26.0
+    with pytest.raises(ValueError):
+        native.read_csv_native(str(p2))
+    # auto backend falls back to pandas and preserves the exact value
+    from analytics_zoo_tpu import data as zdata
+
+    xs = zdata.read_csv(str(p), num_hosts=1, host_index=0)
+    assert int(xs.collect()[0]["a"].iloc[0]) == 18446744073709551615
+
+
+def test_disk_tier_batch_larger_than_rows_raises(tmp_path):
+    from analytics_zoo_tpu.data import FeatureSet
+
+    dfs = FeatureSet.from_arrays(_arrays(64)).to_disk(
+        str(tmp_path / "s.zrec"), block_rows=32)
+    with pytest.raises(ValueError, match="> host rows"):
+        next(dfs.batches(128))
+    dfs.close()
+
+
+def test_native_csv_duplicate_header_rejected(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("a,b,a\n1,2,3\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        native.read_csv_native(str(p))
+
+
+def test_read_csv_native_backend_rejects_pandas_kwargs(tmp_path):
+    from analytics_zoo_tpu import data as zdata
+
+    p = tmp_path / "t.csv"
+    p.write_text("x,y\n1,2\n")
+    with pytest.raises(ValueError, match="pandas kwargs"):
+        zdata.read_csv(str(p), backend="native", usecols=["x"],
+                       num_hosts=1, host_index=0)
+
+
+def test_native_csv_rejects_text(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("a,b\n1,hello\n")
+    with pytest.raises(ValueError):
+        native.read_csv_native(str(p))
+
+
+def test_read_csv_auto_backend_falls_back(tmp_path):
+    """data.read_csv(auto): native for numeric files, pandas for text."""
+    from analytics_zoo_tpu import data as zdata
+
+    num, txt = tmp_path / "n.csv", tmp_path / "s.csv"
+    num.write_text("x,y\n1,2\n3,4\n")
+    txt.write_text("x,name\n1,alice\n2,bob\n")
+    xs = zdata.read_csv(str(num), num_hosts=1, host_index=0)
+    assert xs.to_numpy_dict()["x"].tolist() == [1, 3]
+    xs2 = zdata.read_csv(str(txt), num_hosts=1, host_index=0)
+    assert list(xs2.collect()[0]["name"]) == ["alice", "bob"]
+
+
+# -- record store -----------------------------------------------------------
+
+def test_zrec_roundtrip(tmp_path):
+    p = str(tmp_path / "r.zrec")
+    recs = [b"", b"x", os.urandom(10_000), b"end"]
+    with native.RecordWriter(p) as w:
+        for r in recs:
+            w.write(r)
+    with native.RecordReader(p) as rd:
+        assert len(rd) == len(recs)
+        for i, r in enumerate(recs):
+            assert rd.get_bytes(i) == r
+
+
+def test_zrec_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.zrec"
+    p.write_bytes(b"not a record file, definitely not" * 4)
+    with pytest.raises(IOError):
+        native.RecordReader(str(p))
+
+
+def test_pack_unpack_batch():
+    b = {"x": np.arange(12, dtype=np.float32).reshape(3, 4),
+         "y": np.array([1, 2, 3], dtype=np.int64),
+         "s": np.float64(3.5)}
+    out = native.unpack_batch(native.pack_batch(b))
+    assert set(out) == set(b)
+    np.testing.assert_array_equal(out["x"], b["x"])
+    np.testing.assert_array_equal(out["y"], b["y"])
+    assert out["s"] == 3.5 and out["x"].dtype == np.float32
+
+
+def test_prefetcher_oversized_record_closes_ring(tmp_path):
+    """A record bigger than the ring must end the stream, not hang it."""
+    p = str(tmp_path / "r.zrec")
+    with native.RecordWriter(p) as w:
+        w.write(b"z" * 4096)
+    rd = native.RecordReader(p)
+    ring = native.RingBuffer(capacity_bytes=100)
+    pf = native.Prefetcher(rd, ring, [0])
+    assert ring.pop(timeout=10) is None  # closed, not deadlocked
+    pf.stop()
+
+
+def test_prefetcher_streams_in_order(tmp_path):
+    p = str(tmp_path / "r.zrec")
+    recs = [f"rec{i}".encode() for i in range(50)]
+    with native.RecordWriter(p) as w:
+        for r in recs:
+            w.write(r)
+    rd = native.RecordReader(p)
+    ring = native.RingBuffer(1 << 16)
+    order = list(reversed(range(50)))
+    pf = native.Prefetcher(rd, ring, order)
+    out = []
+    while (x := ring.pop(timeout=10)) is not None:
+        out.append(x)
+    pf.stop()
+    assert out == [recs[i] for i in order]
+
+
+# -- FeatureSet tiers -------------------------------------------------------
+
+def _arrays(n=1000):
+    rng = np.random.default_rng(1)
+    return {"user": rng.integers(0, 100, n).astype(np.int32),
+            "label": rng.random(n).astype(np.float32)}
+
+
+def test_feature_set_dram_batches():
+    from analytics_zoo_tpu.data import FeatureSet
+
+    fs = FeatureSet.from_arrays(_arrays(100))
+    batches = list(fs.batches(32, shuffle=False))
+    assert len(batches) == 3
+    np.testing.assert_array_equal(
+        np.concatenate([b["user"] for b in batches]), fs.arrays["user"][:96])
+
+
+def test_feature_set_disk_tier_roundtrip(tmp_path):
+    from analytics_zoo_tpu.data import FeatureSet
+
+    arr = _arrays(1000)
+    fs = FeatureSet.from_arrays(arr)
+    dfs = fs.to_disk(str(tmp_path / "fs.zrec"), block_rows=128)
+    assert len(dfs) == 1000
+    # unshuffled stream reproduces rows exactly
+    got = list(dfs.batches(250, shuffle=False))
+    assert len(got) == 4
+    np.testing.assert_array_equal(
+        np.concatenate([b["user"] for b in got]), arr["user"])
+    np.testing.assert_array_equal(
+        np.concatenate([b["label"] for b in got]), arr["label"])
+    # shuffled epoch is a permutation, and deterministic per seed
+    a = np.concatenate([b["user"] for b in dfs.batches(100, seed=7)])
+    b = np.concatenate([b["user"] for b in dfs.batches(100, seed=7)])
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.sort(a), np.sort(arr["user"]))
+    dfs.close()
+
+
+def test_feature_set_disk_remainder_and_dram_roundtrip(tmp_path):
+    from analytics_zoo_tpu.data import FeatureSet
+
+    arr = _arrays(130)
+    dfs = FeatureSet.from_arrays(arr).to_disk(
+        str(tmp_path / "f.zrec"), block_rows=64)
+    got = list(dfs.batches(50, shuffle=False, drop_remainder=False))
+    assert [len(b["user"]) for b in got] == [50, 50, 30]
+    back = dfs.to_dram()
+    np.testing.assert_array_equal(back.arrays["label"], arr["label"])
+    dfs.close()
+
+
+def test_feature_set_device_stream():
+    import jax
+
+    from analytics_zoo_tpu.data import FeatureSet
+    from analytics_zoo_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(axes={"dp": len(jax.devices())})
+    fs = FeatureSet.from_arrays(_arrays(64))
+    outs = list(fs.device_stream(mesh, 16, shuffle=False))
+    assert len(outs) == 4
+    assert all(isinstance(b["user"], jax.Array) for b in outs)
+    np.testing.assert_array_equal(
+        np.asarray(outs[0]["user"]), fs.arrays["user"][:16])
