@@ -24,9 +24,6 @@ __all__ = [
     "softmax", "softsign", "softplus", "l2_normalize", "epsilon",
 ]
 
-_py_abs, _py_sum, _py_pow = abs, sum, pow
-
-
 class Variable:
     """A symbolic array expression: composes a pure function env -> jnp."""
 

@@ -31,6 +31,27 @@ __all__ = ["KTensor", "Input", "Sequential", "Model", "KerasNet",
            "symbolic", "merge"]
 
 
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def _natural_key(s: str):
+    import re
+
+    return tuple(int(t) if t.isdigit() else t
+                 for t in re.split(r"(\d+)", s))
+
+
+def _ordered_params(params) -> List[Tuple[str, Any]]:
+    """(path, leaf) pairs in natural (digit-aware) path order, so
+    layers_2 sorts before layers_10."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    items = [(_path_str(p), leaf) for p, leaf in flat]
+    items.sort(key=lambda kv: _natural_key(kv[0]))
+    return items
+
+
 # ---------------------------------------------------------------------------
 # symbolic graph
 # ---------------------------------------------------------------------------
@@ -241,25 +262,30 @@ class KerasNet(nn.Module):
         return np.argmax(self.predict(x, batch_size), axis=-1)
 
     # -- weights ---------------------------------------------------------
+    # get/set_weights expose params as a flat list in LAYER order.  Plain
+    # tree.leaves would sort dict keys lexicographically ("layers_10" <
+    # "layers_2"), silently scrambling >=10-layer stacks — so paths are
+    # ordered with a natural (digit-aware) sort.
 
     def get_weights(self) -> List[np.ndarray]:
         est = getattr(self, "_estimator", None)
         if est is None or est.state is None:
             raise RuntimeError("model has no weights yet (fit/build first)")
-        return [np.asarray(w) for w in jax.tree.leaves(est.state.params)]
+        return [np.asarray(w) for _, w in _ordered_params(est.state.params)]
 
     def set_weights(self, weights: Sequence[np.ndarray]):
         est = getattr(self, "_estimator", None)
         if est is None or est.state is None:
             raise RuntimeError("model has no weights yet (fit/build first)")
-        tdef = jax.tree.structure(est.state.params)
-        leaves = jax.tree.leaves(est.state.params)
-        if len(weights) != len(leaves):
-            raise ValueError(f"expected {len(leaves)} arrays, got "
+        items = _ordered_params(est.state.params)
+        if len(weights) != len(items):
+            raise ValueError(f"expected {len(items)} arrays, got "
                              f"{len(weights)}")
-        new = [jnp.asarray(w).reshape(l.shape)
-               for w, l in zip(weights, leaves)]
-        est.state = est.state.replace(params=jax.tree.unflatten(tdef, new))
+        by_path = {p: jnp.asarray(w).reshape(l.shape)
+                   for (p, l), w in zip(items, weights)}
+        new = jax.tree_util.tree_map_with_path(
+            lambda p, l: by_path[_path_str(p)], est.state.params)
+        est.state = est.state.replace(params=new)
 
     def summary(self) -> str:
         lines = [f"{type(self).__name__}"]
@@ -281,6 +307,10 @@ class KerasNet(nn.Module):
             import flax.serialization as ser
             with open(os.path.join(path, "weights.msgpack"), "wb") as f:
                 f.write(ser.to_bytes({"params": est.state.params}))
+            spec = getattr(est, "sample_spec", None)
+            if spec:
+                with open(os.path.join(path, "input_spec.pkl"), "wb") as f:
+                    pickle.dump(spec, f)
 
     @staticmethod
     def load(path: str, sample_x=None) -> "KerasNet":
@@ -288,11 +318,28 @@ class KerasNet(nn.Module):
         with open(os.path.join(path, "topology.pkl"), "rb") as f:
             net: KerasNet = pickle.load(f)
         wpath = os.path.join(path, "weights.msgpack")
-        if os.path.exists(wpath) and sample_x is not None:
+        spath = os.path.join(path, "input_spec.pkl")
+        if os.path.exists(wpath):
             import flax.serialization as ser
-            est = net._get_estimator(
-                len(sample_x) if isinstance(sample_x, (list, tuple)) else 1)
-            est._ensure_state(net._as_dict(sample_x))
+            if sample_x is not None:
+                n_in = len(sample_x) if isinstance(sample_x, (list, tuple)) \
+                    else 1
+                est = net._get_estimator(n_in)
+                sample = net._as_dict(sample_x)
+            elif os.path.exists(spath):
+                # rebuild a dummy sample from the spec captured at save time
+                with open(spath, "rb") as f:
+                    spec = pickle.load(f)
+                sample = {c: np.zeros((1,) + tuple(shape), dtype=dt)
+                          for c, (shape, dt) in spec.items()}
+                est = net._get_estimator(
+                    len([c for c in sample if c != "y"]))
+            else:
+                raise ValueError(
+                    f"{path} has saved weights but no input spec; pass "
+                    "sample_x so the model can be rebuilt before restore "
+                    "(silently returning random weights would be worse)")
+            est._ensure_state(sample)
             with open(wpath, "rb") as f:
                 restored = ser.from_bytes(
                     {"params": est.state.params}, f.read())

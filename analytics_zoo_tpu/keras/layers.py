@@ -765,6 +765,7 @@ class _RecurrentBase(nn.Module):
     per-timestep python)."""
     output_dim: int = 0
     activation: Any = "tanh"
+    inner_activation: Any = "sigmoid"   # keras 1.2 gate activation
     return_sequences: bool = False
     go_backwards: bool = False
     dropout: float = 0.0          # input dropout (keras dropout_W)
@@ -773,12 +774,15 @@ class _RecurrentBase(nn.Module):
     _cell_kind = "simple"
 
     def _make_cell(self):
+        act = get_activation(self.activation)
+        gate = get_activation(self.inner_activation)
         if self._cell_kind == "lstm":
-            return nn.OptimizedLSTMCell(self.output_dim)
+            return nn.OptimizedLSTMCell(self.output_dim, gate_fn=gate,
+                                        activation_fn=act)
         if self._cell_kind == "gru":
-            return nn.GRUCell(self.output_dim)
-        return nn.SimpleCell(
-            self.output_dim, activation_fn=get_activation(self.activation))
+            return nn.GRUCell(self.output_dim, gate_fn=gate,
+                              activation_fn=act)
+        return nn.SimpleCell(self.output_dim, activation_fn=act)
 
     @nn.compact
     def __call__(self, x, train: bool = False):

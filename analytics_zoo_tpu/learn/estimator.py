@@ -245,6 +245,12 @@ class FlaxEstimator:
             return np.tile(v, (reps,) + (1,) * (v.ndim - 1))[:nb]
 
         feats = [jnp.asarray(rows(c)) for c in self.feature_cols]
+        # Per-column (row_shape, dtype) — lets save() persist enough to
+        # rebuild state on load without the caller resupplying sample data.
+        self.sample_spec = {
+            c: (tuple(np.asarray(sample_batch[c]).shape[1:]),
+                str(np.asarray(sample_batch[c]).dtype))
+            for c in sample_batch}
         kw = self._apply_kwargs(train=False)
 
         def init_fn():

@@ -97,7 +97,7 @@ class InferenceModel:
 
     # ---- predict -----------------------------------------------------
 
-    def _compiled(self, bucket: int, n_feats: int) -> Callable:
+    def _compiled(self) -> Callable:
         # one jit wrapper; jax's own per-shape trace cache (driven by the
         # bucket padding in predict) bounds compilations
         with self._compile_lock:
@@ -122,7 +122,7 @@ class InferenceModel:
                 return self._predict_chunked(inputs, bucket)
             padded.append(a)
         with self._sem:
-            out = self._compiled(bucket, len(inputs))(
+            out = self._compiled()(
                 self._variables, *padded)
         return jax.tree.map(lambda x: np.asarray(x)[:n], out)
 

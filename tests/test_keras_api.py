@@ -246,6 +246,70 @@ class TestEngine:
         m2 = zk.KerasNet.load(str(tmp_path / "model"), sample_x=X[:4])
         np.testing.assert_allclose(m2.predict(X[:10]), pred, atol=1e-5)
 
+    def test_save_load_without_sample_x(self, tmp_path, ctx8):
+        """load() restores weights from the saved input spec alone."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 6)).astype(np.float32)
+        Y = rng.normal(size=(64, 1)).astype(np.float32)
+        m = zk.Sequential().add(zk.Dense(8, activation="tanh")) \
+                           .add(zk.Dense(1))
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(X, Y, batch_size=32, nb_epoch=1)
+        pred = m.predict(X[:10])
+        m.save(str(tmp_path / "model"))
+        m2 = zk.KerasNet.load(str(tmp_path / "model"))
+        np.testing.assert_allclose(m2.predict(X[:10]), pred, atol=1e-5)
+
+    def test_load_without_spec_or_sample_raises(self, tmp_path, ctx8):
+        """A load that cannot restore saved weights must fail loudly."""
+        import os
+        X = np.ones((32, 4), np.float32)
+        Y = np.zeros((32, 1), np.float32)
+        m = zk.Sequential().add(zk.Dense(1))
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(X, Y, batch_size=32, nb_epoch=1)
+        m.save(str(tmp_path / "model"))
+        os.remove(tmp_path / "model" / "input_spec.pkl")
+        with pytest.raises(ValueError, match="sample_x"):
+            zk.KerasNet.load(str(tmp_path / "model"))
+
+    def test_get_set_weights_layer_order(self, ctx8):
+        """Weight lists follow layer order even past 10 layers
+        (lexicographic leaf order would put layers_10 before layers_2)."""
+        X = np.ones((32, 4), np.float32)
+        Y = np.zeros((32, 1), np.float32)
+        m = zk.Sequential()
+        for _ in range(11):
+            m.add(zk.Dense(4))
+        m.add(zk.Dense(1))
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(X, Y, batch_size=32, nb_epoch=1)
+        ws = m.get_weights()
+        # bias, kernel per layer; layer i's kernel is ws[2*i+1] in layer
+        # order.  Mark layer 2's kernel and check it round-trips to the
+        # same position after set_weights.
+        ws[2 * 2 + 1] = np.full_like(ws[2 * 2 + 1], 7.0)
+        m.set_weights(ws)
+        k2 = m._estimator.state.params["layers_2"]
+        leaf = jax.tree.leaves(k2)
+        assert any(np.allclose(np.asarray(x), 7.0) for x in leaf), \
+            "layer-2 kernel not written back to layer 2"
+        assert not any(
+            np.allclose(np.asarray(x), 7.0)
+            for x in jax.tree.leaves(m._estimator.state.params["layers_10"]))
+
+    def test_lstm_activation_respected(self, ctx8):
+        """LSTM(activation=...) must change the computed function."""
+        x = np.random.default_rng(0).normal(size=(2, 5, 3)) \
+            .astype(np.float32)
+        outs = []
+        for act in ("tanh", "relu"):
+            m = zk.LSTM(4, activation=act)
+            v, y = _init_apply(m, jnp.asarray(x))
+            outs.append(np.asarray(y))
+        assert not np.allclose(outs[0], outs[1]), \
+            "activation kwarg silently ignored"
+
     def test_get_set_weights(self, ctx8):
         X = np.ones((32, 4), np.float32)
         Y = np.zeros((32, 1), np.float32)
