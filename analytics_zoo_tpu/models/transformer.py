@@ -52,12 +52,15 @@ def _constrain_seq(x, mesh: Optional[Mesh]):
 
 
 class MultiHeadAttention(nn.Module):
-    """Self-attention; ring attention when the mesh has sp > 1."""
+    """Self-attention; ring attention when the mesh has sp > 1, the fused
+    Pallas flash kernel (ops.flash_attention) on single-sequence-shard TPU
+    runs, XLA full attention otherwise.  use_flash=None means auto."""
 
     num_heads: int
     head_dim: int
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
+    use_flash: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x, kv_mask=None, train: bool = False):
@@ -70,11 +73,26 @@ class MultiHeadAttention(nn.Module):
         if mesh is not None and "sp" in mesh.axis_names and \
                 mesh.shape["sp"] > 1:
             o = ring_self_attention(q, k, v, mesh, kv_mask, causal=False)
+        elif self._flash_ok(T):
+            from analytics_zoo_tpu.ops import (
+                flash_attention, sharded_flash_attention)
+            if mesh is not None and mesh.size > 1:
+                o = sharded_flash_attention(q, k, v, mesh, kv_mask,
+                                            causal=False)
+            else:
+                o = flash_attention(q, k, v, kv_mask, causal=False)
         else:
             o = full_attention(q, k, v, kv_mask, causal=False)
         o = nn.DenseGeneral(E, axis=(-2, -1), dtype=self.dtype,
                             name="attn_out")(o)
         return o
+
+    def _flash_ok(self, seq_len: int) -> bool:
+        if self.use_flash is not None:
+            return self.use_flash
+        # auto: fused kernel on real TPU runs; tiny sequences aren't worth
+        # the pallas dispatch and break the >=8-row block minimum
+        return jax.default_backend() == "tpu" and seq_len >= 64
 
 
 class TransformerLayer(nn.Module):
@@ -86,12 +104,14 @@ class TransformerLayer(nn.Module):
     dropout: float = 0.1
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
+    use_flash: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x, kv_mask=None, train: bool = False):
         H = self.num_heads
         D = self.hidden_size // H
         a = MultiHeadAttention(H, D, dtype=self.dtype, mesh=self.mesh,
+                               use_flash=self.use_flash,
                                name="attention")(x, kv_mask, train)
         a = nn.Dropout(self.dropout, deterministic=not train)(a)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + a)
@@ -119,6 +139,7 @@ class BERT(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
     remat: bool = False
+    use_flash: Optional[bool] = None
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
@@ -143,6 +164,7 @@ class BERT(nn.Module):
             x = layer_cls(self.hidden_size, self.num_heads,
                           self.intermediate_size, self.dropout,
                           dtype=self.dtype, mesh=self.mesh,
+                          use_flash=self.use_flash,
                           name=f"layer_{i}")(x, kv_mask, train)
         pooled = nn.tanh(nn.Dense(self.hidden_size, dtype=jnp.float32,
                                   name="pooler")(x[:, 0].astype(jnp.float32)))

@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.ops.flash_attention import (
+    flash_attention, sharded_flash_attention)
+
+__all__ = ["flash_attention", "sharded_flash_attention"]

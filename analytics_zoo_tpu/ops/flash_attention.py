@@ -1,0 +1,398 @@
+"""Flash attention — fused Pallas TPU kernel (fwd + custom-VJP bwd).
+
+No reference counterpart (the reference's TransformerLayer/BERT materialise
+full [T, T] score matrices on CPU — ref: zoo pipeline/api/keras/layers
+self_attention); this is TPU perf work the rebuild owns: the score matrix
+never hits HBM, softmax is computed online block-by-block in VMEM
+(O(T) memory instead of O(T^2)), and q·k / p·v ride the MXU in the operand
+dtype (bf16 in the transformer stack) with f32 accumulators.
+
+Kernel structure (canonical TPU flash): 3D grid — (batch*heads, q-blocks,
+k-blocks) with the k dimension marked ``arbitrary`` so Mosaic pipelines
+K/V block DMAs against compute; online-softmax state (running max, sum,
+accumulator) lives in VMEM scratch across the k iterations; outputs are
+written on the last k step.  Causal runs skip fully-masked blocks.
+
+Interface matches the model stack: q, k, v are [B, T, H, D]; optional
+``kv_mask`` [B, Tk] bool (True = attend) covers padding; ``causal`` adds the
+autoregressive mask.  On non-TPU backends the kernels run in Pallas
+interpret mode, so the same code path is unit-testable on the CPU mesh
+(SURVEY.md §4 single-box test doctrine).
+
+Layout notes (Mosaic): per-row stats (max / logsumexp / delta) are kept as
+[rows, 1] columns end-to-end — including the HBM residual, shaped
+[B*H, T, 1] — so no row->column relayout is ever needed; the key mask is
+[B, 1, Tk] int32, read as [1, bk] lane-aligned slices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where NaN-free
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to(x, mult, axis):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _causal_mask(s, q0, k0, bq, bk):
+    qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, s, NEG_INF)
+
+
+def _block_live(causal, qi, kj, bq, bk):
+    """False only when the causal mask kills the whole (qi, kj) block."""
+    if not causal:
+        return True
+    return (qi + 1) * bq - 1 >= kj * bk
+
+
+def _params(interpret, n_arb):
+    if interpret:
+        return {"interpret": True}
+    sem = ("parallel",) * (3 - n_arb) + ("arbitrary",) * n_arb
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=sem)}
+
+
+# ---------------------------------------------------------------------------
+# forward kernel:  grid (B*H, num_q_blocks, num_k_blocks)
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(_block_live(causal, qi, kj, block_q, block_k))
+    def _accumulate():
+        q = q_ref[0]                                   # [bq, D] (op dtype)
+        k = k_ref[0]                                   # [bk, D]
+        v = v_ref[0]
+        s = scale * jax.lax.dot_general(               # [bq, bk] f32 accum
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        kvm = mask_ref[0]                              # [1, bk] int32
+        s = jnp.where(kvm > 0, s, NEG_INF)
+        if causal:
+            s = _causal_mask(s, qi * block_q, kj * block_k,
+                             block_q, block_k)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # fully-masked row: s - m_new would be 0 everywhere (both NEG_INF);
+        # subtract 0 instead so exp(NEG_INF) underflows to 0
+        m_sub = jnp.where(m_new > NEG_INF * 0.5, m_new, 0.0)
+        p = jnp.exp(s - m_sub)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # logsumexp residual; fully-masked rows get +big so bwd's
+        # exp(s - lse) underflows to 0 instead of exp(-inf - -inf) = 1
+        lse_ref[0] = jnp.where(l > 0, m_ref[:] + jnp.log(l_safe), -NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_block_live(causal, qi, kj, block_q, block_k))
+    def _accumulate():
+        q = q_ref[0]                                   # [bq, D]
+        do = do_ref[0]
+        lse, delta = lse_ref[0], delta_ref[0]          # [bq, 1]
+        k = k_ref[0]                                   # [bk, D]
+        v = v_ref[0]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        kvm = mask_ref[0]
+        s = jnp.where(kvm > 0, s, NEG_INF)
+        if causal:
+            s = _causal_mask(s, qi * block_q, kj * block_k,
+                             block_q, block_k)
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dp = jax.lax.dot_general(                      # dO @ V^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, mask_ref, q_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, block_q, block_k):
+    # grid (B*H, num_k_blocks, num_q_blocks) — innermost walks q blocks
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_block_live(causal, qi, kj, block_q, block_k))
+    def _accumulate():
+        k = k_ref[0]                                   # [bk, D]
+        v = v_ref[0]
+        kvm = mask_ref[0]                              # [1, bk]
+        q = q_ref[0]                                   # [bq, D]
+        do = do_ref[0]
+        lse, delta = lse_ref[0], delta_ref[0]          # [bq, 1]
+        s = scale * jax.lax.dot_general(               # [bq, bk]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = jnp.where(kvm > 0, s, NEG_INF)
+        if causal:
+            s = _causal_mask(s, qi * block_q, kj * block_k,
+                             block_q, block_k)
+        p = jnp.exp(s - lse)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(   # P^T @ dO
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                          # [bq, bk]
+        dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing (operands flattened to [B*H, T, D])
+# ---------------------------------------------------------------------------
+
+def _fwd_call(q, k, v, mask, *, scale, causal, bq, bk, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    h_per_b = bh // mask.shape[0]
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, tq // bq, tk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // h_per_b, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        **_params(interpret, 1),
+    )(q, k, v, mask)
+
+
+def _bwd_call(q, k, v, mask, o, lse, do, *, scale, causal, bq, bk,
+              interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    h_per_b = bh // mask.shape[0]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [BH, Tq, 1]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(bh, tq // bq, tk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // h_per_b, 0, j)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        **_params(interpret, 1),
+    )(q, k, v, mask, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(bh, tk // bk, tq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, 1, bk), lambda b, j, i: (b // h_per_b, 0, j)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        **_params(interpret, 1),
+    )(k, v, mask, q, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP wrapper (per static config, cached)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _flash_fn(scale, causal, bq, bk, interpret):
+    cfg = dict(scale=scale, causal=causal, bq=bq, bk=bk,
+               interpret=interpret)
+
+    @jax.custom_vjp
+    def fa(q, k, v, mask):
+        return _fwd_call(q, k, v, mask, **cfg)[0]
+
+    def fwd(q, k, v, mask):
+        o, lse = _fwd_call(q, k, v, mask, **cfg)
+        return o, (q, k, v, mask, o, lse)
+
+    def bwd(res, g):
+        q, k, v, mask, o, lse = res
+        dq, dk, dv = _bwd_call(q, k, v, mask, o, lse, g, **cfg)
+        return dq, dk, dv, np.zeros(mask.shape, jax.dtypes.float0)
+
+    fa.defvjp(fwd, bwd)
+    return fa
+
+
+def flash_attention(q, k, v, kv_mask=None, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512,
+                    interpret: Optional[bool] = None):
+    """Fused attention over [B, T, H, D] operands.
+
+    kv_mask: [B, Tk] bool, True = key position attends (padding mask).
+    Padding to block multiples is handled here; padded keys are masked,
+    padded query rows are dropped from the output (their grads flow back
+    as zeros through the pad's VJP).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    if interpret is None:
+        interpret = _interpret_default()
+    # Mosaic tiles are (8, 128): block sublane dims must be 8-multiples
+    # (T itself gets padded up to the block size below, so rounding is free)
+    bq = min(block_q, max(8, -(-Tq // 8) * 8))
+    bk = min(block_k, max(8, -(-Tk // 8) * 8))
+
+    # [B, T, H, D] -> [B*H, T, D]
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    qf = _pad_to(flat(q), bq, axis=1)
+    kf = _pad_to(flat(k), bk, axis=1)
+    vf = _pad_to(flat(v), bk, axis=1)
+    mask = jnp.ones((B, Tk), jnp.int32) if kv_mask is None \
+        else kv_mask.astype(jnp.int32)
+    mask = _pad_to(mask, bk, axis=1)[:, None, :]   # [B, 1, Tk]
+
+    fa = _flash_fn(float(scale), bool(causal), bq, bk, bool(interpret))
+    of = fa(qf, kf, vf, mask)
+    return of[:, :Tq, :].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+def sharded_flash_attention(q, k, v, mesh, kv_mask=None, *,
+                            causal: bool = False, **kw):
+    """flash_attention on a multi-device mesh.
+
+    A Mosaic kernel is a custom call XLA cannot GSPMD-partition, so under a
+    dp/tp-sharded train step the plain kernel would force full all-gathers
+    (or fail to compile).  Attention is independent per (batch row, head):
+    shard_map over the mesh's batch axes (B) and ``tp`` (H) runs the kernel
+    on each shard's local block with zero collectives.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from analytics_zoo_tpu.parallel.mesh import batch_axes
+
+    batch = batch_axes(mesh) or None
+    tp = "tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 else None
+    qkv_spec = P(batch, None, tp, None)
+    mask_spec = P(batch, None)
+
+    def local(qs, ks, vs, ms):
+        return flash_attention(qs, ks, vs, ms, causal=causal, **kw)
+
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:1] + k.shape[1:2], bool)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec, check_vma=False,
+    )(q, k, v, kv_mask)

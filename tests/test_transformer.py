@@ -29,6 +29,24 @@ def test_bert_forward_shapes(devices):
     assert np.isfinite(np.asarray(seq)).all()
 
 
+def test_bert_flash_matches_xla_attention(devices):
+    """BERT with the fused flash kernel (interpret mode on CPU) must match
+    the XLA full-attention path."""
+    ids = jnp.asarray(_ids(B=2, T=16))
+    mask = jnp.asarray(np.random.default_rng(0).random((2, 16)) > 0.25) \
+        .astype(np.int32)
+    cfg = dict(TINY, dtype=jnp.float32, dropout=0.0)
+    m_xla = BERT(**cfg, use_flash=False)
+    m_flash = BERT(**cfg, use_flash=True)
+    vs = m_xla.init(jax.random.key(0), ids)
+    seq0, pool0 = m_xla.apply(vs, ids, attention_mask=mask)
+    seq1, pool1 = m_flash.apply(vs, ids, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(seq0), np.asarray(seq1),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(pool0), np.asarray(pool1),
+                               atol=1e-4, rtol=1e-4)
+
+
 def test_bert_mesh_equivalence(devices):
     """Same params, same inputs: dp-only vs dp*sp*tp mesh give the same
     output — ring attention + TP sharding must not change the math."""
