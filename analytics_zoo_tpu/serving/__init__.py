@@ -1,0 +1,7 @@
+from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.resp import RespClient, RespServer
+from analytics_zoo_tpu.serving.server import ClusterServing, ServingConfig
+from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+
+__all__ = ["InputQueue", "OutputQueue", "RespClient", "RespServer",
+           "ClusterServing", "ServingConfig", "HttpFrontend"]

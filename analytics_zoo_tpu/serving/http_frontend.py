@@ -1,0 +1,223 @@
+"""Cluster Serving HTTP frontend — the akka-http gateway, TPU edition.
+
+Reference surface (SURVEY.md §2.6; ref: serving/http/FrontEndApp.scala with
+RedisPutActor/QueryActor): a REST gateway that accepts JSON/image payloads,
+enqueues them on the Redis input stream, awaits the result hash, and
+responds; optional TLS.
+
+Rebuild shape: stdlib ThreadingHTTPServer (one OS thread per in-flight
+request — the actor pool analog), per-thread RESP connections, and the
+reference's de-facto observability (queue depth + per-request latency)
+exposed as JSON gauges with p50/p90/p99.
+
+Routes:
+  POST /predict   {"instances": [{col: <nested list | {"b64","shape",
+                  "dtype"}>, ...}, ...]} -> {"predictions": [...]}
+  GET  /metrics   backlog, served counts, latency percentiles
+  GET  /healthz   200 once the loop thread is alive
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import json
+import ssl
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.common.log import logger
+from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
+
+
+def _decode_value(v) -> np.ndarray:
+    """JSON value -> ndarray: nested lists, or {"b64","shape","dtype"}."""
+    if isinstance(v, dict):
+        raw = base64.b64decode(v["b64"], validate=True)
+        a = np.frombuffer(raw, dtype=np.dtype(v.get("dtype", "float32")))
+        return a.reshape(v["shape"]) if "shape" in v else a
+    return np.asarray(v)
+
+
+class _Percentiles:
+    """Sliding-window latency gauge (lock-protected deque)."""
+
+    def __init__(self, window: int = 2048):
+        self._lat = collections.deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float):
+        with self._lock:
+            self._lat.append(seconds)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = np.asarray(self._lat)
+        if lat.size == 0:
+            return {"count": 0}
+        return {
+            "count": int(lat.size),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p90_ms": round(float(np.percentile(lat, 90)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        }
+
+
+class HttpFrontend:
+    """ref-parity: FrontEndApp — REST in front of the serving queues."""
+
+    def __init__(self, redis_host: str = "127.0.0.1",
+                 redis_port: int = 6379, http_port: int = 0,
+                 timeout: float = 30.0,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None,
+                 serving=None):
+        self.redis_host, self.redis_port = redis_host, redis_port
+        self.timeout = timeout
+        self.serving = serving          # optional ClusterServing for stats
+        self.latency = _Percentiles()
+        # ThreadingHTTPServer spawns a fresh thread per connection, so
+        # thread-local caching would never hit: pool the RESP client pairs
+        self._pool: list = []
+        self._pool_lock = threading.Lock()
+        self._pool_max = 16
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # route through our logger
+                logger.debug("http: " + a[0], *a[1:])
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                elif self.path == "/metrics":
+                    self._send(200, frontend.metrics())
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                t0 = time.perf_counter()
+                # record failures too — excluding timeouts would hide the
+                # slowest tail exactly when the backend is unhealthy
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    instances = req.get("instances")
+                    if instances is None:
+                        instances = [req]   # single-instance body
+                    preds = frontend._predict(instances)
+                except TimeoutError as e:
+                    self._send(504, {"error": str(e)})
+                    return
+                except Exception as e:   # bad payload, decode errors, ...
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                finally:
+                    frontend.latency.record(time.perf_counter() - t0)
+                self._send(200, {"predictions": preds})
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", http_port), Handler)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            # handshake on first read (per-connection handler thread), not
+            # inside accept() — a stalled client must not block the single
+            # accept loop and with it every other request
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True,
+                do_handshake_on_connect=False)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- pooled queue clients -----------------------------------------
+
+    def _acquire(self):
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return (InputQueue(self.redis_host, self.redis_port),
+                OutputQueue(self.redis_host, self.redis_port))
+
+    def _release(self, pair):
+        with self._pool_lock:
+            if len(self._pool) < self._pool_max:
+                self._pool.append(pair)
+                return
+        pair[0].close()
+        pair[1].close()
+
+    def _predict(self, instances):
+        # decode everything BEFORE enqueueing anything: a bad instance then
+        # rejects the whole request without leaving orphaned work behind
+        decoded = [{k: _decode_value(v) for k, v in inst.items()}
+                   for inst in instances]
+        pair = self._acquire()
+        inq, outq = pair
+        try:
+            uris = [inq.enqueue(str(uuid.uuid4()), **data)
+                    for data in decoded]
+            # one deadline for the whole request — per-uri waits share it
+            # instead of compounding to n * timeout
+            deadline = time.monotonic() + self.timeout
+            preds = []
+            for uri in uris:
+                remaining = deadline - time.monotonic()
+                r = outq.query(uri, timeout=max(0.0, remaining))
+                if r is None:
+                    raise TimeoutError(
+                        f"result for {uri} not ready within "
+                        f"{self.timeout}s")
+                preds.append(np.asarray(r).tolist())
+        except BaseException:
+            # a failure may leave the RESP protocol state mid-message —
+            # drop the pair rather than poisoning the pool
+            pair[0].close()
+            pair[1].close()
+            raise
+        else:
+            self._release(pair)
+            return preds
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def start(self) -> "HttpFrontend":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logger.info("HttpFrontend on :%d -> redis %s:%d", self.port,
+                    self.redis_host, self.redis_port)
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ---- observability ------------------------------------------------
+
+    def metrics(self) -> dict:
+        out = {"latency": self.latency.snapshot()}
+        if self.serving is not None:
+            out["serving"] = dict(self.serving.stats)
+            try:
+                out["backlog"] = self.serving.backlog()
+            except Exception:
+                out["backlog"] = None
+        return out

@@ -16,6 +16,7 @@ from InferenceModel's bucket cache.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
@@ -40,6 +41,7 @@ class ServingConfig:
     batch_size: int = 32            # micro-batch cap
     batch_timeout_ms: float = 5.0   # flush partial batch after this wait
     input_cols: Optional[List[str]] = None  # None: infer from request
+    result_ttl_s: float = 300.0     # abandoned results pruned after this
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -84,6 +86,10 @@ class ClusterServing:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_id = b"0-0"
+        # (uri, written_at) of results not yet known consumed — abandoned
+        # ones (client timed out / died) are pruned after result_ttl_s so
+        # broker memory stays bounded in long-lived deployments
+        self._written: collections.deque = collections.deque()
         self.stats = {"requests": 0, "batches": 0, "batch_fill": 0.0,
                       "predict_ms": 0.0}
 
@@ -135,7 +141,11 @@ class ClusterServing:
             fields = {flat[i].decode(): flat[i + 1]
                       for i in range(0, len(flat), 2)}
             out.append(fields)
-        self.client.execute("XTRIM", INPUT_STREAM, "MAXLEN", 10000)
+        # delete exactly the consumed entries (by id) so XLEN == pending
+        # backlog; MAXLEN-style trimming would race concurrent producers
+        # and could drop entries that were never read
+        self.client.execute("XDEL", INPUT_STREAM,
+                            *[eid for eid, _ in entries])
         return out
 
     def _loop(self):
@@ -173,10 +183,20 @@ class ClusterServing:
         # a set, pruned by the client on consume, so it stays bounded by
         # the number of UNREAD results rather than total requests served
         self.client.execute("SADD", "__result_keys__", *uris)
+        now = time.monotonic()
+        self._written.extend((u, now) for u in uris)
+        self._prune_abandoned(now)
         self.stats["requests"] += len(requests)
         self.stats["batches"] += 1
         self.stats["batch_fill"] = len(requests) / self.config.batch_size
         self.stats["predict_ms"] = dt
+
+    def _prune_abandoned(self, now: float):
+        ttl = self.config.result_ttl_s
+        while self._written and now - self._written[0][1] > ttl:
+            uri, _ = self._written.popleft()
+            self.client.execute("DEL", RESULT_PREFIX + uri)
+            self.client.execute("SREM", "__result_keys__", uri)
 
     # ---- observability (SURVEY §5: queue depth = backlog metric) ------
 

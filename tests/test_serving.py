@@ -1,0 +1,261 @@
+"""Cluster Serving: RESP broker, queues, serving loop, HTTP frontend.
+
+Mirrors the reference's serving test surface (SURVEY.md §4: batching-logic
+specs without the streaming substrate, embedded/local Redis) — here the
+embedded RESP broker plays local Redis, and a tiny flax model serves real
+predictions end-to-end.
+"""
+
+import http.client
+import json
+import time
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.learn.inference_model import InferenceModel
+from analytics_zoo_tpu.serving import (
+    ClusterServing, HttpFrontend, InputQueue, OutputQueue, RespClient,
+    RespServer, ServingConfig)
+
+
+class _Double(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x * 2.0
+
+
+def _serving(batch_size=8, timeout_ms=20.0):
+    model = _Double()
+    variables = model.init(jax.random.key(0), np.zeros((1, 4), np.float32))
+    im = InferenceModel().load_flax(model, variables)
+    cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=timeout_ms)
+    return ClusterServing(im, cfg, embedded_broker=True).start()
+
+
+# ---------------------------------------------------------------------------
+# RESP broker
+# ---------------------------------------------------------------------------
+
+class TestRespBroker:
+    def test_basic_commands(self):
+        srv = RespServer(port=0).start()
+        try:
+            c = RespClient("127.0.0.1", srv.port)
+            assert c.execute("PING") in (b"PONG", "PONG")
+            c.execute("HSET", "h", "f", "v")
+            assert c.execute("HGETALL", "h") == [b"f", b"v"]
+            c.execute("DEL", "h")
+            assert c.execute("HGETALL", "h") == []
+        finally:
+            srv.stop()
+
+    def test_stream_xadd_xread_xlen(self):
+        srv = RespServer(port=0).start()
+        try:
+            c = RespClient("127.0.0.1", srv.port)
+            id1 = c.execute("XADD", "s", "*", "k", "1")
+            c.execute("XADD", "s", "*", "k", "2")
+            assert int(c.execute("XLEN", "s")) == 2
+            out = c.execute("XREAD", "COUNT", "10", "STREAMS", "s", "0-0")
+            entries = out[0][1]
+            assert len(entries) == 2
+            out2 = c.execute("XREAD", "COUNT", "10", "STREAMS", "s", id1)
+            assert len(out2[0][1]) == 1
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: queues -> serving loop -> results
+# ---------------------------------------------------------------------------
+
+class TestClusterServing:
+    def test_enqueue_predict_query(self):
+        serving = _serving()
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            x = np.arange(4, dtype=np.float32)
+            uri = inq.enqueue("req-1", x=x)
+            r = outq.query(uri, timeout=10)
+            np.testing.assert_allclose(r, x * 2.0)
+        finally:
+            serving.stop()
+
+    def test_micro_batching_many_requests(self):
+        serving = _serving(batch_size=4)
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            xs = {f"r{i}": np.full(4, i, np.float32) for i in range(12)}
+            for uri, x in xs.items():
+                inq.enqueue(uri, x=x)
+            for uri, x in xs.items():
+                r = outq.query(uri, timeout=10)
+                np.testing.assert_allclose(r, x * 2.0, err_msg=uri)
+            assert serving.stats["requests"] == 12
+            assert serving.stats["batches"] >= 3   # batch cap is 4
+        finally:
+            serving.stop()
+
+    def test_backlog_and_dequeue(self):
+        serving = _serving()
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            for i in range(3):
+                inq.enqueue(f"d{i}", x=np.ones(4, np.float32))
+            deadline = time.monotonic() + 10
+            got = {}
+            while len(got) < 3 and time.monotonic() < deadline:
+                got.update(outq.dequeue())
+                time.sleep(0.02)
+            assert set(got) == {"d0", "d1", "d2"}
+            assert serving.backlog() >= 0
+        finally:
+            serving.stop()
+
+    def test_backlog_drops_to_zero_after_consumption(self):
+        """XLEN must mean PENDING entries, not total retained history."""
+        serving = _serving()
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            for i in range(5):
+                inq.enqueue(f"b{i}", x=np.ones(4, np.float32))
+            for i in range(5):
+                assert outq.query(f"b{i}", timeout=10) is not None
+            deadline = time.monotonic() + 5
+            while serving.backlog() > 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert serving.backlog() == 0
+        finally:
+            serving.stop()
+
+    def test_abandoned_results_pruned_after_ttl(self):
+        """Results nobody queries must not grow broker memory forever."""
+        serving = _serving()
+        serving.config.result_ttl_s = 0.2
+        try:
+            inq = InputQueue(port=serving.port)
+            inq.enqueue("ghost", x=np.ones(4, np.float32))
+            c = RespClient("127.0.0.1", serving.port)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if c.execute("HGETALL", "result:ghost"):
+                    break
+                time.sleep(0.02)
+            time.sleep(0.3)   # ttl elapses
+            # any later batch triggers the prune
+            inq.enqueue("live", x=np.ones(4, np.float32))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if not c.execute("HGETALL", "result:ghost"):
+                    break
+                time.sleep(0.02)
+            assert not c.execute("HGETALL", "result:ghost")
+            keys = c.execute("SMEMBERS", "__result_keys__") or []
+            assert b"ghost" not in keys
+        finally:
+            serving.stop()
+
+    def test_config_from_yaml(self, tmp_path):
+        p = tmp_path / "config.yaml"
+        p.write_text(
+            "model:\n  path: /models/m\n"
+            "redis:\n  src: 10.0.0.5:6380\n"
+            "params:\n  batch_size: 64\n")
+        cfg = ServingConfig.from_yaml(str(p))
+        assert cfg.model_path == "/models/m"
+        assert (cfg.redis_host, cfg.redis_port) == ("10.0.0.5", 6380)
+        assert cfg.batch_size == 64
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend
+# ---------------------------------------------------------------------------
+
+class TestHttpFrontend:
+    @pytest.fixture()
+    def stack(self):
+        serving = _serving()
+        fe = HttpFrontend(redis_port=serving.port, timeout=10,
+                          serving=serving).start()
+        yield serving, fe
+        fe.stop()
+        serving.stop()
+
+    def _post(self, port, path, payload):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+        conn.request("POST", path, json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    def _get(self, port, path):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=15)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    def test_predict_json_lists(self, stack):
+        _, fe = stack
+        status, body = self._post(fe.port, "/predict", {
+            "instances": [{"x": [1.0, 2.0, 3.0, 4.0]},
+                          {"x": [5.0, 6.0, 7.0, 8.0]}]})
+        assert status == 200
+        np.testing.assert_allclose(body["predictions"],
+                                   [[2, 4, 6, 8], [10, 12, 14, 16]])
+
+    def test_predict_b64_tensor(self, stack):
+        import base64
+        _, fe = stack
+        x = np.arange(4, dtype=np.float32)
+        status, body = self._post(fe.port, "/predict", {
+            "instances": [{"x": {
+                "b64": base64.b64encode(x.tobytes()).decode(),
+                "shape": [4], "dtype": "float32"}}]})
+        assert status == 200
+        np.testing.assert_allclose(body["predictions"][0], x * 2.0)
+
+    def test_bad_payload_400(self, stack):
+        _, fe = stack
+        status, body = self._post(fe.port, "/predict",
+                                  {"instances": [{"x": {"b64": "!!!"}}]})
+        assert status == 400
+        assert "error" in body
+
+    def test_health_and_metrics(self, stack):
+        _, fe = stack
+        assert self._get(fe.port, "/healthz")[0] == 200
+        self.test_predict_json_lists(stack)
+        status, m = self._get(fe.port, "/metrics")
+        assert status == 200
+        assert m["latency"]["count"] >= 1
+        assert m["latency"]["p50_ms"] > 0
+        assert m["serving"]["requests"] >= 2
+        assert "backlog" in m
+
+    def test_unknown_route_404(self, stack):
+        _, fe = stack
+        assert self._get(fe.port, "/nope")[0] == 404
+
+    def test_timeout_shares_one_deadline(self):
+        """n instances must time out within ~timeout, not n * timeout."""
+        broker = RespServer(port=0).start()     # broker but NO serving loop
+        fe = HttpFrontend(redis_port=broker.port, timeout=0.5).start()
+        try:
+            t0 = time.monotonic()
+            status, body = self._post(fe.port, "/predict", {
+                "instances": [{"x": [1.0]} for _ in range(5)]})
+            dt = time.monotonic() - t0
+            assert status == 504
+            assert dt < 2.0, f"timeouts compounded: {dt:.1f}s"
+            # failed requests still count toward latency percentiles
+            assert fe.latency.snapshot()["count"] == 1
+        finally:
+            fe.stop()
+            broker.stop()
