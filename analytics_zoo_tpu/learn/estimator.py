@@ -568,10 +568,35 @@ class Estimator:
             optimizer = optax.adam(1e-3)
         return FlaxEstimator(model, loss or "mse", optimizer, **kw)
 
-    # Reference entry-point names. Each accepted a framework-native model
-    # (tf.keras / torch); here they accept flax modules or creator fns so
-    # existing orchestration code ports by swapping the model definition.
+    # Reference entry-point names. from_keras accepted tf.keras models;
+    # here it accepts our keras/flax modules so orchestration code ports by
+    # swapping the model definition.
     from_keras = from_flax
-    from_torch = from_flax
+
+    @staticmethod
+    def from_torch(*, model=None, model_creator=None, loss=None,
+                   optimizer=None, config: Optional[dict] = None,
+                   **kw) -> FlaxEstimator:
+        """ref-parity: zoo.orca.learn.pytorch.Estimator.from_torch.
+
+        A real torch nn.Module is converted to JAX via TorchNet (torch.fx
+        graph -> pure function + param pytree, ref TorchNet.scala) and then
+        trained by the same pjit Estimator; flax modules pass through."""
+        if model is None:
+            if model_creator is None:
+                raise ValueError("need model or model_creator")
+            model = model_creator(config or {})
+        try:
+            import torch
+
+            if isinstance(model, torch.nn.Module):
+                from analytics_zoo_tpu.net import TorchNet
+
+                model = TorchNet.from_torch(model)
+        except ImportError:
+            pass
+        if optimizer is None:
+            optimizer = optax.adam(1e-3)
+        return FlaxEstimator(model, loss or "mse", optimizer, **kw)
     from_graph = from_flax
     from_bigdl = from_flax

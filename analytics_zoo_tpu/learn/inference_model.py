@@ -81,6 +81,15 @@ class InferenceModel:
         self._jit = None        # new model -> stale compiled wrapper
         return self
 
+    def load_torch(self, module) -> "InferenceModel":
+        """ref-parity: InferenceModel.loadTorch — a torch nn.Module (or
+        path torch.load can read) served on TPU via TorchNet conversion."""
+        from analytics_zoo_tpu.net import Net, TorchNet
+
+        net = module if isinstance(module, TorchNet) \
+            else Net.load_torch(module)
+        return self.load_flax(net, net.init(None))
+
     def load(self, path: str, model) -> "InferenceModel":
         """Restore an ``Estimator.save`` export for `model` (flax module).
 

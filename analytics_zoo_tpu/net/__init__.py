@@ -1,0 +1,72 @@
+"""Net — external-model import (SURVEY.md §2.4 "net loading").
+
+Reference surface (ref: zoo pipeline/api/net/ — ``Net.load_bigdl``,
+``load_caffe``, ``load_keras``, ``load_tf``, ``load_torch``): import
+foreign-framework models as graph nodes of the native runtime.
+
+TPU rebuild: torch is the supported import path (``TorchNet`` converts via
+torch.fx to a pure JAX function — see torch_net.py); Keras models are
+native here (analytics_zoo_tpu.keras builds flax modules directly).
+TensorFlow/Caffe/BigDL runtimes are not in this environment, so their
+loaders raise with the supported migration path spelled out.
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.net.torch_net import TorchNet
+
+
+class Net:
+    """ref-parity constructor facade for external model import."""
+
+    @staticmethod
+    def load_torch(module_or_path, example_inputs=None) -> TorchNet:
+        """A torch nn.Module (or a path to a pickled/state-dict one
+        loadable by ``torch.load``) -> TorchNet running on TPU."""
+        import torch
+
+        m = module_or_path
+        if isinstance(m, (str, bytes)):
+            m = torch.load(m, weights_only=False, map_location="cpu")
+        if not isinstance(m, torch.nn.Module):
+            raise TypeError(f"expected nn.Module or path, got {type(m)}")
+        return TorchNet.from_torch(m, example_inputs)
+
+    @staticmethod
+    def load_keras(model) -> "object":
+        """Our keras API builds flax modules natively — pass them straight
+        to Estimator/InferenceModel (ref load_keras imported HDF5 models
+        into BigDL; here the keras layer library IS the native one)."""
+        from analytics_zoo_tpu.keras.engine import KerasNet
+
+        if isinstance(model, KerasNet):
+            return model
+        raise TypeError(
+            "load_keras takes an analytics_zoo_tpu.keras model; HDF5 "
+            "import of tf.keras models needs tensorflow, which is not in "
+            "this environment — rebuild the topology with "
+            "analytics_zoo_tpu.keras and load weights via set_weights()")
+
+    @staticmethod
+    def load_tf(*a, **kw):
+        raise NotImplementedError(
+            "TensorFlow is not available in this environment; export the "
+            "graph's weights and rebuild with analytics_zoo_tpu.keras or "
+            "flax, or convert a torch port via Net.load_torch")
+
+    @staticmethod
+    def load_bigdl(*a, **kw):
+        raise NotImplementedError(
+            "BigDL JVM models are not loadable without a JVM; rebuild the "
+            "topology with analytics_zoo_tpu.keras (layer set matches the "
+            "BigDL keras API) and load weights via set_weights()")
+
+    @staticmethod
+    def load_caffe(*a, **kw):
+        raise NotImplementedError(
+            "Caffe is not available in this environment; convert the "
+            "model to torch (e.g. via torchvision ports) and use "
+            "Net.load_torch")
+
+
+__all__ = ["TorchNet", "Net"]

@@ -1,0 +1,502 @@
+"""TorchNet — run PyTorch models on TPU by converting them to JAX.
+
+Reference surface (SURVEY.md §2.3, ref: zoo pipeline/api/net/TorchNet.scala
++ native libtorch JNI bindings): the reference executes TorchScript modules
+inside the JVM via libtorch so torch models can ride its optimizer/serving
+stack.
+
+TPU re-design: instead of embedding libtorch (CPU-only here, and a foreign
+runtime XLA cannot fuse into), the module's ``torch.fx`` graph is converted
+ONCE into a pure JAX function + param pytrees pulled from ``state_dict``.
+The converted model is a first-class citizen: it jits, shards, trains under
+the pjit Estimator (``Estimator.from_torch``), and serves through
+InferenceModel — the whole forward compiles to one XLA program.
+
+State split: trainable weights live in the ``params`` collection; BatchNorm
+running stats and ``get_attr`` buffers live in ``batch_stats`` (flax's
+non-trainable collection), so ``fit`` never optimizer-updates them —
+frozen-stats fine-tune semantics, matching how the reference ran TorchNet
+forward passes in eval mode.  Param paths keep the module tree nesting
+(``block.0.weight`` -> params["block"]["0"]["weight"]), so distinct torch
+paths can never collide.
+
+Scope: the fx-traceable eval-mode subset that covers the reference's
+TorchNet usage (MLPs, ConvNets, embeddings, attention-free nets).
+Unsupported layers/configs raise NotImplementedError at conversion time —
+never convert silently wrong.  Dynamic control flow in ``forward`` is
+rejected by fx tracing itself, the same limitation TorchScript tracing had.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _np(t) -> jnp.ndarray:
+    return jnp.asarray(t.detach().cpu().numpy())
+
+
+def _set_nested(tree: Dict, path: Tuple[str, ...], value):
+    for part in path[:-1]:
+        tree = tree.setdefault(part, {})
+    tree[path[-1]] = value
+
+
+def _get_nested(tree: Dict, path: Tuple[str, ...], default=None):
+    for part in path:
+        if not isinstance(tree, dict) or part not in tree:
+            return default if default is not None else {}
+        tree = tree[part]
+    return tree
+
+
+def _merge_trees(a: Dict, b: Dict) -> Dict:
+    """Recursive dict union (b wins on leaf conflicts — there are none by
+    construction: trainable and frozen leaves have distinct names)."""
+    out = dict(a)
+    for k, v in b.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _merge_trees(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# module handlers: torch module -> (trainable, frozen, jax fn(p, *inputs))
+# ---------------------------------------------------------------------------
+
+_MODULE_HANDLERS: Dict[type, Callable] = {}
+
+
+def register_module(torch_type):
+    def deco(fn):
+        _MODULE_HANDLERS[torch_type] = fn
+        return fn
+    return deco
+
+
+def _build_module_handlers():
+    import torch.nn as tnn
+
+    @register_module(tnn.Linear)
+    def linear(m):
+        p = {"weight": _np(m.weight)}
+        if m.bias is not None:
+            p["bias"] = _np(m.bias)
+
+        def fn(p, x):
+            y = x @ p["weight"].T
+            return y + p["bias"] if "bias" in p else y
+        return p, {}, fn
+
+    @register_module(tnn.Embedding)
+    def embedding(m):
+        p = {"weight": _np(m.weight)}
+        return p, {}, lambda p, x: jnp.take(p["weight"], x, axis=0)
+
+    def _conv(m, nd):
+        p = {"weight": _np(m.weight)}
+        if m.bias is not None:
+            p["bias"] = _np(m.bias)
+        stride = m.stride if isinstance(m.stride, tuple) else (m.stride,) * nd
+        dil = m.dilation if isinstance(m.dilation, tuple) \
+            else (m.dilation,) * nd
+        groups = m.groups
+        pad = m.padding
+        if isinstance(pad, str):
+            pad = pad.upper()       # "same"/"valid"
+        else:
+            pad = pad if isinstance(pad, tuple) else (pad,) * nd
+            pad = [(p_, p_) for p_ in pad]
+        dims = ("NCH", "OIH", "NCH") if nd == 1 else ("NCHW", "OIHW", "NCHW")
+
+        def fn(p, x):
+            y = jax.lax.conv_general_dilated(
+                x, p["weight"], window_strides=stride, padding=pad,
+                rhs_dilation=dil, dimension_numbers=dims,
+                feature_group_count=groups)
+            if "bias" in p:
+                y = y + p["bias"].reshape((1, -1) + (1,) * nd)
+            return y
+        return p, {}, fn
+
+    register_module(tnn.Conv1d)(lambda m: _conv(m, 1))
+    register_module(tnn.Conv2d)(lambda m: _conv(m, 2))
+
+    def _bn(m):
+        # running stats are FROZEN state (batch_stats collection), not
+        # trainable params — fit must never optimizer-update them
+        frozen = {"mean": _np(m.running_mean), "var": _np(m.running_var)}
+        p = {}
+        if m.affine:
+            p = {"weight": _np(m.weight), "bias": _np(m.bias)}
+        eps = m.eps
+
+        def fn(p, x):
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            y = (x - p["mean"].reshape(shape)) * jax.lax.rsqrt(
+                p["var"].reshape(shape) + eps)
+            if "weight" in p:
+                y = y * p["weight"].reshape(shape) + p["bias"].reshape(shape)
+            return y
+        return p, frozen, fn
+
+    register_module(tnn.BatchNorm1d)(_bn)
+    register_module(tnn.BatchNorm2d)(_bn)
+
+    @register_module(tnn.LayerNorm)
+    def layernorm(m):
+        p = {}
+        if m.elementwise_affine:
+            p = {"weight": _np(m.weight), "bias": _np(m.bias)}
+        nd, eps = len(m.normalized_shape), m.eps
+
+        def fn(p, x):
+            axes = tuple(range(x.ndim - nd, x.ndim))
+            mu = jnp.mean(x, axes, keepdims=True)
+            var = jnp.var(x, axes, keepdims=True)
+            y = (x - mu) * jax.lax.rsqrt(var + eps)
+            if "weight" in p:
+                y = y * p["weight"] + p["bias"]
+            return y
+        return p, {}, fn
+
+    @register_module(tnn.GroupNorm)
+    def groupnorm(m):
+        p = {"weight": _np(m.weight), "bias": _np(m.bias)} if m.affine \
+            else {}
+        g, eps = m.num_groups, m.eps
+
+        def fn(p, x):
+            n, c = x.shape[:2]
+            xg = x.reshape((n, g, c // g) + x.shape[2:])
+            axes = tuple(range(2, xg.ndim))
+            mu = jnp.mean(xg, axes, keepdims=True)
+            var = jnp.var(xg, axes, keepdims=True)
+            y = ((xg - mu) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+            if "weight" in p:
+                shape = (1, -1) + (1,) * (x.ndim - 2)
+                y = y * p["weight"].reshape(shape) + p["bias"].reshape(shape)
+            return y
+        return p, {}, fn
+
+    # -- stateless modules ------------------------------------------------
+    def _stateless(make):
+        return lambda m: ({}, {}, make(m))
+
+    register_module(tnn.ReLU)(_stateless(lambda m: lambda p, x:
+                                         jax.nn.relu(x)))
+    register_module(tnn.ReLU6)(_stateless(lambda m: lambda p, x:
+                                          jnp.clip(x, 0, 6)))
+    register_module(tnn.Sigmoid)(_stateless(lambda m: lambda p, x:
+                                            jax.nn.sigmoid(x)))
+    register_module(tnn.Tanh)(_stateless(lambda m: lambda p, x:
+                                         jnp.tanh(x)))
+    register_module(tnn.GELU)(_stateless(
+        lambda m: lambda p, x: jax.nn.gelu(
+            x, approximate=m.approximate != "none")))
+    register_module(tnn.SiLU)(_stateless(lambda m: lambda p, x:
+                                         jax.nn.silu(x)))
+    register_module(tnn.LeakyReLU)(_stateless(
+        lambda m: lambda p, x: jax.nn.leaky_relu(x, m.negative_slope)))
+    register_module(tnn.Softmax)(_stateless(
+        lambda m: lambda p, x: jax.nn.softmax(x, axis=m.dim)))
+    register_module(tnn.LogSoftmax)(_stateless(
+        lambda m: lambda p, x: jax.nn.log_softmax(x, axis=m.dim)))
+    register_module(tnn.Dropout)(_stateless(
+        lambda m: lambda p, x: x))          # eval semantics
+    register_module(tnn.Identity)(_stateless(lambda m: lambda p, x: x))
+    register_module(tnn.Flatten)(_stateless(
+        lambda m: lambda p, x: _flatten(x, m.start_dim, m.end_dim)))
+
+    def _pool(m, nd, kind):
+        if getattr(m, "ceil_mode", False):
+            raise NotImplementedError(
+                f"{type(m).__name__}(ceil_mode=True) is not supported")
+        if kind == "max" and getattr(m, "dilation", 1) not in (1, (1,) * nd):
+            raise NotImplementedError(
+                f"{type(m).__name__}(dilation != 1) is not supported")
+        if kind == "avg" and not getattr(m, "count_include_pad", True):
+            raise NotImplementedError(
+                f"{type(m).__name__}(count_include_pad=False) is not "
+                "supported")
+        ks = m.kernel_size if isinstance(m.kernel_size, tuple) \
+            else (m.kernel_size,) * nd
+        st = m.stride if isinstance(m.stride, tuple) else \
+            (m.stride,) * nd if m.stride else ks
+        pd = m.padding if isinstance(m.padding, tuple) \
+            else (m.padding,) * nd
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = ((0, 0), (0, 0)) + tuple((p_, p_) for p_ in pd)
+
+        def maxfn(p, x):
+            return jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, window, strides, pads)
+
+        def avgfn(p, x):
+            s = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, window, strides, pads)
+            return s / float(np.prod(ks))
+        return {}, {}, (maxfn if kind == "max" else avgfn)
+
+    register_module(tnn.MaxPool1d)(lambda m: _pool(m, 1, "max"))
+    register_module(tnn.MaxPool2d)(lambda m: _pool(m, 2, "max"))
+    register_module(tnn.AvgPool1d)(lambda m: _pool(m, 1, "avg"))
+    register_module(tnn.AvgPool2d)(lambda m: _pool(m, 2, "avg"))
+
+    @register_module(tnn.AdaptiveAvgPool2d)
+    def adaptive_avg(m):
+        out = m.output_size
+        out = (out, out) if isinstance(out, int) else out
+        if tuple(out) != (1, 1):
+            raise NotImplementedError(
+                f"AdaptiveAvgPool2d{tuple(out)}: only (1, 1) supported")
+        return {}, {}, lambda p, x: jnp.mean(x, axis=(2, 3), keepdims=True)
+
+
+def _flatten(x, start_dim=1, end_dim=-1):
+    end = end_dim if end_dim >= 0 else x.ndim + end_dim
+    shape = x.shape[:start_dim] + (-1,) + x.shape[end + 1:]
+    return jnp.reshape(x, shape)
+
+
+def _chunk(x, n, dim=0):
+    """torch.chunk semantics: ceil-sized chunks, short last chunk OK
+    (jnp.split requires even division; np.array_split balances — both
+    differ from torch)."""
+    size = -(-x.shape[dim] // n)
+    cuts = list(range(size, x.shape[dim], size))
+    return jnp.split(x, cuts, axis=dim)
+
+
+# ---------------------------------------------------------------------------
+# function / method translation tables
+# ---------------------------------------------------------------------------
+
+def _function_table() -> Dict[Any, Callable]:
+    import torch
+    import torch.nn.functional as F
+
+    t = {
+        operator.add: operator.add, operator.sub: operator.sub,
+        operator.mul: operator.mul, operator.truediv: operator.truediv,
+        operator.neg: operator.neg, operator.matmul: jnp.matmul,
+        operator.getitem: lambda x, i: x[i],
+        operator.pow: operator.pow,
+        torch.add: jnp.add, torch.sub: jnp.subtract,
+        torch.mul: jnp.multiply, torch.div: jnp.divide,
+        torch.matmul: jnp.matmul, torch.bmm: jnp.matmul,
+        torch.relu: jax.nn.relu, F.relu: jax.nn.relu,
+        torch.sigmoid: jax.nn.sigmoid, F.sigmoid: jax.nn.sigmoid,
+        torch.tanh: jnp.tanh, F.tanh: jnp.tanh,
+        # torch default is the exact erf GELU; 'tanh' selects the approx
+        F.gelu: lambda x, approximate="none": jax.nn.gelu(
+            x, approximate=approximate != "none"),
+        F.silu: jax.nn.silu,
+        torch.exp: jnp.exp, torch.log: jnp.log, torch.sqrt: jnp.sqrt,
+        torch.abs: jnp.abs, torch.clamp: jnp.clip,
+        torch.squeeze: jnp.squeeze,
+        torch.flatten: lambda x, start_dim=0, end_dim=-1:
+            _flatten(x, start_dim, end_dim),
+        torch.sum: lambda x, dim=None, keepdim=False:
+            jnp.sum(x, axis=dim, keepdims=keepdim),
+        torch.mean: lambda x, dim=None, keepdim=False:
+            jnp.mean(x, axis=dim, keepdims=keepdim),
+        torch.unsqueeze: lambda x, dim: jnp.expand_dims(x, dim),
+        torch.transpose: lambda x, a, b: jnp.swapaxes(x, a, b),
+        torch.permute: lambda x, dims: jnp.transpose(x, dims),
+        torch.reshape: lambda x, shape: jnp.reshape(x, shape),
+        torch.cat: lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
+        torch.stack: lambda ts, dim=0: jnp.stack(ts, axis=dim),
+        torch.chunk: _chunk,
+        torch.softmax: lambda x, dim: jax.nn.softmax(x, axis=dim),
+        F.softmax: lambda x, dim=None: jax.nn.softmax(x, axis=dim),
+        F.log_softmax: lambda x, dim=None: jax.nn.log_softmax(x, axis=dim),
+        F.dropout: lambda x, p=0.5, training=False: x,
+    }
+    return t
+
+
+_METHODS: Dict[str, Callable] = {
+    "view": lambda x, *shape: jnp.reshape(
+        x, shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple,
+                                                                 list))
+        else shape),
+    "reshape": lambda x, *shape: jnp.reshape(
+        x, shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple,
+                                                                 list))
+        else shape),
+    "flatten": _flatten,
+    "permute": lambda x, *dims: jnp.transpose(
+        x, dims[0] if len(dims) == 1 and isinstance(dims[0], (tuple, list))
+        else dims),
+    "transpose": lambda x, a, b: jnp.swapaxes(x, a, b),
+    "contiguous": lambda x: x,
+    "squeeze": lambda x, dim=None: jnp.squeeze(x, dim),
+    "unsqueeze": lambda x, dim: jnp.expand_dims(x, dim),
+    "size": lambda x, dim=None: x.shape if dim is None else x.shape[dim],
+    "mean": lambda x, dim=None, keepdim=False:
+        jnp.mean(x, axis=dim, keepdims=keepdim),
+    "sum": lambda x, dim=None, keepdim=False:
+        jnp.sum(x, axis=dim, keepdims=keepdim),
+    "float": lambda x: x.astype(jnp.float32),
+    "t": lambda x: x.T,
+    "repeat": lambda x, *reps: jnp.tile(x, reps),
+    "chunk": _chunk,
+}
+
+
+# ---------------------------------------------------------------------------
+# the converter
+# ---------------------------------------------------------------------------
+
+class TorchNet:
+    """A torch module converted to (param pytrees, pure JAX function).
+
+    Implements the flax init/apply protocol the pjit Estimator consumes, so
+    a converted model trains/predicts exactly like a native flax model:
+
+        net = TorchNet.from_torch(torch_module)
+        y = net(net.params, x)                      # trainable-only call
+        est = Estimator.from_torch(model=torch_module, loss=..., ...)
+
+    ``params`` holds trainable weights; ``buffers`` holds BatchNorm running
+    stats and registered buffers (exposed to the Estimator as the
+    ``batch_stats`` collection so the optimizer never touches them).
+    """
+
+    def __init__(self, fn: Callable, params: Dict[str, Any],
+                 buffers: Dict[str, Any], n_inputs: int):
+        self._fn = fn
+        self.params = params
+        self.buffers = buffers
+        self.n_inputs = n_inputs
+
+    def __call__(self, params, *inputs):
+        return self._fn(_merge_trees(self.buffers, params), *inputs)
+
+    # -- flax protocol (FlaxEstimator / InferenceModel) ------------------
+    def init(self, rngs, *inputs, **kw):
+        out = {"params": self.params}
+        if self.buffers:
+            out["batch_stats"] = self.buffers
+        return out
+
+    def apply(self, variables, *inputs, mutable=None, rngs=None, **kw):
+        merged = _merge_trees(variables.get("batch_stats") or {},
+                              variables["params"])
+        out = self._fn(merged, *inputs)
+        if mutable:
+            # stats are frozen by design: echo them back unchanged
+            return out, {"batch_stats": variables.get("batch_stats")}
+        return out
+
+    @staticmethod
+    def from_torch(module, example_inputs=None) -> "TorchNet":
+        """Convert a torch module via torch.fx tracing (weights are read in
+        eval mode; the module's own train/eval flag is restored after)."""
+        import torch.fx as fx
+
+        was_training = module.training
+        module.eval()
+        try:
+            return TorchNet._convert(module, fx, example_inputs)
+        finally:
+            module.train(was_training)
+
+    @staticmethod
+    def _convert(module, fx, example_inputs):
+        gm = fx.symbolic_trace(module)
+        ftable = _function_table()
+
+        params: Dict[str, Any] = {}
+        buffers: Dict[str, Any] = {}
+        handlers: Dict[str, Tuple[Tuple[str, ...], Callable]] = {}
+        n_inputs = 0
+        for node in gm.graph.nodes:
+            if node.op == "placeholder":
+                n_inputs += 1
+            elif node.op == "call_module":
+                sub = gm.get_submodule(node.target)
+                h = _MODULE_HANDLERS.get(type(sub))
+                if h is None:
+                    raise NotImplementedError(
+                        f"no TorchNet handler for {type(sub).__name__} "
+                        f"(at '{node.target}'); supported: "
+                        f"{sorted(t.__name__ for t in _MODULE_HANDLERS)}")
+                p, frozen, fn = h(sub)
+                path = tuple(node.target.split("."))
+                if p:
+                    _set_nested(params, path, p)
+                if frozen:
+                    _set_nested(buffers, path, frozen)
+                handlers[node.target] = (path, fn)
+            elif node.op == "get_attr":
+                t = gm
+                for part in node.target.split("."):
+                    t = getattr(t, part)
+                # registered buffers/constants: non-trainable by definition
+                _set_nested(buffers, ("_attrs",) + tuple(
+                    node.target.split(".")), _np(t))
+            elif node.op == "call_function":
+                if node.target not in ftable:
+                    raise NotImplementedError(
+                        f"no TorchNet translation for function "
+                        f"{getattr(node.target, '__name__', node.target)}")
+            elif node.op == "call_method":
+                if node.target not in _METHODS:
+                    raise NotImplementedError(
+                        f"no TorchNet translation for method "
+                        f".{node.target}()")
+
+        graph = gm.graph
+        from torch.fx.node import map_arg
+
+        def run(p, *inputs):
+            env: Dict[str, Any] = {}
+            it = iter(inputs)
+
+            def lookup(a):
+                # fx's own arg mapper: resolves Nodes inside its immutable
+                # list/dict containers (which jax.tree_map treats as leaves)
+                return map_arg(a, lambda n: env[n.name])
+
+            for node in graph.nodes:
+                if node.op == "placeholder":
+                    env[node.name] = next(it)
+                elif node.op == "get_attr":
+                    env[node.name] = _get_nested(
+                        p, ("_attrs",) + tuple(node.target.split(".")))
+                elif node.op == "call_module":
+                    path, fn = handlers[node.target]
+                    args = lookup(list(node.args))
+                    kwargs = lookup(dict(node.kwargs))
+                    env[node.name] = fn(_get_nested(p, path), *args,
+                                        **kwargs)
+                elif node.op == "call_function":
+                    args = lookup(list(node.args))
+                    kwargs = lookup(dict(node.kwargs))
+                    env[node.name] = ftable[node.target](*args, **kwargs)
+                elif node.op == "call_method":
+                    args = lookup(list(node.args))
+                    kwargs = lookup(dict(node.kwargs))
+                    env[node.name] = _METHODS[node.target](*args, **kwargs)
+                elif node.op == "output":
+                    return lookup(node.args[0])
+            raise RuntimeError("fx graph had no output node")
+
+        net = TorchNet(run, params, buffers, n_inputs)
+        if example_inputs is not None:
+            xs = [jnp.asarray(np.asarray(x)) for x in example_inputs]
+            net(net.params, *xs)   # smoke-run the conversion eagerly
+        return net
+
+
+_build_module_handlers()
