@@ -82,7 +82,7 @@ class FlaxEstimator:
         model_dir: Optional[str] = None,
         param_loss: Optional[Callable] = None,
     ):
-        self.model = model
+        self.model = self._maybe_convert_torch(model)
         # Optional penalty over the param tree (keras-API W_regularizer
         # lowering) added to the training loss inside the jitted step.
         self.param_loss = param_loss
@@ -112,6 +112,21 @@ class FlaxEstimator:
         self._jit_predict_step = None
         self._epoch = 0
         self._global_step = 0
+
+    @staticmethod
+    def _maybe_convert_torch(model):
+        """torch nn.Modules become TorchNets HERE — the common depth — so
+        every entry point (from_flax/from_torch/AutoEstimator trials) gets
+        conversion, not just the from_torch facade."""
+        try:
+            import torch
+        except ImportError:
+            return model
+        if isinstance(model, torch.nn.Module):
+            from analytics_zoo_tpu.net import TorchNet
+
+            return TorchNet.from_torch(model)
+        return model
 
     # ------------------------------------------------------------------
     # model application helpers
@@ -586,17 +601,9 @@ class Estimator:
             if model_creator is None:
                 raise ValueError("need model or model_creator")
             model = model_creator(config or {})
-        try:
-            import torch
-
-            if isinstance(model, torch.nn.Module):
-                from analytics_zoo_tpu.net import TorchNet
-
-                model = TorchNet.from_torch(model)
-        except ImportError:
-            pass
         if optimizer is None:
             optimizer = optax.adam(1e-3)
+        # conversion happens inside FlaxEstimator.__init__ (all paths)
         return FlaxEstimator(model, loss or "mse", optimizer, **kw)
     from_graph = from_flax
     from_bigdl = from_flax

@@ -333,7 +333,9 @@ _METHODS: Dict[str, Callable] = {
         x, shape[0] if len(shape) == 1 and isinstance(shape[0], (tuple,
                                                                  list))
         else shape),
-    "flatten": _flatten,
+    # Tensor.flatten() defaults start_dim=0 (nn.Flatten defaults to 1)
+    "flatten": lambda x, start_dim=0, end_dim=-1:
+        _flatten(x, start_dim, end_dim),
     "permute": lambda x, *dims: jnp.transpose(
         x, dims[0] if len(dims) == 1 and isinstance(dims[0], (tuple, list))
         else dims),
@@ -439,11 +441,17 @@ class TorchNet:
                     _set_nested(buffers, path, frozen)
                 handlers[node.target] = (path, fn)
             elif node.op == "get_attr":
+                import torch
+
                 t = gm
                 for part in node.target.split("."):
                     t = getattr(t, part)
-                # registered buffers/constants: non-trainable by definition
-                _set_nested(buffers, ("_attrs",) + tuple(
+                # direct nn.Parameter attributes (e.g. self.scale used in
+                # forward) are TRAINABLE; registered buffers/constants are
+                # not
+                dest = params if isinstance(t, torch.nn.Parameter) \
+                    else buffers
+                _set_nested(dest, ("_attrs",) + tuple(
                     node.target.split(".")), _np(t))
             elif node.op == "call_function":
                 if node.target not in ftable:

@@ -1,0 +1,40 @@
+"""tfpark — reference-parity namespace for the TF1 training suite.
+
+Reference surface (SURVEY.md §2.3, ref: pyzoo/zoo/tfpark/): KerasModel,
+TFEstimator (tf.estimator clone), TFOptimizer (grad extraction into the
+BigDL optimizer), TFPredictor, TFDataset, GANEstimator.
+
+TPU mapping — every entry point exists, backed by the native JAX stack
+instead of a TF1 session:
+  KerasModel    -> the keras API itself (compile/fit on flax modules);
+                   ``KerasModel(model)`` returns the model unchanged after
+                   validating it, since our keras models ARE estimators.
+  TFEstimator   -> learn.Estimator (same fit/evaluate/predict contract).
+  TFOptimizer   -> subsumed by the pjit train step (there is no separate
+                   grad-extraction machine to port; the whole point of the
+                   rebuild is that XLA fuses forward/backward/update).
+  TFPredictor   -> learn.InferenceModel.
+  TFDataset     -> data.DataCreator / XShards streams.
+  GANEstimator  -> tfpark.gan.GANEstimator (alternating two-optimizer
+                   adversarial training in one jitted step).
+"""
+
+from analytics_zoo_tpu.learn.estimator import Estimator as TFEstimator
+from analytics_zoo_tpu.learn.inference_model import (
+    InferenceModel as TFPredictor)
+from analytics_zoo_tpu.tfpark.gan import GANEstimator
+
+
+def KerasModel(model):
+    """ref-parity: tfpark.KerasModel wrapped a compiled tf.keras model; our
+    keras models already carry compile/fit/evaluate/predict."""
+    from analytics_zoo_tpu.keras.engine import KerasNet
+
+    if not isinstance(model, KerasNet):
+        raise TypeError(
+            f"KerasModel wraps analytics_zoo_tpu.keras models, got "
+            f"{type(model).__name__}")
+    return model
+
+
+__all__ = ["TFEstimator", "TFPredictor", "KerasModel", "GANEstimator"]

@@ -1,0 +1,227 @@
+"""GANEstimator — alternating two-optimizer adversarial training.
+
+Reference surface (SURVEY.md §2.3 TFPark row; ref: pyzoo/zoo/tfpark/gan/
+gan_estimator.py, modeled on tf.contrib.gan's GANEstimator): user supplies
+generator/discriminator model fns, per-network loss fns and optimizers; the
+estimator alternates D and G updates over the input stream.
+
+TPU re-design: BOTH sub-steps live in ONE jitted function — d-grads,
+d-update, g-grads, g-update fuse into a single XLA program per batch (no
+per-network session runs); noise is drawn on-device from the train-state
+RNG; batches arrive through the same make_global_batch dp-sharding path the
+main Estimator uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.common.log import logger
+from analytics_zoo_tpu.data.loader import (DataCreator, NumpyBatchIterator,
+                                           device_prefetch)
+from analytics_zoo_tpu.parallel.mesh import make_mesh
+from analytics_zoo_tpu.parallel.partition import data_sharding
+
+
+# -- built-in GAN losses (ref: tf.contrib.gan losses used by the TFPark
+# estimator).  d_loss(real_logits, fake_logits); g_loss(fake_logits).
+
+def minimax_d_loss(real, fake):
+    return (jnp.mean(optax.sigmoid_binary_cross_entropy(
+        real, jnp.ones_like(real)))
+        + jnp.mean(optax.sigmoid_binary_cross_entropy(
+            fake, jnp.zeros_like(fake))))
+
+
+def minimax_g_loss(fake):
+    # non-saturating variant (the practical default)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(
+        fake, jnp.ones_like(fake)))
+
+
+def lsgan_d_loss(real, fake):
+    return 0.5 * (jnp.mean((real - 1.0) ** 2) + jnp.mean(fake ** 2))
+
+
+def lsgan_g_loss(fake):
+    return 0.5 * jnp.mean((fake - 1.0) ** 2)
+
+
+def wasserstein_d_loss(real, fake):
+    return jnp.mean(fake) - jnp.mean(real)
+
+
+def wasserstein_g_loss(fake):
+    return -jnp.mean(fake)
+
+
+_LOSSES = {
+    "minimax": (minimax_d_loss, minimax_g_loss),
+    "lsgan": (lsgan_d_loss, lsgan_g_loss),
+    "wasserstein": (wasserstein_d_loss, wasserstein_g_loss),
+}
+
+
+class GANEstimator:
+    """Adversarial trainer over flax generator/discriminator modules.
+
+    Args:
+      generator: flax module, noise [B, noise_dim] -> sample.
+      discriminator: flax module, sample -> logits.
+      loss: name in {"minimax", "lsgan", "wasserstein"} OR a pair
+        (d_loss_fn(real_logits, fake_logits), g_loss_fn(fake_logits)).
+      generator_optimizer / discriminator_optimizer: optax transforms.
+      noise_dim: latent dimension sampled N(0, 1) on device.
+      d_steps: discriminator updates per generator update (WGAN-style
+        n_critic); the extra D steps run inside the same jit.
+    """
+
+    def __init__(self, generator, discriminator, *,
+                 loss: Any = "minimax",
+                 generator_optimizer=None, discriminator_optimizer=None,
+                 noise_dim: int = 64, d_steps: int = 1,
+                 mesh=None, seed: int = 0):
+        self.gen = generator
+        self.disc = discriminator
+        if isinstance(loss, str):
+            if loss not in _LOSSES:
+                raise ValueError(f"unknown GAN loss {loss!r}; "
+                                 f"have {sorted(_LOSSES)}")
+            self.d_loss_fn, self.g_loss_fn = _LOSSES[loss]
+        else:
+            self.d_loss_fn, self.g_loss_fn = loss
+        self.g_tx = generator_optimizer or optax.adam(2e-4, b1=0.5)
+        self.d_tx = discriminator_optimizer or optax.adam(2e-4, b1=0.5)
+        self.noise_dim = noise_dim
+        self.d_steps = d_steps
+        if mesh is None:
+            try:
+                from analytics_zoo_tpu.common.context import OrcaContext
+                mesh = OrcaContext.get_context().mesh
+            except RuntimeError:
+                mesh = make_mesh(axes={"dp": -1})
+        self.mesh = mesh
+        self.seed = seed
+        self.state: Optional[Dict[str, Any]] = None
+        self._jit_step = None
+        self._data_sharding = data_sharding(self.mesh)
+
+    # ------------------------------------------------------------------
+
+    def _ensure_state(self, sample_real: np.ndarray):
+        if self.state is not None:
+            return
+        root = jax.random.key(self.seed)
+        kg, kd, ktrain = jax.random.split(root, 3)
+        noise = jnp.zeros((1, self.noise_dim), jnp.float32)
+        gv = self.gen.init(kg, noise)
+        fake = self.gen.apply(gv, noise)
+        dv = self.disc.init(kd, fake)
+        self.state = {
+            "g_params": gv["params"], "d_params": dv["params"],
+            "g_opt": self.g_tx.init(gv["params"]),
+            "d_opt": self.d_tx.init(dv["params"]),
+            "rng": ktrain, "step": jnp.zeros((), jnp.int32),
+        }
+        n = sum(int(np.prod(p.shape))
+                for p in jax.tree.leaves((gv, dv)))
+        logger.info("GANEstimator init: %s params total, mesh=%s",
+                    f"{n:,}", dict(self.mesh.shape))
+
+    def _build_step(self):
+        if self._jit_step is not None:
+            return
+
+        def step(state, real):
+            rng = jax.random.fold_in(state["rng"], state["step"])
+            b = real.shape[0]
+
+            def d_one(carry, key):
+                d_params, d_opt = carry
+                noise = jax.random.normal(key, (b, self.noise_dim))
+                fake = self.gen.apply({"params": state["g_params"]}, noise)
+                fake = jax.lax.stop_gradient(fake)
+
+                def dl(p):
+                    return self.d_loss_fn(
+                        self.disc.apply({"params": p}, real),
+                        self.disc.apply({"params": p}, fake))
+                d_loss, gd = jax.value_and_grad(dl)(d_params)
+                upd, d_opt = self.d_tx.update(gd, d_opt, d_params)
+                return (optax.apply_updates(d_params, upd), d_opt), d_loss
+
+            keys = jax.random.split(rng, self.d_steps + 1)
+            (d_params, d_opt), d_losses = jax.lax.scan(
+                d_one, (state["d_params"], state["d_opt"]),
+                keys[:self.d_steps])
+
+            def gl(p):
+                noise = jax.random.normal(keys[-1], (b, self.noise_dim))
+                fake = self.gen.apply({"params": p}, noise)
+                return self.g_loss_fn(
+                    self.disc.apply({"params": d_params}, fake))
+            g_loss, gg = jax.value_and_grad(gl)(state["g_params"])
+            upd, g_opt = self.g_tx.update(gg, state["g_opt"],
+                                          state["g_params"])
+            new = {
+                "g_params": optax.apply_updates(state["g_params"], upd),
+                "d_params": d_params, "g_opt": g_opt, "d_opt": d_opt,
+                "rng": state["rng"], "step": state["step"] + 1,
+            }
+            return new, {"d_loss": d_losses[-1], "g_loss": g_loss}
+
+        self._jit_step = jax.jit(step, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            feature_col: str = "x") -> list:
+        """data: ndarray of real samples, dict with `feature_col`, XShards,
+        or a creator fn (the Estimator data contract)."""
+        if isinstance(data, np.ndarray):
+            data = {feature_col: data}
+        arrays = DataCreator.to_arrays(data)
+        if feature_col in arrays:
+            real = arrays[feature_col]
+        elif len(arrays) == 1:
+            real = next(iter(arrays.values()))
+        else:
+            raise KeyError(
+                f"feature_col {feature_col!r} not in data columns "
+                f"{sorted(arrays)} — ambiguous which one holds the real "
+                "samples")
+        self._ensure_state(real)
+        self._build_step()
+        it = NumpyBatchIterator({"x": real}, batch_size, seed=self.seed)
+        history = []
+        for ep in range(epochs):
+            acc: list = []
+            # device_prefetch double-buffers H2D staging against compute,
+            # same as the main Estimator's fit loop; metrics stay on device
+            # until epoch end so no per-step host sync blocks the pipeline
+            for gb in device_prefetch(it.epoch_batches(), self.mesh,
+                                      sharding=self._data_sharding):
+                self.state, mets = self._jit_step(self.state, gb["x"])
+                acc.append(mets)
+            n = len(acc)
+            stats = {k: float(np.mean([np.asarray(m[k]) for m in acc]))
+                     for k in (acc[0] if acc else {})}
+            stats["epoch"] = ep + 1
+            stats["steps"] = n
+            history.append(stats)
+            logger.info("GAN epoch %d: %s", ep + 1, stats)
+        return history
+
+    def generate(self, n: int, seed: int = 0) -> np.ndarray:
+        """Sample n outputs from the trained generator."""
+        if self.state is None:
+            raise RuntimeError("fit first")
+        noise = jax.random.normal(jax.random.key(seed),
+                                  (n, self.noise_dim))
+        out = self.gen.apply({"params": self.state["g_params"]}, noise)
+        return np.asarray(out)

@@ -183,6 +183,66 @@ def test_functional_gelu_exact_erf():
     _check(G(), x, atol=1e-6)
 
 
+def test_direct_parameter_attribute_is_trainable(ctx8):
+    """self.scale = nn.Parameter(...) used in forward must be trainable."""
+    import optax
+
+    from analytics_zoo_tpu.learn import Estimator
+
+    class Scaled(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = tnn.Linear(4, 1)
+            self.scale = tnn.Parameter(torch.ones(1))
+            self.register_buffer("offset", torch.full((1,), 0.5))
+
+        def forward(self, x):
+            return self.fc(x) * self.scale + self.offset
+
+    m = Scaled()
+    net = _check(m, np.ones((2, 4), np.float32))
+    assert "scale" in net.params["_attrs"], "nn.Parameter must be trainable"
+    assert "offset" in net.buffers["_attrs"], "buffer must stay frozen"
+
+    est = Estimator.from_torch(model=m, loss="mse",
+                               optimizer=optax.adam(1e-1),
+                               feature_cols=("x",), label_cols=("y",))
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 4)).astype(np.float32)
+    Y = (3.0 * X.sum(1, keepdims=True)).astype(np.float32)
+    est.fit({"x": X, "y": Y}, epochs=3, batch_size=32)
+    scale = float(np.asarray(est.state.params["_attrs"]["scale"]))
+    assert abs(scale - 1.0) > 1e-3, "scale parameter never updated"
+    off = float(np.asarray(est.state.batch_stats["_attrs"]["offset"]))
+    assert off == 0.5, "buffer must not be optimizer-updated"
+
+
+def test_flatten_method_default_start_dim_zero():
+    class F(tnn.Module):
+        def forward(self, x):
+            return x.flatten()
+
+    x = np.random.default_rng(12).normal(size=(2, 3, 4)).astype(np.float32)
+    _check(F(), x)
+
+
+def test_autoestimator_style_creator_converts(ctx8):
+    """A creator returning a raw torch module must convert at any depth
+    (Estimator.from_flax path, as AutoEstimator trials use)."""
+    import optax
+
+    from analytics_zoo_tpu.learn import Estimator
+
+    est = Estimator.from_flax(
+        model_creator=lambda cfg: tnn.Sequential(tnn.Linear(4, 1)),
+        loss="mse", optimizer=optax.adam(1e-2),
+        feature_cols=("x",), label_cols=("y",))
+    X = np.ones((32, 4), np.float32)
+    Y = np.zeros((32, 1), np.float32)
+    stats = est.fit({"x": X, "y": Y}, epochs=1, batch_size=16)
+    assert np.isfinite(stats[0]["loss"])
+
+
 def test_param_path_collision_safe():
     """'block.0' and 'block_0' must map to distinct param paths."""
     class M(tnn.Module):
