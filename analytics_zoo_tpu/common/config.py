@@ -66,6 +66,14 @@ class TrainConfig:
     param_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the model apply
     donate_state: bool = True
+    # observability (SURVEY §5: TrainSummary/TensorBoard + jsonl analogs)
+    tensorboard_dir: Optional[str] = None
+    metrics_jsonl: Optional[str] = None
+    # jax.profiler trace: (logdir, start_global_step, n_steps)
+    profile: Optional[tuple] = None
+    # fault-injection hook (SURVEY §5 failure-recovery testing): raise at
+    # this global step to exercise checkpoint-resume paths
+    fault_inject_step: int = 0
 
 
 @dataclass

@@ -234,6 +234,9 @@ class KerasNet(nn.Module):
             label_cols=("y",),
             param_loss=lambda params: collect_penalty(self, params),
         )
+        tb = getattr(self, "_tb_cfg", None)
+        if tb is not None:
+            est.set_tensorboard(*tb)
         object.__setattr__(self, "_estimator", est)
         return est
 
@@ -286,6 +289,14 @@ class KerasNet(nn.Module):
         new = jax.tree_util.tree_map_with_path(
             lambda p, l: by_path[_path_str(p)], est.state.params)
         est.state = est.state.replace(params=new)
+
+    def set_tensorboard(self, log_dir: str, app_name: str = "zoo"):
+        """ref-parity: KerasNet.set_tensorboard (BigDL TrainSummary)."""
+        object.__setattr__(self, "_tb_cfg", (log_dir, app_name))
+        est = getattr(self, "_estimator", None)
+        if est is not None:
+            est.set_tensorboard(log_dir, app_name)
+        return self
 
     def summary(self) -> str:
         lines = [f"{type(self).__name__}"]

@@ -112,6 +112,7 @@ class FlaxEstimator:
         self._jit_predict_step = None
         self._epoch = 0
         self._global_step = 0
+        self._prof_active = False
 
     @staticmethod
     def _maybe_convert_torch(model):
@@ -285,6 +286,28 @@ class FlaxEstimator:
                     dict(self.mesh.shape))
 
     # ------------------------------------------------------------------
+    # observability (SURVEY §5; ref: KerasNet.set_tensorboard ->
+    # BigDL TrainSummary under log_dir/app_name)
+    # ------------------------------------------------------------------
+
+    def set_tensorboard(self, log_dir: str, app_name: str = "zoo"):
+        import os
+
+        self.config.tensorboard_dir = os.path.join(log_dir, app_name,
+                                                   "train")
+        self.config.metrics_jsonl = os.path.join(log_dir, app_name,
+                                                 "train.jsonl")
+        os.makedirs(self.config.tensorboard_dir, exist_ok=True)
+        return self
+
+    def set_profile(self, logdir: str, start_step: int = 5,
+                    n_steps: int = 5):
+        """Capture a jax.profiler trace for `n_steps` once training reaches
+        `start_step` (skips compile/warmup noise)."""
+        self.config.profile = (logdir, start_step, n_steps)
+        return self
+
+    # ------------------------------------------------------------------
     # public API (reference parity: fit/evaluate/predict/save/load)
     # ------------------------------------------------------------------
 
@@ -317,9 +340,28 @@ class FlaxEstimator:
         self._global_step = int(self.state.step)
         trigger = checkpoint_trigger or (
             EveryEpoch() if self.config.checkpoint_dir else None)
-        mlog = MetricLogger(log_every=self.config.log_every_steps)
+        mlog = MetricLogger(jsonl_path=self.config.metrics_jsonl,
+                            tensorboard_dir=self.config.tensorboard_dir,
+                            log_every=self.config.log_every_steps)
+        prof = self.config.profile      # (logdir, start_step, n_steps)
+        prof_active = False
         history: List[Dict[str, float]] = []
         log_every = max(1, self.config.log_every_steps)
+        try:
+            return self._fit_epochs(
+                epochs, it, batch_size, validation_data, trigger, mlog,
+                prof, history, log_every, callbacks)
+        finally:
+            # fault injection / data errors must not leak an active trace
+            # (next start_trace would fail) or an open jsonl handle
+            if self._prof_active:
+                jax.profiler.stop_trace()
+                self._prof_active = False
+            mlog.close()
+
+    def _fit_epochs(self, epochs, it, batch_size, validation_data, trigger,
+                    mlog, prof, history, log_every, callbacks):
+        prof_active = False
         for _ in range(epochs):
             t0 = time.perf_counter()
             n_steps = 0
@@ -329,10 +371,24 @@ class FlaxEstimator:
                 # Hot loop: never block on device values here — metrics stay
                 # on-device (async dispatch continues); host sync happens
                 # only at log points and epoch end.
+                if prof and not prof_active and \
+                        self._global_step >= prof[1]:
+                    jax.profiler.start_trace(prof[0])
+                    prof_active = self._prof_active = True
                 self.state, mets = self._jit_train_step(self.state, gbatch)
                 step_mets.append(mets)
                 n_steps += 1
                 self._global_step += 1
+                if prof_active and self._global_step >= prof[1] + prof[2]:
+                    jax.block_until_ready(mets["loss"])
+                    jax.profiler.stop_trace()
+                    prof_active = self._prof_active = False
+                    prof = None
+                if self.config.fault_inject_step and \
+                        self._global_step == self.config.fault_inject_step:
+                    raise RuntimeError(
+                        f"injected fault at step {self._global_step} "
+                        "(TrainConfig.fault_inject_step)")
                 if n_steps % log_every == 0:
                     mlog.log(self._global_step,
                              {k: np.asarray(v) for k, v in mets.items()},
@@ -362,7 +418,6 @@ class FlaxEstimator:
             logger.info("epoch %d: %s", self._epoch,
                         {k: round(v, 5) for k, v in stats.items()})
             history.append(stats)
-        mlog.close()
         return history
 
     def evaluate(self, data, batch_size: int = 32,
@@ -568,6 +623,16 @@ def _local_rows(preds) -> Any:
     return jax.tree.map(one, preds)
 
 
+def _route_train_config(config, kw):
+    """`config` on the constructor facade is the reference's model-creator
+    config dict; a TrainConfig passed there is clearly meant for the
+    estimator — route it into kw instead of silently dropping it."""
+    if isinstance(config, TrainConfig):
+        kw.setdefault("config", config)
+        return None
+    return config
+
+
 class Estimator:
     """Constructor facade — reference parity with zoo.orca.learn.*.Estimator."""
 
@@ -575,6 +640,7 @@ class Estimator:
     def from_flax(*, model=None, model_creator=None, loss=None,
                   optimizer=None, config: Optional[dict] = None,
                   **kw) -> FlaxEstimator:
+        config = _route_train_config(config, kw)
         if model is None:
             if model_creator is None:
                 raise ValueError("need model or model_creator")
@@ -597,6 +663,7 @@ class Estimator:
         A real torch nn.Module is converted to JAX via TorchNet (torch.fx
         graph -> pure function + param pytree, ref TorchNet.scala) and then
         trained by the same pjit Estimator; flax modules pass through."""
+        config = _route_train_config(config, kw)
         if model is None:
             if model_creator is None:
                 raise ValueError("need model or model_creator")

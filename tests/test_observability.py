@@ -1,0 +1,110 @@
+"""Aux subsystems (SURVEY §5): metrics sinks, profiler hook, fault
+injection + checkpoint-resume."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+from analytics_zoo_tpu.learn import Estimator
+from analytics_zoo_tpu.models import NeuralCF, NCF_PARTITION_RULES
+
+
+def _est(tmp=None, **cfg_kw):
+    from analytics_zoo_tpu.common.config import TrainConfig
+
+    return Estimator.from_flax(
+        model=NeuralCF(user_count=50, item_count=30, user_embed=8,
+                       item_embed=8, mf_embed=8, hidden_layers=(16,)),
+        loss="sparse_categorical_crossentropy",
+        optimizer=optax.adam(1e-3),
+        feature_cols=("user", "item"), label_cols=("label",),
+        partition_rules=NCF_PARTITION_RULES,
+        config=TrainConfig(log_every_steps=1, **cfg_kw))
+
+
+def _data(n=256):
+    rng = np.random.default_rng(0)
+    return {"user": rng.integers(1, 50, n).astype(np.int32),
+            "item": rng.integers(1, 30, n).astype(np.int32),
+            "label": rng.integers(0, 2, n).astype(np.int32)}
+
+
+def test_set_tensorboard_writes_jsonl_and_events(tmp_path, ctx8):
+    est = _est().set_tensorboard(str(tmp_path), app_name="myapp")
+    est.fit(_data(), epochs=1, batch_size=64)
+    jl = tmp_path / "myapp" / "train.jsonl"
+    assert jl.exists()
+    recs = [json.loads(line) for line in jl.read_text().splitlines()]
+    assert recs and "loss" in recs[0] and "step" in recs[0]
+    # event files appear only when the torch SummaryWriter is available
+    # (torch is an optional extra; MetricLogger degrades to a warning)
+    try:
+        import torch.utils.tensorboard  # noqa: F401
+        has_tb = True
+    except Exception:
+        has_tb = False
+    events = glob.glob(str(tmp_path / "myapp" / "train" / "events.*"))
+    if has_tb:
+        assert events, "tensorboard event file missing"
+
+
+def test_profiler_trace_captured(tmp_path, ctx8):
+    est = _est().set_profile(str(tmp_path / "prof"), start_step=2,
+                             n_steps=2)
+    est.fit(_data(), epochs=1, batch_size=64)
+    traces = glob.glob(str(tmp_path / "prof" / "**" / "*.trace.json.gz"),
+                       recursive=True) + \
+        glob.glob(str(tmp_path / "prof" / "**" / "*.xplane.pb"),
+                  recursive=True)
+    assert traces, f"no profiler artifacts under {tmp_path / 'prof'}"
+
+
+def test_fault_injection_then_resume(tmp_path, ctx8):
+    """SURVEY §5 failure recovery: crash mid-epoch, restart from the step
+    checkpoint, finish training."""
+    ckpt = str(tmp_path / "ckpt")
+    est = _est(checkpoint_dir=ckpt, checkpoint_every_steps=1,
+               fault_inject_step=3)
+    from analytics_zoo_tpu.learn.triggers import SeveralIteration
+
+    with pytest.raises(RuntimeError, match="injected fault"):
+        est.fit(_data(), epochs=2, batch_size=64,
+                checkpoint_trigger=SeveralIteration(1))
+    # fresh estimator resumes from the persisted step
+    est2 = _est(checkpoint_dir=ckpt)
+    est2._ensure_state(_data(64))
+    est2.load_checkpoint(ckpt)
+    resumed_step = int(est2.state.step)
+    assert 1 <= resumed_step <= 3
+    stats = est2.fit(_data(), epochs=1, batch_size=64)
+    assert np.isfinite(stats[-1]["loss"])
+    assert int(est2.state.step) > resumed_step
+
+
+def test_profiler_not_leaked_on_fault(tmp_path, ctx8):
+    """A mid-fit crash while tracing must stop the trace so a retry can
+    start a new one ('Only one profile may be run at a time')."""
+    est = _est(fault_inject_step=3)
+    est.set_profile(str(tmp_path / "p1"), start_step=1, n_steps=50)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        est.fit(_data(), epochs=1, batch_size=64)
+    est2 = _est().set_profile(str(tmp_path / "p2"), start_step=1, n_steps=2)
+    est2.fit(_data(), epochs=1, batch_size=64)   # must not raise
+    assert glob.glob(str(tmp_path / "p2" / "**" / "*.xplane.pb"),
+                     recursive=True)
+
+
+def test_keras_set_tensorboard_before_compile(tmp_path, ctx8):
+    from analytics_zoo_tpu import keras as zk
+
+    m = zk.Sequential().add(zk.Dense(1))
+    m.set_tensorboard(str(tmp_path), "app")     # before compile/fit
+    m.compile(optimizer="sgd", loss="mse")
+    X = np.ones((64, 4), np.float32)
+    Y = np.zeros((64, 1), np.float32)
+    m.fit(X, Y, batch_size=32, nb_epoch=1)
+    assert (tmp_path / "app" / "train.jsonl").exists()
