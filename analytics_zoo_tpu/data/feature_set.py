@@ -183,6 +183,20 @@ class DiskFeatureSet:
         return device_prefetch(self.batches(batch_size, **kw), mesh,
                                depth=depth, sharding=sharding)
 
+    def sample_block(self) -> Dict[str, np.ndarray]:
+        """First row-block (shape/dtype probe) — reads one record, no
+        prefetch thread / ring buffer involved."""
+        if not len(self.reader):
+            raise ValueError(f"{self.path} holds no records")
+        return self._native.unpack_batch(self.reader.get(0))
+
+    def batch_iterator(self, batch_size: int, *, shuffle: bool = True,
+                       seed: int = 0) -> "_DiskEpochIterator":
+        """NumpyBatchIterator-compatible epoch iterator (Estimator.fit's
+        data protocol): each epoch_batches() call streams a fresh shuffled
+        pass through the native prefetch thread."""
+        return _DiskEpochIterator(self, batch_size, shuffle, seed)
+
     def to_dram(self) -> FeatureSet:
         cols: Dict[str, list] = {}
         for i in range(len(self.reader)):
@@ -192,3 +206,25 @@ class DiskFeatureSet:
 
     def close(self):
         self.reader.close()
+
+
+class _DiskEpochIterator:
+    """Adapter: DiskFeatureSet -> the epoch_batches() protocol fit uses."""
+
+    def __init__(self, dfs: DiskFeatureSet, batch_size: int, shuffle: bool,
+                 seed: int):
+        self.dfs = dfs
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+
+    def steps_per_epoch(self) -> int:
+        return len(self.dfs) // self.batch_size
+
+    def epoch_batches(self):
+        it = self.dfs.batches(self.batch_size, shuffle=self.shuffle,
+                              drop_remainder=True, seed=self.seed,
+                              epoch=self.epoch)
+        self.epoch += 1
+        return it

@@ -121,7 +121,13 @@ class DataCreator:
                   ) -> Dict[str, np.ndarray]:
         if callable(data):
             data = data(config or {})
-        if isinstance(data, XShards):
+        # FeatureSet tiers (import locally — feature_set imports loader)
+        from analytics_zoo_tpu.data import feature_set as _fs
+        if isinstance(data, _fs.DiskFeatureSet):
+            data = data.to_dram()       # eval/predict paths materialise
+        if isinstance(data, _fs.FeatureSet):
+            d = dict(data.arrays)
+        elif isinstance(data, XShards):
             d = data.to_numpy_dict()
         elif isinstance(data, dict):
             d = {k: np.asarray(v) for k, v in data.items()}

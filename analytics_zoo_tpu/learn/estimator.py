@@ -326,16 +326,24 @@ class FlaxEstimator:
         the cluster). Returns per-epoch stats dicts (reference: Orca runner
         stats lists)."""
         self._set_cols(feature_cols, label_cols)
-        arrays = _host_local(data)
         n_hosts = jax.process_count()
         if batch_size < 1 or batch_size % n_hosts:
             raise ValueError(f"global batch {batch_size} must be positive "
                              f"and divisible by host count {n_hosts}")
         per_host = batch_size // n_hosts
-        it = NumpyBatchIterator(arrays, per_host, shuffle=True,
-                                drop_remainder=True,
-                                seed=self.config.seed + jax.process_index())
-        self._ensure_state(arrays)
+        from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
+        if isinstance(data, DiskFeatureSet):
+            _require_single_host_for_disk()
+            # DISK tier streams through the native prefetch thread
+            it = data.batch_iterator(
+                per_host, seed=self.config.seed + jax.process_index())
+            self._ensure_state(data.sample_block())
+        else:
+            arrays = _host_local(data)
+            it = NumpyBatchIterator(
+                arrays, per_host, shuffle=True, drop_remainder=True,
+                seed=self.config.seed + jax.process_index())
+            self._ensure_state(arrays)
         self._build_jits()
         self._global_step = int(self.state.step)
         trigger = checkpoint_trigger or (
@@ -420,18 +428,38 @@ class FlaxEstimator:
             history.append(stats)
         return history
 
+    def _eval_chunks(self, data, per_host):
+        """Host-local, fixed-order chunks of <= per_host rows.  The DISK
+        tier streams block-by-block (never materialised to DRAM — the
+        whole point of the tier); everything else normalises to arrays."""
+        from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
+
+        if isinstance(data, DiskFeatureSet):
+            _require_single_host_for_disk()
+            yield from data.batches(per_host, shuffle=False,
+                                    drop_remainder=False)
+            return
+        arrays = _host_local(data)
+        n = len(next(iter(arrays.values())))
+        for lo in range(0, n, per_host):
+            yield {k: v[lo:lo + per_host] for k, v in arrays.items()}
+
+    def _sample_of(self, data) -> Dict[str, np.ndarray]:
+        from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
+
+        if isinstance(data, DiskFeatureSet):
+            return data.sample_block()
+        return _host_local(data)
+
     def evaluate(self, data, batch_size: int = 32,
                  feature_cols=None, label_cols=None) -> Dict[str, float]:
         self._set_cols(feature_cols, label_cols)
-        arrays = _host_local(data)
-        self._ensure_state(arrays)
+        self._ensure_state(self._sample_of(data))
         self._build_jits()
         n_hosts = jax.process_count()
         per_host = max(1, batch_size // n_hosts)
-        n = len(next(iter(arrays.values())))
         acc = EpochAccumulator()
-        for lo in range(0, n, per_host):
-            chunk = {k: v[lo:lo + per_host] for k, v in arrays.items()}
+        for chunk in self._eval_chunks(data, per_host):
             real = len(next(iter(chunk.values())))
             chunk, w = _pad_batch(chunk, per_host)
             gbatch = make_global_batch(self.mesh, chunk, self._data_sharding)
@@ -445,18 +473,17 @@ class FlaxEstimator:
     def predict(self, data, batch_size: int = 32,
                 feature_cols=None) -> np.ndarray:
         self._set_cols(feature_cols, None)
-        arrays = _host_local(data)
+        sample = self._sample_of(data)
         for c in self.feature_cols:
-            if c not in arrays:
+            if c not in sample:
                 raise KeyError(f"feature col {c!r} missing from predict data")
-        self._ensure_state(arrays)
+        self._ensure_state(sample)
         self._build_jits()
         n_hosts = jax.process_count()
         per_host = max(1, batch_size // n_hosts)
-        n = len(next(iter(arrays.values())))
         outs = []
-        for lo in range(0, n, per_host):
-            chunk = {k: v[lo:lo + per_host] for k, v in arrays.items()
+        for chunk in self._eval_chunks(data, per_host):
+            chunk = {k: v for k, v in chunk.items()
                      if k in self.feature_cols}
             real = len(next(iter(chunk.values())))
             chunk, _ = _pad_batch(chunk, per_host)
@@ -568,6 +595,18 @@ def _abs(path: str) -> str:
     import os
 
     return os.path.abspath(path)
+
+
+def _require_single_host_for_disk():
+    """DiskFeatureSet multi-host semantics (each host spilling its own
+    shard vs a replicated file) are not settled — refuse rather than pick
+    one silently; fit would train on duplicates or evaluate would drop
+    rows depending on which assumption the file actually satisfies."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "DiskFeatureSet with multiple hosts is not supported yet: "
+            "spill per-host XShards to per-host files and pass host-local "
+            "arrays, or keep the DRAM tier")
 
 
 def _host_local(data) -> Dict[str, np.ndarray]:

@@ -8,10 +8,11 @@ from analytics_zoo_tpu.zouwu.preprocessing import (
     MinMaxScaler, StandardScaler, TimeSequenceFeatureTransformer,
     datetime_features, roll, train_val_test_split)
 from analytics_zoo_tpu.zouwu.autots import AutoTSTrainer, TSPipeline
+from analytics_zoo_tpu.zouwu.tcmf import TCMFForecaster
 
 __all__ = [
     "Forecaster", "LSTMForecaster", "TCNForecaster", "MTNetForecaster",
-    "Seq2SeqForecaster",
+    "Seq2SeqForecaster", "TCMFForecaster",
     "roll", "train_val_test_split", "StandardScaler", "MinMaxScaler",
     "datetime_features", "TimeSequenceFeatureTransformer",
     "AutoTSTrainer", "TSPipeline",

@@ -289,6 +289,37 @@ def test_feature_set_disk_remainder_and_dram_roundtrip(tmp_path):
     dfs.close()
 
 
+def test_estimator_fit_from_disk_feature_set():
+    """Estimator.fit streams the DISK tier end-to-end (SURVEY §2.2 tiering
+    + §2.3 training contract in one path)."""
+    import optax
+
+    from analytics_zoo_tpu.data import FeatureSet
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models import NeuralCF, NCF_PARTITION_RULES
+
+    rng = np.random.default_rng(3)
+    n = 512
+    arr = {"user": rng.integers(1, 50, n).astype(np.int32),
+           "item": rng.integers(1, 30, n).astype(np.int32),
+           "label": rng.integers(0, 2, n).astype(np.int32)}
+    dfs = FeatureSet.from_arrays(arr).to_disk(block_rows=64)
+    est = Estimator.from_flax(
+        model=NeuralCF(user_count=50, item_count=30, user_embed=8,
+                       item_embed=8, mf_embed=8, hidden_layers=(16,)),
+        loss="sparse_categorical_crossentropy",
+        optimizer=optax.adam(1e-3),
+        feature_cols=("user", "item"), label_cols=("label",),
+        partition_rules=NCF_PARTITION_RULES)
+    stats = est.fit(dfs, epochs=2, batch_size=64)
+    assert len(stats) == 2 and np.isfinite(stats[-1]["loss"])
+    assert stats[-1]["num_samples"] == 512.0
+    # evaluate/predict materialise the disk tier transparently
+    ev = est.evaluate(dfs, batch_size=64)
+    assert np.isfinite(ev["loss"])
+    dfs.close()
+
+
 def test_feature_set_device_stream():
     import jax
 

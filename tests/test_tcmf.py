@@ -1,0 +1,74 @@
+"""TCMFForecaster: low-rank multi-series factorization + forecasting."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.zouwu import TCMFForecaster
+
+
+def _lowrank_series(n=40, T=120, k=3, seed=0):
+    """Y = F X with smooth sinusoidal basis — exactly TCMF's model class."""
+    rng = np.random.default_rng(seed)
+    t = np.arange(T + 24)
+    X = np.stack([np.sin(2 * np.pi * t / p) for p in (12, 24, 37)])[:k]
+    F = rng.normal(size=(n, k))
+    Y = F @ X + 0.02 * rng.normal(size=(n, T + 24))
+    return Y[:, :T].astype(np.float32), Y[:, T:].astype(np.float32)
+
+
+def test_fit_reconstructs_lowrank():
+    y, _ = _lowrank_series()
+    fc = TCMFForecaster(rank=6, window=24, seed=1)
+    stats = fc.fit(y, epochs=400, tcn_epochs=100)
+    assert stats["recon_loss"] < 0.05, stats
+    recon = np.asarray(fc.F @ fc.X)
+    rel = np.linalg.norm(recon - y) / np.linalg.norm(y)
+    assert rel < 0.2, rel
+
+
+def test_forecast_beats_last_value_baseline():
+    y, future = _lowrank_series()
+    fc = TCMFForecaster(rank=6, window=24, seed=1)
+    fc.fit(y, epochs=400, tcn_epochs=300)
+    pred = fc.predict(horizon=24)
+    assert pred.shape == future.shape
+    mse = np.mean((pred - future) ** 2)
+    naive = np.mean((y[:, -1:] - future) ** 2)   # persistence baseline
+    assert mse < naive, (mse, naive)
+
+
+def test_nan_masking():
+    y, _ = _lowrank_series(n=20, T=80)
+    y_missing = y.copy()
+    y_missing[::3, ::5] = np.nan
+    fc = TCMFForecaster(rank=6, window=16, seed=2)
+    stats = fc.fit(y_missing, epochs=300, tcn_epochs=50)
+    assert np.isfinite(stats["recon_loss"])
+    # reconstruction on observed entries still close
+    recon = np.asarray(fc.F @ fc.X)
+    obs = ~np.isnan(y_missing)
+    rel = np.linalg.norm((recon - y)[obs]) / np.linalg.norm(y[obs])
+    assert rel < 0.3, rel
+
+
+def test_save_load_roundtrip(tmp_path):
+    y, _ = _lowrank_series(n=10, T=60)
+    fc = TCMFForecaster(rank=4, window=12, seed=3)
+    fc.fit(y, epochs=100, tcn_epochs=30)
+    pred = fc.predict(horizon=8)
+    fc.save(str(tmp_path))
+    fc2 = TCMFForecaster.load(str(tmp_path))
+    np.testing.assert_allclose(fc2.predict(horizon=8), pred, atol=1e-5)
+
+
+def test_evaluate_and_errors():
+    y, future = _lowrank_series(n=10, T=60)
+    fc = TCMFForecaster(rank=4, window=12)
+    with pytest.raises(RuntimeError):
+        fc.predict(4)
+    with pytest.raises(ValueError):
+        fc.fit(np.zeros((5, 10)))    # shorter than window+1
+    fc.fit(y, epochs=100, tcn_epochs=30)
+    out = fc.evaluate(future, metrics=("mse", "mae", "smape"))
+    assert set(out) == {"mse", "mae", "smape"}
+    assert all(np.isfinite(v) for v in out.values())
