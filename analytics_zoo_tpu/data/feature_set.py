@@ -104,15 +104,11 @@ class DiskFeatureSet:
         meta = native.unpack_batch(self.reader.get(0)) if len(self.reader) \
             else {}
         self.colnames = sorted(meta)
-        self._block_rows = len(next(iter(meta.values()))) if meta else 0
-        # total rows: full blocks + (possibly short) last block
-        nblocks = len(self.reader)
-        if nblocks:
-            last = native.unpack_batch(self.reader.get(nblocks - 1))
-            self._n = (nblocks - 1) * self._block_rows \
-                + len(next(iter(last.values())))
-        else:
-            self._n = 0
+        # Exact total: sum each block's header row count (header peek over
+        # the mmap — no payload copies).  Files written through the public
+        # RecordWriter/pack_batch API may have arbitrarily uneven blocks.
+        self._n = sum(native.peek_batch_rows(self.reader.get(i))
+                      for i in range(len(self.reader)))
 
     def __len__(self) -> int:
         return self._n
@@ -120,9 +116,10 @@ class DiskFeatureSet:
     def batches(self, batch_size: int, *, shuffle: bool = True,
                 drop_remainder: bool = True, seed: int = 0, epoch: int = 0
                 ) -> Iterator[Dict[str, np.ndarray]]:
-        if batch_size > self._n:
-            # match the DRAM tier's NumpyBatchIterator contract — a silent
-            # zero-batch epoch would look like training while doing nothing
+        if batch_size > self._n and drop_remainder:
+            # a silent zero-batch epoch would look like training while doing
+            # nothing; with drop_remainder=False the single short batch is
+            # emitted instead (the DRAM tier's eval/predict contract)
             raise ValueError(
                 f"per-host batch {batch_size} > host rows {self._n}")
         native = self._native

@@ -19,6 +19,7 @@ TPU-first re-design, not a translation:
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
@@ -90,6 +91,11 @@ class MultiHeadAttention(nn.Module):
     def _flash_ok(self, seq_len: int) -> bool:
         if self.use_flash is not None:
             return self.use_flash
+        # kill-switch: a Mosaic lowering failure on some future
+        # TPU generation must be work-aroundable without code changes
+        if os.environ.get("ZOO_DISABLE_FLASH", "").lower() not in (
+                "", "0", "false"):
+            return False
         # auto: fused kernel on real TPU runs; tiny sequences aren't worth
         # the pallas dispatch and break the >=8-row block minimum
         return jax.default_backend() == "tpu" and seq_len >= 64

@@ -339,6 +339,24 @@ def pack_batch(batch: Dict[str, np.ndarray]) -> bytes:
     return b"".join(parts)
 
 
+def peek_batch_rows(data) -> int:
+    """Row count (first array's leading dim) of a packed batch, reading only
+    the first header — no array payload is copied, so scanning every block
+    of a mmap'd ZREC file at open is cheap."""
+    mv = memoryview(data)
+    (n,) = struct.unpack_from("<I", mv, 0)
+    if not n:
+        return 0
+    off = 4
+    (nlen,) = struct.unpack_from("<H", mv, off); off += 2 + nlen
+    (dlen,) = struct.unpack_from("<B", mv, off); off += 1 + dlen
+    (ndim,) = struct.unpack_from("<B", mv, off); off += 1
+    if not ndim:
+        return 1
+    (rows,) = struct.unpack_from("<Q", mv, off)
+    return rows
+
+
 def unpack_batch(data) -> Dict[str, np.ndarray]:
     mv = memoryview(data)
     (n,) = struct.unpack_from("<I", mv, 0)

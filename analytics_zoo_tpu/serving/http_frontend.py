@@ -116,17 +116,30 @@ class HttpFrontend:
                 # record failures too — excluding timeouts would hide the
                 # slowest tail exactly when the backend is unhealthy
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
-                    instances = req.get("instances")
-                    if instances is None:
-                        instances = [req]   # single-instance body
-                    preds = frontend._predict(instances)
+                    # payload-shaped failures are the client's fault (400);
+                    # everything else (broker down, RESP protocol error,
+                    # backend crash) is a server-side failure (502)
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = self.rfile.read(n) or b"{}"
+                        req = json.loads(body)
+                        instances = req.get("instances")
+                        if instances is None:
+                            instances = [req]   # single-instance body
+                        decoded = [
+                            {k: _decode_value(v) for k, v in inst.items()}
+                            for inst in instances]
+                    except (json.JSONDecodeError, KeyError, ValueError,
+                            TypeError, AttributeError) as e:
+                        self._send(400,
+                                   {"error": f"{type(e).__name__}: {e}"})
+                        return
+                    preds = frontend._predict(decoded)
                 except TimeoutError as e:
                     self._send(504, {"error": str(e)})
                     return
-                except Exception as e:   # bad payload, decode errors, ...
-                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                except Exception as e:   # backend/broker failure
+                    self._send(502, {"error": f"{type(e).__name__}: {e}"})
                     return
                 finally:
                     frontend.latency.record(time.perf_counter() - t0)
@@ -162,11 +175,10 @@ class HttpFrontend:
         pair[0].close()
         pair[1].close()
 
-    def _predict(self, instances):
-        # decode everything BEFORE enqueueing anything: a bad instance then
-        # rejects the whole request without leaving orphaned work behind
-        decoded = [{k: _decode_value(v) for k, v in inst.items()}
-                   for inst in instances]
+    def _predict(self, decoded):
+        # instances are decoded by the handler BEFORE enqueueing anything
+        # (payload errors -> 400 without leaving orphaned work behind);
+        # failures in here are backend-side by construction
         pair = self._acquire()
         inq, outq = pair
         try:

@@ -42,9 +42,14 @@ class InputQueue:
     """ref-parity: InputQueue(host, port).enqueue(uri, key=ndarray, ...)"""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
-                 stream: str = INPUT_STREAM):
+                 stream: str = INPUT_STREAM, max_backlog: int = 10000):
+        """max_backlog > 0 rejects enqueues (RuntimeError) once the pending
+        stream holds that many entries; 0 disables the cap.  No MAXLEN
+        trimming is used: the server XDELs entries as it consumes them, so
+        trimming could only ever drop requests that were never read."""
         self.client = RespClient(host, port)
         self.stream = stream
+        self.max_backlog = max_backlog
 
     def enqueue(self, uri: Optional[str] = None, **data) -> str:
         """Enqueue one request; returns its uri (generated when omitted).
@@ -53,8 +58,16 @@ class InputQueue:
         fields = ["uri", uri]
         for k, v in data.items():
             fields += [k, encode_ndarray(np.asarray(v))]
-        self.client.execute("XADD", self.stream, "MAXLEN", 10000, "*",
-                            *fields)
+        entry_id = self.client.execute("XADD", self.stream, "*", *fields)
+        if self.max_backlog:
+            # add-then-check: concurrent producers that overshoot each
+            # remove their own entry, so the cap holds under racing threads
+            depth = int(self.client.execute("XLEN", self.stream) or 0)
+            if depth > self.max_backlog:
+                self.client.execute("XDEL", self.stream, entry_id)
+                raise RuntimeError(
+                    f"serving backlog {depth - 1} >= max_backlog "
+                    f"{self.max_backlog}; request rejected (not trimmed)")
         return uri
 
     def close(self):

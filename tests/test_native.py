@@ -138,6 +138,39 @@ def test_disk_tier_batch_larger_than_rows_raises(tmp_path):
     dfs.close()
 
 
+def test_disk_tier_short_batch_when_not_dropping(tmp_path):
+    """eval/predict path: batch > rows with drop_remainder=False emits the
+    single short batch, matching the DRAM tier (ADVICE r1 medium)."""
+    from analytics_zoo_tpu.data import FeatureSet
+
+    dfs = FeatureSet.from_arrays(_arrays(20)).to_disk(
+        str(tmp_path / "s.zrec"), block_rows=8)
+    got = list(dfs.batches(32, shuffle=False, drop_remainder=False))
+    assert len(got) == 1 and len(got[0]["user"]) == 20
+    dfs.close()
+
+
+def test_disk_tier_uneven_blocks_exact_len(tmp_path):
+    """ZREC written via the public RecordWriter API with uneven blocks:
+    __len__ must sum actual per-block rows (ADVICE r1 low)."""
+    from analytics_zoo_tpu import native
+    from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
+
+    path = str(tmp_path / "uneven.zrec")
+    sizes = [5, 17, 3, 11]
+    with native.RecordWriter(path) as w:
+        for i, s in enumerate(sizes):
+            w.write(native.pack_batch(
+                {"x": np.full((s, 2), i, np.float32),
+                 "y": np.arange(s, dtype=np.int32)}))
+    dfs = DiskFeatureSet(path)
+    assert len(dfs) == sum(sizes)
+    rows = sum(len(b["x"]) for b in
+               dfs.batches(4, shuffle=False, drop_remainder=False))
+    assert rows == sum(sizes)
+    dfs.close()
+
+
 def test_native_csv_duplicate_header_rejected(tmp_path):
     p = tmp_path / "t.csv"
     p.write_text("a,b,a\n1,2,3\n")

@@ -135,6 +135,23 @@ class TestClusterServing:
         finally:
             serving.stop()
 
+    def test_enqueue_rejects_over_max_backlog(self):
+        """Producer-side cap rejects instead of silently trimming unread
+        requests (ADVICE r1: no MAXLEN trim on XADD)."""
+        from analytics_zoo_tpu.serving.resp import RespServer
+
+        broker = RespServer(port=0).start()   # no consumer loop
+        try:
+            inq = InputQueue(port=broker.port, max_backlog=3)
+            for i in range(3):
+                inq.enqueue(f"q{i}", x=np.ones(2, np.float32))
+            with pytest.raises(RuntimeError, match="backlog"):
+                inq.enqueue("q3", x=np.ones(2, np.float32))
+            c = RespClient("127.0.0.1", broker.port)
+            assert int(c.execute("XLEN", "serving_stream")) == 3
+        finally:
+            broker.stop()
+
     def test_abandoned_results_pruned_after_ttl(self):
         """Results nobody queries must not grow broker memory forever."""
         serving = _serving()
@@ -242,6 +259,20 @@ class TestHttpFrontend:
     def test_unknown_route_404(self, stack):
         _, fe = stack
         assert self._get(fe.port, "/nope")[0] == 404
+
+    def test_backend_outage_is_502_not_400(self):
+        """A dead broker is a server-side failure (ADVICE r1: backend
+        outages must not be reported as client errors)."""
+        broker = RespServer(port=0).start()
+        fe = HttpFrontend(redis_port=broker.port, timeout=2).start()
+        broker.stop()     # backend dies after the frontend comes up
+        try:
+            status, body = self._post(fe.port, "/predict",
+                                      {"instances": [{"x": [1.0]}]})
+            assert status == 502, body
+            assert "error" in body
+        finally:
+            fe.stop()
 
     def test_timeout_shares_one_deadline(self):
         """n instances must time out within ~timeout, not n * timeout."""
