@@ -74,6 +74,13 @@ class TrainConfig:
     # fault-injection hook (SURVEY §5 failure-recovery testing): raise at
     # this global step to exercise checkpoint-resume paths
     fault_inject_step: int = 0
+    # debug mode (SURVEY §5 sanitizer analog: jax_debug_nans + deterministic
+    # data order).  debug_nans re-runs the faulting jitted step op-by-op and
+    # raises at the op that produced the NaN; implies donate_state=False so
+    # the re-run still owns its input buffers.  deterministic fixes the data
+    # order (no shuffling) so a faulting step is reproducible.
+    debug_nans: bool = False
+    deterministic: bool = False
 
 
 @dataclass

@@ -116,10 +116,10 @@ class DiskFeatureSet:
     def batches(self, batch_size: int, *, shuffle: bool = True,
                 drop_remainder: bool = True, seed: int = 0, epoch: int = 0
                 ) -> Iterator[Dict[str, np.ndarray]]:
-        if batch_size > self._n and drop_remainder:
-            # a silent zero-batch epoch would look like training while doing
-            # nothing; with drop_remainder=False the single short batch is
-            # emitted instead (the DRAM tier's eval/predict contract)
+        if self._n == 0 or (batch_size > self._n and drop_remainder):
+            # a silent zero-batch epoch/eval would look like running while
+            # doing nothing; with drop_remainder=False and rows present the
+            # single short batch is emitted (the DRAM eval/predict contract)
             raise ValueError(
                 f"per-host batch {batch_size} > host rows {self._n}")
         native = self._native

@@ -229,9 +229,10 @@ class FlaxEstimator:
 
     def _build_jits(self):
         if self._jit_train_step is None:
+            donate = self.config.donate_state and not self.config.debug_nans
             self._jit_train_step = jax.jit(
                 self._train_step,
-                donate_argnums=(0,) if self.config.donate_state else (),
+                donate_argnums=(0,) if donate else (),
                 out_shardings=(self._state_sharding, None))
             self._jit_eval_step = jax.jit(self._eval_step)
             self._jit_predict_step = jax.jit(self._predict_step)
@@ -331,17 +332,19 @@ class FlaxEstimator:
             raise ValueError(f"global batch {batch_size} must be positive "
                              f"and divisible by host count {n_hosts}")
         per_host = batch_size // n_hosts
+        shuffle = not self.config.deterministic
         from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
         if isinstance(data, DiskFeatureSet):
             _require_single_host_for_disk()
             # DISK tier streams through the native prefetch thread
             it = data.batch_iterator(
-                per_host, seed=self.config.seed + jax.process_index())
+                per_host, shuffle=shuffle,
+                seed=self.config.seed + jax.process_index())
             self._ensure_state(data.sample_block())
         else:
             arrays = _host_local(data)
             it = NumpyBatchIterator(
-                arrays, per_host, shuffle=True, drop_remainder=True,
+                arrays, per_host, shuffle=shuffle, drop_remainder=True,
                 seed=self.config.seed + jax.process_index())
             self._ensure_state(arrays)
         self._build_jits()
@@ -355,6 +358,10 @@ class FlaxEstimator:
         prof_active = False
         history: List[Dict[str, float]] = []
         log_every = max(1, self.config.log_every_steps)
+        debug_nans_was = None
+        if self.config.debug_nans:
+            debug_nans_was = jax.config.jax_debug_nans
+            jax.config.update("jax_debug_nans", True)
         try:
             return self._fit_epochs(
                 epochs, it, batch_size, validation_data, trigger, mlog,
@@ -365,6 +372,8 @@ class FlaxEstimator:
             if self._prof_active:
                 jax.profiler.stop_trace()
                 self._prof_active = False
+            if debug_nans_was is not None:
+                jax.config.update("jax_debug_nans", debug_nans_was)
             mlog.close()
 
     def _fit_epochs(self, epochs, it, batch_size, validation_data, trigger,

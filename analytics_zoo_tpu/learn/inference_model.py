@@ -117,27 +117,47 @@ class InferenceModel:
     def predict(self, *inputs: np.ndarray) -> np.ndarray:
         """Batched forward; inputs are [N, ...] host arrays. N is padded
         up to the next bucket so compiled-shape count stays bounded."""
+        return self.predict_async(*inputs)()
+
+    def predict_async(self, *inputs: np.ndarray) -> Callable[[], np.ndarray]:
+        """Dispatch the forward WITHOUT blocking on the device.
+
+        Returns a zero-arg callable that blocks until the result is ready
+        and yields the numpy output.  XLA dispatch is asynchronous, so the
+        host can batch/decode the next request while this one computes —
+        the serving loop's pipelining hook."""
         if self._apply_fn is None:
             raise RuntimeError("load a model first")
         n = len(inputs[0])
         bucket = _next_bucket(n, self._buckets)
+        if n > bucket:          # n above the largest bucket: chunk
+            # serial chunking keeps device memory bounded to ONE chunk in
+            # flight (dispatch-all would stage the entire input in HBM)
+            return lambda: self._predict_chunked(inputs, bucket)
         padded = []
         for a in inputs:
             a = np.asarray(a)
             if len(a) < bucket:
                 pad = np.zeros((bucket - len(a),) + a.shape[1:], a.dtype)
                 a = np.concatenate([a, pad])
-            elif len(a) > bucket:  # n above the largest bucket: chunk
-                return self._predict_chunked(inputs, bucket)
             padded.append(a)
         with self._sem:
             out = self._compiled()(
                 self._variables, *padded)
-        return jax.tree.map(lambda x: np.asarray(x)[:n], out)
+        # start the D2H transfer now: on tunneled/remote devices the fetch
+        # round-trip dominates, so it must overlap the next batch's compute
+        jax.tree.map(lambda x: x.copy_to_host_async(), out)
+        return lambda: jax.tree.map(lambda x: np.asarray(x)[:n], out)
 
     def _predict_chunked(self, inputs, bucket: int):
         n = len(inputs[0])
         outs = []
         for lo in range(0, n, bucket):
-            outs.append(self.predict(*[a[lo:lo + bucket] for a in inputs]))
+            outs.append(self.predict(*[np.asarray(a)[lo:lo + bucket]
+                                       for a in inputs]))
         return jax.tree.map(lambda *xs: np.concatenate(xs), *outs)
+
+    def set_concurrency(self, n: int) -> "InferenceModel":
+        """Resize the host-staging semaphore (ServingConfig.core_number)."""
+        self._sem = threading.Semaphore(max(1, n))
+        return self

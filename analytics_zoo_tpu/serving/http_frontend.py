@@ -129,6 +129,11 @@ class HttpFrontend:
                         decoded = [
                             {k: _decode_value(v) for k, v in inst.items()}
                             for inst in instances]
+                        for inst in decoded:
+                            if "uri" in inst:
+                                raise ValueError(
+                                    "'uri' is reserved for the request id"
+                                    " and cannot be an input column")
                     except (json.JSONDecodeError, KeyError, ValueError,
                             TypeError, AttributeError) as e:
                         self._send(400,

@@ -25,6 +25,7 @@ from analytics_zoo_tpu.serving.resp import RespClient
 
 INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
+SIGNAL_PREFIX = "rsig:"   # per-uri wakeup stream: XREAD BLOCK, not polling
 
 
 def encode_ndarray(a: np.ndarray) -> str:
@@ -55,19 +56,26 @@ class InputQueue:
         """Enqueue one request; returns its uri (generated when omitted).
         `data` values are ndarrays (or scalars) keyed by input name."""
         uri = uri or str(uuid.uuid4())
+        if "uri" in data:
+            raise ValueError(
+                "'uri' is the request id, not an input column name")
         fields = ["uri", uri]
         for k, v in data.items():
             fields += [k, encode_ndarray(np.asarray(v))]
-        entry_id = self.client.execute("XADD", self.stream, "*", *fields)
-        if self.max_backlog:
-            # add-then-check: concurrent producers that overshoot each
-            # remove their own entry, so the cap holds under racing threads
-            depth = int(self.client.execute("XLEN", self.stream) or 0)
-            if depth > self.max_backlog:
-                self.client.execute("XDEL", self.stream, entry_id)
-                raise RuntimeError(
-                    f"serving backlog {depth - 1} >= max_backlog "
-                    f"{self.max_backlog}; request rejected (not trimmed)")
+        if not self.max_backlog:
+            self.client.execute("XADD", self.stream, "*", *fields)
+            return uri
+        # add-then-check in ONE round-trip: concurrent producers that
+        # overshoot each remove their own entry, so the cap holds under
+        # racing threads without a MAXLEN trim dropping unread requests
+        entry_id, depth = self.client.pipeline([
+            ("XADD", self.stream, "*", *fields),
+            ("XLEN", self.stream)])
+        if int(depth or 0) > self.max_backlog:
+            self.client.execute("XDEL", self.stream, entry_id)
+            raise RuntimeError(
+                f"serving backlog {int(depth) - 1} >= max_backlog "
+                f"{self.max_backlog}; request rejected (not trimmed)")
         return uri
 
     def close(self):
@@ -82,20 +90,31 @@ class OutputQueue:
 
     def query(self, uri: str, timeout: float = 30.0,
               poll_interval: float = 0.01) -> Optional[np.ndarray]:
-        """Block until the result for `uri` lands (or timeout -> None)."""
+        """Block until the result for `uri` lands (or timeout -> None).
+
+        Waits on the per-uri signal stream with XREAD BLOCK — one blocking
+        round-trip instead of a poll storm (the broker's condvar wakes the
+        read the instant the server publishes).  `poll_interval` is kept
+        for API compatibility; it only paces the legacy fallback path."""
         deadline = time.monotonic() + timeout
         key = RESULT_PREFIX + uri
-        while True:
-            h = self.client.execute("HGETALL", key)
-            if h:
-                fields = {h[i].decode(): h[i + 1]
-                          for i in range(0, len(h), 2)}
-                self.client.execute("DEL", key)
-                self.client.execute("SREM", "__result_keys__", uri)
-                return decode_ndarray(fields["value"])
-            if time.monotonic() >= deadline:
+        sig = SIGNAL_PREFIX + uri
+        h = self.client.execute("HGETALL", key)
+        while not h:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 return None
-            time.sleep(poll_interval)
+            try:
+                self.client.execute(
+                    "XREAD", "COUNT", 1, "BLOCK",
+                    max(1, int(remaining * 1000)), "STREAMS", sig, "0-0")
+            except Exception:
+                time.sleep(poll_interval)   # legacy broker: plain polling
+            h = self.client.execute("HGETALL", key)
+        fields = {h[i].decode(): h[i + 1] for i in range(0, len(h), 2)}
+        self.client.execute("DEL", key, sig)
+        self.client.execute("SREM", "__result_keys__", uri)
+        return decode_ndarray(fields["value"])
 
     def dequeue(self) -> Dict[str, np.ndarray]:
         """Drain every available result (ref: OutputQueue.dequeue).

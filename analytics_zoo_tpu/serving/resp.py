@@ -163,6 +163,9 @@ class RespServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            # small request/response frames: Nagle + delayed-ACK would add
+            # ~40ms per reply, dwarfing the model forward itself
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -334,6 +337,17 @@ class RespClient:
         with self.lock:
             self.sock.sendall(payload)
             return self.reader.read()
+
+    def pipeline(self, commands):
+        """Send many commands in one write, read all replies (real Redis
+        pipelining — one round-trip for N commands)."""
+        payload = b"".join(
+            encode([a if isinstance(a, (bytes, bytearray))
+                    else str(a).encode() for a in cmd])
+            for cmd in commands)
+        with self.lock:
+            self.sock.sendall(payload)
+            return [self.reader.read() for _ in commands]
 
     def close(self):
         try:

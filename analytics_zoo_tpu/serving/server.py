@@ -27,7 +27,8 @@ import numpy as np
 from analytics_zoo_tpu.common.log import logger
 from analytics_zoo_tpu.learn.inference_model import InferenceModel
 from analytics_zoo_tpu.serving.queues import (
-    INPUT_STREAM, RESULT_PREFIX, decode_ndarray, encode_ndarray)
+    INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX, decode_ndarray,
+    encode_ndarray)
 from analytics_zoo_tpu.serving.resp import RespClient, RespServer
 
 
@@ -42,6 +43,9 @@ class ServingConfig:
     batch_timeout_ms: float = 5.0   # flush partial batch after this wait
     input_cols: Optional[List[str]] = None  # None: infer from request
     result_ttl_s: float = 300.0     # abandoned results pruned after this
+    core_number: int = 4            # ref: host CPU cores per serving task —
+    #                                 here it caps concurrent host staging
+    #                                 (InferenceModel semaphore), NOT batch
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -59,8 +63,10 @@ class ServingConfig:
         if isinstance(redis, str) and ":" in redis:
             host, port = redis.rsplit(":", 1)
             cfg.redis_host, cfg.redis_port = host, int(port)
-        cfg.batch_size = int(params.get("core_number",
-                                        params.get("batch_size", 32)))
+        # reference config.yaml semantics: core_number is CPU cores (a
+        # resource knob), batch_size is the micro-batch — never conflate
+        cfg.batch_size = int(params.get("batch_size", 32))
+        cfg.core_number = int(params.get("core_number", cfg.core_number))
         return cfg
 
 
@@ -77,6 +83,8 @@ class ClusterServing:
                  embedded_broker: bool = False):
         self.model = inference_model
         self.config = config or ServingConfig()
+        if self.config.core_number:
+            inference_model.set_concurrency(self.config.core_number)
         self.broker: Optional[RespServer] = None
         if embedded_broker:
             self.broker = RespServer(port=0).start()
@@ -114,12 +122,15 @@ class ClusterServing:
 
     # ---- serving loop -------------------------------------------------
 
-    def _read_batch(self) -> List[Dict[str, bytes]]:
-        """Micro-batch: block for the first request, then grab whatever
-        else is queued up to batch_size within batch_timeout_ms."""
+    def _read_batch(self, block_ms: int = 200) -> List[Dict[str, bytes]]:
+        """Micro-batch: block up to block_ms for the first request, then
+        grab whatever else is queued up to batch_size within
+        batch_timeout_ms.  With a batch already in flight on the device the
+        loop passes a tiny block_ms so finished results are written
+        promptly instead of waiting out a full idle poll."""
         cfg = self.config
         first = self.client.execute(
-            "XREAD", "COUNT", cfg.batch_size, "BLOCK", 200, "STREAMS",
+            "XREAD", "COUNT", cfg.batch_size, "BLOCK", block_ms, "STREAMS",
             INPUT_STREAM, self._last_id)
         if not first:
             return []
@@ -149,40 +160,64 @@ class ClusterServing:
         return out
 
     def _loop(self):
+        """Pipelined serving loop: while batch N computes on the TPU, batch
+        N+1 is read from the stream and decoded on the host (XLA dispatch
+        is async; blocking happens only when N's results are written)."""
+        pending = None          # (requests, waiter, dispatched_at)
         while not self._stop.is_set():
             try:
-                requests = self._read_batch()
+                # with work in flight, poll briefly so finished results are
+                # published as soon as the device is done
+                requests = self._read_batch(2 if pending else 200)
             except (ConnectionError, OSError):
                 if self._stop.is_set():
-                    return
+                    break
                 time.sleep(0.05)
                 continue
-            if not requests:
-                continue
+            nxt = None
+            if requests:
+                try:
+                    nxt = self._dispatch_batch(requests)
+                except Exception:
+                    logger.exception("serving dispatch failed")
+            if pending is not None:
+                try:
+                    self._publish_batch(*pending)
+                except Exception:
+                    logger.exception("serving publish failed")
+            pending = nxt
+        if pending is not None:
             try:
-                self._serve_batch(requests)
+                self._publish_batch(*pending)
             except Exception:
-                logger.exception("serving batch failed")
+                logger.exception("serving publish failed")
 
-    def _serve_batch(self, requests: List[Dict[str, bytes]]):
+    def _dispatch_batch(self, requests: List[Dict[str, bytes]]):
+        """Decode + enqueue the forward on the device; returns the in-flight
+        handle without blocking on the result."""
         cols = self.config.input_cols or \
             [k for k in requests[0] if k != "uri"]
-        arrays = []
-        for c in cols:
-            arrays.append(np.stack([decode_ndarray(r[c])
-                                    for r in requests]))
-        t0 = time.perf_counter()
-        preds = self.model.predict(*arrays)
-        preds = np.asarray(preds)
+        arrays = [np.stack([decode_ndarray(r[c]) for r in requests])
+                  for c in cols]
+        return requests, self.model.predict_async(*arrays), \
+            time.perf_counter()
+
+    def _publish_batch(self, requests, waiter, t0: float):
+        preds = np.asarray(waiter())    # blocks until the device is done
         dt = (time.perf_counter() - t0) * 1000
         uris = [r["uri"].decode() for r in requests]
+        cmds = []
         for uri, p in zip(uris, preds):
-            self.client.execute("HSET", RESULT_PREFIX + uri,
-                                "value", encode_ndarray(p))
+            cmds.append(("HSET", RESULT_PREFIX + uri,
+                         "value", encode_ndarray(p)))
+            # wake the XREAD-blocked client AFTER the hash is in place
+            # (pipelined commands execute in order on the broker)
+            cmds.append(("XADD", SIGNAL_PREFIX + uri, "*", "ok", "1"))
         # maintain the dequeue-all index (client OutputQueue.dequeue);
         # a set, pruned by the client on consume, so it stays bounded by
         # the number of UNREAD results rather than total requests served
-        self.client.execute("SADD", "__result_keys__", *uris)
+        cmds.append(("SADD", "__result_keys__", *uris))
+        self.client.pipeline(cmds)
         now = time.monotonic()
         self._written.extend((u, now) for u in uris)
         self._prune_abandoned(now)
@@ -195,7 +230,8 @@ class ClusterServing:
         ttl = self.config.result_ttl_s
         while self._written and now - self._written[0][1] > ttl:
             uri, _ = self._written.popleft()
-            self.client.execute("DEL", RESULT_PREFIX + uri)
+            self.client.execute("DEL", RESULT_PREFIX + uri,
+                                SIGNAL_PREFIX + uri)
             self.client.execute("SREM", "__result_keys__", uri)
 
     # ---- observability (SURVEY §5: queue depth = backlog metric) ------

@@ -1,0 +1,118 @@
+"""Cluster Serving benchmark — req/s + latency percentiles (BASELINE.md
+config #6).
+
+Measures the full system: N client threads enqueue through the RESP wire
+protocol into the embedded broker, the pipelined serving loop micro-batches
+and runs the jitted model on the default JAX backend (the real TPU chip when
+run by the driver), results are polled back by the clients.  Latency is
+client-observed end-to-end (enqueue -> result in hand).
+
+Prints one JSON line per scenario and writes SERVING_BENCH.json.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
+                 batch_size: int = 64) -> dict:
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, InputQueue, OutputQueue, ServingConfig)
+
+    if model_kind == "mlp":
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                for w in (256, 256, 128):
+                    x = nn.relu(nn.Dense(w)(x))
+                return nn.Dense(10)(x)
+
+        model, feat = MLP(), np.zeros((1, 64), np.float32)
+    else:
+        raise ValueError(model_kind)
+
+    variables = model.init(jax.random.key(0), feat)
+    im = InferenceModel(batch_buckets=(1, 8, 32, batch_size))
+    im.load_flax(model, variables)
+    cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=2.0)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+
+    # warm the jit buckets so compile time is not measured
+    for b in (1, 8, 32, batch_size):
+        im.predict(np.zeros((b, 64), np.float32))
+
+    lat: list = []
+    lock = threading.Lock()
+    errors: list = []
+
+    def client(idx: int):
+        inq = InputQueue(port=serving.port)
+        outq = OutputQueue(port=serving.port)
+        rng = np.random.default_rng(idx)
+        mine = []
+        try:
+            for i in range(requests_per_client):
+                x = rng.normal(size=(64,)).astype(np.float32)
+                t0 = time.perf_counter()
+                uri = inq.enqueue(f"c{idx}-{i}", x=x)
+                r = outq.query(uri, timeout=30, poll_interval=0.001)
+                if r is None:
+                    raise TimeoutError(f"client {idx} req {i}")
+                mine.append(time.perf_counter() - t0)
+        except Exception as e:      # surface, don't hang the bench
+            with lock:
+                errors.append(repr(e))
+        finally:
+            with lock:
+                lat.extend(mine)
+            inq.close()
+            outq.close()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    served = serving.stats["requests"]
+    avg_fill = served / max(1, serving.stats["batches"])
+    serving.stop()
+    if errors:
+        raise RuntimeError(f"bench clients failed: {errors[:3]}")
+    a = np.asarray(lat)
+    return {
+        "model": model_kind,
+        "clients": n_clients,
+        "requests": int(a.size),
+        "req_per_sec": round(a.size / wall, 1),
+        "p50_ms": round(float(np.percentile(a, 50)) * 1e3, 2),
+        "p90_ms": round(float(np.percentile(a, 90)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(a, 99)) * 1e3, 2),
+        "avg_batch_fill": round(avg_fill, 1),
+    }
+
+
+def main():
+    out = {"scenarios": []}
+    for n_clients, rpc in ((1, 100), (64, 50), (256, 50)):
+        r = run_scenario("mlp", n_clients, requests_per_client=rpc,
+                         batch_size=128)
+        print(json.dumps(r))
+        out["scenarios"].append(r)
+    with open("SERVING_BENCH.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

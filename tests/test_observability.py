@@ -108,3 +108,47 @@ def test_keras_set_tensorboard_before_compile(tmp_path, ctx8):
     Y = np.zeros((64, 1), np.float32)
     m.fit(X, Y, batch_size=32, nb_epoch=1)
     assert (tmp_path / "app" / "train.jsonl").exists()
+
+
+def test_debug_nans_raises_at_faulting_step(ctx8):
+    """SURVEY §5 sanitizer analog: TrainConfig.debug_nans +
+    deterministic data order must raise at the step whose batch poisons
+    the loss, not train through it silently."""
+    import flax.linen as nn
+    import jax
+
+    from analytics_zoo_tpu.common.config import TrainConfig
+
+    class Reg(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return nn.Dense(1)(x[:, None])[:, 0]
+
+    n, bs = 256, 64
+    x = np.linspace(-1, 1, n).astype(np.float32)
+    y = (2 * x).astype(np.float32)
+    y[2 * bs:3 * bs] = np.nan        # poison exactly step 3's batch
+    est = Estimator.from_flax(
+        model=Reg(), loss="mse", optimizer=optax.adam(1e-2),
+        feature_cols=("x",), label_cols=("y",),
+        config=TrainConfig(debug_nans=True, deterministic=True,
+                           log_every_steps=1))
+    with pytest.raises(FloatingPointError, match="[Nn]an"):
+        est.fit({"x": x, "y": y}, epochs=1, batch_size=bs)
+    # the config flag must not leak into the process-global jax config
+    assert not jax.config.jax_debug_nans
+
+
+def test_deterministic_data_order_reproducible(ctx8):
+    """Two runs from identical init must produce bit-identical losses when
+    deterministic=True (fixed data order)."""
+    from analytics_zoo_tpu.common.config import TrainConfig
+
+    losses = []
+    for _ in range(2):
+        est = _est(deterministic=True)
+        seen = []
+        est.fit(_data(), epochs=1, batch_size=64,
+                callbacks=[lambda s: seen.append(s["loss"])])
+        losses.append(seen)
+    assert losses[0] == losses[1]

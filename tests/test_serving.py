@@ -190,6 +190,17 @@ class TestClusterServing:
         assert (cfg.redis_host, cfg.redis_port) == ("10.0.0.5", 6380)
         assert cfg.batch_size == 64
 
+    def test_config_core_number_is_not_batch_size(self, tmp_path):
+        """Reference config.yaml: core_number = CPU cores; a ported config
+        must not have its micro-batch silently set to the core count."""
+        p = tmp_path / "config.yaml"
+        p.write_text(
+            "model:\n  path: /models/m\n"
+            "params:\n  core_number: 4\n")
+        cfg = ServingConfig.from_yaml(str(p))
+        assert cfg.batch_size == 32      # default, NOT 4
+        assert cfg.core_number == 4
+
 
 # ---------------------------------------------------------------------------
 # HTTP frontend
