@@ -407,8 +407,10 @@ class FlaxEstimator:
                         f"injected fault at step {self._global_step} "
                         "(TrainConfig.fault_inject_step)")
                 if n_steps % log_every == 0:
-                    mlog.log(self._global_step,
-                             {k: np.asarray(v) for k, v in mets.items()},
+                    # one batched D2H for the whole metric dict — per-leaf
+                    # np.asarray pays a full round-trip per metric on
+                    # tunneled/remote devices
+                    mlog.log(self._global_step, jax.device_get(mets),
                              n_samples=batch_size * log_every)
                 if trigger and trigger({"step": self._global_step,
                                         "epoch": self._epoch}):
@@ -417,9 +419,11 @@ class FlaxEstimator:
             dt = time.perf_counter() - t0
             self._epoch += 1
             acc = EpochAccumulator()
-            for mets in step_mets:
-                acc.add({k: float(np.asarray(v)) for k, v in mets.items()},
-                        batch_size)
+            # fetch every step's metrics in ONE batched transfer (a
+            # per-value fetch costs a device round-trip each — seconds per
+            # epoch on tunneled devices)
+            for mets in jax.device_get(step_mets):
+                acc.add({k: float(v) for k, v in mets.items()}, batch_size)
             stats = acc.result()
             stats["num_samples"] = float(n_steps * batch_size)
             stats["samples_per_sec"] = (n_steps * batch_size) / dt if dt else 0
@@ -468,15 +472,19 @@ class FlaxEstimator:
         n_hosts = jax.process_count()
         per_host = max(1, batch_size // n_hosts)
         acc = EpochAccumulator()
+        mets_list, counts = [], []
         for chunk in self._eval_chunks(data, per_host):
             real = len(next(iter(chunk.values())))
             chunk, w = _pad_batch(chunk, per_host)
             gbatch = make_global_batch(self.mesh, chunk, self._data_sharding)
             gw = make_global_batch(self.mesh, {"w": w},
                                    self._data_sharding)["w"]
-            mets = self._jit_eval_step(self.state, gbatch, gw)
-            acc.add({k: np.asarray(v) for k, v in mets.items()},
-                    real * n_hosts)
+            # keep metrics on-device: blocking here would serialise eval
+            # steps and pay a device round-trip per chunk
+            mets_list.append(self._jit_eval_step(self.state, gbatch, gw))
+            counts.append(real * n_hosts)
+        for mets, cnt in zip(jax.device_get(mets_list), counts):
+            acc.add(mets, cnt)
         return acc.result()
 
     def predict(self, data, batch_size: int = 32,
@@ -490,7 +498,8 @@ class FlaxEstimator:
         self._build_jits()
         n_hosts = jax.process_count()
         per_host = max(1, batch_size // n_hosts)
-        outs = []
+        outs, window = [], []
+        single_host = jax.process_count() == 1
         for chunk in self._eval_chunks(data, per_host):
             chunk = {k: v for k, v in chunk.items()
                      if k in self.feature_cols}
@@ -498,8 +507,15 @@ class FlaxEstimator:
             chunk, _ = _pad_batch(chunk, per_host)
             gbatch = make_global_batch(self.mesh, chunk, self._data_sharding)
             preds = self._jit_predict_step(self.state, gbatch)
-            local = _local_rows(preds)
-            outs.append(jax.tree.map(lambda a: a[:real], local))
+            # slice on-device, fetch in windowed batches: chunks pipeline
+            # (no per-chunk round-trip) while device memory stays bounded
+            # to `window` chunks of outputs instead of the whole dataset
+            local = preds if single_host else _local_rows(preds)
+            window.append(jax.tree.map(lambda a: a[:real], local))
+            if len(window) >= 8:
+                outs.extend(jax.device_get(window))
+                window.clear()
+        outs.extend(jax.device_get(window))
         return jax.tree.map(lambda *xs: np.concatenate(xs), *outs)
 
     # ------------------------------------------------------------------

@@ -103,6 +103,9 @@ class OutputQueue:
         while not h:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                # the blocking XREAD auto-created the signal stream on the
+                # broker; remove it so abandoned queries don't leak keys
+                self.client.execute("DEL", sig)
                 return None
             try:
                 self.client.execute(

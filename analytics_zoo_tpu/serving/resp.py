@@ -340,14 +340,28 @@ class RespClient:
 
     def pipeline(self, commands):
         """Send many commands in one write, read all replies (real Redis
-        pipelining — one round-trip for N commands)."""
+        pipelining — one round-trip for N commands).
+
+        Every reply is consumed even when some are errors — bailing out
+        mid-stream would leave unread replies in the buffer and desync
+        every later command on this connection.  The first error reply is
+        raised after the stream is drained."""
         payload = b"".join(
             encode([a if isinstance(a, (bytes, bytearray))
                     else str(a).encode() for a in cmd])
             for cmd in commands)
         with self.lock:
             self.sock.sendall(payload)
-            return [self.reader.read() for _ in commands]
+            replies, first_err = [], None
+            for _ in commands:
+                try:
+                    replies.append(self.reader.read())
+                except RedisError as e:   # error reply: keep draining
+                    replies.append(e)
+                    first_err = first_err or e
+        if first_err is not None:
+            raise first_err
+        return replies
 
     def close(self):
         try:

@@ -43,9 +43,11 @@ class ServingConfig:
     batch_timeout_ms: float = 5.0   # flush partial batch after this wait
     input_cols: Optional[List[str]] = None  # None: infer from request
     result_ttl_s: float = 300.0     # abandoned results pruned after this
-    core_number: int = 4            # ref: host CPU cores per serving task —
-    #                                 here it caps concurrent host staging
-    #                                 (InferenceModel semaphore), NOT batch
+    core_number: Optional[int] = None   # ref: host CPU cores per serving
+    #                                     task — here it caps concurrent
+    #                                     host staging (InferenceModel
+    #                                     semaphore), NOT batch; None keeps
+    #                                     the model's own concurrent_num
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -66,7 +68,8 @@ class ServingConfig:
         # reference config.yaml semantics: core_number is CPU cores (a
         # resource knob), batch_size is the micro-batch — never conflate
         cfg.batch_size = int(params.get("batch_size", 32))
-        cfg.core_number = int(params.get("core_number", cfg.core_number))
+        if "core_number" in params:
+            cfg.core_number = int(params["core_number"])
         return cfg
 
 
@@ -83,7 +86,7 @@ class ClusterServing:
                  embedded_broker: bool = False):
         self.model = inference_model
         self.config = config or ServingConfig()
-        if self.config.core_number:
+        if self.config.core_number is not None:
             inference_model.set_concurrency(self.config.core_number)
         self.broker: Optional[RespServer] = None
         if embedded_broker:
