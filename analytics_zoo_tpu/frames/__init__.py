@@ -3,10 +3,11 @@ ref: zoo/pipeline/nnframes/)."""
 
 from analytics_zoo_tpu.frames.nnframes import (
     ChainedPreprocessing, NNClassifier, NNClassifierModel, NNEstimator,
-    NNModel, Preprocessing, ScalerPreprocessing, df_to_arrays)
+    NNImageReader, NNModel, Preprocessing, ScalerPreprocessing,
+    df_to_arrays)
 
 __all__ = [
     "NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
-    "Preprocessing", "ChainedPreprocessing", "ScalerPreprocessing",
-    "df_to_arrays",
+    "NNImageReader", "Preprocessing", "ChainedPreprocessing",
+    "ScalerPreprocessing", "df_to_arrays",
 ]

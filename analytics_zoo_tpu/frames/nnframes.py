@@ -204,3 +204,45 @@ class NNClassifierModel(NNModel):
     def _post(self, preds: np.ndarray):
         return np.argmax(np.asarray(preds), axis=-1).astype(
             np.float64).tolist()
+
+
+class NNImageReader:
+    """ref-parity: NNImageReader.readImages(path) — images as DataFrame
+    rows (ref: zoo pipeline/nnframes/NNImageReader.scala, image schema
+    origin/height/width/nChannels/data).
+
+    The TPU edition returns a pandas DataFrame whose ``image`` column holds
+    decoded RGB ndarrays (HWC uint8; float32 after resize), decoded by the
+    C++ data plane (libjpeg/libpng, PIL fallback — data/image.py), plus the
+    schema columns.  Feed it straight to NNEstimator/NNClassifier with
+    ``setFeaturesCol("image")``.
+    """
+
+    @staticmethod
+    def readImages(path: str, resize_h: int = -1, resize_w: int = -1,
+                   with_label: bool = False, num_shards: int = 1):
+        """Read a dir (or one-subdir-per-class tree when with_label)."""
+        import pandas as pd
+
+        from analytics_zoo_tpu.data.image import ImageResize, ImageSet
+
+        iset = ImageSet.read(path, num_shards=num_shards,
+                             with_label=with_label)
+        if resize_h > 0 and resize_w > 0:
+            iset = iset.transform(ImageResize(resize_h, resize_w))
+        rows = {"origin": [], "image": [], "height": [], "width": [],
+                "n_channels": [], "label": []}
+        for shard in iset.shards.collect():
+            for img, label, p in zip(shard["image"], shard["label"],
+                                     shard["path"]):
+                rows["origin"].append(p)
+                rows["image"].append(img)
+                rows["height"].append(img.shape[0])
+                rows["width"].append(img.shape[1])
+                rows["n_channels"].append(img.shape[2])
+                rows["label"].append(int(label))
+        df = pd.DataFrame(rows)
+        if not with_label:
+            df = df.drop(columns=["label"])
+        df.attrs["class_names"] = iset.class_names
+        return df

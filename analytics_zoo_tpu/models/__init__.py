@@ -13,7 +13,7 @@ from analytics_zoo_tpu.models.anomaly import (
     AnomalyDetector, unroll, detect_anomalies)
 from analytics_zoo_tpu.models.seq2seq import Seq2Seq, greedy_generate
 from analytics_zoo_tpu.models.image import (
-    ResNet, SimpleCNN, ImageClassifier, resnet18, resnet34)
+    ResNet, SimpleCNN, ImageClassifier, resnet18, resnet34, resnet50)
 from analytics_zoo_tpu.models.forecast import (
     LSTMNet, TCN, MTNet, Seq2SeqTS)
 from analytics_zoo_tpu.models.rnn import RNNStack
@@ -28,7 +28,7 @@ __all__ = [
     "TextClassifier", "KNRM",
     "AnomalyDetector", "unroll", "detect_anomalies",
     "Seq2Seq", "greedy_generate",
-    "ResNet", "SimpleCNN", "ImageClassifier", "resnet18", "resnet34",
+    "ResNet", "SimpleCNN", "ImageClassifier", "resnet18", "resnet34", "resnet50",
     "LSTMNet", "TCN", "MTNet", "Seq2SeqTS",
     "RNNStack",
 ]

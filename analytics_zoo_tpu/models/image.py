@@ -42,13 +42,43 @@ class ResNetBlock(nn.Module):
         return nn.relu(x + y)
 
 
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1(x4) bottleneck (ResNet-50/101/152 blocks)."""
+
+    filters: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda name: nn.BatchNorm(
+            use_running_average=not train, dtype=jnp.float32, name=name)
+        out = self.filters * 4
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        y = nn.relu(norm("bn1")(y).astype(self.dtype))
+        y = nn.Conv(self.filters, (3, 3), strides=(self.stride,) * 2,
+                    use_bias=False, dtype=self.dtype, name="conv2")(y)
+        y = nn.relu(norm("bn2")(y).astype(self.dtype))
+        y = nn.Conv(out, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv3")(y)
+        y = norm("bn3")(y).astype(self.dtype)
+        if x.shape[-1] != out or self.stride != 1:
+            x = nn.Conv(out, (1, 1), strides=(self.stride,) * 2,
+                        use_bias=False, dtype=self.dtype, name="proj")(x)
+            x = norm("bn_proj")(x).astype(self.dtype)
+        return nn.relu(x + y)
+
+
 class ResNet(nn.Module):
-    """Basic-block ResNet (18/34-style) for NHWC inputs."""
+    """ResNet for NHWC inputs — basic blocks (18/34) or bottleneck
+    (50/101/152) via ``bottleneck=True``."""
 
     num_classes: int
     stage_sizes: Sequence[int] = (2, 2, 2, 2)
     width: int = 64
     small_inputs: bool = False  # True: 3x3 stem for CIFAR-size images
+    bottleneck: bool = False
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -64,12 +94,13 @@ class ResNet(nn.Module):
         x = nn.relu(nn.BatchNorm(use_running_average=not train,
                                  dtype=jnp.float32,
                                  name="stem_bn")(x).astype(self.dtype))
+        block_cls = BottleneckBlock if self.bottleneck else ResNetBlock
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 stride = 2 if (i > 0 and j == 0) else 1
-                x = ResNetBlock(self.width * (2 ** i), stride,
-                                dtype=self.dtype,
-                                name=f"stage{i}_block{j}")(x, train)
+                x = block_cls(self.width * (2 ** i), stride,
+                              dtype=self.dtype,
+                              name=f"stage{i}_block{j}")(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         name="head")(x.astype(jnp.float32))
@@ -81,6 +112,11 @@ def resnet18(num_classes: int, **kw) -> ResNet:
 
 def resnet34(num_classes: int, **kw) -> ResNet:
     return ResNet(num_classes, stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def resnet50(num_classes: int, **kw) -> ResNet:
+    return ResNet(num_classes, stage_sizes=(3, 4, 6, 3), bottleneck=True,
+                  **kw)
 
 
 class SimpleCNN(nn.Module):
@@ -105,6 +141,7 @@ _BACKBONES = {
     "simple": lambda n, **kw: SimpleCNN(n, **kw),
     "resnet18": resnet18,
     "resnet34": resnet34,
+    "resnet50": resnet50,
 }
 
 

@@ -45,17 +45,22 @@ def _build_so() -> str:
     # PID-unique tmp + atomic replace: concurrent first-use builds (multiple
     # worker processes, shared FS) must not corrupt each other's output.
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except FileNotFoundError as e:
-        raise NativeUnavailable(f"g++ not found: {e}") from e
-    except subprocess.CalledProcessError as e:
-        raise NativeUnavailable(
-            f"native build failed:\n{e.stderr[-2000:]}") from e
-    os.replace(tmp, _SO)
-    return _SO
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            _SRC, "-o", tmp]
+    # image decode needs system libjpeg/libpng; retry without if absent so
+    # the tensor data plane still builds on minimal hosts
+    attempts = [base + ["-DZOO_WITH_IMAGE", "-ljpeg", "-lpng"], base]
+    last_err = ""
+    for cmd in attempts:
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, _SO)
+            return _SO
+        except FileNotFoundError as e:
+            raise NativeUnavailable(f"g++ not found: {e}") from e
+        except subprocess.CalledProcessError as e:
+            last_err = e.stderr[-2000:]
+    raise NativeUnavailable(f"native build failed:\n{last_err}")
 
 
 def load_lib() -> ctypes.CDLL:
@@ -116,6 +121,20 @@ def load_lib() -> ctypes.CDLL:
         lib.zpf_start.restype = P
         lib.zpf_start.argtypes = [P, P, ctypes.POINTER(ctypes.c_long), L, I]
         lib.zpf_stop.argtypes = [P]
+        # image decode symbols are absent when the .so was built without
+        # libjpeg/libpng (ZOO_WITH_IMAGE unset)
+        try:
+            lib.zimg_decode.restype = ctypes.POINTER(ctypes.c_ubyte)
+            lib.zimg_decode.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int)]
+            lib.zimg_decode_mem.restype = ctypes.POINTER(ctypes.c_ubyte)
+            lib.zimg_decode_mem.argtypes = [
+                ctypes.c_void_p, S, ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_int)]
+            lib.zimg_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+        except AttributeError:
+            pass
         _lib = lib
         return lib
 
@@ -223,6 +242,51 @@ def read_csv_native(path: str, n_threads: int = 0) -> Dict[str, np.ndarray]:
         return out
     finally:
         lib.zcsv_close(h)
+
+
+# ---------------------------------------------------------------------------
+# Image decode (SURVEY §2.3 native obligation: host-side C++ decode)
+# ---------------------------------------------------------------------------
+
+def image_available() -> bool:
+    """True when the .so was built with libjpeg/libpng support."""
+    try:
+        return hasattr(load_lib(), "zimg_decode")
+    except NativeUnavailable:
+        return False
+
+
+def decode_image(path_or_bytes) -> np.ndarray:
+    """Decode a JPEG/PNG to an RGB uint8 HWC array via the C++ data plane.
+
+    The decode runs with the GIL released (ctypes), so threading over
+    files gives real parallelism — the Spark-partition-decode analog.
+    Raises ValueError on undecodable input, NativeUnavailable when the
+    library lacks image support (callers fall back to PIL).
+    """
+    lib = load_lib()
+    if not hasattr(lib, "zimg_decode"):
+        raise NativeUnavailable("built without libjpeg/libpng")
+    h = ctypes.c_long()
+    w = ctypes.c_long()
+    c = ctypes.c_int()
+    if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
+        buf = bytes(path_or_bytes)
+        ptr = lib.zimg_decode_mem(buf, len(buf),
+                                  ctypes.byref(h), ctypes.byref(w),
+                                  ctypes.byref(c))
+    else:
+        ptr = lib.zimg_decode(os.fspath(path_or_bytes).encode(),
+                              ctypes.byref(h), ctypes.byref(w),
+                              ctypes.byref(c))
+    if not ptr:
+        raise ValueError(f"native image decode failed: {_err()}")
+    try:
+        n = h.value * w.value * c.value
+        arr = np.ctypeslib.as_array(ptr, shape=(n,)).copy()
+        return arr.reshape(h.value, w.value, c.value)
+    finally:
+        lib.zimg_free(ptr)
 
 
 # ---------------------------------------------------------------------------

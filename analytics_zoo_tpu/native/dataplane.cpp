@@ -650,3 +650,131 @@ void zpf_stop(void *h) {
 }
 
 }  // extern "C"
+
+// -------------------------------------------------------------------------
+// 5. Image decode: JPEG (libjpeg) / PNG (libpng) -> RGB8 HWC buffers.
+//
+// The reference's image path was OpenCV behind BigDL's JNI wrapper (SURVEY
+// §2.2 ImageSet row, §2.3 native obligations: host-side C++ decode, no
+// pure-Python stand-ins).  System libjpeg/libpng replace OpenCV here; the
+// Python side (ImageSet / NNImageReader) threads over files with the GIL
+// released, so decode parallelism matches the Spark-partition decode the
+// reference got for free.
+// -------------------------------------------------------------------------
+
+#ifdef ZOO_WITH_IMAGE
+#include <csetjmp>
+#include <jpeglib.h>
+#include <png.h>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  auto *e = reinterpret_cast<JpegErr *>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, e->msg);
+  longjmp(e->jb, 1);
+}
+
+unsigned char *decode_jpeg(const unsigned char *data, size_t n, long *h,
+                           long *w, int *c) {
+  jpeg_decompress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_err_exit;
+  unsigned char *out = nullptr;
+  if (setjmp(err.jb)) {
+    set_error(std::string("jpeg decode: ") + err.msg);
+    std::free(out);
+    jpeg_destroy_decompress(&cinfo);
+    return nullptr;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char *>(data), n);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const long W = cinfo.output_width, H = cinfo.output_height;
+  const int C = cinfo.output_components;  // 3 after JCS_RGB
+  out = static_cast<unsigned char *>(std::malloc((size_t)W * H * C));
+  if (!out) longjmp(err.jb, 1);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char *row = out + (size_t)cinfo.output_scanline * W * C;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *h = H; *w = W; *c = C;
+  return out;
+}
+
+unsigned char *decode_png(const unsigned char *data, size_t n, long *h,
+                          long *w, int *c) {
+  png_image img;
+  std::memset(&img, 0, sizeof img);
+  img.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&img, data, n)) {
+    set_error(std::string("png decode: ") + img.message);
+    return nullptr;
+  }
+  img.format = PNG_FORMAT_RGB;
+  const size_t stride = PNG_IMAGE_ROW_STRIDE(img);
+  auto *out = static_cast<unsigned char *>(
+      std::malloc(PNG_IMAGE_BUFFER_SIZE(img, stride)));
+  if (!out) {
+    png_image_free(&img);
+    set_error("png decode: oom");
+    return nullptr;
+  }
+  if (!png_image_finish_read(&img, nullptr, out, (png_int_32)stride,
+                             nullptr)) {
+    set_error(std::string("png decode: ") + img.message);
+    std::free(out);
+    return nullptr;
+  }
+  *h = img.height; *w = img.width; *c = 3;
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+unsigned char *zimg_decode_mem(const void *data, size_t n, long *h, long *w,
+                               int *c) {
+  const auto *p = static_cast<const unsigned char *>(data);
+  if (n >= 2 && p[0] == 0xFF && p[1] == 0xD8) return decode_jpeg(p, n, h, w, c);
+  if (n >= 4 && p[0] == 0x89 && p[1] == 'P' && p[2] == 'N' && p[3] == 'G')
+    return decode_png(p, n, h, w, c);
+  set_error("unrecognized image magic (JPEG/PNG supported natively)");
+  return nullptr;
+}
+
+unsigned char *zimg_decode(const char *path, long *h, long *w, int *c) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) {
+    set_error(std::string("open ") + path + ": " + std::strerror(errno));
+    return nullptr;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<unsigned char> buf((size_t)std::max(0L, n));
+  size_t got = n > 0 ? std::fread(buf.data(), 1, (size_t)n, f) : 0;
+  std::fclose(f);
+  if ((long)got != n) {
+    set_error(std::string("short read on ") + path);
+    return nullptr;
+  }
+  return zimg_decode_mem(buf.data(), buf.size(), h, w, c);
+}
+
+void zimg_free(unsigned char *p) { std::free(p); }
+
+}  // extern "C"
+#endif  // ZOO_WITH_IMAGE

@@ -4,15 +4,19 @@ Reference surface (ref: zoo pipeline/api/net/ — ``Net.load_bigdl``,
 ``load_caffe``, ``load_keras``, ``load_tf``, ``load_torch``): import
 foreign-framework models as graph nodes of the native runtime.
 
-TPU rebuild: torch is the supported import path (``TorchNet`` converts via
-torch.fx to a pure JAX function — see torch_net.py); Keras models are
-native here (analytics_zoo_tpu.keras builds flax modules directly).
-TensorFlow/Caffe/BigDL runtimes are not in this environment, so their
-loaders raise with the supported migration path spelled out.
+TPU rebuild: torch imports via ``TorchNet`` (torch.fx -> pure JAX function,
+torch_net.py); TensorFlow imports via ``TFNet`` (frozen GraphDef -> pure
+JAX function, tf_net.py) — both become first-class XLA programs, with the
+foreign framework needed only at load time.  Keras models are native here
+(analytics_zoo_tpu.keras builds flax modules directly); ``load_keras`` also
+accepts tf.keras models/files through TFNet.  Caffe/BigDL runtimes are not
+in this environment, so their loaders raise with the supported migration
+path spelled out.
 """
 
 from __future__ import annotations
 
+from analytics_zoo_tpu.net.tf_net import TFNet
 from analytics_zoo_tpu.net.torch_net import TorchNet
 
 
@@ -34,25 +38,27 @@ class Net:
 
     @staticmethod
     def load_keras(model) -> "object":
-        """Our keras API builds flax modules natively — pass them straight
-        to Estimator/InferenceModel (ref load_keras imported HDF5 models
-        into BigDL; here the keras layer library IS the native one)."""
+        """Native analytics_zoo_tpu.keras models pass through; tf.keras
+        models / .keras / .h5 files import via TFNet (ref: Net.load_keras
+        imported HDF5 topologies into the native graph runtime)."""
         from analytics_zoo_tpu.keras.engine import KerasNet
 
         if isinstance(model, KerasNet):
             return model
-        raise TypeError(
-            "load_keras takes an analytics_zoo_tpu.keras model; HDF5 "
-            "import of tf.keras models needs tensorflow, which is not in "
-            "this environment — rebuild the topology with "
-            "analytics_zoo_tpu.keras and load weights via set_weights()")
+        return TFNet.from_keras(model)
 
     @staticmethod
-    def load_tf(*a, **kw):
-        raise NotImplementedError(
-            "TensorFlow is not available in this environment; export the "
-            "graph's weights and rebuild with analytics_zoo_tpu.keras or "
-            "flax, or convert a torch port via Net.load_torch")
+    def load_tf(path_or_fn, signature: str = "serving_default") -> TFNet:
+        """ref-parity: TFNet — SavedModel dir (or concrete tf.function) ->
+        forward-only JAX callable served by InferenceModel/Estimator."""
+        if isinstance(path_or_fn, (str, bytes)):
+            import os
+
+            p = os.fspath(path_or_fn)
+            if os.path.isdir(p):
+                return TFNet.from_saved_model(p, signature=signature)
+            return TFNet.from_keras(p)
+        return TFNet.from_concrete_function(path_or_fn)
 
     @staticmethod
     def load_bigdl(*a, **kw):
@@ -69,4 +75,4 @@ class Net:
             "Net.load_torch")
 
 
-__all__ = ["TorchNet", "Net"]
+__all__ = ["TorchNet", "TFNet", "Net"]

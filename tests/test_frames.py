@@ -1,6 +1,7 @@
 """NNFrames tests (SURVEY.md §4 parity: DataFrame in, predictions out)."""
 
 import flax.linen as nn
+import jax.numpy as jnp
 import numpy as np
 import optax
 import pandas as pd
@@ -57,3 +58,46 @@ def test_nnclassifier_argmax_and_preprocessing():
     assert acc > 0.8
     # prediction is a plain float class id (Spark ML parity)
     assert isinstance(out["prediction"].iloc[0], float)
+
+
+def test_nn_image_reader_e2e(tmp_path, ctx8):
+    """Folder-of-images -> NNImageReader -> NNClassifier fit -> transform
+    (VERDICT r1 item 6: the NNFrames image story end-to-end)."""
+    from PIL import Image
+
+    from analytics_zoo_tpu.frames import NNClassifier, NNImageReader
+
+    rng = np.random.default_rng(0)
+    # two classes distinguishable by brightness
+    for ci, cname in enumerate(["dark", "bright"]):
+        d = tmp_path / cname
+        d.mkdir()
+        for i in range(16):
+            base = 40 if ci == 0 else 200
+            img = np.clip(rng.normal(base, 20, (12, 12, 3)), 0,
+                          255).astype(np.uint8)
+            Image.fromarray(img).save(d / f"{i}.png")
+
+    df = NNImageReader.readImages(str(tmp_path), resize_h=8, resize_w=8,
+                                  with_label=True)
+    assert set(df.columns) >= {"origin", "image", "height", "width",
+                               "n_channels", "label"}
+    assert len(df) == 32 and df["height"].unique().tolist() == [8]
+    assert df.attrs["class_names"] == ["bright", "dark"]
+
+    class TinyCNN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.astype(jnp.float32) / 255.0
+            x = nn.relu(nn.Conv(4, (3, 3))(x))
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(2)(x)
+
+    clf = (NNClassifier(TinyCNN(), optimizer=optax.adam(1e-2))
+           .setFeaturesCol("image").setLabelCol("label")
+           .setBatchSize(8).setMaxEpoch(40))
+    model = clf.fit(df)
+    out = model.transform(df)
+    acc = (np.asarray(out["prediction"]) ==
+           np.asarray(df["label"], np.float64)).mean()
+    assert acc >= 0.9, f"brightness separation should be learnable: {acc}"

@@ -1,0 +1,122 @@
+"""TFNet: foreign TF model import -> JAX (SURVEY §2.3 TFNet row).
+
+Numerical parity vs TF CPU is the contract (reference TFNet executed the
+graph with libtensorflow; we translate it, so outputs must match)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from analytics_zoo_tpu.net import Net, TFNet  # noqa: E402
+
+
+def _cnn():
+    tf.random.set_seed(0)
+    return tf.keras.Sequential([
+        tf.keras.layers.Input((16, 16, 3)),
+        tf.keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+        tf.keras.layers.BatchNormalization(),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Conv2D(16, 3, padding="valid", activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(10, activation="softmax"),
+    ])
+
+
+def _x(n=4, shape=(16, 16, 3)):
+    return np.random.default_rng(0).normal(size=(n,) + shape).astype(
+        np.float32)
+
+
+def test_keras_cnn_parity():
+    model = _cnn()
+    x = _x()
+    y_tf = model(x, training=False).numpy()
+    net = TFNet.from_keras(model)
+    y_jax = np.asarray(net(net.params, x))
+    np.testing.assert_allclose(y_jax, y_tf, atol=2e-3, rtol=1e-2)
+
+
+def test_keras_file_roundtrip(tmp_path):
+    model = _cnn()
+    p = str(tmp_path / "cnn.keras")
+    model.save(p)
+    net = Net.load_keras(p)
+    x = _x()
+    np.testing.assert_allclose(np.asarray(net(net.params, x)),
+                               model(x, training=False).numpy(),
+                               atol=2e-3, rtol=1e-2)
+
+
+def test_saved_model_via_load_tf(tmp_path):
+    model = _cnn()
+    p = str(tmp_path / "sm")
+    sig = tf.function(lambda x: model(x, training=False))
+    tf.saved_model.save(
+        model, p, signatures=sig.get_concrete_function(
+            tf.TensorSpec([None, 16, 16, 3], tf.float32)))
+    net = Net.load_tf(p)
+    x = _x()
+    y = net(net.params, x)
+    if isinstance(y, (tuple, list)):
+        y = y[0]
+    np.testing.assert_allclose(np.asarray(y),
+                               model(x, training=False).numpy(),
+                               atol=2e-3, rtol=1e-2)
+
+
+def test_serve_through_inference_model():
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    model = _cnn()
+    net = TFNet.from_keras(model)
+    im = InferenceModel().load_flax(net, net.init(None))
+    x = _x(6)
+    preds = im.predict(x)
+    np.testing.assert_allclose(preds, model(x, training=False).numpy(),
+                               atol=2e-3, rtol=1e-2)
+
+
+def test_mlp_and_jit_compatibility():
+    import jax
+
+    tf.random.set_seed(1)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((12,)),
+        tf.keras.layers.Dense(32, activation="tanh"),
+        tf.keras.layers.Dense(3),
+    ])
+    net = TFNet.from_keras(model)
+    x = _x(8, (12,))
+    jitted = jax.jit(net)
+    np.testing.assert_allclose(np.asarray(jitted(net.params, x)),
+                               model(x, training=False).numpy(),
+                               atol=1e-4, rtol=1e-3)
+
+
+def test_unsupported_op_is_explicit():
+    @tf.function
+    def f(x):
+        return tf.signal.fft(tf.cast(x, tf.complex64))
+
+    fn = f.get_concrete_function(tf.TensorSpec([4], tf.float32))
+    with pytest.raises(NotImplementedError, match="FFT"):
+        TFNet.from_concrete_function(fn)
+
+
+def test_embedding_gather():
+    tf.random.set_seed(2)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((5,), dtype="int32"),
+        tf.keras.layers.Embedding(50, 8),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(2),
+    ])
+    ids = np.random.default_rng(0).integers(0, 50, (3, 5)).astype(np.int32)
+    y_tf = model(ids, training=False).numpy()
+    wrapped = tf.function(lambda x: model(x, training=False))
+    net = TFNet.from_concrete_function(wrapped.get_concrete_function(
+        tf.TensorSpec([None, 5], tf.int32)))
+    np.testing.assert_allclose(np.asarray(net(net.params, ids)), y_tf,
+                               atol=1e-4, rtol=1e-3)
