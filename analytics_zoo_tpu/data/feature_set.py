@@ -31,6 +31,18 @@ from analytics_zoo_tpu.data.shards import XShards
 BLOCK_ROWS_DEFAULT = 4096
 
 
+def _host_path(path: str) -> str:
+    """Per-host shard-file naming: a ``{host}`` placeholder expands to this
+    process's index, so N hosts spill/stream N disjoint files from one
+    path template (the multihost DISK-tier contract: each host owns the
+    shard file it writes — nothing is replicated)."""
+    if "{host}" in path:
+        import jax
+
+        return path.format(host=jax.process_index())
+    return path
+
+
 class FeatureSet:
     """DRAM-tier feature set (ref: FeatureSet.rdd / DRAMFeatureSet)."""
 
@@ -74,6 +86,7 @@ class FeatureSet:
         if path is None:
             fd, path = tempfile.mkstemp(suffix=".zrec")
             os.close(fd)
+        path = _host_path(path)
         n = len(self)
         with native.RecordWriter(path) as w:
             for lo in range(0, n, block_rows):
@@ -92,11 +105,18 @@ class DiskFeatureSet:
     requested batch size in numpy.  Block order is shuffled per epoch;
     intra-block order is preserved (the reference's PMEM path likewise
     shuffles at the chunk level).
+
+    Multihost: the file is HOST-LOCAL — each host streams the shard it owns
+    (spill with a ``{host}`` placeholder path, or any per-host path).  The
+    Estimator aligns step/chunk counts across hosts via one row-count
+    allgather, so uneven shards train on ``min_rows`` per host and
+    evaluate/predict over every row exactly once.
     """
 
     def __init__(self, path: str, *, ring_mb: int = 128):
         from analytics_zoo_tpu import native
 
+        path = _host_path(path)
         self.path = path
         self._native = native
         self.reader = native.RecordReader(path)

@@ -335,18 +335,35 @@ class FlaxEstimator:
         shuffle = not self.config.deterministic
         from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
         if isinstance(data, DiskFeatureSet):
-            _require_single_host_for_disk()
-            # DISK tier streams through the native prefetch thread
+            # DISK tier streams through the native prefetch thread.  Each
+            # host streams its OWN shard file (host-local data, like
+            # XShards); multihost step counts are aligned below.
             it = data.batch_iterator(
                 per_host, shuffle=shuffle,
                 seed=self.config.seed + jax.process_index())
             self._ensure_state(data.sample_block())
+            n_local = len(data)
         else:
             arrays = _host_local(data)
             it = NumpyBatchIterator(
                 arrays, per_host, shuffle=shuffle, drop_remainder=True,
                 seed=self.config.seed + jax.process_index())
             self._ensure_state(arrays)
+            n_local = it.n
+        if n_hosts > 1:
+            # Host-local sources (disk shards, XShards) may hold uneven row
+            # counts; every host must run the SAME step count or the
+            # collective program deadlocks.  One allgather of the row count
+            # settles the global minimum.
+            min_rows = int(_allgather_counts(n_local).min())
+            min_steps = min_rows // per_host
+            if min_steps < 1:
+                raise ValueError(
+                    f"global batch {batch_size} needs {per_host} rows per "
+                    f"host but the smallest host shard holds only "
+                    f"{min_rows} rows")
+            if min_steps < it.steps_per_epoch():
+                it = _StepLimitIterator(it, min_steps)
         self._build_jits()
         self._global_step = int(self.state.step)
         trigger = checkpoint_trigger or (
@@ -441,21 +458,52 @@ class FlaxEstimator:
             history.append(stats)
         return history
 
-    def _eval_chunks(self, data, per_host):
-        """Host-local, fixed-order chunks of <= per_host rows.  The DISK
-        tier streams block-by-block (never materialised to DRAM — the
-        whole point of the tier); everything else normalises to arrays."""
+    def _local_eval_stream(self, data, per_host):
+        """-> (iterator of host-local fixed-order chunks of <= per_host
+        rows, local row count, sample dict).  The DISK tier streams
+        block-by-block (never materialised to DRAM — the whole point of
+        the tier); everything else normalises to arrays."""
         from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
 
         if isinstance(data, DiskFeatureSet):
-            _require_single_host_for_disk()
-            yield from data.batches(per_host, shuffle=False,
-                                    drop_remainder=False)
-            return
+            it = data.batches(per_host, shuffle=False,
+                              drop_remainder=False)
+            return it, len(data), data.sample_block()
         arrays = _host_local(data)
         n = len(next(iter(arrays.values())))
-        for lo in range(0, n, per_host):
-            yield {k: v[lo:lo + per_host] for k, v in arrays.items()}
+
+        def gen():
+            for lo in range(0, n, per_host):
+                yield {k: v[lo:lo + per_host] for k, v in arrays.items()}
+
+        return gen(), n, arrays
+
+    def _chunk_plan(self, n_local: int, per_host: int):
+        """Multihost chunk alignment for eval/predict.
+
+        Hosts hold uneven row counts (disk shards, XShards); each chunk is
+        one collective (`make_array_from_process_local_data`), so all hosts
+        must emit the SAME number of chunks.  One allgather of the row
+        counts lets every host derive every other host's deterministic
+        chunk sizes locally.  Returns ``(n_chunks, global_counts)`` where
+        ``global_counts[j]`` is the true row total of chunk j across hosts,
+        or None on a single host.
+        """
+        if jax.process_count() == 1:
+            return None
+        counts = _allgather_counts(n_local)
+
+        def sizes(n):
+            s = [per_host] * (n // per_host)
+            if n % per_host:
+                s.append(n % per_host)
+            return s
+
+        per_host_sizes = [sizes(int(c)) for c in counts]
+        n_chunks = max(len(s) for s in per_host_sizes)
+        gcounts = [sum(s[j] for s in per_host_sizes if j < len(s))
+                   for j in range(n_chunks)]
+        return n_chunks, gcounts
 
     def _sample_of(self, data) -> Dict[str, np.ndarray]:
         from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
@@ -472,8 +520,11 @@ class FlaxEstimator:
         n_hosts = jax.process_count()
         per_host = max(1, batch_size // n_hosts)
         acc = EpochAccumulator()
+        stream, n_local, sample = self._local_eval_stream(data, per_host)
+        plan = self._chunk_plan(n_local, per_host)
         mets_list, counts = [], []
-        for chunk in self._eval_chunks(data, per_host):
+        for j, chunk in enumerate(
+                _padded_chunks(stream, plan and plan[0], sample)):
             real = len(next(iter(chunk.values())))
             chunk, w = _pad_batch(chunk, per_host)
             gbatch = make_global_batch(self.mesh, chunk, self._data_sharding)
@@ -482,7 +533,9 @@ class FlaxEstimator:
             # keep metrics on-device: blocking here would serialise eval
             # steps and pay a device round-trip per chunk
             mets_list.append(self._jit_eval_step(self.state, gbatch, gw))
-            counts.append(real * n_hosts)
+            # exact global row count per chunk: the zero-weight padding
+            # rows never enter the metric averages
+            counts.append(real if plan is None else plan[1][j])
         for mets, cnt in zip(jax.device_get(mets_list), counts):
             acc.add(mets, cnt)
         return acc.result()
@@ -500,7 +553,11 @@ class FlaxEstimator:
         per_host = max(1, batch_size // n_hosts)
         outs, window = [], []
         single_host = jax.process_count() == 1
-        for chunk in self._eval_chunks(data, per_host):
+        stream, n_local, _ = self._local_eval_stream(data, per_host)
+        for chunk in _padded_chunks(
+                stream,
+                None if single_host
+                else self._chunk_plan(n_local, per_host)[0], sample):
             chunk = {k: v for k, v in chunk.items()
                      if k in self.feature_cols}
             real = len(next(iter(chunk.values())))
@@ -622,16 +679,68 @@ def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
-def _require_single_host_for_disk():
-    """DiskFeatureSet multi-host semantics (each host spilling its own
-    shard vs a replicated file) are not settled — refuse rather than pick
-    one silently; fit would train on duplicates or evaluate would drop
-    rows depending on which assumption the file actually satisfies."""
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "DiskFeatureSet with multiple hosts is not supported yet: "
-            "spill per-host XShards to per-host files and pass host-local "
-            "arrays, or keep the DRAM tier")
+def _allgather_counts(n_local: int) -> np.ndarray:
+    """All hosts' local row counts, in process order (one tiny collective;
+    replaces any out-of-band host coordination the reference did through
+    the Spark driver)."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(
+        np.array([n_local], np.int64))).reshape(-1)
+
+
+class _StepLimitIterator:
+    """Caps an epoch iterator at `max_steps` batches so every host runs the
+    same number of collective steps even with uneven local row counts."""
+
+    def __init__(self, it, max_steps: int):
+        self._it = it
+        self.max_steps = max_steps
+
+    def steps_per_epoch(self) -> int:
+        return min(self._it.steps_per_epoch(), self.max_steps)
+
+    def epoch_batches(self):
+        it = self._it
+        e0 = getattr(it, "epoch", None)
+        gen = it.epoch_batches()
+
+        def limited():
+            n = 0
+            for b in gen:
+                yield b
+                n += 1
+                if n >= self.max_steps:
+                    break
+            # release the source promptly (disk readers hold a ring buffer
+            # + prefetch thread in their finally blocks)
+            if hasattr(gen, "close"):
+                gen.close()
+            # NumpyBatchIterator only advances its epoch counter when its
+            # generator runs to natural exhaustion; truncation would freeze
+            # the shuffle seed at epoch 0 — advance it here if the source
+            # didn't (disk iterators advance eagerly).
+            if e0 is not None and getattr(it, "epoch", None) == e0:
+                it.epoch = e0 + 1
+
+        return limited()
+
+
+def _padded_chunks(stream, n_chunks, sample):
+    """Yield `stream`'s chunks, then zero-row chunks (shaped like `sample`'s
+    columns) until `n_chunks` total — hosts that run out of rows still
+    participate in the remaining collectives.  n_chunks=None: no padding."""
+    j = 0
+    for chunk in stream:
+        yield chunk
+        j += 1
+    if n_chunks is not None and j < n_chunks:
+        empty = {k: np.zeros((0,) + np.asarray(v).shape[1:],
+                             np.asarray(v).dtype)
+                 for k, v in sample.items()}
+        while j < n_chunks:
+            yield empty
+            j += 1
 
 
 def _host_local(data) -> Dict[str, np.ndarray]:

@@ -16,6 +16,8 @@ path spelled out.
 
 from __future__ import annotations
 
+import os
+
 from analytics_zoo_tpu.net.tf_net import TFNet
 from analytics_zoo_tpu.net.torch_net import TorchNet
 
@@ -45,16 +47,20 @@ class Net:
 
         if isinstance(model, KerasNet):
             return model
+        if isinstance(model, (str, bytes, os.PathLike)):
+            p = os.fspath(model)
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"no such keras model file: {p!r}")
         return TFNet.from_keras(model)
 
     @staticmethod
     def load_tf(path_or_fn, signature: str = "serving_default") -> TFNet:
         """ref-parity: TFNet — SavedModel dir (or concrete tf.function) ->
         forward-only JAX callable served by InferenceModel/Estimator."""
-        if isinstance(path_or_fn, (str, bytes)):
-            import os
-
+        if isinstance(path_or_fn, (str, bytes, os.PathLike)):
             p = os.fspath(path_or_fn)
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"no such TF model path: {p!r}")
             if os.path.isdir(p):
                 return TFNet.from_saved_model(p, signature=signature)
             return TFNet.from_keras(p)

@@ -1,0 +1,198 @@
+"""Worker process for the multihost tests (spawned by test_multihost.py).
+
+Each invocation is one *host* of a 2-host cluster: it joins a
+`jax.distributed` coordinator on localhost with 4 virtual CPU devices and
+gloo cross-process collectives (the single-box multi-process doctrine of
+the reference's test suite — SURVEY.md §4: `pyzoo/test/zoo/orca/learn/ray/`
+ran multi-worker code paths as N processes on one machine), runs one named
+scenario, and dumps its observations as JSON for the parent to assert on.
+
+Usage: python _multihost_worker.py <scenario> <pid> <nprocs> <port> <outdir>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup(pid: int, nprocs: int, port: int):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from analytics_zoo_tpu import init_orca_context
+
+    return init_orca_context(
+        "multihost", coordinator_address=f"localhost:{port}",
+        num_processes=nprocs, process_id=pid, mesh_axes={"dp": -1})
+
+
+def make_data(n=64, dim=8):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    w = rng.normal(size=(dim, 1)).astype(np.float32)
+    y = np.tanh(x @ w) + 0.1 * rng.normal(size=(n, 1)).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def make_model():
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.tanh(nn.Dense(16, name="h")(x))
+            return nn.Dense(1, name="out")(h)
+
+    return MLP()
+
+
+def make_estimator():
+    import optax
+
+    from analytics_zoo_tpu.common.config import TrainConfig
+    from analytics_zoo_tpu.learn import Estimator
+
+    return Estimator.from_flax(
+        model=make_model(), loss="mse", optimizer=optax.sgd(0.1),
+        config=TrainConfig(deterministic=True, seed=0))
+
+
+def _params_to_lists(params):
+    import jax
+    import numpy as np
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = np.asarray(leaf).tolist()
+    return flat
+
+
+def scenario_fit(pid, outdir):
+    """Replicated ndarrays: _host_local must dedup (each host trains on a
+    disjoint half); loss trajectory is asserted against a single-process
+    run on the same global batches by the parent."""
+    x, y = make_data()
+    est = make_estimator()
+    hist = est.fit({"x": x, "y": y}, epochs=3, batch_size=16)
+    return {"loss": [h["loss"] for h in hist],
+            "num_samples": [h["num_samples"] for h in hist],
+            "params": _params_to_lists(est.state.params)}
+
+
+def scenario_predict(pid, outdir):
+    """predict on replicated rows: each host must get exactly its own
+    slice's predictions, in global row order (_local_rows)."""
+    x, y = make_data()
+    est = make_estimator()
+    preds = est.predict({"x": x}, batch_size=16)
+    # evaluate too: exact global row accounting over all 64 rows
+    ev = est.evaluate({"x": x, "y": y}, batch_size=16)
+    return {"preds": preds.tolist(),
+            "eval_loss": ev["loss"],
+            "params": _params_to_lists(est.state.params)}
+
+
+def scenario_read_csv(pid, outdir):
+    """Per-host file partitioning: the union of hosts' rows must be the
+    full file set, disjointly."""
+    from analytics_zoo_tpu.data import read_csv
+
+    shards = read_csv(os.path.join(outdir, "csv", "part-*.csv"))
+    d = shards.to_numpy_dict() if shards.num_partitions() else {}
+    vals = sorted(int(v) for v in d.get("a", []))
+    return {"rows": vals}
+
+
+def scenario_checkpoint(pid, outdir):
+    """Orbax save/restore across both processes (sharded arrays)."""
+    import jax
+    import numpy as np
+
+    x, y = make_data()
+    est = make_estimator()
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=16)
+    ckdir = os.path.join(outdir, "ckpt")
+    est.save_checkpoint(ckdir)
+    saved_step = int(est.state.step)
+
+    est2 = make_estimator()
+    # different (shorter) trajectory first; restore must overwrite it
+    est2.fit({"x": x, "y": y}, epochs=1, batch_size=32)
+    est2.load_checkpoint(ckdir)
+    same = all(
+        np.allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+        for a, b in zip(jax.tree.leaves(est.state.params),
+                        jax.tree.leaves(est2.state.params)))
+    return {"saved_step": saved_step,
+            "restored_step": int(est2.state.step),
+            "params_match": bool(same)}
+
+
+def scenario_disk(pid, outdir):
+    """Multihost DiskFeatureSet: each host spills and streams its own
+    shard; even shards must reproduce the DRAM trajectory; uneven shards
+    must train on min_rows/host and evaluate over every row exactly once."""
+    import numpy as np
+
+    from analytics_zoo_tpu.data.feature_set import FeatureSet, DiskFeatureSet
+
+    x, y = make_data()
+    half = len(x) // 2
+    lo = pid * half
+    xl, yl = x[lo:lo + half], y[lo:lo + half]
+
+    # -- even shards: trajectory must equal the DRAM/replicated run
+    path = os.path.join(outdir, "shard_{host}.zrec")
+    dfs = FeatureSet({"x": xl, "y": yl}).to_disk(path, block_rows=1024)
+    est = make_estimator()
+    hist = est.fit(dfs, epochs=3, batch_size=16)
+
+    # -- uneven shards: host 1 drops its last 8 rows
+    if pid == 1:
+        xl2, yl2 = xl[:-8], yl[:-8]
+    else:
+        xl2, yl2 = xl, yl
+    path2 = os.path.join(outdir, "uneven_{host}.zrec")
+    dfs2 = FeatureSet({"x": xl2, "y": yl2}).to_disk(path2, block_rows=1024)
+    est2 = make_estimator()
+    hist2 = est2.fit(dfs2, epochs=1, batch_size=16)
+    ev = est2.evaluate(dfs2, batch_size=16)
+    preds = est2.predict(dfs2, batch_size=16)
+    return {"loss": [h["loss"] for h in hist],
+            "num_samples": [h["num_samples"] for h in hist],
+            "uneven_num_samples": [h["num_samples"] for h in hist2],
+            "uneven_eval_loss": ev["loss"],
+            "uneven_preds": np.asarray(preds).tolist(),
+            "uneven_rows": len(xl2),
+            "params2": _params_to_lists(est2.state.params)}
+
+
+SCENARIOS = {
+    "fit": scenario_fit,
+    "predict": scenario_predict,
+    "read_csv": scenario_read_csv,
+    "checkpoint": scenario_checkpoint,
+    "disk": scenario_disk,
+}
+
+
+def main():
+    scenario, pid, nprocs, port, outdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5])
+    setup(pid, nprocs, port)
+    result = SCENARIOS[scenario](pid, outdir)
+    with open(os.path.join(outdir, f"out_{pid}.json"), "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

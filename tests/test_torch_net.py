@@ -279,8 +279,12 @@ def test_net_load_torch_path(tmp_path):
 
 
 def test_net_load_tf_and_bigdl_raise():
-    with pytest.raises(NotImplementedError):
+    # load_tf is implemented (round 2); a nonexistent path must surface as
+    # FileNotFoundError, not a confusing Keras format error.
+    with pytest.raises(FileNotFoundError):
         Net.load_tf("x")
+    with pytest.raises(FileNotFoundError):
+        Net.load_keras("no/such/model.keras")
     with pytest.raises(NotImplementedError):
         Net.load_bigdl("x")
     with pytest.raises(NotImplementedError):
