@@ -200,6 +200,23 @@ class DiskFeatureSet:
         return device_prefetch(self.batches(batch_size, **kw), mesh,
                                depth=depth, sharding=sharding)
 
+    def fingerprint(self) -> int:
+        """Content fingerprint (row count + full first/last record hash),
+        used by the Estimator to detect N hosts accidentally opening ONE
+        replicated/shared shard file instead of per-host shards.  Distinct
+        shards that differ anywhere in their first or last block hash
+        differently; a genuine collision can be overridden with
+        ANALYTICS_ZOO_TPU_ALLOW_SHARED_DISK=1."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=7)
+        h.update(str(self._n).encode())
+        nrec = len(self.reader)
+        if nrec:
+            h.update(bytes(self.reader.get(0)))
+            h.update(bytes(self.reader.get(nrec - 1)))
+        return int.from_bytes(h.digest(), "little")
+
     def sample_block(self) -> Dict[str, np.ndarray]:
         """First row-block (shape/dtype probe) — reads one record, no
         prefetch thread / ring buffer involved."""

@@ -14,7 +14,6 @@ analog of FeatureSet's memory tiers).
 
 from __future__ import annotations
 
-import collections
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 
 import jax
@@ -93,19 +92,54 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], mesh: Mesh, *,
                     depth: int = 2,
                     sharding: Optional[NamedSharding] = None
                     ) -> Iterator[Dict[str, jax.Array]]:
-    """Overlap H2D transfer with compute: keep `depth` batches in flight.
+    """Overlap H2D transfer with compute: keep `depth` batches in flight,
+    staged by a background thread.
 
-    device_put is async — enqueueing the next transfer before the consumer
-    blocks on the current batch double-buffers HBM staging.
+    ``device_put`` is nominally async, but on tunneled/remote devices the
+    call itself blocks for the full transfer — staged on the consumer
+    thread, every step would pay transfer + compute SERIALLY.  A worker
+    thread turns the transfer into true double-buffering: it fills a
+    bounded queue (depth = HBM staging bound) while the main thread
+    dispatches compute.  numpy gather + device_put release the GIL for the
+    copy, so the threads genuinely overlap.
     """
+    import queue as _queue
+    import threading
+
     sh = sharding or data_sharding(mesh)
-    buf: collections.deque = collections.deque()
-    for b in batches:
-        buf.append(make_global_batch(mesh, b, sh))
-        if len(buf) > depth:
-            yield buf.popleft()
-    while buf:
-        yield buf.popleft()
+    q: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+    _END = object()
+
+    def worker():
+        try:
+            for b in batches:
+                if stop.is_set():
+                    return
+                q.put(make_global_batch(mesh, b, sh))
+            q.put(_END)
+        except BaseException as e:  # surface reader errors to the consumer
+            q.put(e)
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name="zoo-device-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        # unblock the worker if it is waiting on a full queue
+        while t.is_alive():
+            try:
+                q.get_nowait()
+            except _queue.Empty:
+                t.join(timeout=0.1)
 
 
 class DataCreator:

@@ -245,8 +245,6 @@ class FlaxEstimator:
         if self.state is not None:
             return
         seed = self.config.seed
-        root = jax.random.key(seed)
-        init_rng, train_rng = jax.random.split(root)
         # Init batch must divide the mesh's batch axes (shard_map paths are
         # strict about divisibility), so tile the sample up to one row per
         # batch-mesh slice instead of using a single row.
@@ -271,6 +269,14 @@ class FlaxEstimator:
         kw = self._apply_kwargs(train=False)
 
         def init_fn():
+            # RNG keys are created INSIDE the traced function: a key built
+            # eagerly and closed over would be embedded as a program
+            # constant, and materialising that constant does a hidden
+            # device->host fetch — which on tunneled devices permanently
+            # degrades the H2D link (~1.6 GB/s -> ~20 MB/s) before
+            # training even starts.
+            root = jax.random.key(seed)
+            init_rng, train_rng = jax.random.split(root)
             variables = self.model.init(
                 {"params": init_rng, "dropout": init_rng}, *feats, **kw)
             return create_train_state(train_rng, self.model.apply,
@@ -334,38 +340,63 @@ class FlaxEstimator:
         per_host = batch_size // n_hosts
         shuffle = not self.config.deterministic
         from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
-        if isinstance(data, DiskFeatureSet):
+        is_disk = isinstance(data, DiskFeatureSet)
+        if is_disk:
             # DISK tier streams through the native prefetch thread.  Each
             # host streams its OWN shard file (host-local data, like
-            # XShards); multihost step counts are aligned below.
-            it = data.batch_iterator(
-                per_host, shuffle=shuffle,
-                seed=self.config.seed + jax.process_index())
-            self._ensure_state(data.sample_block())
+            # XShards).
             n_local = len(data)
         else:
             arrays = _host_local(data)
-            it = NumpyBatchIterator(
-                arrays, per_host, shuffle=shuffle, drop_remainder=True,
-                seed=self.config.seed + jax.process_index())
-            self._ensure_state(arrays)
-            n_local = it.n
+            n_local = len(next(iter(arrays.values())))
+        min_steps = None
         if n_hosts > 1:
             # Host-local sources (disk shards, XShards) may hold uneven row
             # counts; every host must run the SAME step count or the
             # collective program deadlocks.  One allgather of the row count
-            # settles the global minimum.
-            min_rows = int(_allgather_counts(n_local).min())
+            # settles the global minimum — and must happen BEFORE any
+            # per-host record access or iterator validation (sample_block
+            # on an empty shard, batch-size checks) so a too-small host
+            # raises the same error everywhere instead of deadlocking its
+            # peers inside a collective.
+            fp = data.fingerprint() if is_disk else 0
+            gathered = _allgather_counts(n_local, fp)
+            min_rows = int(gathered[:, 0].min())
+            if is_disk and n_local > 0 and not _allow_shared_disk() and \
+                    len({tuple(r) for r in gathered.tolist()}) == 1:
+                raise ValueError(
+                    "every host opened an identical DiskFeatureSet shard "
+                    "(same row count and content fingerprint) — this looks "
+                    "like ONE replicated/shared file, which would train "
+                    "each row once per host.  Spill per-host shards (use a "
+                    "'{host}' placeholder in the path); if these really "
+                    "are distinct shards, set "
+                    "ANALYTICS_ZOO_TPU_ALLOW_SHARED_DISK=1")
             min_steps = min_rows // per_host
             if min_steps < 1:
                 raise ValueError(
                     f"global batch {batch_size} needs {per_host} rows per "
                     f"host but the smallest host shard holds only "
                     f"{min_rows} rows")
-            if min_steps < it.steps_per_epoch():
-                it = _StepLimitIterator(it, min_steps)
+        if is_disk:
+            self._ensure_state(data.sample_block())
+            it = data.batch_iterator(
+                per_host, shuffle=shuffle,
+                seed=self.config.seed + jax.process_index())
+        else:
+            self._ensure_state(arrays)
+            it = NumpyBatchIterator(
+                arrays, per_host, shuffle=shuffle, drop_remainder=True,
+                seed=self.config.seed + jax.process_index())
+        if min_steps is not None and min_steps < it.steps_per_epoch():
+            it = _StepLimitIterator(it, min_steps)
         self._build_jits()
-        self._global_step = int(self.state.step)
+        # NOTE: _global_step is tracked host-side (incremented per step,
+        # synced from device only on checkpoint restore).  Reading
+        # int(self.state.step) here would be a D2H fetch before the hot
+        # loop — on tunneled devices the FIRST device->host fetch
+        # permanently degrades the H2D link (~1.6 GB/s -> ~55 MB/s),
+        # throttling the entire input pipeline that follows.
         trigger = checkpoint_trigger or (
             EveryEpoch() if self.config.checkpoint_dir else None)
         mlog = MetricLogger(jsonl_path=self.config.metrics_jsonl,
@@ -432,22 +463,31 @@ class FlaxEstimator:
                 if trigger and trigger({"step": self._global_step,
                                         "epoch": self._epoch}):
                     self._maybe_checkpoint()
-            jax.block_until_ready(self.state.params)
-            dt = time.perf_counter() - t0
-            self._epoch += 1
+            # Epoch barrier: stack every step's metrics on-device into ONE
+            # array per metric and fetch those.  Two properties matter on
+            # tunneled/remote devices: (a) the barrier must be a real value
+            # fetch, not jax.block_until_ready — which acknowledges enqueue,
+            # not completion, and would credit the epoch with compute still
+            # draining in the device queue; (b) the fetch must be O(metrics)
+            # transfers, not O(steps x metrics) — device_get on a list of
+            # per-step dicts pays a full round-trip per leaf.
             acc = EpochAccumulator()
-            # fetch every step's metrics in ONE batched transfer (a
-            # per-value fetch costs a device round-trip each — seconds per
-            # epoch on tunneled devices)
-            for mets in jax.device_get(step_mets):
-                acc.add({k: float(v) for k, v in mets.items()}, batch_size)
+            if step_mets:
+                fetched = _fetch_stacked(step_mets)
+                dt = time.perf_counter() - t0
+                for i in range(n_steps):
+                    acc.add({k: float(v[i]) for k, v in fetched.items()},
+                            batch_size)
+            else:
+                dt = time.perf_counter() - t0
+            self._epoch += 1
             stats = acc.result()
             stats["num_samples"] = float(n_steps * batch_size)
             stats["samples_per_sec"] = (n_steps * batch_size) / dt if dt else 0
             if validation_data is not None:
                 val = self.evaluate(validation_data, batch_size=batch_size)
                 stats.update({f"val_{k}": v for k, v in val.items()})
-            if trigger and trigger({"step": int(self.state.step),
+            if trigger and trigger({"step": self._global_step,
                                     "epoch": self._epoch, "epoch_end": True,
                                     "metrics": stats}):
                 self._maybe_checkpoint()
@@ -458,25 +498,38 @@ class FlaxEstimator:
             history.append(stats)
         return history
 
-    def _local_eval_stream(self, data, per_host):
+    def _local_n(self, data):
+        """Host-local row count WITHOUT touching any records (safe to call
+        before the multihost alignment collective even on an empty shard).
+        Returns (n_local, arrays-or-None); arrays are reused downstream so
+        in-memory data is normalised exactly once."""
+        from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
+
+        if isinstance(data, DiskFeatureSet):
+            return len(data), None
+        arrays = _host_local(data)
+        return len(next(iter(arrays.values()))), arrays
+
+    def _local_eval_stream(self, data, per_host, arrays=None):
         """-> (iterator of host-local fixed-order chunks of <= per_host
-        rows, local row count, sample dict).  The DISK tier streams
-        block-by-block (never materialised to DRAM — the whole point of
-        the tier); everything else normalises to arrays."""
+        rows, sample dict).  The DISK tier streams block-by-block (never
+        materialised to DRAM — the whole point of the tier); everything
+        else uses the arrays `_local_n` already normalised."""
         from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
 
         if isinstance(data, DiskFeatureSet):
             it = data.batches(per_host, shuffle=False,
                               drop_remainder=False)
-            return it, len(data), data.sample_block()
-        arrays = _host_local(data)
+            return it, data.sample_block()
+        if arrays is None:
+            arrays = _host_local(data)
         n = len(next(iter(arrays.values())))
 
         def gen():
             for lo in range(0, n, per_host):
                 yield {k: v[lo:lo + per_host] for k, v in arrays.items()}
 
-        return gen(), n, arrays
+        return gen(), arrays
 
     def _chunk_plan(self, n_local: int, per_host: int):
         """Multihost chunk alignment for eval/predict.
@@ -491,7 +544,13 @@ class FlaxEstimator:
         """
         if jax.process_count() == 1:
             return None
-        counts = _allgather_counts(n_local)
+        counts = _allgather_counts(n_local)[:, 0]
+        if counts.min() == 0:
+            # every host raises the same error (the allgather already ran)
+            # instead of a zero-row host dying early and deadlocking peers
+            raise ValueError(
+                f"evaluate/predict need rows on every host, but local row "
+                f"counts are {counts.tolist()} (host order)")
 
         def sizes(n):
             s = [per_host] * (n // per_host)
@@ -515,13 +574,17 @@ class FlaxEstimator:
     def evaluate(self, data, batch_size: int = 32,
                  feature_cols=None, label_cols=None) -> Dict[str, float]:
         self._set_cols(feature_cols, label_cols)
-        self._ensure_state(self._sample_of(data))
-        self._build_jits()
         n_hosts = jax.process_count()
         per_host = max(1, batch_size // n_hosts)
-        acc = EpochAccumulator()
-        stream, n_local, sample = self._local_eval_stream(data, per_host)
+        # multihost alignment FIRST — before any record access, so a bad
+        # host raises everywhere instead of deadlocking peers (see fit)
+        n_local, arrays = self._local_n(data)
         plan = self._chunk_plan(n_local, per_host)
+        self._ensure_state(arrays if arrays is not None
+                           else self._sample_of(data))
+        self._build_jits()
+        acc = EpochAccumulator()
+        stream, sample = self._local_eval_stream(data, per_host, arrays)
         mets_list, counts = [], []
         for j, chunk in enumerate(
                 _padded_chunks(stream, plan and plan[0], sample)):
@@ -536,28 +599,30 @@ class FlaxEstimator:
             # exact global row count per chunk: the zero-weight padding
             # rows never enter the metric averages
             counts.append(real if plan is None else plan[1][j])
-        for mets, cnt in zip(jax.device_get(mets_list), counts):
-            acc.add(mets, cnt)
+        if mets_list:
+            fetched = _fetch_stacked(mets_list)
+            for i, cnt in enumerate(counts):
+                acc.add({k: float(v[i]) for k, v in fetched.items()}, cnt)
         return acc.result()
 
     def predict(self, data, batch_size: int = 32,
                 feature_cols=None) -> np.ndarray:
         self._set_cols(feature_cols, None)
-        sample = self._sample_of(data)
+        n_hosts = jax.process_count()
+        per_host = max(1, batch_size // n_hosts)
+        # multihost alignment FIRST — before any record access (see fit)
+        n_local, arrays = self._local_n(data)
+        plan = self._chunk_plan(n_local, per_host)
+        sample = arrays if arrays is not None else self._sample_of(data)
         for c in self.feature_cols:
             if c not in sample:
                 raise KeyError(f"feature col {c!r} missing from predict data")
         self._ensure_state(sample)
         self._build_jits()
-        n_hosts = jax.process_count()
-        per_host = max(1, batch_size // n_hosts)
         outs, window = [], []
-        single_host = jax.process_count() == 1
-        stream, n_local, _ = self._local_eval_stream(data, per_host)
-        for chunk in _padded_chunks(
-                stream,
-                None if single_host
-                else self._chunk_plan(n_local, per_host)[0], sample):
+        single_host = n_hosts == 1
+        stream, _ = self._local_eval_stream(data, per_host, arrays)
+        for chunk in _padded_chunks(stream, plan and plan[0], sample):
             chunk = {k: v for k, v in chunk.items()
                      if k in self.feature_cols}
             real = len(next(iter(chunk.values())))
@@ -622,6 +687,8 @@ class FlaxEstimator:
             step=restored["step"], batch_stats=restored["batch_stats"],
             rng=jax.random.wrap_key_data(restored["rng"]))
         self._epoch = int(restored.get("epoch", 0))
+        # re-sync the host-side step counter (the one deliberate D2H read)
+        self._global_step = int(np.asarray(restored["step"]))
 
     def _checkpoint_manager(self, path: str):
         import orbax.checkpoint as ocp
@@ -679,14 +746,44 @@ def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
-def _allgather_counts(n_local: int) -> np.ndarray:
-    """All hosts' local row counts, in process order (one tiny collective;
-    replaces any out-of-band host coordination the reference did through
-    the Spark driver)."""
+def _fetch_stacked(mets_list, chunk: int = 512):
+    """Fetch a list of per-step scalar-metric dicts as dict of (n,) numpy
+    arrays in O(metrics x n/chunk) device transfers.
+
+    Two scaling traps this avoids: device_get on the raw list pays a full
+    round-trip per leaf (O(n x metrics) — seconds per epoch on tunneled
+    devices), while one giant stack builds an HLO with n operands
+    (trace/lowering time explodes for long epochs).  Chunked eager stacks
+    keep both costs linear with small constants.  The first stack dispatch
+    is also the real epoch completion barrier's work — values must exist.
+    """
+    keys = list(mets_list[0].keys())
+    out = {}
+    for k in keys:
+        vals = [m[k] for m in mets_list]
+        parts = [jnp.stack(vals[i:i + chunk])
+                 for i in range(0, len(vals), chunk)]
+        out[k] = np.concatenate(jax.device_get(parts))
+    return out
+
+
+def _allow_shared_disk() -> bool:
+    """Kill-switch for the replicated-shard heuristic (distinct shards can
+    in principle collide on the count+content fingerprint)."""
+    import os
+
+    return os.environ.get("ANALYTICS_ZOO_TPU_ALLOW_SHARED_DISK", "") == "1"
+
+
+def _allgather_counts(n_local: int, fingerprint: int = 0) -> np.ndarray:
+    """All hosts' (row count, content fingerprint) pairs, in process order
+    (one tiny collective; replaces any out-of-band host coordination the
+    reference did through the Spark driver).  Shape (n_hosts, 2); callers
+    that only need counts use column 0 / ``.min()``."""
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(
-        np.array([n_local], np.int64))).reshape(-1)
+    return np.atleast_2d(np.asarray(multihost_utils.process_allgather(
+        np.array([n_local, fingerprint], np.int64))))
 
 
 class _StepLimitIterator:

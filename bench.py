@@ -1,23 +1,29 @@
 #!/usr/bin/env python
 """Benchmark: BERT-base fine-tune throughput through Estimator.fit()
-(BASELINE.md config #3 — the north star), plus NCF (config #1).
+(BASELINE.md config #3 — the north star), plus NCF (config #1) and
+ResNet-50 (config #2).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-Both models are measured through the REAL training path — ``fit()`` with
+All models are measured through the REAL training path — ``fit()`` with
 host batching, shuffling, and double-buffered device_put prefetch in the
-measured window — not a bare pre-staged step function.  ``vs_baseline``
-compares BERT against the same fit() loop on this host's CPU via a
-subprocess (the reference stack is CPU-only — Xeon/MKL — so TPU-vs-host-CPU
-is the honest capability-parity ratio measurable here; BASELINE.md: no
-published reference numbers exist).  ``extra.bert_mfu`` is measured step
-FLOPs (XLA cost analysis of the compiled train step) over the chip's peak.
+measured window — not a bare pre-staged step function.  Every model bench
+runs in its OWN subprocess: this platform's device link permanently drops
+from ~1.7 GB/s to ~30 MB/s H2D after the first device->host fetch, so one
+bench's metric fetches must not poison the next bench's input pipeline
+(round-2 ResNet measured exactly that artifact).  ``vs_baseline`` compares
+BERT against the same fit() loop on this host's CPU via a subprocess (the
+reference stack is CPU-only — Xeon/MKL — so TPU-vs-host-CPU is the honest
+capability-parity ratio measurable here; BASELINE.md: no published
+reference numbers exist).  ``extra.*_mfu`` is measured step FLOPs (XLA
+cost analysis of the compiled train step) over the chip's peak.
 """
 
 import json
 import os
 import subprocess
 import sys
+import time
 
 BERT_SEQ = 128
 BERT_BATCH = 64
@@ -43,13 +49,17 @@ def _peak_for(device) -> float:
 
 
 def _warm_compile(est, data, batch_size):
-    """Run ONE real train step to populate the jit cache without any D2H.
+    """Populate the jit cache AND settle the device link into its
+    steady-state mode before the measured window.
 
-    The measured window must exclude compile AND stay in the tunnel's
-    fast-transfer mode: this platform's device link permanently drops from
-    ~1.7 GB/s to ~30 MB/s H2D after the first device->host fetch, so the
-    warmup must not read anything back."""
-    import jax
+    Platform facts this encodes (measured, round 3): on the tunneled
+    device, (a) ``jax.block_until_ready`` acknowledges enqueue, not
+    completion — only a value fetch is a real barrier; (b) the FIRST
+    device->host fetch of a process pays a one-time multi-second link
+    reconfiguration and drops H2D from ~1.6 GB/s to ~55 MB/s permanently.
+    An honest steady-state measurement therefore takes that fetch BEFORE
+    the window — every epoch of a real training run after the first
+    metric read lives in this regime."""
     import numpy as np
 
     from analytics_zoo_tpu.data.loader import make_global_batch
@@ -58,19 +68,96 @@ def _warm_compile(est, data, batch_size):
     est._ensure_state(batch)
     est._build_jits()
     g = make_global_batch(est.mesh, batch, est._data_sharding)
-    state, _ = est._jit_train_step(est.state, g)
-    jax.block_until_ready(state.params)     # wait only — no data fetched
+    state, mets = est._jit_train_step(est.state, g)
+    float(np.asarray(mets["loss"]))     # real barrier + link settle
     est.state = state
 
 
-def _fit_throughput(est, data, batch_size, epochs=1):
-    """samples/sec through fit() — host batching, shuffling and H2D
-    prefetch inside the measured window; compile excluded via warmup.
-    fit's per-epoch timer stops before its own metric fetch, so epoch 1
-    runs entirely in fast-transfer mode."""
+def _fit_throughput(est, data, batch_size, epochs=2):
+    """Steady-state samples/sec through fit() — host batching, shuffling,
+    H2D prefetch and the epoch metric fetch all inside the measured
+    window; compile and the one-time link reconfiguration excluded via
+    warmup.  fit's epoch barrier is a real value fetch (estimator.py)."""
     _warm_compile(est, data, batch_size)
     hist = est.fit(data, epochs=epochs, batch_size=batch_size)
     return max(h["samples_per_sec"] for h in hist)
+
+
+def _compute_throughput(est, data, batch_size, steps=20, n_buf=4):
+    """Pure per-chip compute rate: batches pre-staged in HBM, no H2D in
+    the loop, real fetch barrier at the end.  This is what the chip
+    sustains when the input pipeline keeps up — the number to compare
+    against MFU/peak (the tunnel's ~55 MB/s H2D cap is a harness
+    artifact real TPU-VM hosts don't have)."""
+    import numpy as np
+
+    from analytics_zoo_tpu.data.loader import make_global_batch
+
+    bufs = []
+    for i in range(n_buf):
+        lo = (i * batch_size) % (len(next(iter(data.values()))) - batch_size)
+        bufs.append(make_global_batch(
+            est.mesh, {k: np.asarray(v[lo:lo + batch_size])
+                       for k, v in data.items()}, est._data_sharding))
+    # drain any queued work so the window starts clean
+    state, mets = est._jit_train_step(est.state, bufs[0])
+    est.state = state
+    float(np.asarray(mets["loss"]))
+    t0 = time.perf_counter()
+    for i in range(steps):
+        est.state, mets = est._jit_train_step(est.state, bufs[i % n_buf])
+    float(np.asarray(mets["loss"]))     # real completion barrier
+    dt = time.perf_counter() - t0
+    return steps * batch_size / dt
+
+
+def _mfu(est, data, batch_size, sps, flops=None):
+    """Measured FLOP/s over chip peak for the compiled train step.  Pass
+    `flops` when calling more than once — _step_flops re-lowers and
+    re-compiles the whole train step each time."""
+    import jax
+
+    try:
+        if flops is None:
+            flops = _step_flops(est, data, batch_size)
+        peak = _peak_for(jax.devices()[0])
+        if flops and peak and sps:
+            return round(flops / (batch_size / sps) / peak, 4)
+    except Exception as e:
+        print(f"mfu estimate failed: {e!r}", file=sys.stderr)
+    return None
+
+
+def _step_flops(est, data, batch_size):
+    """FLOPs of one compiled train step (XLA cost analysis)."""
+    import numpy as np
+
+    from analytics_zoo_tpu.data.loader import make_global_batch
+
+    batch = {k: np.asarray(v[:batch_size]) for k, v in data.items()}
+    gbatch = make_global_batch(est.mesh, batch, est._data_sharding)
+    lowered = est._jit_train_step.lower(est.state, gbatch)
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    return float(cost.get("flops", 0.0)) if cost else 0.0
+
+
+def _h2d_rate_mb_s(n_mb: int = 64) -> float:
+    """Current host->device transfer rate (diagnoses the degraded-link
+    mode; call AFTER the measured window — it is harmless there)."""
+    import jax
+    import numpy as np
+
+    buf = np.ones((n_mb << 20) // 4, np.float32)
+    a = jax.device_put(buf)
+    float(np.asarray(a[0]))             # warm path; real completion barrier
+    t0 = time.perf_counter()
+    a = jax.device_put(buf)
+    # block_until_ready only acknowledges enqueue on this platform — a
+    # tiny value fetch is the real barrier (adds ~one round-trip of noise)
+    float(np.asarray(a[0]))
+    return n_mb / (time.perf_counter() - t0)
 
 
 def bench_bert(platform: str):
@@ -80,7 +167,6 @@ def bench_bert(platform: str):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    import jax
     import numpy as np
     import optax
 
@@ -106,38 +192,27 @@ def bench_bert(platform: str):
     }
     if platform == "cpu":
         data = {k: v[:BERT_BATCH * 2] for k, v in data.items()}
+        sps = _fit_throughput(est, data, BERT_BATCH, epochs=1)
+        stop_orca_context()
+        return {"samples_per_sec": sps, "mfu": None}
     sps = _fit_throughput(est, data, BERT_BATCH)
-    mfu = None
-    if platform != "cpu":
-        try:
-            flops = _step_flops(est, data)
-            step_time = BERT_BATCH / sps
-            peak = _peak_for(jax.devices()[0])
-            if flops and peak:
-                mfu = round(flops / step_time / peak, 4)
-        except Exception as e:
-            print(f"mfu estimate failed: {e!r}", file=sys.stderr)
+    comp = _compute_throughput(est, data, BERT_BATCH)
+    flops = _step_flops(est, data, BERT_BATCH)
+    out = {"samples_per_sec": sps,
+           "compute_samples_per_sec": comp,
+           "mfu": _mfu(est, data, BERT_BATCH, comp, flops),
+           "fit_mfu": _mfu(est, data, BERT_BATCH, sps, flops)}
     stop_orca_context()
-    return sps, mfu
-
-
-def _step_flops(est, data):
-    """FLOPs of one compiled train step (XLA cost analysis)."""
-    import numpy as np
-
-    from analytics_zoo_tpu.data.loader import make_global_batch
-
-    batch = {k: np.asarray(v[:BERT_BATCH]) for k, v in data.items()}
-    gbatch = make_global_batch(est.mesh, batch, est._data_sharding)
-    lowered = est._jit_train_step.lower(est.state, gbatch)
-    cost = lowered.compile().cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    return float(cost.get("flops", 0.0)) if cost else 0.0
+    return out
 
 
 def bench_resnet50():
-    """ResNet-50 ImageNet-shape training throughput (config #2)."""
+    """ResNet-50 ImageNet-shape training throughput (config #2).
+
+    Must run in a FRESH process: its 77 MB/step input stream is the most
+    transfer-sensitive bench, and any earlier D2H fetch leaves the link in
+    the ~30 MB/s degraded mode (round-2 artifact).  extra reports the
+    post-run H2D rate so a transfer-bound number is identifiable."""
     import numpy as np
     import optax
 
@@ -159,8 +234,18 @@ def bench_resnet50():
         feature_cols=("x",), label_cols=("y",))
     est.config.log_every_steps = 1000
     sps = _fit_throughput(est, data, bs)
+    comp = _compute_throughput(est, data, bs, steps=10, n_buf=2)
+    h2d = _h2d_rate_mb_s()
     stop_orca_context()
-    return sps
+    # 128x224x224x3 f32 = ~77 MB/step; the fit path is transfer-bound when
+    # the steady-state H2D rate caps samples/sec below the compute rate
+    step_mb = bs * 224 * 224 * 3 * 4 / 2**20
+    return {"samples_per_sec": sps,
+            "compute_samples_per_sec": comp,
+            "mfu": _mfu(est, data, bs, comp),
+            "transfer_bound": sps < 0.8 * comp,
+            "h2d_rate_mb_s": round(h2d, 1),
+            "input_mb_per_step": round(step_mb, 1)}
 
 
 def bench_ncf():
@@ -188,49 +273,97 @@ def bench_ncf():
         feature_cols=("user", "item"), label_cols=("label",),
         partition_rules=NCF_PARTITION_RULES)
     est.config.log_every_steps = 1000
-    sps = _fit_throughput(est, data, NCF_BATCH)
+    sps = _fit_throughput(est, data, NCF_BATCH, epochs=2)
+    comp = _compute_throughput(est, data, NCF_BATCH)
     stop_orca_context()
-    return sps
+    return {"samples_per_sec": sps, "compute_samples_per_sec": comp}
+
+
+BENCHES = {
+    "bert": lambda: bench_bert("tpu"),
+    "ncf": bench_ncf,
+    "resnet": bench_resnet50,
+    "cpu-baseline": lambda: bench_bert("cpu"),
+}
+
+
+def _run_sub(name: str, timeout: int = 1800):
+    """One bench in its own process — a pristine device link each time."""
+    env = dict(os.environ)
+    if name == "cpu-baseline":
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--bench", name],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        for line in out.stdout.splitlines():
+            if line.startswith("{"):
+                return json.loads(line)
+        print(f"{name} bench produced no JSON:\n{out.stderr[-2000:]}",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"{name} bench failed: {e!r}", file=sys.stderr)
+    return None
 
 
 def main():
-    if "--cpu-baseline" in sys.argv:
-        sps, _ = bench_bert("cpu")
-        print(json.dumps({"cpu_samples_per_sec": sps}))
+    if "--bench" in sys.argv:
+        name = sys.argv[sys.argv.index("--bench") + 1]
+        print(json.dumps(BENCHES[name]()))
         return
-    bert_sps, bert_mfu = bench_bert("tpu")
-    ncf_sps = bench_ncf()
-    try:
-        resnet_sps = bench_resnet50()
-    except Exception as e:
-        print(f"resnet bench failed: {e!r}", file=sys.stderr)
-        resnet_sps = None
-    cpu_sps = None
-    try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--cpu-baseline"],
-            capture_output=True, text=True, timeout=1800,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"})
-        for line in out.stdout.splitlines():
-            if line.startswith("{"):
-                cpu_sps = json.loads(line)["cpu_samples_per_sec"]
-    except Exception as e:
-        print(f"cpu baseline failed: {e!r}", file=sys.stderr)
+    if "--cpu-baseline" in sys.argv:      # back-compat entry point
+        res = bench_bert("cpu")
+        res["cpu_samples_per_sec"] = res["samples_per_sec"]  # old key
+        print(json.dumps(res))
+        return
+    bert = _run_sub("bert")
+    ncf = _run_sub("ncf")
+    resnet = _run_sub("resnet")
+    cpu = _run_sub("cpu-baseline")
+    bert_sps = bert["samples_per_sec"] if bert else None
+    cpu_sps = cpu["samples_per_sec"] if cpu else None
     # vs_baseline is null (not 1.0) when the CPU baseline could not be
     # measured — 1.0 would read as "exactly at parity".
     print(json.dumps({
         "metric": "bert_base_ft_samples_per_sec_per_chip",
-        "value": round(bert_sps, 1),
+        "value": round(bert_sps, 1) if bert_sps else None,
         "unit": "samples/sec",
-        "vs_baseline": round(bert_sps / cpu_sps, 2) if cpu_sps else None,
+        "vs_baseline": round(bert_sps / cpu_sps, 2)
+        if bert_sps and cpu_sps else None,
         "extra": {
-            "bert_mfu": bert_mfu,
+            "bert_mfu": bert and bert.get("mfu"),
+            "bert_fit_mfu": bert and bert.get("fit_mfu"),
+            "bert_compute_samples_per_sec":
+                bert and round(bert["compute_samples_per_sec"], 1),
             "bert_seq_len": BERT_SEQ,
             "bert_global_batch": BERT_BATCH,
-            "measured_through": "Estimator.fit (host batching + prefetch)",
-            "ncf_train_samples_per_sec_per_chip": round(ncf_sps, 1),
+            "measured_through":
+                "Estimator.fit steady state (host batching + prefetch + "
+                "epoch metric fetch); *_compute_* = pre-staged batches, "
+                "value-fetch barrier; mfu uses the compute rate",
+            "isolation": "each model benched in its own subprocess "
+                         "(pristine device link)",
+            "ncf_train_samples_per_sec_per_chip":
+                ncf and round(ncf["samples_per_sec"], 1),
+            "ncf_compute_samples_per_sec":
+                ncf and round(ncf["compute_samples_per_sec"], 1),
+            "fit_vs_compute_note":
+                "this harness's tunneled device serialises H2D with "
+                "compute (measured: interleaved puts+compute = sum, not "
+                "max), so the fit path's floor is transfer + compute per "
+                "step; the threaded prefetch overlaps them on real "
+                "TPU-VM hosts",
             "resnet50_train_samples_per_sec_per_chip":
-                round(resnet_sps, 1) if resnet_sps else None,
+                resnet and round(resnet["samples_per_sec"], 1),
+            "resnet50_compute_samples_per_sec":
+                resnet and round(resnet["compute_samples_per_sec"], 1),
+            "resnet50_mfu": resnet and resnet.get("mfu"),
+            "resnet50_transfer_bound": resnet
+                and resnet.get("transfer_bound"),
+            "resnet50_h2d_rate_mb_s": resnet
+                and resnet.get("h2d_rate_mb_s"),
+            "resnet50_input_mb_per_step":
+                resnet and resnet.get("input_mb_per_step"),
         },
     }))
 

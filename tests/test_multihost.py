@@ -44,7 +44,14 @@ def run_scenario(scenario: str, tmp_path, timeout=420):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
         for i in range(NPROCS)
     ]
-    outs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    try:
+        outs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
+    finally:
+        # a crashed worker leaves its peer blocked in a gloo collective —
+        # never leak a hung process into the rest of the pytest session
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for i, p in enumerate(procs):
         assert p.returncode == 0, \
             f"worker {i} failed:\n{outs[i][-4000:]}"
