@@ -362,13 +362,14 @@ class FlaxEstimator:
             fp = data.fingerprint() if is_disk else 0
             gathered = _allgather_counts(n_local, fp)
             min_rows = int(gathered[:, 0].min())
-            if is_disk and n_local > 0 and not _allow_shared_disk() and \
-                    len({tuple(r) for r in gathered.tolist()}) == 1:
+            pairs = [tuple(r) for r in gathered.tolist() if r[0] > 0]
+            if is_disk and not _allow_shared_disk() and \
+                    len(set(pairs)) < len(pairs):
                 raise ValueError(
-                    "every host opened an identical DiskFeatureSet shard "
-                    "(same row count and content fingerprint) — this looks "
-                    "like ONE replicated/shared file, which would train "
-                    "each row once per host.  Spill per-host shards (use a "
+                    "two or more hosts opened an identical DiskFeatureSet "
+                    "shard (same row count and content fingerprint) — that "
+                    "is ONE replicated/shared file, which would train its "
+                    "rows once per host.  Spill per-host shards (use a "
                     "'{host}' placeholder in the path); if these really "
                     "are distinct shards, set "
                     "ANALYTICS_ZOO_TPU_ALLOW_SHARED_DISK=1")
@@ -511,16 +512,15 @@ class FlaxEstimator:
         return len(next(iter(arrays.values()))), arrays
 
     def _local_eval_stream(self, data, per_host, arrays=None):
-        """-> (iterator of host-local fixed-order chunks of <= per_host
-        rows, sample dict).  The DISK tier streams block-by-block (never
-        materialised to DRAM — the whole point of the tier); everything
-        else uses the arrays `_local_n` already normalised."""
+        """Iterator of host-local fixed-order chunks of <= per_host rows.
+        The DISK tier streams block-by-block (never materialised to DRAM —
+        the whole point of the tier); everything else uses the arrays
+        `_local_n` already normalised."""
         from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
 
         if isinstance(data, DiskFeatureSet):
-            it = data.batches(per_host, shuffle=False,
-                              drop_remainder=False)
-            return it, data.sample_block()
+            return data.batches(per_host, shuffle=False,
+                                drop_remainder=False)
         if arrays is None:
             arrays = _host_local(data)
         n = len(next(iter(arrays.values())))
@@ -529,7 +529,7 @@ class FlaxEstimator:
             for lo in range(0, n, per_host):
                 yield {k: v[lo:lo + per_host] for k, v in arrays.items()}
 
-        return gen(), arrays
+        return gen()
 
     def _chunk_plan(self, n_local: int, per_host: int):
         """Multihost chunk alignment for eval/predict.
@@ -580,11 +580,11 @@ class FlaxEstimator:
         # host raises everywhere instead of deadlocking peers (see fit)
         n_local, arrays = self._local_n(data)
         plan = self._chunk_plan(n_local, per_host)
-        self._ensure_state(arrays if arrays is not None
-                           else self._sample_of(data))
+        sample = arrays if arrays is not None else self._sample_of(data)
+        self._ensure_state(sample)
         self._build_jits()
         acc = EpochAccumulator()
-        stream, sample = self._local_eval_stream(data, per_host, arrays)
+        stream = self._local_eval_stream(data, per_host, arrays)
         mets_list, counts = [], []
         for j, chunk in enumerate(
                 _padded_chunks(stream, plan and plan[0], sample)):
@@ -621,7 +621,7 @@ class FlaxEstimator:
         self._build_jits()
         outs, window = [], []
         single_host = n_hosts == 1
-        stream, _ = self._local_eval_stream(data, per_host, arrays)
+        stream = self._local_eval_stream(data, per_host, arrays)
         for chunk in _padded_chunks(stream, plan and plan[0], sample):
             chunk = {k: v for k, v in chunk.items()
                      if k in self.feature_cols}

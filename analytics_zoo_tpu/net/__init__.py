@@ -17,9 +17,18 @@ path spelled out.
 from __future__ import annotations
 
 import os
+import re
 
 from analytics_zoo_tpu.net.tf_net import TFNet
 from analytics_zoo_tpu.net.torch_net import TorchNet
+
+# gs://, hdfs://, s3://, ... — handled by TF's filesystem layer, not ours;
+# os.path.exists would falsely reject them
+_REMOTE_SCHEME = re.compile(r"^[A-Za-z][A-Za-z0-9+.-]*://")
+
+
+def _is_local_path(p: str) -> bool:
+    return not _REMOTE_SCHEME.match(p)
 
 
 class Net:
@@ -49,7 +58,7 @@ class Net:
             return model
         if isinstance(model, (str, bytes, os.PathLike)):
             p = os.fspath(model)
-            if not os.path.exists(p):
+            if _is_local_path(p) and not os.path.exists(p):
                 raise FileNotFoundError(f"no such keras model file: {p!r}")
         return TFNet.from_keras(model)
 
@@ -59,6 +68,8 @@ class Net:
         forward-only JAX callable served by InferenceModel/Estimator."""
         if isinstance(path_or_fn, (str, bytes, os.PathLike)):
             p = os.fspath(path_or_fn)
+            if not _is_local_path(p):
+                return TFNet.from_saved_model(p, signature=signature)
             if not os.path.exists(p):
                 raise FileNotFoundError(f"no such TF model path: {p!r}")
             if os.path.isdir(p):

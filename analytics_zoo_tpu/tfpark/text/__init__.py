@@ -1,0 +1,234 @@
+"""tfpark.text — NLP estimators over TextSet (reference-parity glue).
+
+Reference surface (SURVEY.md §2.3 TFPark suite "NLP estimators"; ref:
+pyzoo/zoo/tfpark/text/estimator/ — TextEstimator base plus
+TextClassification / BERTClassifier estimators driving TF1 sessions):
+estimator-level entry points that take a prepared ``TextSet`` (or raw
+arrays) and run fit / evaluate / predict / distributed inference.
+
+TPU re-design: one thin ``TextEstimator`` base adapts text containers to
+the ONE pjit runtime (``learn.FlaxEstimator``).  There is no session or
+graph machinery to port — the estimators differ only in which flax model
+and column mapping they bind:
+
+  TextClassificationEstimator  -> models.TextClassifier (CNN/LSTM/GRU)
+  KNRMEstimator                -> models.KNRM (text matching, pairs)
+  BERTClassifier               -> models.BERTForSequenceClassification
+  NEREstimator / POSEstimator / IntentEntityEstimator
+                               -> tfpark.text.keras taggers
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Union
+
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.data.text import TextSet
+from analytics_zoo_tpu.learn.estimator import FlaxEstimator
+from analytics_zoo_tpu.tfpark.text import keras
+from analytics_zoo_tpu.tfpark.text.keras import (
+    NER, POSTagger, IntentEntity, intent_entity_loss)
+
+
+def _text_arrays(data) -> Dict[str, np.ndarray]:
+    """TextSet / (TextSet, TextSet) pair / dict / (x, y) -> array dict."""
+    if isinstance(data, TextSet):
+        return data.to_numpy_dict()                  # {"tokens", "y"}
+    if isinstance(data, (tuple, list)) and len(data) == 2 and \
+            all(isinstance(t, TextSet) for t in data):
+        a, b = (t.to_numpy_dict() for t in data)
+        # matching pair: labels ride on the first set (ref: KNRM corpus
+        # relevance labels are attached to the query side)
+        return {"text1": a["tokens"], "text2": b["tokens"], "y": a["y"]}
+    return data
+
+
+class TextEstimator:
+    """Base NLP estimator: binds a flax model + column mapping onto the
+    pjit runtime and accepts TextSet inputs everywhere.
+
+    (ref: tfpark.text.estimator.TextEstimator — model_fn + input_fn glue
+    onto TFEstimator; here the runtime is the shared FlaxEstimator.)
+    """
+
+    def __init__(self, model, loss, optimizer=None, *,
+                 feature_cols: Sequence[str] = ("tokens",),
+                 label_cols: Sequence[str] = ("y",),
+                 metrics: Sequence = ("accuracy",), **kw):
+        self.estimator = FlaxEstimator(
+            model, loss, optimizer if optimizer is not None
+            else optax.adam(1e-3),
+            feature_cols=feature_cols, label_cols=label_cols,
+            metrics=metrics, **kw)
+
+    @property
+    def model(self):
+        return self.estimator.model
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            validation_data=None, **kw):
+        if validation_data is not None:
+            validation_data = _text_arrays(validation_data)
+        return self.estimator.fit(
+            _text_arrays(data), epochs=epochs, batch_size=batch_size,
+            validation_data=validation_data, **kw)
+
+    def evaluate(self, data, batch_size: int = 32, **kw):
+        return self.estimator.evaluate(_text_arrays(data),
+                                       batch_size=batch_size, **kw)
+
+    def predict(self, data, batch_size: int = 32, **kw):
+        return self.estimator.predict(_text_arrays(data),
+                                      batch_size=batch_size, **kw)
+
+    def save_checkpoint(self, path: str):
+        return self.estimator.save_checkpoint(path)
+
+    def load_checkpoint(self, path: str, step: Optional[int] = None):
+        return self.estimator.load_checkpoint(path, step)
+
+    def save(self, path: str):
+        return self.estimator.save(path)
+
+    def load(self, path: str, sample_data=None):
+        if sample_data is not None:
+            sample_data = _text_arrays(sample_data)
+        return self.estimator.load(path, sample_data)
+
+
+class TextClassificationEstimator(TextEstimator):
+    """ref-parity: tfpark text classification estimator over
+    models.TextClassifier (token CNN/LSTM/GRU encoder + softmax)."""
+
+    def __init__(self, class_num: int, vocab_size: int, *,
+                 token_length: int = 200, sequence_length: int = 500,
+                 encoder: str = "cnn", encoder_output_dim: int = 256,
+                 embed_weights: Optional[np.ndarray] = None,
+                 optimizer=None, **kw):
+        from analytics_zoo_tpu.models.text import TextClassifier
+
+        super().__init__(
+            TextClassifier(class_num=class_num, vocab_size=vocab_size,
+                           token_length=token_length,
+                           sequence_length=sequence_length,
+                           encoder=encoder,
+                           encoder_output_dim=encoder_output_dim,
+                           embed_weights=embed_weights),
+            "sparse_categorical_crossentropy", optimizer, **kw)
+
+
+class KNRMEstimator(TextEstimator):
+    """ref-parity: kernel-pooled text-matching estimator over models.KNRM.
+    Data: {"text1", "text2", "y"} arrays or an (query TextSet, doc
+    TextSet) pair; `target_mode="ranking"` trains logistic relevance."""
+
+    def __init__(self, vocab_size: int, *, text1_length: int = 10,
+                 text2_length: int = 40, embed_dim: int = 300,
+                 kernel_num: int = 21, sigma: float = 0.1,
+                 exact_sigma: float = 0.001, target_mode: str = "ranking",
+                 embed_weights: Optional[np.ndarray] = None,
+                 optimizer=None, **kw):
+        from analytics_zoo_tpu.models.text import KNRM
+
+        loss = "bce" if target_mode == "ranking" \
+            else "sparse_categorical_crossentropy"
+        metrics = kw.pop("metrics", ("binary_accuracy",)
+                         if target_mode == "ranking" else ("accuracy",))
+        super().__init__(
+            KNRM(vocab_size=vocab_size, text1_length=text1_length,
+                 text2_length=text2_length, embed_dim=embed_dim,
+                 kernel_num=kernel_num, sigma=sigma,
+                 exact_sigma=exact_sigma, target_mode=target_mode,
+                 embed_weights=embed_weights),
+            loss, optimizer,
+            feature_cols=("text1", "text2"), metrics=metrics, **kw)
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32, **kw):
+        arrays = dict(_text_arrays(data))
+        if "y" in arrays and self.model.target_mode == "ranking":
+            # BCE against a [B, 1] score column
+            arrays["y"] = np.asarray(arrays["y"],
+                                     np.float32).reshape(-1, 1)
+        return super().fit(arrays, epochs=epochs, batch_size=batch_size,
+                           **kw)
+
+
+class BERTClassifier(TextEstimator):
+    """ref-parity: tfpark.text.estimator.BERTClassifier — sequence
+    classification over the BERT encoder (here models.BERT, with flash
+    attention / remat / TP partition rules available via the model)."""
+
+    def __init__(self, num_classes: int, *, bert=None, optimizer=None,
+                 **kw):
+        from analytics_zoo_tpu.models import (
+            BERT_PARTITION_RULES, BERTForSequenceClassification)
+
+        kw.setdefault("partition_rules", BERT_PARTITION_RULES)
+        super().__init__(
+            BERTForSequenceClassification(num_classes=num_classes,
+                                          bert=bert),
+            "sparse_categorical_crossentropy",
+            optimizer if optimizer is not None else optax.adamw(2e-5),
+            feature_cols=("input_ids",), label_cols=("y",), **kw)
+
+
+def token_accuracy(logits, labels):
+    """Per-token accuracy over non-pad positions is not knowable here
+    (pad id lives in the data), so this reports plain per-token accuracy —
+    the reference's taggers did the same."""
+    import jax.numpy as jnp
+
+    return jnp.mean(
+        (jnp.argmax(logits, -1) == labels.astype(jnp.int32)))
+
+
+class NEREstimator(TextEstimator):
+    """Sequence tagger estimator over tfpark.text.keras.NER."""
+
+    def __init__(self, num_entities: int, vocab_size: int, *,
+                 embed_dim: int = 100, hidden: int = 100, optimizer=None,
+                 **kw):
+        kw.setdefault("metrics", (token_accuracy,))
+        super().__init__(
+            NER(vocab_size=vocab_size, embed_dim=embed_dim, hidden=hidden,
+                num_entities=num_entities),
+            "sparse_categorical_crossentropy", optimizer, **kw)
+
+
+class POSEstimator(TextEstimator):
+    """Sequence tagger estimator over tfpark.text.keras.POSTagger."""
+
+    def __init__(self, num_pos_tags: int, vocab_size: int, *,
+                 embed_dim: int = 100, hidden: int = 100, optimizer=None,
+                 **kw):
+        kw.setdefault("metrics", (token_accuracy,))
+        super().__init__(
+            POSTagger(vocab_size=vocab_size, embed_dim=embed_dim,
+                      hidden=hidden, num_pos_tags=num_pos_tags),
+            "sparse_categorical_crossentropy", optimizer, **kw)
+
+
+class IntentEntityEstimator(TextEstimator):
+    """Joint intent + entity estimator over tfpark.text.keras.IntentEntity.
+    Data columns: tokens, intent (int per row), entity (int per token)."""
+
+    def __init__(self, num_intents: int, num_entities: int,
+                 vocab_size: int, *, embed_dim: int = 100,
+                 hidden: int = 100, optimizer=None, **kw):
+        kw.setdefault("metrics", ())
+        super().__init__(
+            IntentEntity(vocab_size=vocab_size, embed_dim=embed_dim,
+                         hidden=hidden, num_intents=num_intents,
+                         num_entities=num_entities),
+            intent_entity_loss, optimizer,
+            label_cols=("intent", "entity"), **kw)
+
+
+__all__ = [
+    "TextEstimator", "TextClassificationEstimator", "KNRMEstimator",
+    "BERTClassifier", "NEREstimator", "POSEstimator",
+    "IntentEntityEstimator", "keras",
+    "NER", "POSTagger", "IntentEntity",
+]

@@ -1,0 +1,126 @@
+"""tfpark.text.keras — named NLP models (sequence taggers + intent).
+
+Reference surface (SURVEY.md §2.3 TFPark suite; ref: pyzoo/zoo/tfpark/text/
+keras/ — ``TextModel`` base with ``NER``, ``POSTagger``, ``IntentEntity``
+built on TF1 Keras): word-embedding + recurrent encoders with per-token
+and/or per-utterance heads.
+
+TPU re-design: flax modules whose encoders are bidirectional GRU stacks
+(two ``nn.RNN`` scans — XLA compiles each to one fused loop; the pair runs
+as independent programs) and whose heads are plain MXU matmuls.  They plug
+into ``tfpark.text.TextEstimator`` (or ``learn.Estimator`` directly) rather
+than carrying their own session machinery — compile/fit/predict is the one
+pjit runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class BiRNN(nn.Module):
+    """Bidirectional recurrent encoder over [B, T, F] -> [B, T, 2H]."""
+
+    hidden: int
+    rnn_type: str = "gru"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from analytics_zoo_tpu.models.rnn import make_cell
+
+        fwd = nn.RNN(make_cell(self.rnn_type, self.hidden, dtype=self.dtype),
+                     name="fwd")(x)
+        bwd = nn.RNN(make_cell(self.rnn_type, self.hidden, dtype=self.dtype),
+                     reverse=True, keep_order=True, name="bwd")(x)
+        return jnp.concatenate([fwd, bwd], axis=-1)
+
+
+class TextModel(nn.Module):
+    """Shared encoder: word embedding -> BiGRU (ref: TextModel base)."""
+
+    vocab_size: int
+    embed_dim: int = 100
+    hidden: int = 100
+    dropout: float = 0.25
+    embed_weights: Optional[np.ndarray] = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def encode(self, tokens, train: bool):
+        from analytics_zoo_tpu.models.text import _embedding
+
+        x = _embedding(self.vocab_size, self.embed_dim,
+                       self.embed_weights, "word_embedding")(tokens)
+        x = x.astype(self.dtype)
+        h = BiRNN(self.hidden, dtype=self.dtype, name="birnn")(x)
+        return nn.Dropout(self.dropout, deterministic=not train)(h)
+
+
+class NER(TextModel):
+    """Named-entity tagger: per-token entity logits [B, T, num_entities]
+    (ref: tfpark.text.keras.NER)."""
+
+    num_entities: int = 9
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        h = self.encode(tokens, train)
+        return nn.Dense(self.num_entities, dtype=jnp.float32,
+                        name="entity_head")(h)
+
+
+class POSTagger(TextModel):
+    """Part-of-speech tagger: per-token tag logits [B, T, num_pos_tags]
+    (ref: tfpark.text.keras.POSTagger)."""
+
+    num_pos_tags: int = 45
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        h = self.encode(tokens, train)
+        return nn.Dense(self.num_pos_tags, dtype=jnp.float32,
+                        name="pos_head")(h)
+
+
+class IntentEntity(TextModel):
+    """Joint intent classification + entity tagging
+    (ref: tfpark.text.keras.IntentEntity): shared encoder, an utterance
+    head over the final states and a per-token entity head.  Returns
+    ``(intent_logits [B, I], entity_logits [B, T, E])``."""
+
+    num_intents: int = 8
+    num_entities: int = 9
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        h = self.encode(tokens, train)             # [B, T, 2H]
+        # utterance representation: max over time (pad rows contribute
+        # -inf-free zeros after masking)
+        mask = (tokens > 0)[:, :, None]
+        pooled = jnp.max(jnp.where(mask, h, -1e9), axis=1)
+        intent = nn.Dense(self.num_intents, dtype=jnp.float32,
+                          name="intent_head")(pooled)
+        entity = nn.Dense(self.num_entities, dtype=jnp.float32,
+                          name="entity_head")(h)
+        return intent, entity
+
+
+def intent_entity_loss(preds, labels):
+    """Joint loss for IntentEntity: CE(intent) + per-token CE(entity)."""
+    import optax
+
+    intent_logits, entity_logits = preds
+    intent_y, entity_y = labels
+    li = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+        intent_logits, intent_y.astype(jnp.int32)))
+    le = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+        entity_logits, entity_y.astype(jnp.int32)))
+    return li + le
+
+
+__all__ = ["TextModel", "BiRNN", "NER", "POSTagger", "IntentEntity",
+           "intent_entity_loss"]

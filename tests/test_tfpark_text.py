@@ -1,0 +1,159 @@
+"""tfpark.text NLP estimators — TextSet -> fit/evaluate/predict glue
+(VERDICT r2 ask #6; ref: pyzoo/zoo/tfpark/text/ estimator + keras suites).
+
+Synthetic tasks with learnable signal: classification by keyword, matching
+by token overlap, tagging by token identity — each estimator must beat
+chance convincingly after a few epochs on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.text import TextSet
+
+
+VOCAB = ["alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+         "hotel", "india", "juliett", "kilo", "lima"]
+
+
+def _class_texts(n=256, seed=0):
+    """Label 1 iff the text contains 'alpha'."""
+    rng = np.random.default_rng(seed)
+    texts, labels = [], []
+    for i in range(n):
+        words = list(rng.choice(VOCAB[1:], size=6))
+        y = int(rng.random() < 0.5)
+        if y:
+            words[rng.integers(0, len(words))] = "alpha"
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        labels.append(y)
+    return texts, labels
+
+
+def _prepared(texts, labels, length=8, index=None):
+    ts = TextSet.from_texts(texts, labels).tokenize().word2idx(
+        existing_index=index)
+    return ts.shape_sequence(length)
+
+
+def test_text_classification_estimator(ctx8):
+    from analytics_zoo_tpu.tfpark.text import TextClassificationEstimator
+
+    texts, labels = _class_texts()
+    ts = _prepared(texts, labels)
+    est = TextClassificationEstimator(
+        class_num=2, vocab_size=ts.vocab_size(), token_length=16,
+        sequence_length=8, encoder="cnn", encoder_output_dim=32)
+    hist = est.fit(ts, epochs=6, batch_size=32)
+    ev = est.evaluate(ts, batch_size=32)
+    assert ev["accuracy"] > 0.9, ev
+    preds = est.predict(ts, batch_size=32)
+    assert preds.shape == (256, 2)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_text_classification_lstm_encoder(ctx8):
+    from analytics_zoo_tpu.tfpark.text import TextClassificationEstimator
+
+    texts, labels = _class_texts(n=128)
+    ts = _prepared(texts, labels)
+    est = TextClassificationEstimator(
+        class_num=2, vocab_size=ts.vocab_size(), token_length=16,
+        sequence_length=8, encoder="lstm", encoder_output_dim=24)
+    est.fit(ts, epochs=4, batch_size=32)
+    ev = est.evaluate(ts, batch_size=32)
+    assert ev["accuracy"] > 0.8, ev
+
+
+def test_knrm_estimator_pairs(ctx8):
+    """Relevance = token overlap between query and doc."""
+    from analytics_zoo_tpu.tfpark.text import KNRMEstimator
+
+    rng = np.random.default_rng(1)
+    q_texts, d_texts, labels = [], [], []
+    for i in range(256):
+        q = list(rng.choice(VOCAB, size=4, replace=False))
+        y = int(rng.random() < 0.5)
+        if y:                       # relevant: doc shares query tokens
+            d = q * 2
+        else:
+            pool = [w for w in VOCAB if w not in q]
+            d = list(rng.choice(pool, size=8))
+        q_texts.append(" ".join(q))
+        d_texts.append(" ".join(d))
+        labels.append(y)
+    # one shared index so ids agree across the pair
+    base = TextSet.from_texts(q_texts + d_texts).tokenize().word2idx()
+    index = base.word_index
+    qs = _prepared(q_texts, labels, length=4, index=index)
+    ds = _prepared(d_texts, None, length=8, index=index)
+    import optax
+    est = KNRMEstimator(vocab_size=qs.vocab_size(), text1_length=4,
+                        text2_length=8, embed_dim=16, kernel_num=11,
+                        optimizer=optax.adam(1e-2))
+    est.fit((qs, ds), epochs=8, batch_size=32)
+    ev = est.evaluate(
+        {"text1": qs.to_numpy_dict()["tokens"],
+         "text2": ds.to_numpy_dict()["tokens"],
+         "y": np.asarray(labels, np.float32).reshape(-1, 1)},
+        batch_size=32)
+    assert ev["binary_accuracy"] > 0.85, ev
+
+
+def test_ner_estimator_tags_tokens(ctx8):
+    """Entity class = token id parity (word-identity-learnable)."""
+    from analytics_zoo_tpu.tfpark.text import NEREstimator
+
+    rng = np.random.default_rng(2)
+    toks = rng.integers(2, 12, size=(192, 8)).astype(np.int32)
+    tags = (toks % 3).astype(np.int32)       # 3 entity classes from id
+    import optax
+    est = NEREstimator(num_entities=3, vocab_size=12, embed_dim=16,
+                       hidden=16, optimizer=optax.adam(1e-2))
+    est.fit({"tokens": toks, "y": tags}, epochs=5, batch_size=32)
+    ev = est.evaluate({"tokens": toks, "y": tags}, batch_size=32)
+    assert ev["token_accuracy"] > 0.95, ev
+    preds = est.predict({"tokens": toks}, batch_size=32)
+    assert preds.shape == (192, 8, 3)
+
+
+def test_intent_entity_estimator_joint(ctx8):
+    """Intent = presence of token 2; entity = token parity."""
+    from analytics_zoo_tpu.tfpark.text import IntentEntityEstimator
+
+    rng = np.random.default_rng(3)
+    toks = rng.integers(3, 12, size=(192, 8)).astype(np.int32)
+    intent = (rng.random(192) < 0.5).astype(np.int32)
+    toks[intent == 1, 0] = 2                 # marker token
+    entity = (toks % 2).astype(np.int32)
+    data = {"tokens": toks, "intent": intent, "entity": entity}
+    import optax
+    est = IntentEntityEstimator(num_intents=2, num_entities=2,
+                                vocab_size=12, embed_dim=16, hidden=16,
+                                optimizer=optax.adam(1e-2))
+    hist = est.fit(data, epochs=6, batch_size=32)
+    assert hist[-1]["loss"] < 0.35 * hist[0]["loss"], hist
+    ip, ep = est.predict({"tokens": toks}, batch_size=32)
+    assert ip.shape == (192, 2) and ep.shape == (192, 8, 2)
+    acc = np.mean(np.argmax(ip, -1) == intent)
+    assert acc > 0.9, acc
+
+
+def test_bert_classifier_builds_and_steps(ctx8):
+    """BERTClassifier with a tiny BERT config runs the full fit path."""
+    from analytics_zoo_tpu.models import BERT
+    from analytics_zoo_tpu.tfpark.text import BERTClassifier
+
+    rng = np.random.default_rng(4)
+    n = 64
+    data = {"input_ids": rng.integers(0, 100, (n, 16)).astype(np.int32),
+            "y": rng.integers(0, 2, n).astype(np.int32)}
+    est = BERTClassifier(
+        num_classes=2,
+        bert=BERT(vocab_size=100, hidden_size=32, num_layers=2,
+                  num_heads=2, intermediate_size=64, max_position=32))
+    hist = est.fit(data, epochs=2, batch_size=16)
+    assert len(hist) == 2
+    preds = est.predict({"input_ids": data["input_ids"]}, batch_size=16)
+    assert preds.shape == (n, 2)
