@@ -104,6 +104,37 @@ def ImageMatToTensor(to_chw: bool = False) -> ImageTransform:
 # ImageSet
 # ---------------------------------------------------------------------------
 
+def _to_rgb(img: np.ndarray) -> np.ndarray:
+    """Native decode returns the FILE's channel count (1 for grayscale,
+    4 for RGBA); normalise to 3-channel RGB like the PIL fallback does so
+    behavior doesn't depend on which decoder a host was built with."""
+    if img.ndim == 2:
+        img = img[..., None]
+    c = img.shape[-1]
+    if c == 1:
+        return np.repeat(img, 3, axis=-1)
+    if c == 4:
+        return np.ascontiguousarray(img[..., :3])
+    return img
+
+
+def decode_image_bytes(raw) -> np.ndarray:
+    """Encoded JPEG/PNG bytes -> RGB uint8 HWC (native C++ decode with the
+    GIL released; PIL long-tail fallback). The bytes-input sibling of
+    `_read_image` — serving and in-memory pipelines share it."""
+    from analytics_zoo_tpu import native
+
+    try:
+        return _to_rgb(native.decode_image(raw))
+    except Exception:
+        import io
+
+        from PIL import Image
+
+        with Image.open(io.BytesIO(raw)) as im:
+            return np.asarray(im.convert("RGB"))
+
+
 def _read_image(path: str) -> np.ndarray:
     """Decode one image to RGB uint8 HWC.
 
@@ -114,7 +145,7 @@ def _read_image(path: str) -> np.ndarray:
     from analytics_zoo_tpu import native
 
     try:
-        return native.decode_image(path)
+        return _to_rgb(native.decode_image(path))
     except Exception:
         from PIL import Image
 

@@ -14,6 +14,8 @@ from analytics_zoo_tpu.models.anomaly import (
 from analytics_zoo_tpu.models.seq2seq import Seq2Seq, greedy_generate
 from analytics_zoo_tpu.models.image import (
     ResNet, SimpleCNN, ImageClassifier, resnet18, resnet34, resnet50)
+from analytics_zoo_tpu.models.detection import (
+    SSD, SSDDetector, ssd_anchors, multibox_loss, decode_detections)
 from analytics_zoo_tpu.models.forecast import (
     LSTMNet, TCN, MTNet, Seq2SeqTS)
 from analytics_zoo_tpu.models.rnn import RNNStack
@@ -29,6 +31,8 @@ __all__ = [
     "AnomalyDetector", "unroll", "detect_anomalies",
     "Seq2Seq", "greedy_generate",
     "ResNet", "SimpleCNN", "ImageClassifier", "resnet18", "resnet34", "resnet50",
+    "SSD", "SSDDetector", "ssd_anchors", "multibox_loss",
+    "decode_detections",
     "LSTMNet", "TCN", "MTNet", "Seq2SeqTS",
     "RNNStack",
 ]

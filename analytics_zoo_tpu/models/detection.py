@@ -1,0 +1,370 @@
+"""Object detection — SSD over a ResNet feature pyramid.
+
+Reference surface (SURVEY.md §2.5 model zoo "image classification/object
+detection loaders"; ref: zoo models/image/objectdetection/ — SSD-VGG /
+SSD-MobileNet wrappers with a `Predictor` + `visualize` post-processing
+chain): single-shot detection heads over backbone features, multibox
+matching loss, and a decode step (offsets -> boxes, score filter, NMS).
+
+TPU-first design decisions:
+- **Anchor matching lives INSIDE the jitted train step** as dense IoU
+  matrices ([anchors, max_boxes] per image, vmapped over batch) — no
+  per-image Python, no ragged tensors, one fused XLA program.  Ground
+  truth arrives padded to `max_boxes` with class -1 (the XShards/ImageSet
+  collate convention).
+- **Hard-negative mining is a sort, not a loop**: rank negative losses
+  with top_k and keep 3:1 neg:pos, exactly the reference semantics but
+  batch-vectorised on the MXU/VPU.
+- **Decode + NMS run on host** (numpy): tiny tensors after score
+  filtering, data-dependent shapes that would force padded worst-case
+  compute on device — same split the reference used (JVM-side
+  post-processing after the native forward).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.image import ResNet
+
+# anchor aspect ratios per cell (w/h); one scale per pyramid level
+DEFAULT_ASPECTS = (1.0, 2.0, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# anchors
+# ---------------------------------------------------------------------------
+
+def ssd_anchors(image_size: int, strides: Sequence[int],
+                scales: Sequence[float],
+                aspects: Sequence[float] = DEFAULT_ASPECTS) -> np.ndarray:
+    """Anchor grid [N, 4] as (cy, cx, h, w), normalised to [0, 1].
+
+    Level k tiles `image_size/strides[k]` cells; each cell holds
+    len(aspects) anchors of area scales[k]^2 (scales are fractions of the
+    image side).  Matches the head layout in SSD.__call__ exactly:
+    anchors iterate (row, col, aspect), levels concatenated in order.
+    """
+    if len(strides) != len(scales):
+        raise ValueError("strides and scales must align per level")
+    out = []
+    for stride, scale in zip(strides, scales):
+        # ceil-div: SAME-padded stride-2 convs produce ceil(in/2) per
+        # downsample, and iterated ceil-halving equals ceil(n / 2^k) — so
+        # this matches the head grid for ANY image size, not just
+        # multiples of the deepest stride
+        fm = -(-image_size // stride)
+        cy, cx = np.meshgrid(
+            (np.arange(fm) + 0.5) / fm, (np.arange(fm) + 0.5) / fm,
+            indexing="ij")
+        for ar in aspects:
+            h = scale / np.sqrt(ar)
+            w = scale * np.sqrt(ar)
+            lvl = np.stack([cy, cx, np.full_like(cy, h),
+                            np.full_like(cx, w)], axis=-1)
+            out.append(lvl.reshape(-1, 4))
+        # interleave aspects per cell: reorder so the fastest axis is the
+        # aspect (head emits [H, W, A*4])
+    per_level = []
+    i = 0
+    for stride in strides:
+        fm = -(-image_size // stride)
+        cells = fm * fm
+        block = np.stack(out[i:i + len(aspects)], axis=1)  # [cells, A, 4]
+        per_level.append(block.reshape(-1, 4))
+        i += len(aspects)
+    return np.concatenate(per_level).astype(np.float32)
+
+
+def _encode_boxes(anchors: jnp.ndarray, boxes: jnp.ndarray) -> jnp.ndarray:
+    """(ymin,xmin,ymax,xmax) gt vs (cy,cx,h,w) anchors -> regression
+    targets (dy, dx, log dh, log dw) — standard SSD parameterisation."""
+    b_cy = (boxes[..., 0] + boxes[..., 2]) / 2
+    b_cx = (boxes[..., 1] + boxes[..., 3]) / 2
+    b_h = jnp.maximum(boxes[..., 2] - boxes[..., 0], 1e-6)
+    b_w = jnp.maximum(boxes[..., 3] - boxes[..., 1], 1e-6)
+    return jnp.stack([
+        (b_cy - anchors[..., 0]) / anchors[..., 2],
+        (b_cx - anchors[..., 1]) / anchors[..., 3],
+        jnp.log(b_h / anchors[..., 2]),
+        jnp.log(b_w / anchors[..., 3]),
+    ], axis=-1)
+
+
+def _decode_boxes(anchors: np.ndarray, deltas: np.ndarray) -> np.ndarray:
+    cy = deltas[..., 0] * anchors[..., 2] + anchors[..., 0]
+    cx = deltas[..., 1] * anchors[..., 3] + anchors[..., 1]
+    h = np.exp(deltas[..., 2]) * anchors[..., 2]
+    w = np.exp(deltas[..., 3]) * anchors[..., 3]
+    return np.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2],
+                    axis=-1)
+
+
+def _iou_matrix(anchors_yx: jnp.ndarray, boxes: jnp.ndarray) -> jnp.ndarray:
+    """IoU [N_anchors, M_boxes]; both as (ymin,xmin,ymax,xmax)."""
+    a = anchors_yx[:, None, :]
+    b = boxes[None, :, :]
+    inter_h = jnp.clip(jnp.minimum(a[..., 2], b[..., 2]) -
+                       jnp.maximum(a[..., 0], b[..., 0]), 0)
+    inter_w = jnp.clip(jnp.minimum(a[..., 3], b[..., 3]) -
+                       jnp.maximum(a[..., 1], b[..., 1]), 0)
+    inter = inter_h * inter_w
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = jnp.clip((b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1]), 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+class SSD(nn.Module):
+    """SSD heads over a ResNet pyramid.
+
+    Inputs [B, S, S, 3] (S = image_size); outputs
+    ``(loc [B, N, 4], cls_logits [B, N, num_classes+1])`` with class 0 =
+    background.  Use :func:`multibox_loss` for training and
+    :func:`decode_detections` / :class:`SSDDetector` for inference.
+    """
+
+    num_classes: int                      # foreground classes
+    image_size: int = 256
+    backbone_width: int = 64
+    backbone_stages: Sequence[int] = (2, 2, 2, 2)   # resnet-18 layout
+    levels: Sequence[int] = (1, 2, 3)     # pyramid stages (/8, /16, /32)
+    scales: Sequence[float] = (0.15, 0.35, 0.6)
+    aspects: Sequence[float] = DEFAULT_ASPECTS
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def strides(self) -> List[int]:
+        return [4 * (2 ** s) for s in self.levels]
+
+    def anchors(self) -> np.ndarray:
+        return ssd_anchors(self.image_size, self.strides(),
+                           list(self.scales), list(self.aspects))
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.shape[1] != self.image_size or x.shape[2] != self.image_size:
+            raise ValueError(
+                f"SSD(image_size={self.image_size}) got {x.shape}")
+        feats = ResNet(num_classes=1, width=self.backbone_width,
+                       stage_sizes=tuple(self.backbone_stages),
+                       return_features=True, dtype=self.dtype,
+                       name="backbone")(x, train)
+        A = len(self.aspects)
+        locs, clss = [], []
+        for li, s in enumerate(self.levels):
+            f = feats[s]
+            h = nn.relu(nn.Conv(128, (3, 3), dtype=self.dtype,
+                                name=f"head{li}_conv")(f))
+            loc = nn.Conv(A * 4, (3, 3), dtype=jnp.float32,
+                          name=f"head{li}_loc")(h)
+            cls = nn.Conv(A * (self.num_classes + 1), (3, 3),
+                          dtype=jnp.float32, name=f"head{li}_cls")(h)
+            B = x.shape[0]
+            locs.append(loc.reshape(B, -1, 4))
+            clss.append(cls.reshape(B, -1, self.num_classes + 1))
+        return jnp.concatenate(locs, 1), jnp.concatenate(clss, 1)
+
+
+# ---------------------------------------------------------------------------
+# loss
+# ---------------------------------------------------------------------------
+
+def multibox_loss(anchors: np.ndarray, num_classes: int,
+                  neg_pos_ratio: int = 3, iou_thresh: float = 0.5):
+    """Returns an Estimator-compatible loss ``fn(preds, labels)``.
+
+    labels = (boxes [B, M, 4] in (ymin,xmin,ymax,xmax) normalised,
+    classes [B, M] int32 with -1 padding).  Matching, encoding and
+    3:1 hard-negative mining are all dense ops inside the jit.
+    """
+    anc = jnp.asarray(anchors)
+    anc_yx = jnp.stack([anc[:, 0] - anc[:, 2] / 2, anc[:, 1] - anc[:, 3] / 2,
+                        anc[:, 0] + anc[:, 2] / 2, anc[:, 1] + anc[:, 3] / 2],
+                       axis=-1)
+
+    def one_image(loc, cls_logits, boxes, classes):
+        import optax
+
+        valid = classes >= 0                            # [M]
+        iou = _iou_matrix(anc_yx, boxes)                # [N, M]
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_iou = iou.max(axis=1)                      # [N]
+        best_box = iou.argmax(axis=1)                   # [N]
+        pos = best_iou >= iou_thresh
+        # classic SSD: every valid gt also claims its single best anchor
+        # (so tiny objects below iou_thresh still train)
+        best_anchor = iou.argmax(axis=0)                # [M]
+        # scatter only VALID boxes: padding rows all argmax to anchor 0,
+        # and duplicate-index scatters with conflicting values resolve in
+        # implementation-defined order — route invalid rows to an
+        # out-of-bounds index that mode="drop" discards
+        safe_anchor = jnp.where(valid, best_anchor, anc.shape[0])
+        pos = pos | jnp.zeros_like(pos).at[safe_anchor].set(
+            True, mode="drop")
+        best_box = best_box.at[safe_anchor].set(
+            jnp.arange(boxes.shape[0]), mode="drop")
+
+        tgt_cls = jnp.where(pos, classes[best_box] + 1, 0)  # 0 = background
+        tgt_loc = _encode_boxes(anc, boxes[best_box])
+
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            cls_logits, tgt_cls)                        # [N]
+        n_pos = jnp.maximum(pos.sum(), 1)
+        # hard negative mining: top (ratio * n_pos) negative CE values
+        neg_ce = jnp.where(pos, -jnp.inf, ce)
+        rank = jnp.argsort(jnp.argsort(-neg_ce))        # rank 0 = hardest
+        neg = (~pos) & (rank < neg_pos_ratio * n_pos)
+        cls_loss = jnp.where(pos | neg, ce, 0.0).sum() / n_pos
+        loc_loss = jnp.where(
+            pos, optax.huber_loss(loc, tgt_loc).sum(-1), 0.0).sum() / n_pos
+        return cls_loss + loc_loss
+
+    def loss_fn(preds, labels):
+        loc, cls_logits = preds
+        boxes, classes = labels
+        per_img = jax.vmap(one_image)(loc, cls_logits,
+                                      boxes.astype(jnp.float32),
+                                      classes.astype(jnp.int32))
+        return per_img.mean()
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# decode (host)
+# ---------------------------------------------------------------------------
+
+def _nms(boxes: np.ndarray, scores: np.ndarray, iou_thresh: float,
+         top_k: int) -> List[int]:
+    order = np.argsort(-scores)[:top_k * 4]
+    keep: List[int] = []
+    while order.size and len(keep) < top_k:
+        i = order[0]
+        keep.append(int(i))
+        if order.size == 1:
+            break
+        rest = order[1:]
+        yx1 = np.maximum(boxes[i, :2], boxes[rest, :2])
+        yx2 = np.minimum(boxes[i, 2:], boxes[rest, 2:])
+        wh = np.clip(yx2 - yx1, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        area_i = np.prod(boxes[i, 2:] - boxes[i, :2])
+        area_r = np.prod(boxes[rest, 2:] - boxes[rest, :2], axis=1)
+        iou = inter / np.maximum(area_i + area_r - inter, 1e-9)
+        order = rest[iou <= iou_thresh]
+    return keep
+
+
+def decode_detections(loc: np.ndarray, cls_logits: np.ndarray,
+                      anchors: np.ndarray, *, score_thresh: float = 0.5,
+                      iou_thresh: float = 0.45, top_k: int = 100
+                      ) -> List[dict]:
+    """Raw head outputs -> per-image detections.
+
+    Returns one dict per image: {"boxes" [K,4] (ymin,xmin,ymax,xmax in
+    [0,1]), "scores" [K], "classes" [K] (0-based foreground ids)}.
+    (ref: object-detection `Predictor` + `decode_output` chain.)
+    """
+    loc = np.asarray(loc)
+    cls_logits = np.asarray(cls_logits)
+    e = np.exp(cls_logits - cls_logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    out = []
+    for b in range(loc.shape[0]):
+        boxes = _decode_boxes(anchors, loc[b])
+        fg = probs[b, :, 1:]                       # drop background
+        cls_id = fg.argmax(-1)
+        score = fg.max(-1)
+        m = score >= score_thresh
+        bx, sc, ci = boxes[m], score[m], cls_id[m]
+        final_b, final_s, final_c = [], [], []
+        for c in np.unique(ci):                    # per-class NMS
+            sel = np.flatnonzero(ci == c)
+            kept = _nms(bx[sel], sc[sel], iou_thresh, top_k)
+            final_b.append(bx[sel[kept]])
+            final_s.append(sc[sel[kept]])
+            final_c.append(np.full(len(kept), c))
+        if final_b:
+            bx = np.concatenate(final_b)
+            sc = np.concatenate(final_s)
+            ci = np.concatenate(final_c)
+            order = np.argsort(-sc)[:top_k]
+            bx, sc, ci = bx[order], sc[order], ci[order]
+        else:
+            bx = np.zeros((0, 4), np.float32)
+            sc = np.zeros((0,), np.float32)
+            ci = np.zeros((0,), np.int64)
+        out.append({"boxes": np.clip(bx, 0, 1), "scores": sc,
+                    "classes": ci})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# user-facing wrapper (ref: ObjectDetector load/predict surface)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SSDDetector:
+    """Train/predict convenience over (SSD model + Estimator).
+
+    ``fit(data)`` expects columns {"x" images, "boxes" [B,M,4],
+    "classes" [B,M] (-1 padded)}; ``detect(images)`` returns decoded
+    per-image detections.
+    """
+
+    num_classes: int
+    image_size: int = 256
+    backbone_width: int = 64
+    max_boxes: int = 8
+    optimizer: object = None
+    score_thresh: float = 0.5
+
+    def __post_init__(self):
+        import optax
+
+        from analytics_zoo_tpu.learn import Estimator
+
+        self.model = SSD(num_classes=self.num_classes,
+                         image_size=self.image_size,
+                         backbone_width=self.backbone_width)
+        self.anchors = self.model.anchors()
+        self.estimator = Estimator.from_flax(
+            model=self.model,
+            loss=multibox_loss(self.anchors, self.num_classes),
+            optimizer=self.optimizer or optax.adam(1e-3),
+            feature_cols=("x",), label_cols=("boxes", "classes"))
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 8, **kw):
+        return self.estimator.fit(data, epochs=epochs,
+                                  batch_size=batch_size, **kw)
+
+    def detect(self, images, batch_size: int = 8, **decode_kw):
+        loc, cls_logits = self.estimator.predict(
+            {"x": np.asarray(images)}, batch_size=batch_size)
+        decode_kw.setdefault("score_thresh", self.score_thresh)
+        return decode_detections(loc, cls_logits, self.anchors,
+                                 **decode_kw)
+
+    def save(self, path: str):
+        self.estimator.save(path)
+
+    def load(self, path: str, sample_images=None):
+        sample = None
+        if sample_images is not None:
+            sample = {"x": np.asarray(sample_images),
+                      "boxes": np.zeros((1, self.max_boxes, 4), np.float32),
+                      "classes": np.full((1, self.max_boxes), -1, np.int32)}
+        self.estimator.load(path, sample)
+
+
+__all__ = ["SSD", "SSDDetector", "ssd_anchors", "multibox_loss",
+           "decode_detections"]

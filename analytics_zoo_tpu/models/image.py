@@ -79,6 +79,7 @@ class ResNet(nn.Module):
     width: int = 64
     small_inputs: bool = False  # True: 3x3 stem for CIFAR-size images
     bottleneck: bool = False
+    return_features: bool = False  # True: pyramid (C2..C5) for detection
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -95,12 +96,17 @@ class ResNet(nn.Module):
                                  dtype=jnp.float32,
                                  name="stem_bn")(x).astype(self.dtype))
         block_cls = BottleneckBlock if self.bottleneck else ResNetBlock
+        features = []
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 stride = 2 if (i > 0 and j == 0) else 1
                 x = block_cls(self.width * (2 ** i), stride,
                               dtype=self.dtype,
                               name=f"stage{i}_block{j}")(x, train)
+            features.append(x)
+        if self.return_features:
+            return tuple(features)      # strides /4, /8, /16, /32 (or
+            #                             /1../8 with small_inputs)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         name="head")(x.astype(jnp.float32))

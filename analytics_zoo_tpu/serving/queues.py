@@ -26,6 +26,10 @@ from analytics_zoo_tpu.serving.resp import RespClient
 INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
 SIGNAL_PREFIX = "rsig:"   # per-uri wakeup stream: XREAD BLOCK, not polling
+IMG_MAGIC = b"IMG!"       # field prefix: raw encoded image (JPEG/PNG bytes)
+#                           decoded server-side — ref: Cluster Serving
+#                           clients enqueued base64 image bytes and the
+#                           Flink job decoded/resized before inference
 
 
 def encode_ndarray(a: np.ndarray) -> str:
@@ -62,6 +66,9 @@ class InputQueue:
         fields = ["uri", uri]
         for k, v in data.items():
             fields += [k, encode_ndarray(np.asarray(v))]
+        return self._xadd_capped(uri, fields)
+
+    def _xadd_capped(self, uri: str, fields) -> str:
         if not self.max_backlog:
             self.client.execute("XADD", self.stream, "*", *fields)
             return uri
@@ -77,6 +84,16 @@ class InputQueue:
                 f"serving backlog {int(depth) - 1} >= max_backlog "
                 f"{self.max_backlog}; request rejected (not trimmed)")
         return uri
+
+    def enqueue_image(self, uri: Optional[str] = None, *,
+                      image: bytes, col: str = "x") -> str:
+        """Enqueue one ENCODED image (JPEG/PNG bytes) — the server decodes
+        it natively (C++ libjpeg/libpng), resizes per its config, and
+        batches it into the model input (ref: InputQueue.enqueue_image).
+        The wire carries the compressed bytes, not a dense tensor."""
+        uri = uri or str(uuid.uuid4())
+        return self._xadd_capped(
+            uri, ["uri", uri, col, IMG_MAGIC + bytes(image)])
 
     def close(self):
         self.client.close()
@@ -117,6 +134,12 @@ class OutputQueue:
         fields = {h[i].decode(): h[i + 1] for i in range(0, len(h), 2)}
         self.client.execute("DEL", key, sig)
         self.client.execute("SREM", "__result_keys__", uri)
+        if "error" in fields:
+            # the server could not process this request (bad payload,
+            # shape mismatch) — fail fast rather than hand back None
+            raise RuntimeError(
+                f"serving error for {uri!r}: "
+                f"{fields['error'].decode(errors='replace')}")
         return decode_ndarray(fields["value"])
 
     def dequeue(self) -> Dict[str, np.ndarray]:
@@ -126,7 +149,10 @@ class OutputQueue:
         out: Dict[str, np.ndarray] = {}
         keys = self.client.execute("SMEMBERS", "__result_keys__") or []
         for uri in keys:
-            v = self.query(uri.decode(), timeout=0.05)
+            try:
+                v = self.query(uri.decode(), timeout=0.05)
+            except RuntimeError:    # errored request: consumed, not drained
+                continue
             if v is not None:
                 out[uri.decode()] = v
         return out

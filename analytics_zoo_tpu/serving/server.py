@@ -27,7 +27,7 @@ import numpy as np
 from analytics_zoo_tpu.common.log import logger
 from analytics_zoo_tpu.learn.inference_model import InferenceModel
 from analytics_zoo_tpu.serving.queues import (
-    INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX, decode_ndarray,
+    IMG_MAGIC, INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX, decode_ndarray,
     encode_ndarray)
 from analytics_zoo_tpu.serving.resp import RespClient, RespServer
 
@@ -42,6 +42,10 @@ class ServingConfig:
     batch_size: int = 32            # micro-batch cap
     batch_timeout_ms: float = 5.0   # flush partial batch after this wait
     input_cols: Optional[List[str]] = None  # None: infer from request
+    image_shape: Optional[List[int]] = None  # (H, W): resize decoded
+    #                                          image payloads to the model
+    #                                          input (ref: serving image
+    #                                          resize per model config)
     result_ttl_s: float = 300.0     # abandoned results pruned after this
     core_number: Optional[int] = None   # ref: host CPU cores per serving
     #                                     task — here it caps concurrent
@@ -70,6 +74,8 @@ class ServingConfig:
         cfg.batch_size = int(params.get("batch_size", 32))
         if "core_number" in params:
             cfg.core_number = int(params["core_number"])
+        if "image_shape" in params:
+            cfg.image_shape = [int(v) for v in params["image_shape"]]
         return cfg
 
 
@@ -103,6 +109,13 @@ class ClusterServing:
         self._written: collections.deque = collections.deque()
         self.stats = {"requests": 0, "batches": 0, "batch_fill": 0.0,
                       "predict_ms": 0.0}
+        self._img_resize = None
+        from concurrent.futures import ThreadPoolExecutor
+        import os as _os
+
+        self._decode_pool = ThreadPoolExecutor(
+            max_workers=min(8, _os.cpu_count() or 4),
+            thread_name_prefix="zoo-serving-decode")
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -122,6 +135,7 @@ class ClusterServing:
             self._thread.join(timeout=5)
         if self.broker is not None:
             self.broker.stop()
+        self._decode_pool.shutdown(wait=False)
 
     # ---- serving loop -------------------------------------------------
 
@@ -195,14 +209,86 @@ class ClusterServing:
             except Exception:
                 logger.exception("serving publish failed")
 
+    def _decode_value(self, v: bytes) -> np.ndarray:
+        """One request field -> ndarray.  IMG! payloads are compressed
+        image bytes: native C++ decode (GIL released, RGB-normalised) +
+        optional resize to the configured model input shape; everything
+        else is a dense tensor (b64 npy)."""
+        if not v.startswith(IMG_MAGIC):
+            return decode_ndarray(v)
+        from analytics_zoo_tpu.data.image import decode_image_bytes
+
+        img = decode_image_bytes(v[len(IMG_MAGIC):])
+        if self.config.image_shape:
+            if self._img_resize is None:
+                from analytics_zoo_tpu.data.image import ImageResize
+
+                h, w = self.config.image_shape
+                self._img_resize = ImageResize(int(h), int(w))
+            img = self._img_resize(img)
+        return img
+
+    def _publish_error(self, req: Dict[str, bytes], msg: str):
+        """One request failed decode/shape checks: publish an error result
+        so its client fails fast instead of blocking to timeout.  (The
+        stream entry is already consumed — without this the request would
+        vanish.)"""
+        try:
+            uri = req["uri"].decode()
+            self.client.pipeline([
+                ("HSET", RESULT_PREFIX + uri, "error", msg[:500]),
+                ("XADD", SIGNAL_PREFIX + uri, "*", "ok", "0")])
+            self._written.append((uri, time.monotonic()))
+        except Exception:
+            logger.exception("failed to publish serving error")
+
     def _dispatch_batch(self, requests: List[Dict[str, bytes]]):
         """Decode + enqueue the forward on the device; returns the in-flight
-        handle without blocking on the result."""
+        handle without blocking on the result.  Image payloads decode on a
+        thread pool — the native decoder releases the GIL, so a batch of
+        JPEGs decodes in parallel while the previous batch computes.
+        A request that fails to decode (or whose shape disagrees with the
+        batch) gets an ERROR result published; the rest of the batch still
+        runs — one bad payload must never black-hole its batchmates."""
         cols = self.config.input_cols or \
             [k for k in requests[0] if k != "uri"]
-        arrays = [np.stack([decode_ndarray(r[c]) for r in requests])
-                  for c in cols]
-        return requests, self.model.predict_async(*arrays), \
+        per_req: List[Optional[List[np.ndarray]]] = [None] * len(requests)
+
+        def decode_req(i_req):
+            i, r = i_req
+            try:
+                per_req[i] = [self._decode_value(r[c]) for c in cols]
+            except Exception as e:
+                self._publish_error(r, f"decode failed: {e!r}")
+
+        heavy = any(requests[0].get(c, b"").startswith(IMG_MAGIC)
+                    for c in cols)
+        items = list(enumerate(requests))
+        if heavy and len(requests) >= 4:
+            list(self._decode_pool.map(decode_req, items))
+        else:
+            for it in items:
+                decode_req(it)
+        # shape check against the first good request: mismatches error out
+        # individually instead of failing np.stack for everyone
+        ref_shapes = next((tuple(a.shape for a in v)
+                           for v in per_req if v is not None), None)
+        good_reqs, good_vals = [], []
+        for r, v in zip(requests, per_req):
+            if v is None:
+                continue
+            if tuple(a.shape for a in v) != ref_shapes:
+                self._publish_error(
+                    r, f"input shape {[a.shape for a in v]} != batch "
+                       f"shape {list(ref_shapes)}")
+                continue
+            good_reqs.append(r)
+            good_vals.append(v)
+        if not good_reqs:
+            return None
+        arrays = [np.stack([v[ci] for v in good_vals])
+                  for ci in range(len(cols))]
+        return good_reqs, self.model.predict_async(*arrays), \
             time.perf_counter()
 
     def _publish_batch(self, requests, waiter, t0: float):

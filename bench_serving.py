@@ -37,18 +37,53 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
                 return nn.Dense(10)(x)
 
         model, feat = MLP(), np.zeros((1, 64), np.float32)
+        cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=2.0)
+    elif model_kind == "resnet18":
+        # REAL serving economics (VERDICT r2 ask #7): encoded JPEG in over
+        # the wire, native decode + resize on the server's thread pool,
+        # uint8 H2D, normalisation on device, ResNet-18 forward on TPU.
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.models import resnet18
+
+        class ServedResNet18(nn.Module):
+            @nn.compact
+            def __call__(self, x):          # uint8 [B, 224, 224, 3]
+                x = x.astype(jnp.float32) / 255.0
+                mean = jnp.asarray([0.485, 0.456, 0.406])
+                std = jnp.asarray([0.229, 0.224, 0.225])
+                x = (x - mean) / std
+                return resnet18(1000)(x, train=False)
+
+        model = ServedResNet18()
+        feat = np.zeros((1, 224, 224, 3), np.uint8)
+        cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=4.0,
+                            image_shape=[224, 224])
     else:
         raise ValueError(model_kind)
 
     variables = model.init(jax.random.key(0), feat)
     im = InferenceModel(batch_buckets=(1, 8, 32, batch_size))
     im.load_flax(model, variables)
-    cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=2.0)
     serving = ClusterServing(im, cfg, embedded_broker=True).start()
 
     # warm the jit buckets so compile time is not measured
     for b in (1, 8, 32, batch_size):
-        im.predict(np.zeros((b, 64), np.float32))
+        im.predict(np.zeros((b,) + feat.shape[1:], feat.dtype))
+
+    jpegs = []
+    if model_kind == "resnet18":
+        # a handful of distinct 256x256 JPEGs; server resizes to 224
+        import io
+
+        from PIL import Image
+
+        rng = np.random.default_rng(7)
+        for _ in range(8):
+            arr = rng.integers(0, 256, (256, 256, 3)).astype(np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, "JPEG", quality=85)
+            jpegs.append(buf.getvalue())
 
     lat: list = []
     lock = threading.Lock()
@@ -61,10 +96,14 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
         mine = []
         try:
             for i in range(requests_per_client):
-                x = rng.normal(size=(64,)).astype(np.float32)
                 t0 = time.perf_counter()
-                uri = inq.enqueue(f"c{idx}-{i}", x=x)
-                r = outq.query(uri, timeout=30, poll_interval=0.001)
+                if jpegs:
+                    uri = inq.enqueue_image(
+                        f"c{idx}-{i}", image=jpegs[(idx + i) % len(jpegs)])
+                else:
+                    x = rng.normal(size=(64,)).astype(np.float32)
+                    uri = inq.enqueue(f"c{idx}-{i}", x=x)
+                r = outq.query(uri, timeout=60, poll_interval=0.001)
                 if r is None:
                     raise TimeoutError(f"client {idx} req {i}")
                 mine.append(time.perf_counter() - t0)
@@ -108,6 +147,12 @@ def main():
     for n_clients, rpc in ((1, 100), (64, 50), (256, 50)):
         r = run_scenario("mlp", n_clients, requests_per_client=rpc,
                          batch_size=128)
+        print(json.dumps(r))
+        out["scenarios"].append(r)
+    # real model: encoded JPEG -> native decode -> resize -> TPU forward
+    for n_clients, rpc in ((1, 50), (16, 20), (64, 10)):
+        r = run_scenario("resnet18", n_clients, requests_per_client=rpc,
+                         batch_size=64)
         print(json.dumps(r))
         out["scenarios"].append(r)
     with open("SERVING_BENCH.json", "w") as f:

@@ -1,0 +1,152 @@
+"""Object detection: SSD anchors/loss/decode units + an ImageSet e2e
+train->detect loop on synthetic box data (VERDICT r2 ask #8; ref: zoo
+models/image/objectdetection/ SSD wrappers + Predictor chain)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.detection import (
+    SSD, SSDDetector, decode_detections, multibox_loss, ssd_anchors)
+
+
+def _boxed_images(n, size=64, seed=0, max_boxes=4):
+    """Images with one bright square each on dark noise; returns x,
+    padded boxes (ymin,xmin,ymax,xmax in [0,1]) and classes (-1 pad)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0.0, 0.05, (n, size, size, 3)).astype(np.float32)
+    boxes = np.zeros((n, max_boxes, 4), np.float32)
+    classes = np.full((n, max_boxes), -1, np.int32)
+    for i in range(n):
+        s = int(rng.integers(size // 4, size // 2))       # 16..32 px
+        top = int(rng.integers(0, size - s))
+        left = int(rng.integers(0, size - s))
+        x[i, top:top + s, left:left + s] = 1.0
+        boxes[i, 0] = (top / size, left / size, (top + s) / size,
+                       (left + s) / size)
+        classes[i, 0] = 0
+    return x, boxes, classes
+
+
+def _iou(a, b):
+    yx1 = np.maximum(a[:2], b[:2])
+    yx2 = np.minimum(a[2:], b[2:])
+    wh = np.clip(yx2 - yx1, 0, None)
+    inter = wh[0] * wh[1]
+    ua = np.prod(a[2:] - a[:2]) + np.prod(b[2:] - b[:2]) - inter
+    return inter / max(ua, 1e-9)
+
+
+def test_anchor_grid_layout():
+    anc = ssd_anchors(64, strides=[8, 16, 32], scales=[0.15, 0.35, 0.6])
+    assert anc.shape == ((8 * 8 + 4 * 4 + 2 * 2) * 3, 4)
+    # centers inside the unit square, aspect fastest within a cell
+    assert anc[:, :2].min() > 0 and anc[:, :2].max() < 1
+    c0 = anc[0]
+    c1 = anc[1]
+    np.testing.assert_allclose(c0[:2], c1[:2])    # same cell center
+    assert c0[2] != c1[2]                          # different aspect
+
+
+def test_multibox_loss_perfect_vs_noise(ctx8):
+    """Loss with logits/locs matching ground truth must be far below a
+    random prediction's loss."""
+    import jax.numpy as jnp
+
+    model = SSD(num_classes=1, image_size=64, backbone_width=16)
+    anc = model.anchors()
+    loss_fn = multibox_loss(anc, num_classes=1)
+    x, boxes, classes = _boxed_images(2)
+    N = anc.shape[0]
+    rng = np.random.default_rng(0)
+    rand = (jnp.asarray(rng.normal(size=(2, N, 4)).astype(np.float32)),
+            jnp.asarray(rng.normal(size=(2, N, 2)).astype(np.float32)))
+    l_rand = float(loss_fn(rand, (jnp.asarray(boxes),
+                                  jnp.asarray(classes))))
+    # construct near-perfect predictions: background everywhere except
+    # anchors overlapping the gt box
+    from analytics_zoo_tpu.models.detection import (
+        _encode_boxes, _iou_matrix)
+
+    anc_yx = np.stack([anc[:, 0] - anc[:, 2] / 2, anc[:, 1] - anc[:, 3] / 2,
+                       anc[:, 0] + anc[:, 2] / 2, anc[:, 1] + anc[:, 3] / 2],
+                      axis=-1)
+    locs, clss = [], []
+    for b in range(2):
+        iou = np.asarray(_iou_matrix(jnp.asarray(anc_yx),
+                                     jnp.asarray(boxes[b])))
+        pos = iou[:, 0] >= 0.5
+        pos[iou[:, 0].argmax()] = True   # the loss force-matches each gt
+        #                                  to its best anchor
+        cls = np.zeros((N, 2), np.float32)
+        cls[:, 0] = 8.0
+        cls[pos, 0] = 0.0
+        cls[pos, 1] = 8.0
+        tgt = np.asarray(_encode_boxes(jnp.asarray(anc),
+                                       jnp.asarray(np.broadcast_to(
+                                           boxes[b, 0], (N, 4)))))
+        locs.append(tgt)
+        clss.append(cls)
+    l_good = float(loss_fn((jnp.asarray(np.stack(locs)),
+                            jnp.asarray(np.stack(clss))),
+                           (jnp.asarray(boxes), jnp.asarray(classes))))
+    assert l_good < 0.3 * l_rand, (l_good, l_rand)
+
+
+def test_decode_recovers_planted_box():
+    anc = ssd_anchors(64, strides=[8, 16, 32], scales=[0.15, 0.35, 0.6])
+    N = anc.shape[0]
+    # plant: anchor 10 predicts its own box with high class-1 score
+    loc = np.zeros((1, N, 4), np.float32)
+    cls = np.zeros((1, N, 2), np.float32)
+    cls[:, :, 0] = 6.0
+    cls[0, 10, 0] = -6.0
+    cls[0, 10, 1] = 6.0
+    dets = decode_detections(loc, cls, anc, score_thresh=0.5)
+    assert len(dets) == 1
+    d = dets[0]
+    assert d["boxes"].shape == (1, 4)
+    a = anc[10]
+    expect = np.array([a[0] - a[2] / 2, a[1] - a[3] / 2,
+                       a[0] + a[2] / 2, a[1] + a[3] / 2])
+    np.testing.assert_allclose(d["boxes"][0], np.clip(expect, 0, 1),
+                               atol=1e-5)
+    assert d["classes"][0] == 0 and d["scores"][0] > 0.99
+
+
+def test_ssd_detector_learns_synthetic_boxes(ctx8):
+    """e2e: ImageSet pipeline -> fit -> detect; the detector must localise
+    the planted square (IoU > 0.3) on training images."""
+    import optax
+
+    from analytics_zoo_tpu.data.image import ImageSet
+
+    x, boxes, classes = _boxed_images(96, size=64, seed=1)
+    # route the images through the ImageSet surface (e2e requirement)
+    iset = ImageSet.from_arrays((x * 127 + 64).astype(np.uint8))
+    imgs = np.stack(iset.get_image()).astype(np.float32) / 127.0 - 0.5
+    det = SSDDetector(num_classes=1, image_size=64, backbone_width=16,
+                      optimizer=optax.adam(3e-3), score_thresh=0.3)
+    hist = det.fit({"x": imgs, "boxes": boxes, "classes": classes},
+                   epochs=8, batch_size=16)
+    assert hist[-1]["loss"] < 0.5 * hist[0]["loss"], \
+        [h["loss"] for h in hist]
+    dets = det.detect(imgs[:16])
+    hits = 0
+    for i, d in enumerate(dets):
+        if len(d["scores"]) and _iou(d["boxes"][0], boxes[i, 0]) > 0.3:
+            hits += 1
+    assert hits >= 12, f"localised {hits}/16"
+
+
+def test_anchor_head_alignment_non_multiple_size(ctx8):
+    """image_size not divisible by 32: head grids are SAME-conv ceil
+    divisions; anchors must match exactly."""
+    import jax
+    import numpy as np
+
+    model = SSD(num_classes=1, image_size=72, backbone_width=16)
+    anc = model.anchors()
+    x = np.zeros((8, 72, 72, 3), np.float32)
+    variables = model.init(jax.random.key(0), x)
+    loc, cls = model.apply(variables, x)
+    assert loc.shape[1] == anc.shape[0] == cls.shape[1]

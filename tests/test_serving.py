@@ -301,3 +301,145 @@ class TestHttpFrontend:
         finally:
             fe.stop()
             broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# encoded-image payloads (ref: Cluster Serving image path — enqueue
+# compressed bytes, server-side decode + resize before inference)
+# ---------------------------------------------------------------------------
+
+class _MeanPix(nn.Module):
+    """[B, H, W, 3] uint8 -> per-image mean pixel (checks decode fidelity)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x.astype(np.float32).mean(axis=(1, 2, 3))
+
+
+def _png_bytes(arr):
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "PNG")    # lossless: means must match
+    return buf.getvalue()
+
+
+class TestImageServing:
+    def _image_serving(self, image_shape):
+        model = _MeanPix()
+        variables = model.init(
+            jax.random.key(0), np.zeros((1, 8, 8, 3), np.uint8))
+        im = InferenceModel().load_flax(model, variables)
+        cfg = ServingConfig(batch_size=4, batch_timeout_ms=20.0,
+                            image_shape=image_shape)
+        return ClusterServing(im, cfg, embedded_broker=True).start()
+
+    def test_enqueue_image_decodes_and_predicts(self):
+        serving = self._image_serving(image_shape=None)
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            rng = np.random.default_rng(0)
+            imgs = {f"img-{i}": rng.integers(0, 256, (8, 8, 3), np.uint8)
+                    for i in range(6)}
+            for uri, arr in imgs.items():
+                inq.enqueue_image(uri, image=_png_bytes(arr))
+            for uri, arr in imgs.items():
+                r = outq.query(uri, timeout=15)
+                assert r is not None, uri
+                np.testing.assert_allclose(float(r), arr.mean(), rtol=1e-5)
+        finally:
+            serving.stop()
+
+    def test_image_resize_to_model_shape(self):
+        serving = self._image_serving(image_shape=[8, 8])
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            # 16x16 constant image resizes to 8x8 with the same mean
+            arr = np.full((16, 16, 3), 77, np.uint8)
+            uri = inq.enqueue_image(image=_png_bytes(arr))
+            r = outq.query(uri, timeout=15)
+            assert r is not None
+            np.testing.assert_allclose(float(r), 77.0, atol=0.5)
+        finally:
+            serving.stop()
+
+    def test_mixed_tensor_and_image_columns_rejected_gracefully(self):
+        """A plain tensor enqueue still works on an image-configured
+        server (the IMG! magic is per-value, not per-server)."""
+        serving = self._image_serving(image_shape=None)
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            arr = np.full((8, 8, 3), 11, np.uint8)
+            uri = inq.enqueue("tensor-req", x=arr)
+            r = outq.query(uri, timeout=15)
+            np.testing.assert_allclose(float(r), 11.0, rtol=1e-5)
+        finally:
+            serving.stop()
+
+    def test_bad_payload_errors_without_batch_loss(self):
+        """One corrupt image must error fast for ITS client while its
+        batchmates still get results (no silent whole-batch drop)."""
+        serving = self._image_serving(image_shape=None)
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            arr = np.full((8, 8, 3), 42, np.uint8)
+            good = [inq.enqueue_image(f"g{i}", image=_png_bytes(arr))
+                    for i in range(3)]
+            bad = inq.enqueue_image("bad", image=b"not-an-image")
+            for uri in good:
+                r = outq.query(uri, timeout=15)
+                assert r is not None
+                np.testing.assert_allclose(float(r), 42.0, rtol=1e-5)
+            with pytest.raises(RuntimeError, match="decode failed"):
+                outq.query(bad, timeout=15)
+        finally:
+            serving.stop()
+
+    def test_shape_mismatch_isolated(self):
+        """Without a configured resize, a differently-sized image errors
+        individually instead of killing np.stack for the batch."""
+        serving = self._image_serving(image_shape=None)
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            a8 = np.full((8, 8, 3), 10, np.uint8)
+            a16 = np.full((16, 16, 3), 20, np.uint8)
+            u1 = inq.enqueue_image("s1", image=_png_bytes(a8))
+            u2 = inq.enqueue_image("s2", image=_png_bytes(a16))
+            results, errors = 0, 0
+            for u in (u1, u2):
+                try:
+                    r = outq.query(u, timeout=15)
+                    assert r is not None
+                    results += 1
+                except RuntimeError:
+                    errors += 1
+            # whichever decoded first set the batch shape; the other
+            # errored — but exactly one of each, nothing lost
+            assert (results, errors) == (2, 0) or (results, errors) == (1, 1)
+        finally:
+            serving.stop()
+
+    def test_grayscale_png_normalised_to_rgb(self):
+        serving = self._image_serving(image_shape=None)
+        try:
+            import io
+
+            from PIL import Image
+
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            buf = io.BytesIO()
+            Image.fromarray(np.full((8, 8), 99, np.uint8), "L").save(
+                buf, "PNG")
+            uri = inq.enqueue_image(image=buf.getvalue())
+            r = outq.query(uri, timeout=15)
+            np.testing.assert_allclose(float(r), 99.0, rtol=1e-5)
+        finally:
+            serving.stop()
