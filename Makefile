@@ -1,0 +1,37 @@
+# Developer entry points (ref: the reference's pyzoo/dev run scripts +
+# make-dist.sh packaging glue).
+
+PY ?= python
+
+.PHONY: test verify examples bench native clean
+
+# full suite on the 8-virtual-device CPU mesh (tests/conftest.py forces it)
+test:
+	$(PY) -m pytest tests/ -q
+
+# quick smoke: native build + fast test subset + every example vertical
+# (examples run on the default platform — TPU when present; set
+# EXAMPLE_PLATFORM=cpu to force host CPU)
+verify: native
+	$(PY) -m pytest tests/test_context.py tests/test_data.py \
+	    tests/test_estimator.py -q
+	$(PY) examples/train_ncf.py
+	$(PY) examples/forecast_taxi.py
+	$(PY) examples/serve_model.py
+
+examples:
+	$(PY) examples/train_ncf.py
+	$(PY) examples/forecast_taxi.py
+	$(PY) examples/serve_model.py
+
+# compile the C++ data plane in place (csv parser, zrec store, ring
+# buffer, image decode)
+native:
+	$(PY) -c "from analytics_zoo_tpu import native; native.load_lib(); print('native data plane:', native.available())"
+
+# one-chip benchmark suite (writes the driver-facing JSON line)
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -rf build dist *.egg-info analytics_zoo_tpu/native/*.so

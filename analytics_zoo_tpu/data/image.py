@@ -105,14 +105,18 @@ def ImageMatToTensor(to_chw: bool = False) -> ImageTransform:
 # ---------------------------------------------------------------------------
 
 def _to_rgb(img: np.ndarray) -> np.ndarray:
-    """Native decode returns the FILE's channel count (1 for grayscale,
-    4 for RGBA); normalise to 3-channel RGB like the PIL fallback does so
-    behavior doesn't depend on which decoder a host was built with."""
+    """Normalise any decoder output to 3-channel RGB.  The in-tree native
+    decoder already requests RGB (JCS_RGB / PNG_FORMAT_RGB in
+    dataplane.cpp), so this is a defensive shim for alternate builds:
+    grayscale (1), gray+alpha (2) and RGBA (4) all map to RGB so batch
+    shapes never depend on which decoder a host compiled in."""
     if img.ndim == 2:
         img = img[..., None]
     c = img.shape[-1]
     if c == 1:
         return np.repeat(img, 3, axis=-1)
+    if c == 2:                      # gray + alpha: drop alpha, splat gray
+        return np.repeat(img[..., :1], 3, axis=-1)
     if c == 4:
         return np.ascontiguousarray(img[..., :3])
     return img

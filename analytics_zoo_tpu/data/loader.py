@@ -155,6 +155,11 @@ class DataCreator:
                   ) -> Dict[str, np.ndarray]:
         if callable(data):
             data = data(config or {})
+        # TFDataset bridging adapter (tfpark surface; duck-typed — also
+        # covers subclasses — to keep the data layer import-free of tfpark)
+        if not isinstance(data, dict) and callable(
+                getattr(data, "to_arrays", None)):
+            data = data.to_arrays()
         # FeatureSet tiers (import locally — feature_set imports loader)
         from analytics_zoo_tpu.data import feature_set as _fs
         if isinstance(data, _fs.DiskFeatureSet):

@@ -322,7 +322,7 @@ class FlaxEstimator:
         self,
         data,
         epochs: int = 1,
-        batch_size: int = 32,
+        batch_size: Optional[int] = None,
         validation_data=None,
         feature_cols: Optional[Sequence[str]] = None,
         label_cols: Optional[Sequence[str]] = None,
@@ -330,8 +330,12 @@ class FlaxEstimator:
         callbacks: Sequence[Callable[[Dict], None]] = (),
     ) -> List[Dict[str, float]]:
         """Train. `batch_size` is GLOBAL (reference semantics: total across
-        the cluster). Returns per-epoch stats dicts (reference: Orca runner
-        stats lists)."""
+        the cluster); when omitted it falls back to the data container's
+        own batch_size (TFDataset carries one) and then 32. Returns
+        per-epoch stats dicts (reference: Orca runner stats lists)."""
+        batch_size = _resolve_batch(batch_size, data, "batch_size")
+        if validation_data is None:
+            validation_data = getattr(data, "val", None)
         self._set_cols(feature_cols, label_cols)
         n_hosts = jax.process_count()
         if batch_size < 1 or batch_size % n_hosts:
@@ -571,8 +575,9 @@ class FlaxEstimator:
             return data.sample_block()
         return _host_local(data)
 
-    def evaluate(self, data, batch_size: int = 32,
+    def evaluate(self, data, batch_size: Optional[int] = None,
                  feature_cols=None, label_cols=None) -> Dict[str, float]:
+        batch_size = _resolve_batch(batch_size, data, "batch_per_thread")
         self._set_cols(feature_cols, label_cols)
         n_hosts = jax.process_count()
         per_host = max(1, batch_size // n_hosts)
@@ -605,8 +610,9 @@ class FlaxEstimator:
                 acc.add({k: float(v[i]) for k, v in fetched.items()}, cnt)
         return acc.result()
 
-    def predict(self, data, batch_size: int = 32,
+    def predict(self, data, batch_size: Optional[int] = None,
                 feature_cols=None) -> np.ndarray:
+        batch_size = _resolve_batch(batch_size, data, "batch_per_thread")
         self._set_cols(feature_cols, None)
         n_hosts = jax.process_count()
         per_host = max(1, batch_size // n_hosts)
@@ -765,6 +771,18 @@ def _fetch_stacked(mets_list, chunk: int = 512):
                  for i in range(0, len(vals), chunk)]
         out[k] = np.concatenate(jax.device_get(parts))
     return out
+
+
+def _resolve_batch(batch_size, data, attr: str) -> int:
+    """Explicit batch_size wins; otherwise the data container's own
+    metadata (TFDataset carries the reference's batch_size /
+    batch_per_thread); otherwise the historical default of 32."""
+    if batch_size is not None:
+        return batch_size
+    meta = getattr(data, attr, None)
+    if isinstance(meta, int) and meta > 0:
+        return meta
+    return 32
 
 
 def _allow_shared_disk() -> bool:

@@ -237,7 +237,10 @@ class ClusterServing:
             uri = req["uri"].decode()
             self.client.pipeline([
                 ("HSET", RESULT_PREFIX + uri, "error", msg[:500]),
-                ("XADD", SIGNAL_PREFIX + uri, "*", "ok", "0")])
+                ("XADD", SIGNAL_PREFIX + uri, "*", "ok", "0"),
+                # index it like a normal result so dequeue()-only clients
+                # still observe (and consume) the failure
+                ("SADD", "__result_keys__", uri)])
             self._written.append((uri, time.monotonic()))
         except Exception:
             logger.exception("failed to publish serving error")
@@ -261,8 +264,8 @@ class ClusterServing:
             except Exception as e:
                 self._publish_error(r, f"decode failed: {e!r}")
 
-        heavy = any(requests[0].get(c, b"").startswith(IMG_MAGIC)
-                    for c in cols)
+        heavy = any(r.get(c, b"").startswith(IMG_MAGIC)
+                    for r in requests for c in cols)
         items = list(enumerate(requests))
         if heavy and len(requests) >= 4:
             list(self._decode_pool.map(decode_req, items))

@@ -23,6 +23,8 @@ from analytics_zoo_tpu.learn.estimator import Estimator as TFEstimator
 from analytics_zoo_tpu.learn.inference_model import (
     InferenceModel as TFPredictor)
 from analytics_zoo_tpu.tfpark.gan import GANEstimator
+from analytics_zoo_tpu.tfpark.tf_dataset import TFDataset
+from analytics_zoo_tpu.tfpark import text  # noqa: F401 (NLP estimators)
 
 
 def KerasModel(model):
@@ -37,4 +39,5 @@ def KerasModel(model):
     return model
 
 
-__all__ = ["TFEstimator", "TFPredictor", "KerasModel", "GANEstimator"]
+__all__ = ["TFEstimator", "TFPredictor", "KerasModel", "GANEstimator",
+           "TFDataset", "text"]

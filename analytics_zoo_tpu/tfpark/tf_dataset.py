@@ -1,0 +1,106 @@
+"""TFDataset — the reference's dataset-bridging surface.
+
+Reference surface (SURVEY.md §2.2; ref: pyzoo/zoo/tfpark/tf_dataset.py):
+``TFDataset.from_rdd / from_ndarrays / from_image_set / from_text_set /
+from_feature_set`` adapted every data container into the TF1 per-partition
+feeding pipeline, carrying batch size and tensor structure metadata.
+
+TPU re-design: there is no TF1 session to feed — the pjit Estimator
+consumes host-local array dicts.  TFDataset is therefore a thin,
+named-constructor adapter that (a) normalises any framework container to
+the column-dict currency, (b) carries the reference's
+batch_size/batch_per_thread semantics so ported call sites keep working,
+and (c) plugs directly into ``Estimator.fit/evaluate/predict`` (whose
+``DataCreator`` accepts it like any dict).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+class TFDataset:
+    """Adapter carrying (columns, batch metadata) — accepted anywhere the
+    estimators take data (DataCreator normalises via ``to_arrays()``)."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray],
+                 batch_size: int = -1, batch_per_thread: int = -1):
+        lens = {k: len(v) for k, v in arrays.items()}
+        if len(set(lens.values())) > 1:
+            raise ValueError(f"ragged columns: {lens}")
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        # reference semantics: batch_size is the GLOBAL training batch;
+        # batch_per_thread is the per-worker inference batch
+        self.batch_size = batch_size
+        self.batch_per_thread = batch_per_thread
+
+    # -- reference-parity constructors ---------------------------------
+
+    @staticmethod
+    def from_ndarrays(tensors, batch_size: int = -1,
+                      batch_per_thread: int = -1,
+                      val_tensors=None) -> "TFDataset":
+        """tensors: dict of ndarrays, or (x, y) tuple like the reference's
+        (features, labels) pair."""
+        from analytics_zoo_tpu.data.loader import DataCreator
+
+        ds = TFDataset(DataCreator.to_arrays(tensors), batch_size,
+                       batch_per_thread)
+        if val_tensors is not None:
+            ds.val = TFDataset.from_ndarrays(val_tensors)
+        return ds
+
+    @staticmethod
+    def from_rdd(shards, batch_size: int = -1, batch_per_thread: int = -1,
+                 **_compat) -> "TFDataset":
+        """ref: from_rdd(rdd) — here the partitioned currency is XShards
+        (SURVEY §2.2: XShards replaces the RDD)."""
+        return TFDataset(shards.to_numpy_dict(), batch_size,
+                         batch_per_thread)
+
+    @staticmethod
+    def from_image_set(image_set, batch_size: int = -1,
+                       batch_per_thread: int = -1) -> "TFDataset":
+        """ref: from_image_set(ImageSet) — images (+labels when present)
+        become the x/y columns after the transform chain has run."""
+        d = image_set.to_numpy_dict()
+        return TFDataset(d, batch_size, batch_per_thread)
+
+    @staticmethod
+    def from_text_set(text_set, batch_size: int = -1,
+                      batch_per_thread: int = -1) -> "TFDataset":
+        """ref: from_text_set(TextSet) — tokens/labels after
+        tokenize/word2idx/shape_sequence."""
+        return TFDataset(text_set.to_numpy_dict(), batch_size,
+                         batch_per_thread)
+
+    @staticmethod
+    def from_feature_set(feature_set, batch_size: int = -1,
+                         batch_per_thread: int = -1) -> "TFDataset":
+        """ref: from_feature_set(FeatureSet) — DRAM tier only; the DISK
+        tier streams and should be passed to fit() directly."""
+        from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
+
+        if isinstance(feature_set, DiskFeatureSet):
+            raise TypeError(
+                "DiskFeatureSet streams from disk — pass it to "
+                "Estimator.fit directly instead of materialising it "
+                "through TFDataset")
+        return TFDataset(dict(feature_set.arrays), batch_size,
+                         batch_per_thread)
+
+    # -- consumption ----------------------------------------------------
+
+    def to_arrays(self) -> Dict[str, np.ndarray]:
+        return self.arrays
+
+    def column_names(self) -> Sequence[str]:
+        return list(self.arrays)
+
+    def __len__(self) -> int:
+        return len(next(iter(self.arrays.values()))) if self.arrays else 0
+
+
+__all__ = ["TFDataset"]

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Example: Cluster Serving end-to-end — start the server with an
+embedded RESP broker, enqueue tensor AND encoded-image requests through
+the client queues, read results back.
+
+Run:  python examples/serve_model.py
+(ref vertical: Cluster Serving quickstart — config.yaml + InputQueue /
+OutputQueue clients.)
+"""
+
+import io
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("EXAMPLE_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["EXAMPLE_PLATFORM"])
+
+import flax.linen as nn
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.learn.inference_model import InferenceModel
+from analytics_zoo_tpu.serving import (
+    ClusterServing, InputQueue, OutputQueue, ServingConfig)
+
+
+class TinyClassifier(nn.Module):
+    """Mean-pixel "classifier" over [B, 32, 32, 3] uint8 images."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(np.float32) / 255.0
+        h = nn.relu(nn.Conv(8, (3, 3), strides=(2, 2))(x))
+        h = h.mean(axis=(1, 2))
+        return nn.Dense(10)(h)
+
+
+def main():
+    model = TinyClassifier()
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 32, 32, 3), np.uint8))
+    im = InferenceModel(batch_buckets=(1, 8, 32))
+    im.load_flax(model, variables)
+    cfg = ServingConfig(batch_size=32, batch_timeout_ms=5.0,
+                        image_shape=[32, 32])
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    print(f"serving on 127.0.0.1:{serving.port} (RESP wire protocol)")
+
+    inq = InputQueue(port=serving.port)
+    outq = OutputQueue(port=serving.port)
+
+    # 1) dense-tensor request
+    uri = inq.enqueue("tensor-req",
+                      x=np.random.default_rng(0).integers(
+                          0, 256, (32, 32, 3)).astype(np.uint8))
+    print("tensor logits:", np.round(outq.query(uri, timeout=30), 3))
+
+    # 2) encoded-image request (JPEG over the wire, server-side decode)
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(np.random.default_rng(1).integers(
+        0, 256, (48, 48, 3)).astype(np.uint8)).save(buf, "JPEG")
+    uri = inq.enqueue_image("image-req", image=buf.getvalue())
+    print("image  logits:", np.round(outq.query(uri, timeout=30), 3))
+
+    print("server stats:", serving.stats)
+    inq.close()
+    outq.close()
+    serving.stop()
+
+
+if __name__ == "__main__":
+    main()

