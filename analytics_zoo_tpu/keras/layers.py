@@ -927,3 +927,197 @@ MaxPool3D = MaxPooling3D
 AvgPool1D = AveragePooling1D
 AvgPool2D = AveragePooling2D
 AvgPool3D = AveragePooling3D
+
+
+# ---------------------------------------------------------------------------
+# tensor-manipulation / elementwise layers (zoo additions — ref:
+# zoo pipeline/api/keras/layers Select/Narrow/Squeeze/Exp/Log/Power/
+# Sqrt/Square/Abs/Negative/CAdd/CMul/Scale/SReLU/LRN2D/ResizeBilinear.
+# In BigDL these existed because graphs could not use host control flow;
+# here each is a thin named wrapper over the obvious jnp op so ported
+# model definitions keep their vocabulary.)
+# ---------------------------------------------------------------------------
+
+
+@symbolic
+class Select(nn.Module):
+    """ref: Select(dim, index) — pick one slice along `dim` (dim counts
+    the batch axis, like the reference; negative dims allowed)."""
+    dim: int
+    index: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = x.shape[self.dim]
+        if not -d <= self.index < d:
+            # jnp.take would silently fill NaNs for an OOB index
+            raise ValueError(
+                f"Select index {self.index} out of range for dim "
+                f"{self.dim} of size {d}")
+        return jnp.take(x, self.index, axis=self.dim)
+
+
+@symbolic
+class Narrow(nn.Module):
+    """ref: Narrow(dim, offset, length) — contiguous slice along `dim`."""
+    dim: int
+    offset: int
+    length: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return lax.slice_in_dim(x, self.offset, self.offset + self.length,
+                                axis=self.dim % x.ndim)
+
+
+@symbolic
+class Squeeze(nn.Module):
+    """ref: Squeeze(dim) — drop a size-1 axis (or all, dim=None)."""
+    dim: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jnp.squeeze(x, axis=self.dim)
+
+
+@symbolic
+class ExpandDim(nn.Module):
+    """ref: ExpandDim(dim) — insert a size-1 axis."""
+    dim: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jnp.expand_dims(x, self.dim)
+
+
+def _elementwise(name: str, fn):
+    @symbolic
+    class _E(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            return fn(x)
+
+    _E.__name__ = _E.__qualname__ = name
+    _E.__doc__ = f"ref: zoo keras layer {name} — elementwise jnp.{name.lower()}."
+    return _E
+
+
+Exp = _elementwise("Exp", jnp.exp)
+Log = _elementwise("Log", jnp.log)
+Sqrt = _elementwise("Sqrt", jnp.sqrt)
+Square = _elementwise("Square", jnp.square)
+Abs = _elementwise("Abs", jnp.abs)
+Negative = _elementwise("Negative", jnp.negative)
+
+
+@symbolic
+class Power(nn.Module):
+    """ref: Power(power, scale, shift) — (scale*x + shift) ** power."""
+    power: float
+    scale: float = 1.0
+    shift: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return jnp.power(self.scale * x + self.shift, self.power)
+
+
+@symbolic
+class CAdd(nn.Module):
+    """ref: CAdd(size) — learnable per-element bias, broadcast to x."""
+    size: Sequence[int]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b = self.param("bias", constant_init(0.0), tuple(self.size))
+        return x + b
+
+
+@symbolic
+class CMul(nn.Module):
+    """ref: CMul(size) — learnable per-element scale, broadcast to x."""
+    size: Sequence[int]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.param("weight", constant_init(1.0), tuple(self.size))
+        return x * w
+
+
+@symbolic
+class Scale(nn.Module):
+    """ref: Scale(size) — learnable elementwise affine (CMul then CAdd)."""
+    size: Sequence[int]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = self.param("weight", constant_init(1.0), tuple(self.size))
+        b = self.param("bias", constant_init(0.0), tuple(self.size))
+        return x * w + b
+
+
+@symbolic
+class SReLU(nn.Module):
+    """ref: SReLU — s-shaped rectifier with four learnable per-channel
+    parameters (t_r, a_r, t_l, a_l): y = t_r + a_r*(x - t_r) for x >= t_r,
+    x in between, t_l + a_l*(x - t_l) for x <= t_l."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        shape = (x.shape[-1],)
+        t_l = self.param("t_left", constant_init(0.0), shape)
+        a_l = self.param("a_left", constant_init(0.2), shape)
+        t_r = self.param("t_right", constant_init(1.0), shape)
+        a_r = self.param("a_right", constant_init(0.2), shape)
+        y = jnp.where(x >= t_r, t_r + a_r * (x - t_r), x)
+        return jnp.where(x <= t_l, t_l + a_l * (x - t_l), y)
+
+
+@symbolic
+class LRN2D(nn.Module):
+    """ref: LRN2D — local response normalization across channels (NHWC):
+    x / (k + alpha/n * sum_{channel window} x^2) ** beta."""
+    alpha: float = 1e-4
+    k: float = 1.0
+    beta: float = 0.75
+    n: int = 5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        sq = jnp.square(x)
+        half = self.n // 2
+        # sum over a channel window via reduce_window on the last axis
+        window = (1,) * (x.ndim - 1) + (self.n,)
+        pads = [(0, 0)] * (x.ndim - 1) + [(half, self.n - 1 - half)]
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window,
+                                 (1,) * x.ndim, pads)
+        return x / jnp.power(self.k + self.alpha / self.n * ssum, self.beta)
+
+
+@symbolic
+class ResizeBilinear(nn.Module):
+    """ref: ResizeBilinear(output_height, output_width) — NHWC resize."""
+    output_height: int
+    output_width: int
+    align_corners: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.align_corners:
+            # jax.image.resize only implements half-pixel sampling;
+            # silently producing different pixel values would be a quiet
+            # parity break (cf. _check_tf_ordering's loud refusal)
+            raise ValueError(
+                "align_corners=True is not supported (jax.image.resize "
+                "uses half-pixel centers); re-export the model with "
+                "align_corners=False")
+        shape = (x.shape[0], self.output_height, self.output_width,
+                 x.shape[-1])
+        return jax.image.resize(x, shape, method="bilinear")
+
+
+__all__ += [
+    "Select", "Narrow", "Squeeze", "ExpandDim",
+    "Exp", "Log", "Sqrt", "Square", "Abs", "Negative", "Power",
+    "CAdd", "CMul", "Scale", "SReLU", "LRN2D", "ResizeBilinear",
+]

@@ -1,0 +1,111 @@
+"""Tensor-manipulation / elementwise keras layers (zoo additions — ref:
+zoo pipeline/api/keras/layers Select/Narrow/.../SReLU/LRN2D) — numerical
+checks against plain numpy and trainability of the learnable ones."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import layers as L
+
+RNG = np.random.default_rng(0)
+X = RNG.normal(size=(2, 3, 4)).astype(np.float32)
+
+
+def _apply(layer, x):
+    v = layer.init(jax.random.key(0), jnp.asarray(x))
+    return np.asarray(layer.apply(v, jnp.asarray(x)))
+
+
+def test_select_narrow_squeeze_expand():
+    np.testing.assert_allclose(_apply(L.Select(dim=1, index=2), X),
+                               X[:, 2])
+    np.testing.assert_allclose(_apply(L.Narrow(dim=2, offset=1, length=2),
+                                      X), X[:, :, 1:3])
+    x1 = X[:, :1]
+    np.testing.assert_allclose(_apply(L.Squeeze(dim=1), x1), x1[:, 0])
+    np.testing.assert_allclose(_apply(L.ExpandDim(dim=1), X),
+                               X[:, None])
+
+
+def test_elementwise_family():
+    pos = np.abs(X) + 0.1
+    np.testing.assert_allclose(_apply(L.Exp(), X), np.exp(X), rtol=1e-6)
+    np.testing.assert_allclose(_apply(L.Log(), pos), np.log(pos),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_apply(L.Sqrt(), pos), np.sqrt(pos),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_apply(L.Square(), X), X * X, rtol=1e-6)
+    np.testing.assert_allclose(_apply(L.Abs(), X), np.abs(X))
+    np.testing.assert_allclose(_apply(L.Negative(), X), -X)
+    np.testing.assert_allclose(
+        _apply(L.Power(power=2.0, scale=3.0, shift=1.0), X),
+        (3 * X + 1) ** 2, rtol=1e-5)
+
+
+def test_learnable_elementwise_affine():
+    ca = L.CAdd(size=(4,))
+    v = ca.init(jax.random.key(0), jnp.asarray(X))
+    assert v["params"]["bias"].shape == (4,)
+    np.testing.assert_allclose(np.asarray(ca.apply(v, jnp.asarray(X))), X)
+
+    sc = L.Scale(size=(4,))
+    v = sc.init(jax.random.key(0), jnp.asarray(X))
+    # gradients flow to both weight and bias
+    def loss(params):
+        return jnp.sum(sc.apply({"params": params}, jnp.asarray(X)) ** 2)
+    g = jax.grad(loss)(v["params"])
+    assert float(jnp.abs(g["weight"]).sum()) > 0
+    assert float(jnp.abs(g["bias"]).sum()) > 0
+
+
+def test_srelu_identity_region_and_params():
+    sr = L.SReLU()
+    x = np.linspace(0.1, 0.9, 12).reshape(3, 4).astype(np.float32)
+    v = sr.init(jax.random.key(0), jnp.asarray(x))
+    # defaults: t_l=0, t_r=1 — values in (0,1) pass through unchanged
+    np.testing.assert_allclose(np.asarray(sr.apply(v, jnp.asarray(x))), x,
+                               rtol=1e-6)
+    big = np.full((1, 4), 3.0, np.float32)
+    out = np.asarray(sr.apply(v, jnp.asarray(big)))
+    np.testing.assert_allclose(out, 1.0 + 0.2 * (3.0 - 1.0), rtol=1e-6)
+
+
+def test_lrn2d_matches_reference_formula():
+    x = RNG.normal(size=(2, 3, 3, 6)).astype(np.float32)
+    layer = L.LRN2D(alpha=1e-2, k=2.0, beta=0.5, n=3)
+    got = _apply(layer, x)
+    # direct numpy reference
+    sq = x ** 2
+    pad = np.pad(sq, [(0, 0)] * 3 + [(1, 1)], mode="constant")
+    ssum = sum(pad[..., i:i + 6] for i in range(3))
+    want = x / np.power(2.0 + 1e-2 / 3 * ssum, 0.5)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_resize_bilinear():
+    x = RNG.normal(size=(2, 4, 4, 3)).astype(np.float32)
+    out = _apply(L.ResizeBilinear(output_height=8, output_width=8), x)
+    assert out.shape == (2, 8, 8, 3)
+    # constant images stay constant under bilinear resize
+    c = np.full((1, 4, 4, 3), 5.0, np.float32)
+    np.testing.assert_allclose(
+        _apply(L.ResizeBilinear(output_height=7, output_width=3), c), 5.0,
+        rtol=1e-6)
+
+
+def test_layers_compose_in_sequential(ctx8):
+    """The new layers participate in the keras engine like any other."""
+    from analytics_zoo_tpu.keras.engine import Sequential
+
+    m = Sequential()
+    m.add(L.Dense(8, input_shape=(4,)))
+    m.add(L.SReLU())
+    m.add(L.Scale(size=(8,)))
+    m.add(L.Dense(1))
+    m.compile(optimizer="sgd", loss="mse")
+    x = RNG.normal(size=(32, 4)).astype(np.float32)
+    y = x.sum(1, keepdims=True).astype(np.float32)
+    hist = m.fit(x, y, batch_size=8, nb_epoch=3)
+    assert hist[-1]["loss"] < hist[0]["loss"]

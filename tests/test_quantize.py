@@ -1,0 +1,108 @@
+"""Weight-only quantized inference (the reference's OpenVINO int8 role —
+SURVEY §2.3 InferenceModel row): measured compression AND measured
+accuracy deviation, not an asserted story."""
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.learn.inference_model import InferenceModel
+from analytics_zoo_tpu.learn.quantize import dequantize, quantize_params
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        for w in (128, 128):
+            x = nn.relu(nn.Dense(w)(x))
+        return nn.Dense(10)(x)
+
+
+def _model_and_data():
+    model = MLP()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 32)).astype(np.float32)
+    variables = model.init(jax.random.key(0), x[:1])
+    return model, variables, x
+
+
+def test_int8_roundtrip_error_bounded():
+    _, variables, _ = _model_and_data()
+    q, stats = quantize_params(variables, "int8")
+    deq = jax.device_get(dequantize(q))
+    for a, b in zip(jax.tree.leaves(variables), jax.tree.leaves(deq)):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.ndim >= 2 and a.size >= 1024:
+            # symmetric per-channel int8: error <= scale/2 = amax/254
+            amax = np.abs(a).max(axis=tuple(range(a.ndim - 1)),
+                                 keepdims=True)
+            assert np.all(np.abs(a - b) <= amax / 254 + 1e-8)
+        else:
+            np.testing.assert_array_equal(a, b)   # small leaves untouched
+
+
+def test_int8_compression_measured():
+    _, variables, _ = _model_and_data()
+    _, stats = quantize_params(variables, "int8")
+    # kernels dominate this MLP: overall compression must approach 4x
+    assert stats["compression"] > 3.0, stats
+    _, stats16 = quantize_params(variables, "bf16")
+    assert 1.8 < stats16["compression"] <= 2.05, stats16
+
+
+def test_quantized_inference_model_accuracy(ctx8):
+    model, variables, x = _model_and_data()
+    im32 = InferenceModel().load_flax(model, variables)
+    ref = im32.predict(x)
+
+    im8 = InferenceModel().load_flax(model, variables, quantize="int8")
+    assert im8.quant_stats["compression"] > 3.0
+    got8 = im8.predict(x)
+    assert got8.shape == ref.shape
+    # logits deviation small relative to logit scale; argmax agrees for
+    # nearly all rows
+    denom = np.maximum(np.abs(ref).max(), 1e-6)
+    assert np.abs(got8 - ref).max() / denom < 0.05
+    agree = np.mean(np.argmax(got8, -1) == np.argmax(ref, -1))
+    assert agree > 0.95, agree
+
+    im16 = InferenceModel().load_flax(model, variables, quantize="bf16")
+    got16 = im16.predict(x)
+    assert np.abs(got16 - ref).max() / denom < 0.05
+
+
+def test_quantized_resnet_serving_path(ctx8):
+    """int8 weights through the full serving stack (decode -> batch ->
+    quantized forward)."""
+    from analytics_zoo_tpu.models import resnet18
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, InputQueue, OutputQueue, ServingConfig)
+
+    class Served(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return resnet18(10, width=16)(
+                x.astype(np.float32) / 255.0, train=False)
+
+    model = Served()
+    rng = np.random.default_rng(0)
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 32, 32, 3), np.uint8))
+    im = InferenceModel(batch_buckets=(1, 4)).load_flax(
+        model, variables, quantize="int8")
+    cfg = ServingConfig(batch_size=4, batch_timeout_ms=10.0)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        inq = InputQueue(port=serving.port)
+        outq = OutputQueue(port=serving.port)
+        x = rng.integers(0, 256, (32, 32, 3)).astype(np.uint8)
+        uri = inq.enqueue("q-req", x=x)
+        r = outq.query(uri, timeout=20)
+        assert r is not None and r.shape == (10,)
+        # parity vs the unquantized model on the same input
+        ref = np.asarray(model.apply(variables, x[None]))[0]
+        denom = np.maximum(np.abs(ref).max(), 1e-6)
+        assert np.abs(r - ref).max() / denom < 0.1
+    finally:
+        serving.stop()
