@@ -972,11 +972,19 @@ class Narrow(nn.Module):
 
 @symbolic
 class Squeeze(nn.Module):
-    """ref: Squeeze(dim) — drop a size-1 axis (or all, dim=None)."""
+    """ref: Squeeze(dim) — drop a size-1 axis (dim=None: every size-1
+    axis EXCEPT the batch axis, matching the reference's sample-level
+    semantics; squeezing axis 0 would break batched serving's unpad
+    slicing for batch-size-1 requests)."""
     dim: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.dim is None:
+            axes = tuple(i for i in range(1, x.ndim) if x.shape[i] == 1)
+            return jnp.squeeze(x, axis=axes) if axes else x
+        if self.dim % x.ndim == 0:
+            raise ValueError("Squeeze cannot drop the batch axis")
         return jnp.squeeze(x, axis=self.dim)
 
 

@@ -32,12 +32,19 @@ from typing import Optional
 import numpy as np
 
 from analytics_zoo_tpu.common.log import logger
-from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.queues import (
+    ImageBytes, InputQueue, OutputQueue)
 
 
-def _decode_value(v) -> np.ndarray:
-    """JSON value -> ndarray: nested lists, or {"b64","shape","dtype"}."""
+def _decode_value(v):
+    """JSON value -> ndarray or image payload: nested lists,
+    {"b64","shape","dtype"} dense tensors, or {"image_b64": ...} encoded
+    JPEG/PNG bytes the server decodes natively (ref: FrontEndApp accepted
+    base64 image bodies)."""
     if isinstance(v, dict):
+        if "image_b64" in v:
+            return ImageBytes(base64.b64decode(v["image_b64"],
+                                               validate=True))
         raw = base64.b64decode(v["b64"], validate=True)
         a = np.frombuffer(raw, dtype=np.dtype(v.get("dtype", "float32")))
         return a.reshape(v["shape"]) if "shape" in v else a

@@ -43,6 +43,13 @@ def decode_ndarray(s) -> np.ndarray:
     return np.load(io.BytesIO(raw), allow_pickle=False)
 
 
+class ImageBytes(bytes):
+    """Marker type: a value that is ENCODED image bytes (JPEG/PNG), to be
+    decoded server-side — lets image payloads travel through the generic
+    ``enqueue(uri, col=value)`` surface (and the HTTP frontend) alongside
+    dense-tensor columns."""
+
+
 class InputQueue:
     """ref-parity: InputQueue(host, port).enqueue(uri, key=ndarray, ...)"""
 
@@ -65,7 +72,10 @@ class InputQueue:
                 "'uri' is the request id, not an input column name")
         fields = ["uri", uri]
         for k, v in data.items():
-            fields += [k, encode_ndarray(np.asarray(v))]
+            if isinstance(v, ImageBytes):
+                fields += [k, IMG_MAGIC + bytes(v)]
+            else:
+                fields += [k, encode_ndarray(np.asarray(v))]
         return self._xadd_capped(uri, fields)
 
     def _xadd_capped(self, uri: str, fields) -> str:

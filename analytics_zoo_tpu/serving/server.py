@@ -137,6 +137,19 @@ class ClusterServing:
             self.broker.stop()
         self._decode_pool.shutdown(wait=False)
 
+    def reload_model(self, inference_model: InferenceModel
+                     ) -> "ClusterServing":
+        """Hot-swap the served model without stopping the loop (ref:
+        ClusterServingHelper model hot-load from config).  The swap is one
+        attribute assignment — the loop reads ``self.model`` once per
+        dispatch, so in-flight batches finish on the old model and the
+        next batch runs the new one; no request is dropped."""
+        if self.config.core_number is not None:
+            inference_model.set_concurrency(self.config.core_number)
+        self.model = inference_model
+        logger.info("ClusterServing model hot-reloaded")
+        return self
+
     # ---- serving loop -------------------------------------------------
 
     def _read_batch(self, block_ms: int = 200) -> List[Dict[str, bytes]]:
@@ -291,8 +304,17 @@ class ClusterServing:
             return None
         arrays = [np.stack([v[ci] for v in good_vals])
                   for ci in range(len(cols))]
-        return good_reqs, self.model.predict_async(*arrays), \
-            time.perf_counter()
+        try:
+            waiter = self.model.predict_async(*arrays)
+        except Exception as e:
+            # dispatch itself failed (e.g. an incompatible hot-reloaded
+            # model): the stream entries are already consumed, so every
+            # request must get an error result, not a silent vanish
+            logger.exception("serving model dispatch failed")
+            for r in good_reqs:
+                self._publish_error(r, f"model dispatch failed: {e!r}")
+            return None
+        return good_reqs, waiter, time.perf_counter()
 
     def _publish_batch(self, requests, waiter, t0: float):
         preds = np.asarray(waiter())    # blocks until the device is done

@@ -25,6 +25,11 @@ def test_select_narrow_squeeze_expand():
                                       X), X[:, :, 1:3])
     x1 = X[:, :1]
     np.testing.assert_allclose(_apply(L.Squeeze(dim=1), x1), x1[:, 0])
+    # dim=None never squeezes the batch axis (serving batch-1 safety)
+    one = X[:1, :1]
+    assert _apply(L.Squeeze(), one).shape == (1, 4)
+    with pytest.raises(ValueError, match="batch axis"):
+        _apply(L.Squeeze(dim=0), X[:1])
     np.testing.assert_allclose(_apply(L.ExpandDim(dim=1), X),
                                X[:, None])
 

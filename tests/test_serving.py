@@ -443,3 +443,68 @@ class TestImageServing:
             np.testing.assert_allclose(float(r), 99.0, rtol=1e-5)
         finally:
             serving.stop()
+
+    def test_http_frontend_image_payload(self):
+        """POST /predict with {"image_b64": ...} — the akka frontend's
+        image-body parity path."""
+        import base64
+
+        from analytics_zoo_tpu.serving import HttpFrontend
+
+        serving = self._image_serving(image_shape=None)
+        fe = HttpFrontend(redis_port=serving.port, serving=serving).start()
+        try:
+            arr = np.full((8, 8, 3), 33, np.uint8)
+            body = json.dumps({"instances": [
+                {"x": {"image_b64":
+                       base64.b64encode(_png_bytes(arr)).decode()}}]})
+            conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                              timeout=20)
+            conn.request("POST", "/predict", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            assert resp.status == 200, out
+            np.testing.assert_allclose(out["predictions"][0], 33.0,
+                                       rtol=1e-5)
+        finally:
+            fe.stop()
+            serving.stop()
+
+    def test_model_hot_reload_between_batches(self):
+        """reload_model swaps the served model without dropping requests."""
+        serving = _serving()        # _Double model
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            x = np.arange(4, dtype=np.float32)
+            r1 = outq.query(inq.enqueue("before", x=x), timeout=10)
+            np.testing.assert_allclose(r1, x * 2.0)
+
+            class _Triple(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    return x * 3.0
+
+            m = _Triple()
+            im = InferenceModel().load_flax(
+                m, m.init(jax.random.key(0), np.zeros((1, 4), np.float32)))
+            serving.reload_model(im)
+            r2 = outq.query(inq.enqueue("after", x=x), timeout=10)
+            np.testing.assert_allclose(r2, x * 3.0)
+        finally:
+            serving.stop()
+
+    def test_incompatible_reload_errors_not_blackholes(self):
+        """Requests hitting a bad hot-reloaded model get fast error
+        results, not query timeouts."""
+        serving = _serving()
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            serving.reload_model(InferenceModel())    # never loaded
+            uri = inq.enqueue("doomed", x=np.zeros(4, np.float32))
+            with pytest.raises(RuntimeError, match="dispatch failed"):
+                outq.query(uri, timeout=15)
+        finally:
+            serving.stop()
