@@ -7,7 +7,8 @@ from analytics_zoo_tpu.models.transformer import (
     BERT, BERTForSequenceClassification, BERTForQuestionAnswering,
     TransformerLayer, MultiHeadAttention, BERT_PARTITION_RULES, qa_loss)
 from analytics_zoo_tpu.models.recommendation import (
-    ColumnFeatureInfo, WideAndDeep, SessionRecommender, WND_PARTITION_RULES)
+    ColumnFeatureInfo, WideAndDeep, SessionRecommender, DIEN,
+    WND_PARTITION_RULES)
 from analytics_zoo_tpu.models.text import TextClassifier, KNRM
 from analytics_zoo_tpu.models.anomaly import (
     AnomalyDetector, unroll, detect_anomalies)
@@ -25,7 +26,7 @@ __all__ = [
     "BERT", "BERTForSequenceClassification", "BERTForQuestionAnswering",
     "TransformerLayer", "MultiHeadAttention", "BERT_PARTITION_RULES",
     "qa_loss",
-    "ColumnFeatureInfo", "WideAndDeep", "SessionRecommender",
+    "ColumnFeatureInfo", "WideAndDeep", "SessionRecommender", "DIEN",
     "WND_PARTITION_RULES",
     "TextClassifier", "KNRM",
     "AnomalyDetector", "unroll", "detect_anomalies",

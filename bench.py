@@ -279,10 +279,82 @@ def bench_ncf():
     return {"samples_per_sec": sps, "compute_samples_per_sec": comp}
 
 
+def bench_wide_and_deep():
+    """Wide&Deep recommendation throughput (config #5)."""
+    import numpy as np
+    import optax
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models import (
+        ColumnFeatureInfo, WideAndDeep, WND_PARTITION_RULES)
+
+    init_orca_context("local")
+    info = ColumnFeatureInfo(
+        wide_base_cols=("b0", "b1"), wide_base_dims=(100, 100),
+        indicator_cols=("gender",), indicator_dims=(3,),
+        embed_cols=("user", "item"), embed_in_dims=(6040, 3706),
+        embed_out_dims=(64, 64), continuous_cols=("age",))
+    rng = np.random.default_rng(0)
+    bs = 16384
+    n = bs * 8
+    data = {
+        "wide_cols": np.stack([rng.integers(1, 101, n),
+                               rng.integers(101, 201, n)], 1).astype(np.int32),
+        "indicator_cols": rng.integers(0, 3, (n, 1)).astype(np.int32),
+        "embed_cols": np.stack([rng.integers(0, 6040, n),
+                                rng.integers(0, 3706, n)], 1).astype(np.int32),
+        "continuous_cols": rng.normal(size=(n, 1)).astype(np.float32),
+        "label": rng.integers(0, 2, n).astype(np.int32),
+    }
+    model = WideAndDeep(class_num=2, column_info=info)
+    est = Estimator.from_flax(
+        model=model, loss="sparse_categorical_crossentropy",
+        optimizer=optax.adam(1e-3),
+        feature_cols=tuple(model.feature_groups()), label_cols=("label",),
+        partition_rules=WND_PARTITION_RULES)
+    est.config.log_every_steps = 1000
+    sps = _fit_throughput(est, data, bs, epochs=2)
+    comp = _compute_throughput(est, data, bs)
+    stop_orca_context()
+    return {"samples_per_sec": sps, "compute_samples_per_sec": comp}
+
+
+def bench_forecast():
+    """Zouwu LSTM forecaster throughput (config #4) through the
+    Forecaster.fit surface on NYC-taxi-shaped windows."""
+    import numpy as np
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.zouwu.forecaster import LSTMForecaster
+    from analytics_zoo_tpu.zouwu.preprocessing import roll
+
+    init_orca_context("local")
+    from analytics_zoo_tpu.zouwu.preprocessing import StandardScaler
+
+    t = np.arange(80_000, dtype=np.float32)
+    series = (10 + 3 * np.sin(2 * np.pi * t / 48)
+              + 0.3 * np.random.default_rng(0).normal(size=t.size))
+    series = StandardScaler().fit_transform(series[:, None].astype(np.float32))
+    x, y = roll(series, 96, 1)
+    fc = LSTMForecaster(target_dim=1, feature_dim=1, lstm_units=(32, 16))
+    fc.estimator.config.log_every_steps = 1000   # no mid-window fetches
+    fc.fit(x[:1024], y[:1024], epochs=1, batch_size=512)   # warm compile
+    # settle the device link (first fetch) before the measured window
+    fc.evaluate(x[:512], y[:512])
+    last = fc.fit(x, y, epochs=1, batch_size=512)   # returns last-epoch stats
+    sps = last["samples_per_sec"]
+    mse = fc.evaluate(x[-2048:], y[-2048:])["mse"]
+    stop_orca_context()
+    return {"samples_per_sec": sps, "holdout_mse": round(float(mse), 4)}
+
+
 BENCHES = {
     "bert": lambda: bench_bert("tpu"),
     "ncf": bench_ncf,
     "resnet": bench_resnet50,
+    "wnd": bench_wide_and_deep,
+    "forecast": bench_forecast,
     "cpu-baseline": lambda: bench_bert("cpu"),
 }
 
@@ -319,6 +391,8 @@ def main():
     bert = _run_sub("bert")
     ncf = _run_sub("ncf")
     resnet = _run_sub("resnet")
+    wnd = _run_sub("wnd")
+    fcst = _run_sub("forecast")
     cpu = _run_sub("cpu-baseline")
     bert_sps = bert["samples_per_sec"] if bert else None
     cpu_sps = cpu["samples_per_sec"] if cpu else None
@@ -364,6 +438,13 @@ def main():
                 and resnet.get("h2d_rate_mb_s"),
             "resnet50_input_mb_per_step":
                 resnet and resnet.get("input_mb_per_step"),
+            "wide_and_deep_train_samples_per_sec_per_chip":
+                wnd and round(wnd["samples_per_sec"], 1),
+            "wide_and_deep_compute_samples_per_sec":
+                wnd and round(wnd["compute_samples_per_sec"], 1),
+            "forecaster_train_samples_per_sec_per_chip":
+                fcst and round(fcst["samples_per_sec"], 1),
+            "forecaster_holdout_mse": fcst and fcst.get("holdout_mse"),
         },
     }))
 
