@@ -23,6 +23,7 @@ examples:
 	$(PY) examples/train_ncf.py
 	$(PY) examples/forecast_taxi.py
 	$(PY) examples/serve_model.py
+	$(PY) examples/multihost_fit.py
 
 # compile the C++ data plane in place (csv parser, zrec store, ring
 # buffer, image decode)
