@@ -37,7 +37,7 @@ def worker(pid: int, nprocs: int, port: int, workdir: str):
 
     from analytics_zoo_tpu import init_orca_context
     from analytics_zoo_tpu.common.config import TrainConfig
-    from analytics_zoo_tpu.data.feature_set import FeatureSet, DiskFeatureSet
+    from analytics_zoo_tpu.data.feature_set import FeatureSet
     from analytics_zoo_tpu.learn import Estimator
 
     ctx = init_orca_context(
@@ -95,7 +95,14 @@ def main():
              str(i), "2", str(port), workdir], env=env)
         for i in range(2)
     ]
-    rcs = [p.wait(timeout=600) for p in procs]
+    try:
+        rcs = [p.wait(timeout=600) for p in procs]
+    finally:
+        # a crashed worker leaves its peer blocked in a gloo collective —
+        # never leak a hung process (same pattern as tests/test_multihost)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     if any(rcs):
         raise SystemExit(f"worker exit codes: {rcs}")
     print("multihost example complete")
