@@ -962,3 +962,28 @@ class Estimator:
         return FlaxEstimator(model, loss or "mse", optimizer, **kw)
     from_graph = from_flax
     from_bigdl = from_flax
+
+    @staticmethod
+    def from_openvino(*, model_path: Optional[str] = None, **kw):
+        """ref-parity name: zoo.orca.learn.openvino.Estimator.from_openvino
+        (batch inference with OpenVINO IR over Spark partitions).
+
+        OpenVINO's IR format and IE runtime are x86-specific and not
+        present in this environment; the ROLE (optimized batched
+        inference, optionally int8) is served natively:
+
+          * TF SavedModel / frozen graph -> ``Net.load_tf`` ->
+            ``InferenceModel.load_flax``
+          * torch module -> ``InferenceModel.load_torch``
+          * int8: ``InferenceModel.load_flax(..., quantize="int8")``
+            (weight-only, measured ~4x smaller, no calibration set)
+
+        Re-export the original model (IR files cannot be converted back
+        without the OpenVINO toolchain).
+        """
+        raise NotImplementedError(
+            "OpenVINO IR needs the x86 IE runtime, which this TPU "
+            "environment does not ship. Serve the ORIGINAL model instead: "
+            "Net.load_tf(saved_model) or InferenceModel.load_torch(module), "
+            "then InferenceModel.load_flax(..., quantize='int8') for the "
+            "int8 role (see learn/quantize.py)")

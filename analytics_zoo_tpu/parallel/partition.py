@@ -22,6 +22,16 @@ PartitionRules = Sequence[Tuple[str, P]]
 # Rules for plain data-parallel: every param replicated.
 DP_RULES: PartitionRules = ((".*", P()),)
 
+# ZeRO-style fully-sharded data parallel: every sizable tensor's leading
+# dim sharded over the `fsdp` mesh axis — params AND optimizer state
+# (state_sharding applies the rules to the whole TrainState, and adam's
+# mu/nu mirror the param paths).  XLA inserts the all-gather before each
+# use and reduce-scatters the gradients; tensors whose leading dim does
+# not divide the axis fall back to replication (_valid_spec).  The
+# reference has no counterpart (SURVEY §2.3: ZeRO absent upstream) —
+# this is a TPU-native extension for models larger than one chip's HBM.
+FSDP_RULES: PartitionRules = ((r".*", P("fsdp")),)
+
 
 def _param_path(path) -> str:
     parts = []

@@ -146,3 +146,12 @@ def test_changing_cols_invalidates_jit(ctx8):
     acc_b = e.evaluate(data, batch_size=32, feature_cols=["b"])["accuracy"]
     assert acc_a > 0.9
     assert acc_b != acc_a  # all-zero features can't match trained accuracy
+
+
+def test_from_openvino_refuses_with_migration_path():
+    """ref-parity entry point: the OpenVINO IR runtime cannot exist here;
+    the refusal must name the native routes (TFNet/torch + int8 quant)."""
+    from analytics_zoo_tpu.learn import Estimator
+
+    with pytest.raises(NotImplementedError, match="quantize='int8'"):
+        Estimator.from_openvino(model_path="model.xml")

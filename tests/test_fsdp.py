@@ -1,0 +1,94 @@
+"""FSDP (ZeRO-style fully-sharded data parallel) — a TPU-native extension
+beyond the reference's DP-only story (SURVEY §2.3 lists ZeRO as absent
+upstream): params + optimizer state sharded over the `fsdp` axis, with
+training numerically equivalent to plain DP."""
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import optax
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.common.config import TrainConfig
+from analytics_zoo_tpu.learn import Estimator
+from analytics_zoo_tpu.parallel.partition import DP_RULES, FSDP_RULES
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        h = nn.tanh(nn.Dense(32, name="h")(x))   # 32 % fsdp sizes == 0
+        return nn.Dense(1, name="out")(h)
+
+
+def _fit(mesh_axes, rules):
+    ctx = init_orca_context("local", mesh_axes=mesh_axes)
+    try:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(128, 16)).astype(np.float32)
+        y = x.sum(1, keepdims=True).astype(np.float32)
+        est = Estimator.from_flax(
+            model=MLP(), loss="mse", optimizer=optax.adam(1e-2),
+            partition_rules=rules,
+            config=TrainConfig(deterministic=True, seed=0))
+        hist = est.fit({"x": x, "y": y}, epochs=3, batch_size=32)
+        return [h["loss"] for h in hist], est
+    finally:
+        stop_orca_context()
+
+
+def test_fsdp_matches_dp_trajectory(devices):
+    """dp=8 vs dp=2 x fsdp=4: identical global batches, identical math —
+    the loss trajectories must agree to float tolerance."""
+    dp_losses, _ = _fit({"dp": -1}, DP_RULES)
+    fsdp_losses, est = _fit({"dp": 2, "fsdp": 4}, FSDP_RULES)
+    np.testing.assert_allclose(fsdp_losses, dp_losses, rtol=1e-4)
+
+    # params and adam state really are sharded over fsdp
+    k = est.state.params["h"]["kernel"]
+    assert "fsdp" in str(k.sharding.spec), k.sharding.spec
+    hit = any("fsdp" in str(l.sharding.spec)
+              for l in jax.tree.leaves(est.state.opt_state)
+              if hasattr(l, "sharding") and l.ndim >= 1)
+    assert hit, "optimizer state not fsdp-sharded"
+
+
+def test_fsdp_indivisible_dims_fall_back(devices):
+    """A leading dim that doesn't divide the fsdp axis replicates instead
+    of erroring (the _valid_spec contract) — training still works."""
+
+    class Odd(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(nn.tanh(nn.Dense(13)(x)))  # 13 odd
+
+    ctx = init_orca_context("local", mesh_axes={"dp": 2, "fsdp": 4})
+    try:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 7)).astype(np.float32)   # 7 odd too
+        y = x.sum(1, keepdims=True).astype(np.float32)
+        est = Estimator.from_flax(model=Odd(), loss="mse",
+                                  optimizer=optax.adam(1e-2),
+                                  partition_rules=FSDP_RULES)
+        hist = est.fit({"x": x, "y": y}, epochs=2, batch_size=32)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+    finally:
+        stop_orca_context()
+
+
+def test_fsdp_checkpoint_roundtrip(devices, tmp_path):
+    """Sharded state checkpoints and restores (Orbax sharding-aware)."""
+    _, est = _fit({"dp": 2, "fsdp": 4}, FSDP_RULES)
+    est.save_checkpoint(str(tmp_path / "ck"))
+    before = jax.device_get(est.state.params)
+    # diverge, then restore
+    rng = np.random.default_rng(1)
+    est.fit({"x": rng.normal(size=(64, 16)).astype(np.float32),
+             "y": np.zeros((64, 1), np.float32)}, epochs=1, batch_size=32)
+    est.load_checkpoint(str(tmp_path / "ck"))
+    after = jax.device_get(est.state.params)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-7)
