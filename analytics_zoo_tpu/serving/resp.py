@@ -109,6 +109,11 @@ class Stream:
         self.entries: List[Tuple[bytes, List[bytes]]] = []  # (id, kv flat)
         self.seq = itertools.count(1)
         self.cond = threading.Condition()
+        # consumer groups: name -> {"last": delivered-up-to id,
+        #                           "pending": {id: consumer}}
+        # (the mechanism behind horizontally-scaled serving workers —
+        # ref: Flink source parallelism over XREADGROUP)
+        self.groups: Dict[bytes, Dict] = {}
 
     def add(self, fields: List[bytes]) -> bytes:
         eid = f"{int(time.time() * 1000)}-{next(self.seq)}".encode()
@@ -123,6 +128,38 @@ def _id_after(eid: bytes, last: bytes) -> bool:
         a, _, b = x.partition(b"-")
         return (int(a), int(b or 0))
     return parse(eid) > parse(last)
+
+
+def _scan_read_opts(args: List[bytes], i: int):
+    """Parse [COUNT c] [BLOCK ms] up to STREAMS; returns (count, block_ms,
+    index-of-STREAMS) — shared by XREAD and XREADGROUP."""
+    count, block_ms = None, None
+    while args[i].upper() != b"STREAMS":
+        if args[i].upper() == b"COUNT":
+            count = int(args[i + 1])
+        elif args[i].upper() == b"BLOCK":
+            block_ms = int(args[i + 1])
+        i += 2
+    return count, block_ms, i
+
+
+def _await_fresh(s: "Stream", block_ms, select):
+    """Run `select()` under s.cond until it yields entries or the block
+    window expires.  select() may mutate claim state (XREADGROUP) — it is
+    always called with the stream lock held, so claims are atomic."""
+    deadline = None if block_ms is None else \
+        time.monotonic() + block_ms / 1000.0
+    while True:
+        with s.cond:
+            got = select()
+            if got:
+                return got
+            if deadline is None:
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            s.cond.wait(remaining)
 
 
 class RespServer:
@@ -284,35 +321,90 @@ class RespServer:
                 return cut
         if cmd == b"XREAD":
             # XREAD [COUNT c] [BLOCK ms] STREAMS key id
-            count, block_ms = None, None
-            i = 1
-            while args[i].upper() != b"STREAMS":
-                if args[i].upper() == b"COUNT":
-                    count = int(args[i + 1])
-                elif args[i].upper() == b"BLOCK":
-                    block_ms = int(args[i + 1])
-                i += 2
+            count, block_ms, i = _scan_read_opts(args, 1)
             key, last = args[i + 1], args[i + 2]
-            if last == b"$":
-                s = self._stream(key)
-                last = s.entries[-1][0] if s.entries else b"0-0"
             s = self._stream(key)
-            deadline = None if block_ms is None else \
-                time.monotonic() + block_ms / 1000.0
-            while True:
+            if last == b"$":
                 with s.cond:
-                    fresh = [e for e in s.entries
-                             if _id_after(e[0], last)]
-                    if fresh:
-                        if count:
-                            fresh = fresh[:count]
-                        return [[key, [[eid, fv] for eid, fv in fresh]]]
-                    if deadline is None:
-                        return None
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return None
-                    s.cond.wait(remaining)
+                    last = s.entries[-1][0] if s.entries else b"0-0"
+
+            def select():
+                fresh = [e for e in s.entries if _id_after(e[0], last)]
+                return fresh[:count] if count else fresh
+
+            got = _await_fresh(s, block_ms, select)
+            if got is None:
+                return None
+            return [[key, [[eid, fv] for eid, fv in got]]]
+        if cmd == b"XGROUP":
+            # XGROUP CREATE key group id [MKSTREAM]
+            if args[1].upper() != b"CREATE":
+                raise RedisError("only XGROUP CREATE is supported")
+            s = self._stream(args[2])
+            start = args[4]
+            with s.cond:
+                if args[3] in s.groups:
+                    raise RedisError("BUSYGROUP Consumer Group name "
+                                     "already exists")
+                if start == b"$":
+                    start = s.entries[-1][0] if s.entries else b"0-0"
+                s.groups[args[3]] = {"last": start, "pending": {}}
+            return _OK()
+        if cmd == b"XREADGROUP":
+            # XREADGROUP GROUP g consumer [COUNT c] [BLOCK ms] STREAMS key >
+            group, consumer = args[2], args[3]
+            count, block_ms, i = _scan_read_opts(args, 4)
+            key, cursor = args[i + 1], args[i + 2]
+            if cursor != b">":
+                raise RedisError("only the '>' cursor is supported")
+            s = self._stream(key)
+            with s.cond:
+                if group not in s.groups:
+                    raise RedisError(
+                        f"NOGROUP no such consumer group {group.decode()}")
+
+            def select():
+                # atomic claim under s.cond (held by _await_fresh):
+                # advance the group pointer so no other consumer sees these
+                g = s.groups.get(group)
+                if g is None:
+                    return None
+                fresh = [e for e in s.entries
+                         if _id_after(e[0], g["last"])]
+                if not fresh:
+                    return None
+                if count:
+                    fresh = fresh[:count]
+                g["last"] = fresh[-1][0]
+                for eid, _ in fresh:
+                    g["pending"][eid] = consumer
+                return fresh
+
+            got = _await_fresh(s, block_ms, select)
+            if got is None:
+                return None
+            return [[key, [[eid, fv] for eid, fv in got]]]
+        if cmd == b"XACK":
+            # XACK key group id [id ...]
+            s = self._stream(args[1])
+            with s.cond:
+                g = s.groups.get(args[2])
+                if g is None:
+                    return 0
+                n = 0
+                for eid in args[3:]:
+                    n += g["pending"].pop(eid, None) is not None
+                return n
+        if cmd == b"XPENDING":
+            # XPENDING key group -> [count, min-id, max-id, consumers]
+            s = self._stream(args[1])
+            with s.cond:
+                g = s.groups.get(args[2])
+                if g is None:
+                    return [0, None, None, None]
+                ids = sorted(g["pending"])
+                return [len(ids), ids[0] if ids else None,
+                        ids[-1] if ids else None, None]
         raise RedisError(f"unknown command {cmd.decode()}")
 
 

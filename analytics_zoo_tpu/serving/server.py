@@ -41,6 +41,12 @@ class ServingConfig:
     redis_port: int = 6379
     batch_size: int = 32            # micro-batch cap
     batch_timeout_ms: float = 5.0   # flush partial batch after this wait
+    workers: int = 1                # parallel serving-loop consumers in
+    #                                 one shared consumer group (ref: Flink
+    #                                 source parallelism; >1 overlaps host
+    #                                 decode/batching across workers, and N
+    #                                 ClusterServing PROCESSES on one broker
+    #                                 scale out the same way)
     input_cols: Optional[List[str]] = None  # None: infer from request
     image_shape: Optional[List[int]] = None  # (H, W): resize decoded
     #                                          image payloads to the model
@@ -76,6 +82,8 @@ class ServingConfig:
             cfg.core_number = int(params["core_number"])
         if "image_shape" in params:
             cfg.image_shape = [int(v) for v in params["image_shape"]]
+        if "workers" in params:
+            cfg.workers = int(params["workers"])
         return cfg
 
 
@@ -102,7 +110,7 @@ class ClusterServing:
         self.port = self.config.redis_port
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._last_id = b"0-0"
+        self._stats_lock = threading.Lock()
         # (uri, written_at) of results not yet known consumed — abandoned
         # ones (client timed out / died) are pruned after result_ttl_s so
         # broker memory stays bounded in long-lived deployments
@@ -119,20 +127,40 @@ class ClusterServing:
 
     # ---- lifecycle ----------------------------------------------------
 
+    GROUP = b"serving"
+
     def start(self) -> "ClusterServing":
         self.client = RespClient(self.config.redis_host,
                                  self.config.redis_port)
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
-        logger.info("ClusterServing up (redis %s:%d, batch<=%d)",
-                    self.config.redis_host, self.config.redis_port,
-                    self.config.batch_size)
+        # one shared consumer group: every worker (thread here; other
+        # ClusterServing PROCESSES on the same broker too) claims disjoint
+        # entries atomically — the Flink-source-parallelism analog
+        try:
+            # MKSTREAM: a real redis-server refuses to create a group on a
+            # stream that has no entries yet (the embedded broker
+            # auto-creates either way)
+            self.client.execute("XGROUP", "CREATE", INPUT_STREAM,
+                                self.GROUP, "0-0", "MKSTREAM")
+        except Exception as e:
+            if "BUSYGROUP" not in str(e):
+                raise
+        self._threads = []
+        for w in range(max(1, self.config.workers)):
+            t = threading.Thread(target=self._loop, args=(f"w{w}",),
+                                 daemon=True, name=f"zoo-serving-{w}")
+            t.start()
+            self._threads.append(t)
+        self._thread = self._threads[0]     # back-compat attribute
+        logger.info("ClusterServing up (redis %s:%d, batch<=%d, "
+                    "workers=%d)", self.config.redis_host,
+                    self.config.redis_port, self.config.batch_size,
+                    len(self._threads))
         return self
 
     def stop(self):
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        for t in getattr(self, "_threads", []):
+            t.join(timeout=5)
         if self.broker is not None:
             self.broker.stop()
         self._decode_pool.shutdown(wait=False)
@@ -152,75 +180,104 @@ class ClusterServing:
 
     # ---- serving loop -------------------------------------------------
 
-    def _read_batch(self, block_ms: int = 200) -> List[Dict[str, bytes]]:
-        """Micro-batch: block up to block_ms for the first request, then
-        grab whatever else is queued up to batch_size within
-        batch_timeout_ms.  With a batch already in flight on the device the
-        loop passes a tiny block_ms so finished results are written
-        promptly instead of waiting out a full idle poll."""
+    def _read_batch(self, client: RespClient, consumer: str,
+                    block_ms: int = 200) -> List[Dict[str, bytes]]:
+        """Micro-batch via the shared consumer group: XREADGROUP claims
+        entries ATOMICALLY for this consumer (no worker ever sees another
+        worker's requests), blocking up to block_ms for the first one and
+        topping up within batch_timeout_ms.  With a batch already in
+        flight on the device the loop passes a tiny block_ms so finished
+        results are written promptly instead of waiting out a full idle
+        poll."""
         cfg = self.config
-        first = self.client.execute(
-            "XREAD", "COUNT", cfg.batch_size, "BLOCK", block_ms, "STREAMS",
-            INPUT_STREAM, self._last_id)
+
+        def claim(count, wait_ms):
+            return client.execute(
+                "XREADGROUP", "GROUP", self.GROUP, consumer,
+                "COUNT", count, "BLOCK", wait_ms, "STREAMS",
+                INPUT_STREAM, ">")
+
+        first = claim(cfg.batch_size, block_ms)
         if not first:
-            return []
+            return [], []
         entries = first[0][1]
         deadline = time.monotonic() + cfg.batch_timeout_ms / 1000.0
         while len(entries) < cfg.batch_size:
             wait_ms = int(max(0, (deadline - time.monotonic()) * 1000))
             if wait_ms <= 0:
                 break
-            more = self.client.execute(
-                "XREAD", "COUNT", cfg.batch_size - len(entries), "BLOCK",
-                wait_ms, "STREAMS", INPUT_STREAM, entries[-1][0])
+            more = claim(cfg.batch_size - len(entries), wait_ms)
             if not more:
                 break
             entries.extend(more[0][1])
-        self._last_id = entries[-1][0]
         out = []
         for eid, flat in entries:
             fields = {flat[i].decode(): flat[i + 1]
                       for i in range(0, len(flat), 2)}
             out.append(fields)
-        # delete exactly the consumed entries (by id) so XLEN == pending
-        # backlog; MAXLEN-style trimming would race concurrent producers
-        # and could drop entries that were never read
-        self.client.execute("XDEL", INPUT_STREAM,
-                            *[eid for eid, _ in entries])
-        return out
+        # NOT acked here: entries stay pending (and XLEN counts them)
+        # until their results are published, so XPENDING shows the true
+        # in-flight window — _finish_entries acks+deletes after publish
+        return out, [eid for eid, _ in entries]
 
-    def _loop(self):
-        """Pipelined serving loop: while batch N computes on the TPU, batch
-        N+1 is read from the stream and decoded on the host (XLA dispatch
-        is async; blocking happens only when N's results are written)."""
-        pending = None          # (requests, waiter, dispatched_at)
-        while not self._stop.is_set():
-            try:
-                # with work in flight, poll briefly so finished results are
-                # published as soon as the device is done
-                requests = self._read_batch(2 if pending else 200)
-            except (ConnectionError, OSError):
-                if self._stop.is_set():
-                    break
-                time.sleep(0.05)
-                continue
-            nxt = None
-            if requests:
+    def _loop(self, consumer: str = "w0"):
+        """Pipelined serving loop (one per worker): while batch N computes
+        on the TPU, batch N+1 is read from the stream and decoded on the
+        host (XLA dispatch is async; blocking happens only when N's
+        results are written).  Each worker owns its RESP connection."""
+        try:
+            client = RespClient(self.config.redis_host,
+                                self.config.redis_port)
+        except OSError:
+            logger.exception("serving worker %s could not connect to the "
+                             "broker — worker not started", consumer)
+            return
+        pending = None      # (requests, ids, waiter, dispatched_at)
+        try:
+            while not self._stop.is_set():
                 try:
-                    nxt = self._dispatch_batch(requests)
-                except Exception:
-                    logger.exception("serving dispatch failed")
+                    # with work in flight, poll briefly so finished results
+                    # are published as soon as the device is done
+                    requests, ids = self._read_batch(
+                        client, consumer, 2 if pending else 200)
+                except (ConnectionError, OSError):
+                    if self._stop.is_set():
+                        break
+                    time.sleep(0.05)
+                    continue
+                nxt = None
+                if requests:
+                    try:
+                        nxt = self._dispatch_batch(client, requests, ids)
+                    except Exception:
+                        logger.exception("serving dispatch failed")
+                        self._finish_entries(client, ids)
+                if pending is not None:
+                    try:
+                        self._publish_batch(client, *pending)
+                    except Exception:
+                        logger.exception("serving publish failed")
+                        self._finish_entries(client, pending[1])
+                pending = nxt
             if pending is not None:
                 try:
-                    self._publish_batch(*pending)
+                    self._publish_batch(client, *pending)
                 except Exception:
                     logger.exception("serving publish failed")
-            pending = nxt
-        if pending is not None:
-            try:
-                self._publish_batch(*pending)
-            except Exception:
-                logger.exception("serving publish failed")
+                    self._finish_entries(client, pending[1])
+        finally:
+            client.close()
+
+    def _finish_entries(self, client: RespClient, ids):
+        """Ack + delete consumed stream entries (after their results —
+        value or error — are published); one pipeline round-trip."""
+        if not ids:
+            return
+        try:
+            client.pipeline([("XACK", INPUT_STREAM, self.GROUP, *ids),
+                             ("XDEL", INPUT_STREAM, *ids)])
+        except Exception:
+            logger.exception("serving ack failed")
 
     def _decode_value(self, v: bytes) -> np.ndarray:
         """One request field -> ndarray.  IMG! payloads are compressed
@@ -254,18 +311,21 @@ class ClusterServing:
                 # index it like a normal result so dequeue()-only clients
                 # still observe (and consume) the failure
                 ("SADD", "__result_keys__", uri)])
-            self._written.append((uri, time.monotonic()))
+            with self._stats_lock:
+                self._written.append((uri, time.monotonic()))
         except Exception:
             logger.exception("failed to publish serving error")
 
-    def _dispatch_batch(self, requests: List[Dict[str, bytes]]):
+    def _dispatch_batch(self, client: RespClient,
+                        requests: List[Dict[str, bytes]], ids: List[bytes]):
         """Decode + enqueue the forward on the device; returns the in-flight
         handle without blocking on the result.  Image payloads decode on a
         thread pool — the native decoder releases the GIL, so a batch of
         JPEGs decodes in parallel while the previous batch computes.
         A request that fails to decode (or whose shape disagrees with the
-        batch) gets an ERROR result published; the rest of the batch still
-        runs — one bad payload must never black-hole its batchmates."""
+        batch) gets an ERROR result published and its entry finished; the
+        rest of the batch still runs — one bad payload must never
+        black-hole its batchmates."""
         cols = self.config.input_cols or \
             [k for k in requests[0] if k != "uri"]
         per_req: List[Optional[List[np.ndarray]]] = [None] * len(requests)
@@ -289,17 +349,21 @@ class ClusterServing:
         # individually instead of failing np.stack for everyone
         ref_shapes = next((tuple(a.shape for a in v)
                            for v in per_req if v is not None), None)
-        good_reqs, good_vals = [], []
-        for r, v in zip(requests, per_req):
+        good_reqs, good_ids, good_vals, done_ids = [], [], [], []
+        for r, eid, v in zip(requests, ids, per_req):
             if v is None:
+                done_ids.append(eid)        # error already published
                 continue
             if tuple(a.shape for a in v) != ref_shapes:
                 self._publish_error(
                     r, f"input shape {[a.shape for a in v]} != batch "
                        f"shape {list(ref_shapes)}")
+                done_ids.append(eid)
                 continue
             good_reqs.append(r)
+            good_ids.append(eid)
             good_vals.append(v)
+        self._finish_entries(client, done_ids)
         if not good_reqs:
             return None
         arrays = [np.stack([v[ci] for v in good_vals])
@@ -313,10 +377,12 @@ class ClusterServing:
             logger.exception("serving model dispatch failed")
             for r in good_reqs:
                 self._publish_error(r, f"model dispatch failed: {e!r}")
+            self._finish_entries(client, good_ids)
             return None
-        return good_reqs, waiter, time.perf_counter()
+        return good_reqs, good_ids, waiter, time.perf_counter()
 
-    def _publish_batch(self, requests, waiter, t0: float):
+    def _publish_batch(self, client: RespClient, requests, ids, waiter,
+                       t0: float):
         preds = np.asarray(waiter())    # blocks until the device is done
         dt = (time.perf_counter() - t0) * 1000
         uris = [r["uri"].decode() for r in requests]
@@ -331,22 +397,31 @@ class ClusterServing:
         # a set, pruned by the client on consume, so it stays bounded by
         # the number of UNREAD results rather than total requests served
         cmds.append(("SADD", "__result_keys__", *uris))
-        self.client.pipeline(cmds)
+        client.pipeline(cmds)
+        self._finish_entries(client, ids)   # results are visible: ack+del
         now = time.monotonic()
-        self._written.extend((u, now) for u in uris)
-        self._prune_abandoned(now)
-        self.stats["requests"] += len(requests)
-        self.stats["batches"] += 1
-        self.stats["batch_fill"] = len(requests) / self.config.batch_size
-        self.stats["predict_ms"] = dt
+        with self._stats_lock:
+            self._written.extend((u, now) for u in uris)
+            self.stats["requests"] += len(requests)
+            self.stats["batches"] += 1
+            self.stats["batch_fill"] = len(requests) / self.config.batch_size
+            self.stats["predict_ms"] = dt
+        self._prune_abandoned(client, now)
 
-    def _prune_abandoned(self, now: float):
+    def _prune_abandoned(self, client: RespClient, now: float):
+        """One pipeline round-trip per pruned uri, on the calling worker's
+        own connection — pruning a TTL burst must not serialise every
+        worker through the shared client's lock."""
         ttl = self.config.result_ttl_s
-        while self._written and now - self._written[0][1] > ttl:
-            uri, _ = self._written.popleft()
-            self.client.execute("DEL", RESULT_PREFIX + uri,
-                                SIGNAL_PREFIX + uri)
-            self.client.execute("SREM", "__result_keys__", uri)
+        while True:
+            with self._stats_lock:
+                if not self._written or \
+                        now - self._written[0][1] <= ttl:
+                    return
+                uri, _ = self._written.popleft()
+            client.pipeline([
+                ("DEL", RESULT_PREFIX + uri, SIGNAL_PREFIX + uri),
+                ("SREM", "__result_keys__", uri)])
 
     # ---- observability (SURVEY §5: queue depth = backlog metric) ------
 

@@ -20,7 +20,7 @@ import numpy as np
 
 
 def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
-                 batch_size: int = 64) -> dict:
+                 batch_size: int = 64, workers: int = 1) -> dict:
     import flax.linen as nn
     import jax
 
@@ -37,7 +37,8 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
                 return nn.Dense(10)(x)
 
         model, feat = MLP(), np.zeros((1, 64), np.float32)
-        cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=2.0)
+        cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=2.0,
+                            workers=workers)
     elif model_kind.startswith("resnet18"):
         # REAL serving economics (VERDICT r2 ask #7): encoded JPEG in over
         # the wire, native decode + resize on the server's thread pool,
@@ -58,7 +59,7 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
         model = ServedResNet18()
         feat = np.zeros((1, 224, 224, 3), np.uint8)
         cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=4.0,
-                            image_shape=[224, 224])
+                            image_shape=[224, 224], workers=workers)
     else:
         raise ValueError(model_kind)
 
@@ -137,6 +138,7 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
         extra["weight_compression"] = im.quant_stats["compression"]
     return {
         **extra,
+        "workers": workers,
         "model": model_kind,
         "clients": n_clients,
         "requests": int(a.size),

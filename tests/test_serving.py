@@ -508,3 +508,71 @@ class TestImageServing:
                 outq.query(uri, timeout=15)
         finally:
             serving.stop()
+
+
+# ---------------------------------------------------------------------------
+# consumer groups / multi-worker serving (ref: Flink source parallelism
+# over XREADGROUP — horizontal scaling of the serving loop)
+# ---------------------------------------------------------------------------
+
+class TestConsumerGroups:
+    def test_xreadgroup_claims_are_disjoint(self):
+        broker = RespServer(port=0).start()
+        try:
+            c1 = RespClient(port=broker.port)
+            c2 = RespClient(port=broker.port)
+            c1.execute("XGROUP", "CREATE", "s", "g", "0-0")
+            for i in range(10):
+                c1.execute("XADD", "s", "*", "i", str(i))
+            got1 = c1.execute("XREADGROUP", "GROUP", "g", "a", "COUNT", 6,
+                              "BLOCK", 100, "STREAMS", "s", ">")
+            got2 = c2.execute("XREADGROUP", "GROUP", "g", "b", "COUNT", 6,
+                              "BLOCK", 100, "STREAMS", "s", ">")
+            ids1 = {e[0] for e in got1[0][1]}
+            ids2 = {e[0] for e in (got2[0][1] if got2 else [])}
+            assert ids1.isdisjoint(ids2)
+            assert len(ids1) + len(ids2) == 10
+            # XACK clears pending
+            acked = c1.execute("XACK", "s", "g", *sorted(ids1))
+            assert acked == len(ids1)
+            pend = c1.execute("XPENDING", "s", "g")
+            assert pend[0] == len(ids2)
+        finally:
+            broker.stop()
+
+    def test_busygroup_and_nogroup_errors(self):
+        broker = RespServer(port=0).start()
+        try:
+            c = RespClient(port=broker.port)
+            c.execute("XGROUP", "CREATE", "s", "g", "$")
+            with pytest.raises(Exception, match="BUSYGROUP"):
+                c.execute("XGROUP", "CREATE", "s", "g", "$")
+            with pytest.raises(Exception, match="NOGROUP"):
+                c.execute("XREADGROUP", "GROUP", "nope", "a", "COUNT", 1,
+                          "BLOCK", 10, "STREAMS", "s", ">")
+        finally:
+            broker.stop()
+
+    def test_multi_worker_serving_exactly_once(self):
+        """2 worker loops on one stream: every request answered exactly
+        once, none duplicated, none lost."""
+        model = _Double()
+        variables = model.init(jax.random.key(0),
+                               np.zeros((1, 4), np.float32))
+        im = InferenceModel().load_flax(model, variables)
+        cfg = ServingConfig(batch_size=4, batch_timeout_ms=5.0, workers=2)
+        serving = ClusterServing(im, cfg, embedded_broker=True).start()
+        try:
+            inq = InputQueue(port=serving.port)
+            outq = OutputQueue(port=serving.port)
+            xs = {f"m{i}": np.full(4, i, np.float32) for i in range(40)}
+            for uri, x in xs.items():
+                inq.enqueue(uri, x=x)
+            for uri, x in xs.items():
+                r = outq.query(uri, timeout=20)
+                assert r is not None, uri
+                np.testing.assert_allclose(r, x * 2.0, err_msg=uri)
+            assert serving.stats["requests"] == 40
+            assert serving.backlog() == 0
+        finally:
+            serving.stop()
