@@ -142,6 +142,14 @@ class FlaxEstimator:
         return kw
 
     def _forward(self, params, batch_stats, batch, rng, train: bool):
+        """Returns (preds, new_batch_stats, aux_loss).
+
+        ``aux_loss`` is the sum of everything modules sowed into the
+        ``"losses"`` collection during a TRAIN forward (MoE load-balancing
+        losses, models/moe.py; any custom regulariser a user sows) — added
+        to the training loss by _train_step.  Eval applies run without
+        mutable collections, so sown losses drop out there (eval loss stays
+        comparable across MoE/dense models)."""
         variables = {"params": params}
         has_bs = batch_stats is not None
         if has_bs:
@@ -149,12 +157,17 @@ class FlaxEstimator:
         feats = [batch[c] for c in self.feature_cols]
         kw = self._apply_kwargs(train)
         rngs = {"dropout": rng} if (train and rng is not None) else None
-        if train and has_bs:
+        if train:
             out, mut = self.model.apply(
-                variables, *feats, mutable=["batch_stats"], rngs=rngs, **kw)
-            return out, mut["batch_stats"]
+                variables, *feats, mutable=["batch_stats", "losses"],
+                rngs=rngs, **kw)
+            aux = sum((jnp.sum(leaf) for leaf in
+                       jax.tree.leaves(mut.get("losses", {}))),
+                      jnp.float32(0.0))
+            new_bs = mut["batch_stats"] if has_bs else None
+            return out, new_bs, aux
         out = self.model.apply(variables, *feats, rngs=rngs, **kw)
-        return out, batch_stats
+        return out, batch_stats, jnp.float32(0.0)
 
     def _labels(self, batch):
         ys = [batch[c] for c in self.label_cols]
@@ -168,9 +181,9 @@ class FlaxEstimator:
         rng = state.step_rng()
 
         def loss_of(params):
-            preds, new_bs = self._forward(
+            preds, new_bs, aux = self._forward(
                 params, state.batch_stats, batch, rng, train=True)
-            loss = self.loss_fn(preds, self._labels(batch))
+            loss = self.loss_fn(preds, self._labels(batch)) + aux
             if self.param_loss is not None:
                 loss = loss + self.param_loss(params)
             return loss, (preds, new_bs)
@@ -187,7 +200,7 @@ class FlaxEstimator:
     def _eval_step(self, state: ZooTrainState, batch, weights):
         """Masked eval: per-sample losses/metrics via singleton-batch vmap,
         weighted by `weights` (0 for padding rows)."""
-        preds, _ = self._forward(
+        preds, _, _ = self._forward(
             state.params, state.batch_stats, batch, None, train=False)
         labels = self._labels(batch)
 
@@ -211,7 +224,7 @@ class FlaxEstimator:
         return mets
 
     def _predict_step(self, state: ZooTrainState, batch):
-        preds, _ = self._forward(
+        preds, _, _ = self._forward(
             state.params, state.batch_stats, batch, None, train=False)
         return preds
 

@@ -20,6 +20,10 @@ from analytics_zoo_tpu.models.detection import (
 from analytics_zoo_tpu.models.forecast import (
     LSTMNet, TCN, MTNet, Seq2SeqTS)
 from analytics_zoo_tpu.models.rnn import RNNStack
+from analytics_zoo_tpu.models.moe import (
+    MoEMLP, MoETransformerLayer, MoETransformerClassifier,
+    MOE_PARTITION_RULES, MOE_CLASSIFIER_PARTITION_RULES,
+    load_balancing_loss)
 
 __all__ = [
     "NeuralCF", "NCF_PARTITION_RULES",
@@ -36,4 +40,7 @@ __all__ = [
     "decode_detections",
     "LSTMNet", "TCN", "MTNet", "Seq2SeqTS",
     "RNNStack",
+    "MoEMLP", "MoETransformerLayer", "MoETransformerClassifier",
+    "MOE_PARTITION_RULES", "MOE_CLASSIFIER_PARTITION_RULES",
+    "load_balancing_loss",
 ]
