@@ -43,12 +43,14 @@ from analytics_zoo_tpu.parallel.partition import with_sharding_constraint
 
 # Expert weights: stacked expert dim over ep, Megatron tp layout within each
 # expert (up-projection sharded on the output dim, down on the input dim).
-# Compose with BERT_PARTITION_RULES for a full MoE transformer.
+# Patterns match the MoE-unique PARAM names (not the instance path), so the
+# rules apply under any module name, not just name="moe".  Compose with
+# BERT_PARTITION_RULES for a full MoE transformer.
 MOE_PARTITION_RULES = (
-    (r"moe.*/w_up", P("ep", None, "tp")),
-    (r"moe.*/w_down", P("ep", "tp", None)),
-    (r"moe.*/b_up", P("ep", None)),
-    (r"moe.*/b_down", P("ep", None)),
+    (r"w_up$", P("ep", None, "tp")),
+    (r"w_down$", P("ep", "tp", None)),
+    (r"b_up$", P("ep", None)),
+    (r"b_down$", P("ep", None)),
     (r"router/kernel", P()),
 )
 
@@ -120,13 +122,10 @@ class MoEMLP(nn.Module):
         pos = pos_flat.reshape(K, N, X).transpose(1, 0, 2)  # [N, K, X]
         within = (pos < capacity) * assign                  # keep in-capacity
         pos_id = jnp.sum(pos * assign, axis=-1).astype(jnp.int32)   # [N, K]
+        slot_oh = jax.nn.one_hot(pos_id, capacity, dtype=jnp.float32)
         # dispatch [N, X, C]: token n occupies slot pos_id[n,k] of expert
-        dispatch = jnp.einsum(
-            "nkx,nkc->nxc", within,
-            jax.nn.one_hot(pos_id, capacity, dtype=jnp.float32))
-        combine = jnp.einsum("nkx,nk,nkc->nxc", within, gate_vals,
-                             jax.nn.one_hot(pos_id, capacity,
-                                            dtype=jnp.float32))
+        dispatch = jnp.einsum("nkx,nkc->nxc", within, slot_oh)
+        combine = jnp.einsum("nkx,nk,nkc->nxc", within, gate_vals, slot_oh)
 
         # --- expert computation (bf16, ep-sharded) ------------------------
         w_up = self.param("w_up", nn.initializers.lecun_normal(),

@@ -12,6 +12,12 @@ from analytics_zoo_tpu.parallel.partition import (
     state_sharding,
     with_sharding_constraint,
 )
+from analytics_zoo_tpu.parallel.pipeline import (
+    GPipe,
+    pipeline_apply,
+    sequential_apply,
+    pp_stage_rules,
+)
 
 __all__ = [
     "make_mesh",
@@ -24,4 +30,8 @@ __all__ = [
     "data_sharding",
     "state_sharding",
     "with_sharding_constraint",
+    "GPipe",
+    "pipeline_apply",
+    "sequential_apply",
+    "pp_stage_rules",
 ]

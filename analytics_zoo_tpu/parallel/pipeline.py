@@ -1,0 +1,170 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (SPMD GPipe).
+
+No reference counterpart (SURVEY.md §2.3 item 6: the reference is a CPU
+data-parallel stack); like ring attention this is a TPU-native extension
+that makes the mesh's declared ``pp`` axis real.
+
+TPU-first shape of the solution: pipelining is expressed as ONE jitted SPMD
+program, not a runtime scheduler.  Stage parameters are stacked on a leading
+stage dim sharded ``P("pp")``; inside ``shard_map`` each pp rank holds its
+stage's weights, a ``lax.scan`` runs the GPipe tick schedule, and
+activations hop rank→rank over ICI via ``lax.ppermute``.  Every rank
+computes every tick (bubble ticks compute masked garbage) — the standard
+static-SPMD pipeline trade: bubble fraction (S-1)/(M+S-1) for S stages and
+M microbatches.  The whole schedule differentiates through scan/ppermute,
+so the SAME code is forward and backward pipelining; XLA overlaps the
+ppermute hop with the next tick's compute.
+
+Composes with the other axes: batch stays sharded over dp/fsdp (each pp
+rank sees its dp-local batch), and stage-internal tensor parallelism works
+by giving stage weights tp-sharded dims via ``pp_stage_rules``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel.partition import PartitionRules
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def sequential_apply(stage_fn: StageFn, stacked_params: Any,
+                     x: jax.Array) -> jax.Array:
+    """Reference semantics: apply the S stacked stages in order (what the
+    pipeline must equal).  Used on meshes without a pp axis."""
+
+    def body(a, p):
+        return stage_fn(p, a), None
+
+    out, _ = lax.scan(body, x, stacked_params)
+    return out
+
+
+def pipeline_apply(stage_fn: StageFn, stacked_params: Any, x: jax.Array,
+                   mesh: Mesh, n_microbatches: int, *,
+                   batch_axes: Sequence[str] = ("dp", "fsdp"),
+                   pp_axis: str = "pp") -> jax.Array:
+    """Run ``x`` through S pipelined stages; equals ``sequential_apply``.
+
+    stage_fn: ``(one_stage_params, act) -> act`` — shape- and
+    dtype-preserving, per-sample (no cross-batch mixing: microbatching
+    changes what a batch is).
+    stacked_params: pytree with leading dim S on every leaf (S =
+    ``mesh.shape[pp_axis]``), to be sharded ``P("pp")``.
+    x: global batch ``[B, ...]``; each rank splits its local batch into
+    ``gcd(n_microbatches, local_batch)`` microbatches (the knob is
+    perf-only — a non-dividing value degrades the bubble, never errors).
+    """
+    S = int(mesh.shape[pp_axis]) if pp_axis in mesh.axis_names else 1
+    if S == 1:
+        return sequential_apply(stage_fn, stacked_params, x)
+    M = int(n_microbatches)
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    xspec = P(batch, *([None] * (x.ndim - 1)))
+    pspec = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+
+    def ranked(params, xl):
+        idx = lax.axis_index(pp_axis)
+        p_local = jax.tree.map(lambda a: a[0], params)  # [1,...] -> [...]
+        b = xl.shape[0]
+        # n_microbatches is a performance knob, never a correctness
+        # constraint: when it doesn't divide the per-rank batch (e.g. the
+        # Estimator's tiny init batch), fall back to the nearest divisor
+        m_eff = math.gcd(M, b)
+        mb = xl.reshape((m_eff, b // m_eff) + xl.shape[1:])
+        ticks = m_eff + S - 1
+
+        def tick(carry, t):
+            state_in, out_buf = carry
+            inject = lax.dynamic_index_in_dim(
+                mb, jnp.clip(t, 0, m_eff - 1), 0, keepdims=False)
+            cur = jnp.where(idx == 0, inject, state_in)
+            y = stage_fn(p_local, cur)
+            # the last rank finished microbatch t-(S-1) this tick
+            w = t - (S - 1)
+            valid = (idx == S - 1) & (w >= 0)
+            wc = jnp.clip(w, 0, m_eff - 1)
+            slot = lax.dynamic_index_in_dim(out_buf, wc, 0, keepdims=False)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(valid, y, slot), wc, 0)
+            nxt = lax.ppermute(y, pp_axis,
+                               [(i, i + 1) for i in range(S - 1)])
+            return (nxt, out_buf), None
+
+        # Scan carries must be pp-VARYING from tick 0: the loop writes
+        # ppermute/axis_index-derived values into them, and shard_map's
+        # vma type system rejects an invariant->varying carry (same
+        # constraint ring_attention.py works around).  lax.pvary marks
+        # the zeros as device-varying without computing anything.
+        def vary(z):
+            try:
+                return lax.pcast(z, pp_axis, to="varying")
+            except (AttributeError, TypeError):
+                return z + (idx * 0).astype(z.dtype)
+        carry = (vary(jnp.zeros_like(mb[0])), vary(jnp.zeros_like(mb)))
+        (_, out_buf), _ = lax.scan(tick, carry, jnp.arange(ticks))
+        # outputs live on the last rank only; psum broadcasts them so the
+        # result is pp-invariant (loss/metrics compute identically on all
+        # ranks — same contract as data parallelism)
+        out = lax.psum(jnp.where(idx == S - 1, out_buf, 0.0), pp_axis)
+        return out.reshape(xl.shape).astype(xl.dtype)
+
+    return jax.shard_map(ranked, mesh=mesh, in_specs=(pspec, xspec),
+                         out_specs=xspec)(stacked_params, x)
+
+
+def pp_stage_rules(inner: PartitionRules = ()) -> PartitionRules:
+    """Partition rules for GPipe's stacked stage params: prepend the stage
+    dim ``"pp"`` to each stage-internal rule, then shard everything else's
+    stage dim.  ``inner`` patterns should be stage-scoped (they are matched
+    against paths under ``stages/``)."""
+    out = [(pat, P("pp", *tuple(spec))) for (pat, spec) in inner]
+    out.append((r"stages/", P("pp")))
+    return tuple(out)
+
+
+class GPipe(nn.Module):
+    """Flax wrapper: S copies of a stage module run as a pipeline.
+
+    ``stage`` is a template module whose ``__call__(x)`` is shape- and
+    dtype-preserving and per-sample (Dense/LayerNorm/attention fine;
+    BatchNorm or dropout belong outside the pipelined trunk — stages run
+    without rng/mutable plumbing).  Params are created stacked ``[S, ...]``
+    (path prefix ``stages/``) so ``pp_stage_rules`` shards them; on meshes
+    without pp > 1 the stages run sequentially — same math, one device.
+    """
+
+    stage: nn.Module
+    n_stages: int
+    n_microbatches: int = 4
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x):
+        template = self.stage.clone(parent=None)
+
+        def init_stacked(rng) -> Any:
+            keys = jax.random.split(rng, self.n_stages)
+            probe = x[:1]
+            return jax.vmap(
+                lambda k: template.init(k, probe)["params"])(keys)
+
+        params = self.param("stages", init_stacked)
+
+        def fn(p, a):
+            return template.apply({"params": p}, a)
+
+        if self.mesh is not None and \
+                self.mesh.shape.get("pp", 1) == self.n_stages and \
+                self.n_stages > 1:
+            return pipeline_apply(fn, params, x, self.mesh,
+                                  self.n_microbatches)
+        return sequential_apply(fn, params, x)

@@ -101,10 +101,9 @@ def test_estimator_collects_losses_collection(ctx8):
     assert train_loss == pytest.approx(eval_loss + 3.0, abs=1e-3)
 
 
-def test_ep_sharded_matches_single_device(ctx8):
+def test_ep_sharded_matches_single_device():
     """dp=2 x ep=2 x tp=2 sharded apply == unsharded apply (the mesh only
     changes layout constraints, never the math)."""
-    from analytics_zoo_tpu.common.context import OrcaContext
     from analytics_zoo_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(axes={"dp": 2, "ep": 2, "tp": 2})
