@@ -65,6 +65,10 @@ class TrainConfig:
     dtype: str = "bfloat16"  # compute dtype on the MXU
     param_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the model apply
+    # split each global batch into N sequentially-scanned microbatches and
+    # apply ONE averaged-gradient update — same math as the full batch (for
+    # mean losses) at 1/N the activation memory
+    accum_steps: int = 1
     donate_state: bool = True
     # observability (SURVEY §5: TrainSummary/TensorBoard + jsonl analogs)
     tensorboard_dir: Optional[str] = None

@@ -40,9 +40,11 @@ from analytics_zoo_tpu.learn.metrics import (
 from analytics_zoo_tpu.learn.objectives import get_loss
 from analytics_zoo_tpu.learn.train_state import ZooTrainState, create_train_state
 from analytics_zoo_tpu.learn.triggers import EveryEpoch, Trigger
-from analytics_zoo_tpu.parallel.mesh import make_mesh
+from analytics_zoo_tpu.parallel.mesh import batch_axes, make_mesh
 from analytics_zoo_tpu.parallel.partition import (
-    DP_RULES, PartitionRules, data_sharding, state_sharding)
+    DP_RULES, PartitionRules, data_sharding, state_sharding,
+    with_sharding_constraint)
+from jax.sharding import PartitionSpec as P
 
 
 def _model_accepts(model, kwarg: str) -> bool:
@@ -178,6 +180,9 @@ class FlaxEstimator:
     # ------------------------------------------------------------------
 
     def _train_step(self, state: ZooTrainState, batch):
+        accum = int(getattr(self.config, "accum_steps", 1) or 1)
+        if accum > 1:
+            return self._train_step_accum(state, batch, accum)
         rng = state.step_rng()
 
         def loss_of(params):
@@ -192,6 +197,62 @@ class FlaxEstimator:
             loss_of, has_aux=True)(state.params)
         new_state = state.apply_gradients(grads=grads, batch_stats=new_bs)
         mets = {"loss": loss}
+        labels = self._labels(batch)
+        for name, fn in self.metric_fns:
+            mets[name] = fn(preds, labels)
+        return new_state, mets
+
+    def _train_step_accum(self, state: ZooTrainState, batch, accum: int):
+        """Gradient accumulation: the global batch is split into `accum`
+        microbatches scanned sequentially; averaged grads feed ONE optimizer
+        update, so the math equals the full-batch step (for mean-reduced
+        losses) at 1/accum the activation memory.  The reference has no
+        counterpart (its effective batch scaled with executor count,
+        SURVEY.md §2.3); on TPU this is how a big global batch fits HBM —
+        remat trades FLOPs for memory, accumulation trades steps for it."""
+        rng = state.step_rng()
+        baxes = batch_axes(self.mesh) or None
+
+        def split(v):
+            b = v.shape[0]
+            if b % accum:
+                raise ValueError(
+                    f"global batch {b} not divisible by "
+                    f"accum_steps={accum}")
+            mb = v.reshape((accum, b // accum) + v.shape[1:])
+            # keep microbatch rows sharded over the dp-like axes
+            return with_sharding_constraint(mb, P(None, baxes))
+
+        mbs = {k: split(v) for k, v in batch.items()}
+
+        def loss_of(params, mb, bs, r):
+            preds, new_bs, aux = self._forward(params, bs, mb, r,
+                                               train=True)
+            loss = self.loss_fn(preds, self._labels(mb)) + aux
+            if self.param_loss is not None:
+                loss = loss + self.param_loss(params)
+            return loss, (preds, new_bs)
+
+        def body(carry, xs):
+            g_acc, loss_acc, bs = carry
+            mb, i = xs
+            (loss, (preds, new_bs)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(
+                state.params, mb, bs, jax.random.fold_in(rng, i))
+            g_acc = jax.tree.map(jnp.add, g_acc, grads)
+            return (g_acc, loss_acc + loss, new_bs), preds
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        (g_acc, loss_sum, bs_final), preds = jax.lax.scan(
+            body, (zeros, jnp.float32(0.0), state.batch_stats),
+            (mbs, jnp.arange(accum)))
+        grads = jax.tree.map(lambda g: g / accum, g_acc)
+        new_state = state.apply_gradients(grads=grads,
+                                          batch_stats=bs_final)
+        # models may return pytree predictions (e.g. SSD's (locs, cls))
+        preds = jax.tree.map(
+            lambda p: p.reshape((-1,) + p.shape[2:]), preds)
+        mets = {"loss": loss_sum / accum}
         labels = self._labels(batch)
         for name, fn in self.metric_fns:
             mets[name] = fn(preds, labels)
@@ -241,6 +302,13 @@ class FlaxEstimator:
             self._jit_predict_step = None
 
     def _build_jits(self):
+        # accum_steps is baked into the train-step trace: a config change
+        # after the first fit must invalidate the cached jit (same
+        # requirement _set_cols documents for column names)
+        accum = int(getattr(self.config, "accum_steps", 1) or 1)
+        if self._jit_train_step is not None and \
+                getattr(self, "_jit_accum", accum) != accum:
+            self._jit_train_step = None
         if self._jit_train_step is None:
             donate = self.config.donate_state and not self.config.debug_nans
             self._jit_train_step = jax.jit(
@@ -249,6 +317,7 @@ class FlaxEstimator:
                 out_shardings=(self._state_sharding, None))
             self._jit_eval_step = jax.jit(self._eval_step)
             self._jit_predict_step = jax.jit(self._predict_step)
+            self._jit_accum = accum
 
     # ------------------------------------------------------------------
     # state init
