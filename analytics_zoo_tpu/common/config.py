@@ -69,6 +69,10 @@ class TrainConfig:
     # apply ONE averaged-gradient update — same math as the full batch (for
     # mean losses) at 1/N the activation memory
     accum_steps: int = 1
+    # ship each training batch as ONE packed uint8 buffer (one device_put
+    # per step instead of one per column) with on-device bitcast unpack;
+    # bitwise-identical data, k fixed transfer costs collapsed into one
+    pack_transfer: bool = True
     donate_state: bool = True
     # observability (SURVEY §5: TrainSummary/TensorBoard + jsonl analogs)
     tensorboard_dir: Optional[str] = None

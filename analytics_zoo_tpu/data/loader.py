@@ -14,6 +14,7 @@ analog of FeatureSet's memory tiers).
 
 from __future__ import annotations
 
+from functools import lru_cache as _functools_cache
 from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 
 import jax
@@ -78,19 +79,91 @@ def shards_to_iterator(shards: XShards, per_host_batch: int,
 
 
 def make_global_batch(mesh: Mesh, batch: Dict[str, np.ndarray],
-                      sharding: Optional[NamedSharding] = None
-                      ) -> Dict[str, jax.Array]:
-    """Host-local batch dict -> globally-sharded jax.Array dict."""
+                      sharding: Optional[NamedSharding] = None,
+                      pack: bool = False) -> Dict[str, jax.Array]:
+    """Host-local batch dict -> globally-sharded jax.Array dict.
+
+    ``pack=True`` ships the whole batch as ONE row-major uint8 buffer
+    (one ``device_put``/assembly instead of one per column) and unpacks
+    on-device via slice + bitcast.  Each transfer has a fixed dispatch
+    cost — per-call runtime overhead, and a full round-trip latency on
+    tunneled devices — so for many-column batches (recommenders: user,
+    item, label, ...) packing collapses k fixed costs into one.  The
+    pack itself is a single host memcpy at DRAM bandwidth.
+    """
     sh = sharding or data_sharding(mesh)
+    if pack:
+        packed = _pack_rows(batch)
+        if packed is not None:
+            buf, spec = packed
+            if jax.process_count() == 1:
+                gbuf = jax.device_put(buf, sh)
+            else:
+                gbuf = jax.make_array_from_process_local_data(sh, buf)
+            return _unpacker(spec)(gbuf)
     if jax.process_count() == 1:
         return {k: jax.device_put(v, sh) for k, v in batch.items()}
     return {k: jax.make_array_from_process_local_data(sh, v)
             for k, v in batch.items()}
 
 
+def _pack_rows(batch: Dict[str, np.ndarray]):
+    """Pack columns (all sharing leading dim B) into a [B, total_row_bytes]
+    uint8 buffer + a static spec for on-device unpacking.  Returns None if
+    the batch can't be packed (mismatched leading dims)."""
+    cols = []
+    spec = []
+    B = None
+    for k, v in batch.items():
+        v = np.asarray(v)
+        # match device_put semantics under disabled x64: 64-bit dtypes
+        # canonicalize to their 32-bit counterparts BEFORE byte-packing
+        canon = jax.dtypes.canonicalize_dtype(v.dtype)
+        v = np.ascontiguousarray(v, dtype=canon)
+        if B is None:
+            B = v.shape[0]
+        if v.ndim == 0 or v.shape[0] != B:
+            return None
+        rows = v.view(np.uint8).reshape(B, -1)
+        spec.append((k, v.shape, v.dtype.str, rows.shape[1]))
+        cols.append(rows)
+    if not cols:
+        return None
+    return np.concatenate(cols, axis=1), tuple(spec)
+
+
+@_functools_cache
+def _unpacker(spec):
+    """Jitted on-device unpack for a packed-row buffer: per column, slice
+    its byte range and bitcast back to the original dtype/shape.  Row
+    sharding (dp over dim 0) propagates through — no reshard."""
+    from jax import lax
+
+    def unpack(buf):
+        out = {}
+        off = 0
+        for name, shape, dtypestr, rowbytes in spec:
+            dt = np.dtype(dtypestr)
+            sl = lax.slice_in_dim(buf, off, off + rowbytes, axis=1)
+            off += rowbytes
+            if dt == np.bool_:
+                arr = sl.reshape(shape) != 0
+            elif dt.itemsize == 1:
+                arr = lax.bitcast_convert_type(sl, dt).reshape(shape)
+            else:
+                arr = lax.bitcast_convert_type(
+                    sl.reshape(shape[0], -1, dt.itemsize), dt)
+                arr = arr.reshape(shape)
+            out[name] = arr
+        return out
+
+    return jax.jit(unpack)
+
+
 def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], mesh: Mesh, *,
                     depth: int = 2,
-                    sharding: Optional[NamedSharding] = None
+                    sharding: Optional[NamedSharding] = None,
+                    pack: bool = False
                     ) -> Iterator[Dict[str, jax.Array]]:
     """Overlap H2D transfer with compute: keep `depth` batches in flight,
     staged by a background thread.
@@ -116,7 +189,7 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], mesh: Mesh, *,
             for b in batches:
                 if stop.is_set():
                     return
-                q.put(make_global_batch(mesh, b, sh))
+                q.put(make_global_batch(mesh, b, sh, pack=pack))
             q.put(_END)
         except BaseException as e:  # surface reader errors to the consumer
             q.put(e)

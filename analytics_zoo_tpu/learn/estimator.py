@@ -308,16 +308,17 @@ class FlaxEstimator:
         accum = int(getattr(self.config, "accum_steps", 1) or 1)
         if self._jit_train_step is not None and \
                 getattr(self, "_jit_accum", accum) != accum:
-            self._jit_train_step = None
+            self._jit_train_step = None   # eval/predict don't see accum
         if self._jit_train_step is None:
             donate = self.config.donate_state and not self.config.debug_nans
             self._jit_train_step = jax.jit(
                 self._train_step,
                 donate_argnums=(0,) if donate else (),
                 out_shardings=(self._state_sharding, None))
+            self._jit_accum = accum
+        if self._jit_eval_step is None:
             self._jit_eval_step = jax.jit(self._eval_step)
             self._jit_predict_step = jax.jit(self._predict_step)
-            self._jit_accum = accum
 
     # ------------------------------------------------------------------
     # state init
@@ -518,8 +519,10 @@ class FlaxEstimator:
             t0 = time.perf_counter()
             n_steps = 0
             step_mets: List[Dict[str, jax.Array]] = []
-            for gbatch in device_prefetch(it.epoch_batches(), self.mesh,
-                                          sharding=self._data_sharding):
+            for gbatch in device_prefetch(
+                    it.epoch_batches(), self.mesh,
+                    sharding=self._data_sharding,
+                    pack=bool(getattr(self.config, "pack_transfer", True))):
                 # Hot loop: never block on device values here — metrics stay
                 # on-device (async dispatch continues); host sync happens
                 # only at log points and epoch end.

@@ -5,7 +5,8 @@ classification, transformer/BERT, plus the forecasting nets Zouwu wraps."""
 from analytics_zoo_tpu.models.ncf import NeuralCF, NCF_PARTITION_RULES
 from analytics_zoo_tpu.models.transformer import (
     BERT, BERTForSequenceClassification, BERTForQuestionAnswering,
-    TransformerLayer, MultiHeadAttention, BERT_PARTITION_RULES, qa_loss)
+    TransformerLayer, MultiHeadAttention, BERT_PARTITION_RULES,
+    BERT_MOE_PARTITION_RULES, qa_loss)
 from analytics_zoo_tpu.models.recommendation import (
     ColumnFeatureInfo, WideAndDeep, SessionRecommender, DIEN,
     WND_PARTITION_RULES)
@@ -29,6 +30,7 @@ __all__ = [
     "NeuralCF", "NCF_PARTITION_RULES",
     "BERT", "BERTForSequenceClassification", "BERTForQuestionAnswering",
     "TransformerLayer", "MultiHeadAttention", "BERT_PARTITION_RULES",
+    "BERT_MOE_PARTITION_RULES",
     "qa_loss",
     "ColumnFeatureInfo", "WideAndDeep", "SessionRecommender", "DIEN",
     "WND_PARTITION_RULES",

@@ -42,6 +42,14 @@ BERT_PARTITION_RULES = (
 )
 
 
+# MoE-BERT (moe_experts > 0): expert weights over ep(+tp), attention and
+# dense layers Megatron-tp as above.  moe.py imports transformer only
+# inside a method, so this top-level import cannot cycle.
+from analytics_zoo_tpu.models.moe import MOE_PARTITION_RULES as _MOE_RULES
+
+BERT_MOE_PARTITION_RULES = _MOE_RULES + BERT_PARTITION_RULES
+
+
 def _constrain_seq(x, mesh: Optional[Mesh]):
     """hidden states: [B, T, E] -> shard B over dp(+fsdp), T over sp."""
     if mesh is None:
@@ -104,7 +112,11 @@ class MultiHeadAttention(nn.Module):
 
 
 class TransformerLayer(nn.Module):
-    """ref-parity: Keras-API TransformerLayer (post-LN encoder block)."""
+    """ref-parity: Keras-API TransformerLayer (post-LN encoder block).
+
+    ``num_experts > 0`` swaps the dense FFN for an expert-parallel MoE
+    block (models/moe.py) — a TPU-native extension with no reference
+    counterpart; the residual connection carries capacity-dropped tokens."""
 
     hidden_size: int
     num_heads: int
@@ -113,6 +125,9 @@ class TransformerLayer(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, kv_mask=None, train: bool = False):
@@ -124,10 +139,20 @@ class TransformerLayer(nn.Module):
         a = nn.Dropout(self.dropout, deterministic=not train)(a)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + a)
         x = _constrain_seq(x, self.mesh)
-        h = nn.Dense(self.intermediate_size, dtype=self.dtype,
-                     name="ffn_up")(x)
-        h = nn.gelu(h)
-        h = nn.Dense(self.hidden_size, dtype=self.dtype, name="ffn_down")(h)
+        if self.num_experts > 0:
+            from analytics_zoo_tpu.models.moe import MoEMLP
+
+            h = MoEMLP(self.num_experts, self.intermediate_size,
+                       top_k=self.moe_top_k,
+                       capacity_factor=self.moe_capacity_factor,
+                       dtype=self.dtype, mesh=self.mesh,
+                       name="moe")(x, train)
+        else:
+            h = nn.Dense(self.intermediate_size, dtype=self.dtype,
+                         name="ffn_up")(x)
+            h = nn.gelu(h)
+            h = nn.Dense(self.hidden_size, dtype=self.dtype,
+                         name="ffn_down")(h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")(x + h)
         return _constrain_seq(x, self.mesh)
@@ -148,6 +173,11 @@ class BERT(nn.Module):
     mesh: Optional[Mesh] = None
     remat: bool = False
     use_flash: Optional[bool] = None
+    # MoE-BERT: every `moe_every`-th layer gets an expert-parallel MoE FFN
+    # (interleaved dense/MoE, the standard sparse-transformer layout)
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
@@ -169,10 +199,14 @@ class BERT(nn.Module):
         if self.remat:
             layer_cls = nn.remat(TransformerLayer, static_argnums=(3,))
         for i in range(self.num_layers):
+            moe = self.moe_experts if (
+                self.moe_experts > 0 and
+                (i + 1) % max(1, self.moe_every) == 0) else 0
             x = layer_cls(self.hidden_size, self.num_heads,
                           self.intermediate_size, self.dropout,
                           dtype=self.dtype, mesh=self.mesh,
                           use_flash=self.use_flash,
+                          num_experts=moe, moe_top_k=self.moe_top_k,
                           name=f"layer_{i}")(x, kv_mask, train)
         pooled = nn.tanh(nn.Dense(self.hidden_size, dtype=jnp.float32,
                                   name="pooler")(x[:, 0].astype(jnp.float32)))

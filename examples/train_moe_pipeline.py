@@ -1,0 +1,109 @@
+"""Example: 5-axis parallel training — MoE-BERT on a dp x ep x tp mesh,
+then a GPipe-pipelined trunk on a pp x dp mesh.
+
+Runs anywhere: on a single chip the axes collapse to size 1 (same code);
+pass --devices N to force an N-device virtual CPU mesh and see the real
+collectives compile.  This is the capability the reference never had
+(SURVEY.md §2.3 item 6: no TP/PP/SP/EP upstream) — on this framework a
+parallelism strategy is a mesh shape plus partition rules.
+
+    python examples/train_moe_pipeline.py --devices 8
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="force an N-device virtual CPU mesh (0 = real)")
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    if args.devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={args.devices}")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models import (
+        BERT, BERTForSequenceClassification, BERT_MOE_PARTITION_RULES)
+    from analytics_zoo_tpu.parallel import GPipe, pp_stage_rules
+
+    n = len(jax.devices())
+
+    # ---- phase 1: MoE-BERT, experts sharded over ep, attention over tp ----
+    axes = {"dp": -1, "ep": 2 if n % 2 == 0 else 1,
+            "tp": 2 if n % 4 == 0 else 1}
+    ctx = zoo.init_orca_context("local", mesh_axes=axes)
+    print(f"[moe] mesh: {dict(ctx.mesh.shape)}")
+    rng = np.random.default_rng(0)
+    n_rows, seq, vocab = 512, 16, 512
+    data = {
+        "input_ids": rng.integers(0, vocab, (n_rows, seq)).astype(np.int32),
+        "label": rng.integers(0, 2, n_rows).astype(np.int32),
+    }
+    model = BERTForSequenceClassification(
+        num_classes=2,
+        bert=BERT(vocab_size=vocab, hidden_size=64, num_layers=2,
+                  num_heads=4, intermediate_size=128, max_position=seq,
+                  mesh=ctx.mesh, moe_experts=4, moe_every=1))
+    est = Estimator.from_flax(
+        model=model, loss="sparse_categorical_crossentropy",
+        optimizer=optax.adamw(1e-3), metrics=("accuracy",),
+        feature_cols=("input_ids",), label_cols=("label",),
+        partition_rules=BERT_MOE_PARTITION_RULES)
+    hist = est.fit(data, epochs=args.epochs, batch_size=128)
+    print(f"[moe] final: {hist[-1]}")
+    zoo.stop_orca_context()
+
+    # ---- phase 2: GPipe trunk over pp ------------------------------------
+    axes = {"pp": 2 if n % 2 == 0 else 1, "dp": -1}
+    ctx = zoo.init_orca_context("local", mesh_axes=axes)
+    print(f"[pipe] mesh: {dict(ctx.mesh.shape)}")
+
+    class Stage(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.gelu(nn.Dense(128, name="up")(x))
+            return nn.LayerNorm(name="ln")(x + nn.Dense(64, name="down")(h))
+
+    mesh = ctx.mesh
+
+    class PipedNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(64, name="embed")(x)
+            x = GPipe(stage=Stage(), n_stages=max(2, mesh.shape["pp"]),
+                      n_microbatches=4, mesh=mesh, name="trunk")(x)
+            return nn.Dense(2, name="head")(x)
+
+    xs = rng.normal(size=(512, 32)).astype(np.float32)
+    ys = (xs.sum(-1) > 0).astype(np.int32)
+    est = Estimator.from_flax(
+        model=PipedNet(), loss="sparse_categorical_crossentropy",
+        optimizer=optax.adam(3e-3), metrics=("accuracy",),
+        feature_cols=("x",), label_cols=("y",),
+        partition_rules=pp_stage_rules() + ((r".*", P()),))
+    hist = est.fit({"x": xs, "y": ys}, epochs=args.epochs, batch_size=128)
+    print(f"[pipe] final: {hist[-1]}")
+    zoo.stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

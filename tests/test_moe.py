@@ -121,6 +121,47 @@ def test_ep_sharded_matches_single_device():
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
 
 
+def test_moe_bert_trains_ep_sharded():
+    """MoE-BERT (moe_experts>0): interleaved dense/MoE layers train one
+    step on a dp x ep x tp mesh; expert weights ep-sharded; per-layer aux
+    losses accumulate through the losses collection."""
+    import optax
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models import (
+        BERT, BERTForSequenceClassification, BERT_MOE_PARTITION_RULES)
+
+    init_orca_context("local", mesh_axes={"dp": 2, "ep": 2, "tp": 2})
+    try:
+        from analytics_zoo_tpu.common.context import OrcaContext
+
+        mesh = OrcaContext.get_context().mesh
+        model = BERTForSequenceClassification(
+            num_classes=2,
+            bert=BERT(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=2, intermediate_size=64, max_position=16,
+                      dtype=jnp.float32, mesh=mesh,
+                      moe_experts=4, moe_every=1, moe_top_k=2))
+        est = Estimator.from_flax(
+            model=model, loss="sparse_categorical_crossentropy",
+            optimizer=optax.adam(1e-3), feature_cols=("input_ids",),
+            label_cols=("label",),
+            partition_rules=BERT_MOE_PARTITION_RULES)
+        rng = np.random.default_rng(0)
+        data = {"input_ids": rng.integers(0, 64, (64, 8)).astype(np.int32),
+                "label": rng.integers(0, 2, 64).astype(np.int32)}
+        hist = est.fit(data, epochs=2, batch_size=32)
+        assert np.isfinite(hist[-1]["loss"])
+        w_up = est.state.params["bert"]["layer_0"]["moe"]["w_up"]
+        assert w_up.sharding.spec and w_up.sharding.spec[0] == "ep", \
+            w_up.sharding.spec
+        # both MoE layers exist (moe_every=1)
+        assert "moe" in est.state.params["bert"]["layer_1"]
+    finally:
+        stop_orca_context()
+
+
 def test_moe_classifier_trains_ep_sharded():
     """e2e: MoE transformer classifier through Estimator.fit on a
     dp=2 x ep=2 x tp=2 mesh — loss decreases on a learnable rule."""
