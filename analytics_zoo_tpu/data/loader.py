@@ -100,6 +100,12 @@ def make_global_batch(mesh: Mesh, batch: Dict[str, np.ndarray],
                 gbuf = jax.device_put(buf, sh)
             else:
                 gbuf = jax.make_array_from_process_local_data(sh, buf)
+            if gbuf.shape[0] != buf.shape[0]:
+                # multihost: the assembled array holds GLOBAL rows (local
+                # x data-shard groups); globalise the spec's leading dims
+                spec = tuple(
+                    (k, (gbuf.shape[0],) + shape[1:], dt, rb)
+                    for (k, shape, dt, rb) in spec)
             return _unpacker(spec)(gbuf)
     if jax.process_count() == 1:
         return {k: jax.device_put(v, sh) for k, v in batch.items()}

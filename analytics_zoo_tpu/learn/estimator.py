@@ -42,8 +42,8 @@ from analytics_zoo_tpu.learn.train_state import ZooTrainState, create_train_stat
 from analytics_zoo_tpu.learn.triggers import EveryEpoch, Trigger
 from analytics_zoo_tpu.parallel.mesh import batch_axes, make_mesh
 from analytics_zoo_tpu.parallel.partition import (
-    DP_RULES, PartitionRules, data_sharding, state_sharding,
-    with_sharding_constraint)
+    DP_RULES, PartitionRules, data_process_groups, data_sharding,
+    state_sharding, with_sharding_constraint)
 from jax.sharding import PartitionSpec as P
 
 
@@ -107,6 +107,12 @@ class FlaxEstimator:
         self.state: Optional[ZooTrainState] = None
         self._state_sharding = None
         self._data_sharding = data_sharding(self.mesh)
+        # (n_groups, my_group, group_of_process): how the process boundary
+        # lies relative to the batch axes.  dp across hosts -> one data
+        # shard per process; a pp/ep/tp-only boundary -> processes are
+        # batch REPLICAS and must feed identical rows (see
+        # parallel.partition.data_process_groups).
+        self._data_groups = data_process_groups(self._data_sharding)
         self._takes_train = _model_accepts(model, "train")
         self._takes_det = _model_accepts(model, "deterministic")
         self._jit_train_step = None
@@ -421,20 +427,26 @@ class FlaxEstimator:
             validation_data = getattr(data, "val", None)
         self._set_cols(feature_cols, label_cols)
         n_hosts = jax.process_count()
-        if batch_size < 1 or batch_size % n_hosts:
+        n_groups, my_group, _ = self._data_groups
+        if batch_size < 1 or batch_size % n_groups:
             raise ValueError(f"global batch {batch_size} must be positive "
-                             f"and divisible by host count {n_hosts}")
-        per_host = batch_size // n_hosts
+                             f"and divisible by data-shard group count "
+                             f"{n_groups}")
+        # rows each PROCESS contributes per step: one data shard per
+        # GROUP; group-mates (processes replicated along the batch dim,
+        # e.g. across a pp boundary) feed identical rows
+        per_host = batch_size // n_groups
         shuffle = not self.config.deterministic
         from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
         is_disk = isinstance(data, DiskFeatureSet)
+        self._check_host_local_source(data)
         if is_disk:
             # DISK tier streams through the native prefetch thread.  Each
             # host streams its OWN shard file (host-local data, like
             # XShards).
             n_local = len(data)
         else:
-            arrays = _host_local(data)
+            arrays = _host_local(data, self._data_groups)
             n_local = len(next(iter(arrays.values())))
         min_steps = None
         if n_hosts > 1:
@@ -470,12 +482,12 @@ class FlaxEstimator:
             self._ensure_state(data.sample_block())
             it = data.batch_iterator(
                 per_host, shuffle=shuffle,
-                seed=self.config.seed + jax.process_index())
+                seed=self.config.seed + my_group)
         else:
             self._ensure_state(arrays)
             it = NumpyBatchIterator(
                 arrays, per_host, shuffle=shuffle, drop_remainder=True,
-                seed=self.config.seed + jax.process_index())
+                seed=self.config.seed + my_group)
         if min_steps is not None and min_steps < it.steps_per_epoch():
             it = _StepLimitIterator(it, min_steps)
         self._build_jits()
@@ -588,6 +600,27 @@ class FlaxEstimator:
             history.append(stats)
         return history
 
+    def _check_host_local_source(self, data):
+        """Host-local sources (DiskFeatureSet/XShards) hold DISJOINT rows
+        per process; on a mesh whose process boundary is NOT along the
+        batch axes (batch-replica groups), those rows cannot satisfy the
+        required replication — raise instead of feeding inconsistent
+        global arrays.  Applies to fit, evaluate and predict alike."""
+        from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
+        from analytics_zoo_tpu.data.shards import XShards
+
+        n_groups = self._data_groups[0]
+        n_hosts = jax.process_count()
+        if n_groups != n_hosts and isinstance(
+                data, (DiskFeatureSet, XShards)):
+            raise ValueError(
+                "host-local data sources (DiskFeatureSet/XShards) hold "
+                "DISJOINT rows per process, but this mesh's process "
+                f"boundary makes {n_hosts} processes form {n_groups} "
+                "batch-replica group(s) that must feed identical rows. "
+                "Feed replicated in-memory arrays, or lay the mesh out "
+                "with the batch (dp/fsdp) axes across processes")
+
     def _local_n(self, data):
         """Host-local row count WITHOUT touching any records (safe to call
         before the multihost alignment collective even on an empty shard).
@@ -597,7 +630,7 @@ class FlaxEstimator:
 
         if isinstance(data, DiskFeatureSet):
             return len(data), None
-        arrays = _host_local(data)
+        arrays = _host_local(data, self._data_groups)
         return len(next(iter(arrays.values()))), arrays
 
     def _local_eval_stream(self, data, per_host, arrays=None):
@@ -611,7 +644,7 @@ class FlaxEstimator:
             return data.batches(per_host, shuffle=False,
                                 drop_remainder=False)
         if arrays is None:
-            arrays = _host_local(data)
+            arrays = _host_local(data, self._data_groups)
         n = len(next(iter(arrays.values())))
 
         def gen():
@@ -649,7 +682,16 @@ class FlaxEstimator:
 
         per_host_sizes = [sizes(int(c)) for c in counts]
         n_chunks = max(len(s) for s in per_host_sizes)
-        gcounts = [sum(s[j] for s in per_host_sizes if j < len(s))
+        # global row totals must count each DATA-SHARD GROUP once: batch
+        # replica processes (e.g. across a pp boundary) hold the same rows,
+        # so sum over one representative process per group
+        _, _, gop = self._data_groups
+        reps = {}
+        for p in range(len(per_host_sizes)):
+            g = gop[p] if gop and p < len(gop) else p
+            reps.setdefault(g, p)
+        rep_sizes = [per_host_sizes[p] for p in sorted(reps.values())]
+        gcounts = [sum(s[j] for s in rep_sizes if j < len(s))
                    for j in range(n_chunks)]
         return n_chunks, gcounts
 
@@ -658,14 +700,14 @@ class FlaxEstimator:
 
         if isinstance(data, DiskFeatureSet):
             return data.sample_block()
-        return _host_local(data)
+        return _host_local(data, self._data_groups)
 
     def evaluate(self, data, batch_size: Optional[int] = None,
                  feature_cols=None, label_cols=None) -> Dict[str, float]:
         batch_size = _resolve_batch(batch_size, data, "batch_per_thread")
         self._set_cols(feature_cols, label_cols)
-        n_hosts = jax.process_count()
-        per_host = max(1, batch_size // n_hosts)
+        per_host = max(1, batch_size // self._data_groups[0])
+        self._check_host_local_source(data)
         # multihost alignment FIRST — before any record access, so a bad
         # host raises everywhere instead of deadlocking peers (see fit)
         n_local, arrays = self._local_n(data)
@@ -699,8 +741,8 @@ class FlaxEstimator:
                 feature_cols=None) -> np.ndarray:
         batch_size = _resolve_batch(batch_size, data, "batch_per_thread")
         self._set_cols(feature_cols, None)
-        n_hosts = jax.process_count()
-        per_host = max(1, batch_size // n_hosts)
+        per_host = max(1, batch_size // self._data_groups[0])
+        self._check_host_local_source(data)
         # multihost alignment FIRST — before any record access (see fit)
         n_local, arrays = self._local_n(data)
         plan = self._chunk_plan(n_local, per_host)
@@ -711,7 +753,7 @@ class FlaxEstimator:
         self._ensure_state(sample)
         self._build_jits()
         outs, window = [], []
-        single_host = n_hosts == 1
+        single_host = jax.process_count() == 1
         stream = self._local_eval_stream(data, per_host, arrays)
         for chunk in _padded_chunks(stream, plan and plan[0], sample):
             chunk = {k: v for k, v in chunk.items()
@@ -943,26 +985,30 @@ def _padded_chunks(stream, n_chunks, sample):
             j += 1
 
 
-def _host_local(data) -> Dict[str, np.ndarray]:
+def _host_local(data, groups=None) -> Dict[str, np.ndarray]:
     """Normalise `data` to this host's local rows.
 
     XShards are already host-disjoint (readers slice files per host);
     in-memory dicts/tuples are assumed REPLICATED across hosts (the natural
-    way users pass ndarrays) and are row-sliced per host here — otherwise
-    every host would feed identical rows into the global batch, silently
-    training on num_hosts duplicates.  Row counts are truncated to the
-    minimum across hosts so every host runs the same step count (collective
-    programs must agree)."""
+    way users pass ndarrays) and are row-sliced per DATA-SHARD GROUP here
+    (`groups` = estimator._data_groups) — otherwise every host would feed
+    identical rows into the global batch, silently training on duplicates.
+    Group-mates (processes that are batch replicas, e.g. across a pp-only
+    process boundary) intentionally keep identical rows.  Row counts
+    truncate to the per-group share so every host runs the same step count
+    (collective programs must agree)."""
     from analytics_zoo_tpu.data.shards import XShards
 
     arrays = DataCreator.to_arrays(data)
-    pc, pi = jax.process_count(), jax.process_index()
-    if pc == 1 or isinstance(data, XShards):
+    ngroups, gi, _ = groups or (jax.process_count(), jax.process_index(),
+                                None)
+    if jax.process_count() == 1 or ngroups == 1 or \
+            isinstance(data, XShards):
         return arrays
     n = len(next(iter(arrays.values())))
-    per_host = n // pc
-    lo = pi * per_host
-    return {k: v[lo:lo + per_host] for k, v in arrays.items()}
+    per_group = n // ngroups
+    lo = gi * per_group
+    return {k: v[lo:lo + per_group] for k, v in arrays.items()}
 
 
 def _pad_batch(batch: Dict[str, np.ndarray], to: int):

@@ -118,6 +118,46 @@ def state_sharding(mesh: Mesh, state: Any,
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def data_process_groups(sharding: NamedSharding):
+    """Group processes by their row coverage under the batch sharding.
+
+    The batch dim is sharded over the dp-like mesh axes; whether two
+    PROCESSES hold the same or disjoint rows depends on how those axes lie
+    relative to the process boundary.  dp across hosts (the classic layout)
+    -> every process owns a distinct row block and hosts feed disjoint
+    data; a pp/ep/tp-only process boundary -> every process owns ALL row
+    blocks and hosts must feed IDENTICAL (replicated) data.  Mixed layouts
+    (4 hosts over dp=2 x pp=2) give groups of replica processes.
+
+    Returns ``(n_groups, my_group, group_of_process)`` where
+    ``group_of_process[p]`` is the group id of process p, groups ordered by
+    first owned row block.  Data loaders split datasets across GROUPS (one
+    shard per group, replicated within), never blindly across processes.
+    """
+    mesh = sharding.mesh
+    from analytics_zoo_tpu.parallel.mesh import mesh_batch_size
+
+    nb = max(1, mesh_batch_size(mesh))
+    imap = sharding.devices_indices_map((nb,))
+    per_proc = {}
+    for d, idx in imap.items():
+        sl = idx[0] if idx else slice(None)
+        start = sl.start or 0 if isinstance(sl, slice) else 0
+        per_proc.setdefault(d.process_index, set()).add(start)
+    by_coverage = {}
+    for p, blocks in per_proc.items():
+        by_coverage.setdefault(tuple(sorted(blocks)), []).append(p)
+    ordered = sorted(by_coverage)
+    group_of_process = {}
+    for gi, cov in enumerate(ordered):
+        for p in by_coverage[cov]:
+            group_of_process[p] = gi
+    gop = [group_of_process.get(p, 0)
+           for p in range(max(group_of_process, default=0) + 1)]
+    me = jax.process_index()
+    return len(ordered), group_of_process.get(me, 0), gop
+
+
 def with_sharding_constraint(x: Any, spec: P) -> Any:
     """`lax.with_sharding_constraint` that is a no-op outside jit/mesh."""
     try:

@@ -17,7 +17,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def setup(pid: int, nprocs: int, port: int):
+def setup(pid: int, nprocs: int, port: int, mesh_axes=None):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -27,7 +27,8 @@ def setup(pid: int, nprocs: int, port: int):
 
     return init_orca_context(
         "multihost", coordinator_address=f"localhost:{port}",
-        num_processes=nprocs, process_id=pid, mesh_axes={"dp": -1})
+        num_processes=nprocs, process_id=pid,
+        mesh_axes=mesh_axes or {"dp": -1})
 
 
 def make_data(n=64, dim=8):
@@ -175,12 +176,68 @@ def scenario_disk(pid, outdir):
             "params2": _params_to_lists(est2.state.params)}
 
 
+def scenario_pp_ep(pid, outdir):
+    """Pipeline + expert parallelism ACROSS the host boundary: a
+    pp=2 x dp=2 x ep=2 mesh over 2 processes x 4 devices, so the GPipe
+    ppermute hops and the MoE dispatch all_to_alls ride the gloo
+    cross-process transport.  Both hosts must observe the identical
+    (global) loss trajectory."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from analytics_zoo_tpu.common.config import TrainConfig
+    from analytics_zoo_tpu.common.context import OrcaContext
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models import MoEMLP, MOE_PARTITION_RULES
+    from analytics_zoo_tpu.parallel import GPipe, pp_stage_rules
+
+    mesh = OrcaContext.get_context().mesh
+
+    class Stage(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.gelu(nn.Dense(32, name="up")(x))
+            return nn.LayerNorm(name="ln")(x + nn.Dense(16, name="down")(h))
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Dense(16, name="embed")(x)
+            x = GPipe(stage=Stage(), n_stages=mesh.shape["pp"],
+                      n_microbatches=2, mesh=mesh, name="trunk")(x)
+            x = x + MoEMLP(num_experts=4, intermediate_size=32, top_k=2,
+                           dtype=jnp.float32, mesh=mesh,
+                           name="moe")(x, train)
+            return nn.Dense(1, name="head")(x)
+
+    x, y = make_data()
+    rules = pp_stage_rules() + MOE_PARTITION_RULES + ((r".*", P()),)
+    est = Estimator.from_flax(
+        model=Net(), loss="mse", optimizer=optax.adam(3e-3),
+        partition_rules=rules,
+        config=TrainConfig(deterministic=True, seed=0))
+    hist = est.fit({"x": x, "y": y}, epochs=3, batch_size=16)
+    stage_spec = est.state.params["trunk"]["stages"]["up"]["kernel"]
+    moe_spec = est.state.params["moe"]["w_up"]
+    return {"loss": [h["loss"] for h in hist],
+            "mesh": dict(mesh.shape),
+            "stage_spec": str(stage_spec.sharding.spec),
+            "moe_spec": str(moe_spec.sharding.spec)}
+
+
 SCENARIOS = {
     "fit": scenario_fit,
     "predict": scenario_predict,
     "read_csv": scenario_read_csv,
     "checkpoint": scenario_checkpoint,
     "disk": scenario_disk,
+    "pp_ep": scenario_pp_ep,
+}
+
+SCENARIO_MESH = {
+    "pp_ep": {"pp": 2, "dp": 2, "ep": 2},
 }
 
 
@@ -188,7 +245,7 @@ def main():
     scenario, pid, nprocs, port, outdir = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
         sys.argv[5])
-    setup(pid, nprocs, port)
+    setup(pid, nprocs, port, SCENARIO_MESH.get(scenario))
     result = SCENARIOS[scenario](pid, outdir)
     with open(os.path.join(outdir, f"out_{pid}.json"), "w") as f:
         json.dump(result, f)

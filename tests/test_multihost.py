@@ -221,3 +221,20 @@ def test_multihost_disk_feature_set(tmp_path, ctx8):
     assert p0.shape == (32, 1) and p1.shape == (24, 1)
     np.testing.assert_allclose(p0, ref[:32], atol=1e-5)
     np.testing.assert_allclose(p1, ref[32:], atol=1e-5)
+
+
+def test_multihost_pp_ep(tmp_path):
+    """Pipeline + expert parallelism across the host boundary: GPipe
+    ppermute hops and MoE dispatch collectives ride gloo between the two
+    processes; both hosts observe the same finite, decreasing global
+    loss and the pp/ep shardings."""
+    results = run_scenario("pp_ep", tmp_path)
+    for r in results:
+        assert r["mesh"] == {"pp": 2, "dp": 2, "ep": 2}
+        assert "'pp'" in r["stage_spec"], r["stage_spec"]
+        assert "'ep'" in r["moe_spec"], r["moe_spec"]
+        assert all(np.isfinite(v) for v in r["loss"])
+        assert r["loss"][-1] < r["loss"][0]
+    # the loss is a global computation: hosts must agree exactly
+    np.testing.assert_allclose(results[0]["loss"], results[1]["loss"],
+                               rtol=1e-6)
