@@ -1,0 +1,247 @@
+"""Decoder-only causal language model + KV-cache generation.
+
+The reference's generative surface is the RNN ``Seq2seq`` (SURVEY.md §2.5,
+upstream ``pyzoo/zoo/models/seq2seq``) — it predates decoder-only LMs.
+This module completes the family the TPU-native way:
+
+- **Training** is one causal transformer forward: full attention on a
+  single chip, the fused Pallas flash kernel where measured to win, and
+  causal RING attention over the ``sp`` axis for long sequences (the same
+  `parallel/ring_attention.py` machinery BERT uses, with the causal mask
+  staying exact across ring hops).
+- **Generation** is ONE ``lax.scan`` over positions with a preallocated
+  KV cache threaded through the carry — static shapes, no Python loop, no
+  per-token dispatch; prompt prefill and sampling are the same scan
+  (prompt positions teacher-force, later positions feed back argmax).
+- Weights are tied (logits = hidden @ embed.T) and carry the same
+  Megatron tp layout as BERT, so ``LM_PARTITION_RULES`` compose with
+  dp/sp/tp meshes unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from analytics_zoo_tpu.models.transformer import (
+    _constrain_seq, attention_dispatch)
+
+LM_PARTITION_RULES = (
+    (r"pos_embed/embedding", P()),      # positions replicate (before the
+    (r"embed/embedding", P("tp", None)),   # vocab rule can re.search-match)
+    (r"(query|key|value)/kernel", P(None, "tp")),
+    (r"attn_out/kernel", P("tp", None)),
+    (r"ffn_up/kernel", P(None, "tp")),
+    (r"ffn_down/kernel", P("tp", None)),
+    (r".*", P()),
+)
+
+
+class DecoderAttention(nn.Module):
+    """Causal self-attention with a training path and a cached decode path
+    sharing the same projections (setup-style module)."""
+
+    hidden_size: int
+    num_heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+    mesh: Optional[Mesh] = None
+    use_flash: Optional[bool] = None
+
+    def setup(self):
+        H = self.num_heads
+        D = self.hidden_size // H
+        self._h, self._d = H, D
+        dense = lambda name: nn.DenseGeneral((H, D), dtype=self.dtype,
+                                             name=name)
+        self.query, self.key, self.value = (
+            dense("query"), dense("key"), dense("value"))
+        self.attn_out = nn.DenseGeneral(self.hidden_size, axis=(-2, -1),
+                                        dtype=self.dtype, name="attn_out")
+
+    def __call__(self, x, train: bool = False):
+        """Training/scoring: [B, T, E] -> [B, T, E], causal."""
+        q, k, v = self.query(x), self.key(x), self.value(x)
+        o = attention_dispatch(q, k, v, None, causal=True, mesh=self.mesh,
+                               use_flash=self.use_flash)
+        return self.attn_out(o)
+
+    def decode(self, x1, cache_k, cache_v, pos):
+        """One cached decode step.
+
+        x1: [B, 1, E] current-position hidden; cache_k/v: [B, L, H, D]
+        preallocated; pos: scalar int32 current position.  Returns
+        (y1 [B, 1, E], new_cache_k, new_cache_v).
+        """
+        B = x1.shape[0]
+        q = self.query(x1)                              # [B, 1, H, D]
+        k1 = self.key(x1)
+        v1 = self.value(x1)
+        cache_k = lax.dynamic_update_slice(
+            cache_k, k1.astype(cache_k.dtype), (0, pos, 0, 0))
+        cache_v = lax.dynamic_update_slice(
+            cache_v, v1.astype(cache_v.dtype), (0, pos, 0, 0))
+        L = cache_k.shape[1]
+        scale = 1.0 / jnp.sqrt(self._d).astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k,
+                            preferred_element_type=jnp.float32) * scale
+        mask = (jnp.arange(L) <= pos)[None, None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        w = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", w.astype(cache_v.dtype), cache_v,
+                       preferred_element_type=jnp.float32)
+        return self.attn_out(o.astype(self.dtype)), cache_k, cache_v
+
+
+class DecoderLayer(nn.Module):
+    """Pre-LN causal decoder block (pre-LN trains stably at depth without
+    the reference's warmup tricks; BERT keeps post-LN for ref parity)."""
+
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+    mesh: Optional[Mesh] = None
+    use_flash: Optional[bool] = None
+
+    def setup(self):
+        self.ln_attn = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")
+        self.attention = DecoderAttention(
+            self.hidden_size, self.num_heads, dtype=self.dtype,
+            mesh=self.mesh, use_flash=self.use_flash, name="attention")
+        self.ln_ffn = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")
+        self.ffn_up = nn.Dense(self.intermediate_size, dtype=self.dtype,
+                               name="ffn_up")
+        self.ffn_down = nn.Dense(self.hidden_size, dtype=self.dtype,
+                                 name="ffn_down")
+        self.drop = nn.Dropout(self.dropout)
+
+    def _mlp(self, x, train):
+        h = self.ffn_down(nn.gelu(self.ffn_up(x)))
+        return self.drop(h, deterministic=not train)
+
+    def __call__(self, x, train: bool = False):
+        a = self.attention(self.ln_attn(x).astype(self.dtype), train)
+        x = x + self.drop(a, deterministic=not train)
+        x = _constrain_seq(x, self.mesh)
+        x = x + self._mlp(self.ln_ffn(x).astype(self.dtype), train)
+        return _constrain_seq(x, self.mesh)
+
+    def decode(self, x1, cache_k, cache_v, pos):
+        a, ck, cv = self.attention.decode(
+            self.ln_attn(x1).astype(self.dtype), cache_k, cache_v, pos)
+        x1 = x1 + a
+        x1 = x1 + self._mlp(self.ln_ffn(x1).astype(self.dtype), False)
+        return x1, ck, cv
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM with tied embeddings.
+
+    ``__call__(tokens)`` -> next-token logits ``[B, T, V]`` (causal);
+    ``decode_step`` runs one cached generation step (used by
+    ``generate``)."""
+
+    vocab_size: int
+    hidden_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    intermediate_size: int = 1024
+    max_position: int = 512
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    mesh: Optional[Mesh] = None
+    use_flash: Optional[bool] = None
+
+    def setup(self):
+        self.embed = nn.Embed(self.vocab_size, self.hidden_size,
+                              name="embed")
+        self.pos_embed = nn.Embed(self.max_position, self.hidden_size,
+                                  name="pos_embed")
+        self.layers = [
+            DecoderLayer(self.hidden_size, self.num_heads,
+                         self.intermediate_size, self.dropout,
+                         dtype=self.dtype, mesh=self.mesh,
+                         use_flash=self.use_flash, name=f"layer_{i}")
+            for i in range(self.num_layers)]
+        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+
+    def _logits(self, x):
+        # tied head: f32 logits for a stable softmax/CE
+        emb = self.embed.embedding.astype(jnp.float32)
+        return jnp.einsum("bte,ve->btv", x.astype(jnp.float32), emb)
+
+    def __call__(self, tokens, train: bool = False):
+        B, T = tokens.shape
+        if T > self.max_position:
+            raise ValueError(
+                f"sequence length {T} exceeds max_position "
+                f"{self.max_position} (out-of-range position lookups "
+                "would silently return NaN/clamped rows)")
+        x = self.embed(tokens) + self.pos_embed(jnp.arange(T)[None])
+        x = _constrain_seq(x.astype(self.dtype), self.mesh)
+        for layer in self.layers:
+            x = layer(x, train)
+        return self._logits(self.ln_f(x))
+
+    def decode_step(self, tok, caches_k, caches_v, pos):
+        """tok: [B] current tokens; caches_k/v: [n_layers, B, L, H, D];
+        pos: scalar.  Returns (logits [B, V], caches_k, caches_v)."""
+        x = self.embed(tok)[:, None] + self.pos_embed(pos)[None, None]
+        x = x.astype(self.dtype)
+        ks, vs = [], []
+        for i, layer in enumerate(self.layers):
+            x, ck, cv = layer.decode(x, caches_k[i], caches_v[i], pos)
+            ks.append(ck)
+            vs.append(cv)
+        logits = self._logits(self.ln_f(x))[:, 0]
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def lm_loss(logits, tokens):
+    """Shifted next-token CE (mean over B x (T-1))."""
+    import optax
+
+    return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]))
+
+
+def generate(model: TransformerLM, variables, prompt,
+             max_new_tokens: int) -> jax.Array:
+    """Greedy generation as ONE lax.scan with a threaded KV cache.
+
+    prompt: [B, P] int32.  Returns [B, max_new_tokens].  The same scan
+    does prompt prefill (positions < P teacher-force the prompt) and
+    generation (positions >= P feed back the argmax) — no separate
+    prefill program, no dynamic shapes.
+    """
+    B, Pn = prompt.shape
+    L = Pn + max_new_tokens
+    if L > model.max_position:
+        raise ValueError(f"prompt+new = {L} exceeds max_position "
+                         f"{model.max_position}")
+    H = model.num_heads
+    D = model.hidden_size // H
+    cdtype = jnp.dtype(model.dtype)
+    ck0 = jnp.zeros((model.num_layers, B, L, H, D), cdtype)
+    cv0 = jnp.zeros_like(ck0)
+
+    def step(carry, t):
+        tok, ck, cv = carry
+        logits, ck, cv = model.apply(
+            variables, tok, ck, cv, t, method=TransformerLM.decode_step)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # positions before the prompt end replay the prompt
+        nxt = jnp.where(t + 1 < Pn, prompt[:, jnp.minimum(t + 1, Pn - 1)],
+                        nxt)
+        return (nxt, ck, cv), nxt
+
+    (_, _, _), toks = lax.scan(
+        step, (prompt[:, 0], ck0, cv0), jnp.arange(L - 1))
+    # toks[t] is the token at position t+1; generated span is [Pn, L)
+    return toks.transpose(1, 0)[:, Pn - 1:]

@@ -50,6 +50,42 @@ from analytics_zoo_tpu.models.moe import MOE_PARTITION_RULES as _MOE_RULES
 BERT_MOE_PARTITION_RULES = _MOE_RULES + BERT_PARTITION_RULES
 
 
+def flash_ok(use_flash: Optional[bool], seq_len: int) -> bool:
+    """Fused-kernel dispatch policy — ONE home for the measured numbers.
+
+    use_flash=None means auto; the kill-switch env var covers Mosaic
+    lowering failures on future TPU generations without code changes.
+    Measured on v5e (BERT-base fine-tune through fit, bf16): XLA wins at
+    seq 128 (+44%) and 256 (+15%); the Pallas kernel wins from seq 512
+    (+20%), where attention turns HBM-bound and fusion pays."""
+    if use_flash is not None:
+        return use_flash
+    if os.environ.get("ZOO_DISABLE_FLASH", "").lower() not in (
+            "", "0", "false"):
+        return False
+    return jax.default_backend() == "tpu" and seq_len >= 512
+
+
+def attention_dispatch(q, k, v, kv_mask, *, causal: bool,
+                       mesh: Optional[Mesh],
+                       use_flash: Optional[bool]) -> jax.Array:
+    """The three-way attention dispatch every attention layer shares:
+    sp-ring (ppermute) when the mesh shards the sequence, the Pallas flash
+    kernel where measured to win, XLA full attention otherwise."""
+    if mesh is not None and "sp" in mesh.axis_names and \
+            mesh.shape["sp"] > 1:
+        return ring_self_attention(q, k, v, mesh, kv_mask, causal=causal)
+    if flash_ok(use_flash, q.shape[1]):
+        from analytics_zoo_tpu.ops import (
+            flash_attention, sharded_flash_attention)
+
+        if mesh is not None and mesh.size > 1:
+            return sharded_flash_attention(q, k, v, mesh, kv_mask,
+                                           causal=causal)
+        return flash_attention(q, k, v, kv_mask, causal=causal)
+    return full_attention(q, k, v, kv_mask, causal=causal)
+
+
 def _constrain_seq(x, mesh: Optional[Mesh]):
     """hidden states: [B, T, E] -> shard B over dp(+fsdp), T over sp."""
     if mesh is None:
@@ -78,37 +114,10 @@ class MultiHeadAttention(nn.Module):
         dense = lambda name: nn.DenseGeneral(
             (H, D), dtype=self.dtype, name=name)
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
-        mesh = self.mesh
-        if mesh is not None and "sp" in mesh.axis_names and \
-                mesh.shape["sp"] > 1:
-            o = ring_self_attention(q, k, v, mesh, kv_mask, causal=False)
-        elif self._flash_ok(T):
-            from analytics_zoo_tpu.ops import (
-                flash_attention, sharded_flash_attention)
-            if mesh is not None and mesh.size > 1:
-                o = sharded_flash_attention(q, k, v, mesh, kv_mask,
-                                            causal=False)
-            else:
-                o = flash_attention(q, k, v, kv_mask, causal=False)
-        else:
-            o = full_attention(q, k, v, kv_mask, causal=False)
-        o = nn.DenseGeneral(E, axis=(-2, -1), dtype=self.dtype,
-                            name="attn_out")(o)
-        return o
-
-    def _flash_ok(self, seq_len: int) -> bool:
-        if self.use_flash is not None:
-            return self.use_flash
-        # kill-switch: a Mosaic lowering failure on some future
-        # TPU generation must be work-aroundable without code changes
-        if os.environ.get("ZOO_DISABLE_FLASH", "").lower() not in (
-                "", "0", "false"):
-            return False
-        # auto: fused kernel only where it beats XLA's own attention.
-        # Measured on v5e (BERT-base fine-tune through fit, bf16): XLA wins
-        # at seq 128 (+44%) and 256 (+15%); the Pallas kernel wins from
-        # seq 512 (+20%), where attention turns HBM-bound and fusion pays.
-        return jax.default_backend() == "tpu" and seq_len >= 512
+        o = attention_dispatch(q, k, v, kv_mask, causal=False,
+                               mesh=self.mesh, use_flash=self.use_flash)
+        return nn.DenseGeneral(E, axis=(-2, -1), dtype=self.dtype,
+                               name="attn_out")(o)
 
 
 class TransformerLayer(nn.Module):

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Release build (ref: the reference's make-dist.sh + pyzoo packaging glue):
+# green suite -> native build -> sdist/wheel into dist/ -> docker image.
+# Usage: scripts/release.sh [--skip-tests] [--docker]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SKIP_TESTS=0; DOCKER=0
+for a in "$@"; do
+  case "$a" in
+    --skip-tests) SKIP_TESTS=1 ;;
+    --docker) DOCKER=1 ;;
+    *) echo "unknown arg $a" >&2; exit 2 ;;
+  esac
+done
+
+if [ "$SKIP_TESTS" = 0 ]; then
+  python -m pytest tests/ -q
+fi
+
+# native data plane compiles on install; delete any cached .so so a
+# broken toolchain fails the release, not the user's first import
+rm -f analytics_zoo_tpu/native/*.so
+python -c "from analytics_zoo_tpu import native; native.load_lib(); print('native:', native.available())"
+
+rm -rf dist
+if python -c "import build" 2>/dev/null; then
+  python -m build --sdist --wheel --no-isolation
+else
+  python setup.py -q sdist
+  python setup.py -q bdist_wheel || \
+    echo "WARNING: wheel build failed (is 'wheel' installed?); release has sdist only" >&2
+fi
+ls -l dist/
+
+if [ "$DOCKER" = 1 ]; then
+  docker build -t analytics-zoo-tpu:$(python -c "import analytics_zoo_tpu as z; print(getattr(z, '__version__', 'dev'))") -f docker/Dockerfile .
+fi
+echo "release artifacts in dist/"
