@@ -57,7 +57,9 @@ def flash_ok(use_flash: Optional[bool], seq_len: int) -> bool:
     lowering failures on future TPU generations without code changes.
     Measured on v5e (BERT-base fine-tune through fit, bf16): XLA wins at
     seq 128 (+44%) and 256 (+15%); the Pallas kernel wins from seq 512
-    (+20%), where attention turns HBM-bound and fusion pays."""
+    (+20%), where attention turns HBM-bound and fusion pays.  At seq 2048
+    (111M-param causal LM) the kernel is +94% and survives batch sizes
+    whose full-attention logits OOM."""
     if use_flash is not None:
         return use_flash
     if os.environ.get("ZOO_DISABLE_FLASH", "").lower() not in (
