@@ -157,17 +157,24 @@ class TransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
+    remat: bool = False
 
     def setup(self):
         self.embed = nn.Embed(self.vocab_size, self.hidden_size,
                               name="embed")
         self.pos_embed = nn.Embed(self.max_position, self.hidden_size,
                                   name="pos_embed")
+        # remat checkpoints each block's training __call__ (recompute in
+        # backward instead of storing activations); decode is untouched
+        # (no gradients there)
+        layer_cls = nn.remat(DecoderLayer, static_argnums=(2,),
+                             methods=["__call__"]) if self.remat \
+            else DecoderLayer
         self.layers = [
-            DecoderLayer(self.hidden_size, self.num_heads,
-                         self.intermediate_size, self.dropout,
-                         dtype=self.dtype, mesh=self.mesh,
-                         use_flash=self.use_flash, name=f"layer_{i}")
+            layer_cls(self.hidden_size, self.num_heads,
+                      self.intermediate_size, self.dropout,
+                      dtype=self.dtype, mesh=self.mesh,
+                      use_flash=self.use_flash, name=f"layer_{i}")
             for i in range(self.num_layers)]
         self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
 

@@ -93,6 +93,24 @@ def test_generate_learned_repetition():
         stop_orca_context()
 
 
+def test_remat_matches_non_remat():
+    """remat=True recomputes in backward — forward AND grads must be
+    identical to the stored-activation path."""
+    toks = _toks(b=2, t=8)
+    kw = dict(vocab_size=32, hidden_size=32, num_layers=2, num_heads=2,
+              intermediate_size=64, max_position=16, dtype=jnp.float32)
+    m1, m2 = TransformerLM(**kw), TransformerLM(remat=True, **kw)
+    v = m1.init(jax.random.key(0), toks)
+    np.testing.assert_allclose(np.asarray(m1.apply(v, toks)),
+                               np.asarray(m2.apply(v, toks)), rtol=1e-6)
+    g1 = jax.grad(lambda p: jnp.sum(
+        m1.apply({"params": p}, toks) ** 2))(v["params"])
+    g2 = jax.grad(lambda p: jnp.sum(
+        m2.apply({"params": p}, toks) ** 2))(v["params"])
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=1e-5, atol=1e-6), g1, g2)
+
+
 def test_sp_ring_causal_training_matches_single_device():
     """Causal LM forward on a dp x sp mesh (ring attention path) equals
     the single-device full-attention forward."""
