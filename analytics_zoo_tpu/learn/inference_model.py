@@ -54,6 +54,8 @@ class InferenceModel:
         self._compile_lock = threading.Lock()
         self._sem = threading.Semaphore(max(1, concurrent_num))
         self._takes_train: Optional[str] = None
+        # optional host-side input normaliser (generator prompt padding)
+        self._pre_pad: Optional[Callable] = None
 
     # ---- loading -----------------------------------------------------
 
@@ -107,7 +109,81 @@ class InferenceModel:
             return model.apply(variables, *feats, **kw)
 
         self._apply_fn = apply_fn
+        self._pre_pad = None    # a stale generator pad hook would corrupt
+        #                         plain-model inputs
         self._jit = None        # new model -> stale compiled wrapper
+        return self
+
+    def load_flax_generator(self, model, variables, max_new_tokens: int,
+                            prompt_buckets: Sequence[int] = (16, 32, 64,
+                                                             128),
+                            pad_id: int = 0) -> "InferenceModel":
+        """Serve autoregressive GENERATION from a TransformerLM: predict
+        takes right-padded prompts [B, P] (+ optional per-row lengths [B])
+        and returns [B, max_new_tokens] generated token ids.
+
+        The prompt dim is padded up to ``prompt_buckets`` (the seq-dim
+        analog of the batch buckets) so the KV-cache generation scan
+        compiles a bounded set of shapes.  When lengths are omitted they
+        are inferred as the non-``pad_id`` trailing-pad width of each row.
+        No reference counterpart (SURVEY.md §2.5: no generative LM
+        upstream) — this is the serving face of models/lm.generate.
+        """
+        from analytics_zoo_tpu.models.lm import generate
+
+        self.model = model
+        self.quant_stats = None
+        self._dequant = None
+        self._variables = variables
+        self._takes_train = None
+        # a bucket only counts if the padded prompt + generation still
+        # fits the model's position table — otherwise a prompt that
+        # genuinely fits would fail generate()'s length check after
+        # bucket padding
+        limit = int(model.max_position) - int(max_new_tokens)
+        pbuckets = tuple(b for b in sorted(prompt_buckets) if b <= limit)
+        if not pbuckets:
+            raise ValueError(
+                f"no prompt bucket fits: max_position "
+                f"{model.max_position} - max_new_tokens {max_new_tokens} "
+                f"= {limit} < smallest bucket {min(prompt_buckets)}")
+        # serving batcher reads this to bounds-check ragged prompts
+        # per-request instead of failing whole batches
+        self.max_prompt_width = pbuckets[-1]
+
+        def apply_fn(variables, prompts, lengths):
+            return generate(model, variables, prompts, max_new_tokens,
+                            prompt_len=lengths)
+
+        def pre_pad(inputs):
+            prompts = np.asarray(inputs[0])
+            if len(inputs) > 1:
+                lengths = np.asarray(inputs[1], np.int32)
+            else:
+                nonpad = prompts != pad_id
+                # length = index of last non-pad + 1 (right padding)
+                lengths = np.where(
+                    nonpad.any(axis=1),
+                    prompts.shape[1] - np.argmax(nonpad[:, ::-1], axis=1),
+                    0).astype(np.int32)
+            if (lengths <= 0).any():
+                raise ValueError(
+                    "empty prompt (length 0) — generation needs at least "
+                    "one real token per row")
+            pb = _next_bucket(prompts.shape[1], pbuckets)
+            if prompts.shape[1] < pb:
+                prompts = np.concatenate(
+                    [prompts, np.full((len(prompts), pb - prompts.shape[1]),
+                                      pad_id, prompts.dtype)], axis=1)
+            elif prompts.shape[1] > pb:
+                raise ValueError(
+                    f"prompt length {prompts.shape[1]} exceeds the largest "
+                    f"usable prompt bucket {pb}")
+            return prompts, lengths
+
+        self._apply_fn = apply_fn
+        self._pre_pad = pre_pad
+        self._jit = None
         return self
 
     def load_torch(self, module) -> "InferenceModel":
@@ -157,6 +233,8 @@ class InferenceModel:
         the serving loop's pipelining hook."""
         if self._apply_fn is None:
             raise RuntimeError("load a model first")
+        if self._pre_pad is not None:
+            inputs = self._pre_pad(inputs)
         n = len(inputs[0])
         bucket = _next_bucket(n, self._buckets)
         if n > bucket:          # n above the largest bucket: chunk

@@ -219,19 +219,28 @@ def lm_loss(logits, tokens):
 
 
 def generate(model: TransformerLM, variables, prompt,
-             max_new_tokens: int) -> jax.Array:
+             max_new_tokens: int, prompt_len=None) -> jax.Array:
     """Greedy generation as ONE lax.scan with a threaded KV cache.
 
-    prompt: [B, P] int32.  Returns [B, max_new_tokens].  The same scan
-    does prompt prefill (positions < P teacher-force the prompt) and
-    generation (positions >= P feed back the argmax) — no separate
-    prefill program, no dynamic shapes.
+    prompt: [B, P] int32; ``prompt_len`` (optional [B] int32) gives each
+    row's true prompt length for right-padded ragged batches (the serving
+    path) — defaults to the full width P.  Returns [B, max_new_tokens]:
+    row i's tokens generated after its own prompt end.  The same scan
+    does prompt prefill (positions < prompt_len teacher-force the prompt)
+    and generation (argmax feedback) — no separate prefill program, no
+    dynamic shapes.
     """
     B, Pn = prompt.shape
     L = Pn + max_new_tokens
     if L > model.max_position:
         raise ValueError(f"prompt+new = {L} exceeds max_position "
                          f"{model.max_position}")
+    # plen < 1 has no defined meaning (the scan must start from SOME real
+    # token); clamp so an all-pad row degrades to "prompt = its first
+    # slot" instead of emitting off-by-one garbage.  Callers that can
+    # reject empty prompts per-request (serving) do so before this.
+    plen = (jnp.full((B,), Pn, jnp.int32) if prompt_len is None
+            else jnp.maximum(jnp.asarray(prompt_len, jnp.int32), 1))
     H = model.num_heads
     D = model.hidden_size // H
     cdtype = jnp.dtype(model.dtype)
@@ -243,12 +252,16 @@ def generate(model: TransformerLM, variables, prompt,
         logits, ck, cv = model.apply(
             variables, tok, ck, cv, t, method=TransformerLM.decode_step)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # positions before the prompt end replay the prompt
-        nxt = jnp.where(t + 1 < Pn, prompt[:, jnp.minimum(t + 1, Pn - 1)],
+        # rows still inside their own prompt replay it
+        nxt = jnp.where(t + 1 < plen, prompt[:, jnp.minimum(t + 1, Pn - 1)],
                         nxt)
         return (nxt, ck, cv), nxt
 
     (_, _, _), toks = lax.scan(
         step, (prompt[:, 0], ck0, cv0), jnp.arange(L - 1))
-    # toks[t] is the token at position t+1; generated span is [Pn, L)
-    return toks.transpose(1, 0)[:, Pn - 1:]
+    # toks[t] is the token at position t+1; row i's generated span is
+    # positions [plen_i, plen_i + max_new) -> rows plen_i-1 .. of toks
+    toks = toks.transpose(1, 0)                       # [B, L-1]
+    idx = jnp.clip(plen[:, None] - 1 + jnp.arange(max_new_tokens)[None],
+                   0, L - 2)
+    return jnp.take_along_axis(toks, idx, axis=1)

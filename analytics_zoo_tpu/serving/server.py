@@ -58,6 +58,13 @@ class ServingConfig:
     #                                     host staging (InferenceModel
     #                                     semaphore), NOT batch; None keeps
     #                                     the model's own concurrent_num
+    # Generative serving (LM generate): requests in `prompt_col` are
+    # RAGGED 1-D token arrays; the batcher right-pads them to a common
+    # width with `prompt_pad_id` and appends each request's true length
+    # as an extra model input (InferenceModel.load_flax_generator's
+    # (prompts, lengths) contract).  None = ordinary fixed-shape serving.
+    prompt_col: Optional[str] = None
+    prompt_pad_id: int = 0
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -84,6 +91,10 @@ class ServingConfig:
             cfg.image_shape = [int(v) for v in params["image_shape"]]
         if "workers" in params:
             cfg.workers = int(params["workers"])
+        if "prompt_col" in params:
+            cfg.prompt_col = str(params["prompt_col"])
+        if "prompt_pad_id" in params:
+            cfg.prompt_pad_id = int(params["prompt_pad_id"])
         return cfg
 
 
@@ -345,12 +356,46 @@ class ClusterServing:
         else:
             for it in items:
                 decode_req(it)
+        # generative serving: ragged prompts right-pad to the batch max
+        # BEFORE the shape check, and their true lengths ride along as an
+        # extra model input (load_flax_generator contract)
+        req_lengths: List[Optional[int]] = [None] * len(requests)
+        if self.config.prompt_col and self.config.prompt_col in cols:
+            ci = cols.index(self.config.prompt_col)
+            # per-request bounds check FIRST — an over-long or empty
+            # prompt must error alone, not (via the shared pad width)
+            # black-hole its batchmates at dispatch
+            limit = getattr(self.model, "max_prompt_width", None)
+            for i, (r, v) in enumerate(zip(requests, per_req)):
+                if v is None or np.asarray(v[ci]).ndim != 1:
+                    continue        # shape check below errors non-1D out
+                n = len(v[ci])
+                if n < 1 or (limit is not None and n > limit):
+                    self._publish_error(
+                        r, f"prompt length {n} outside [1, {limit}]")
+                    per_req[i] = None
+            widths = [len(v[ci]) for v in per_req
+                      if v is not None and np.asarray(v[ci]).ndim == 1]
+            wmax = max(widths) if widths else 0
+            for i, v in enumerate(per_req):
+                if v is None:
+                    continue
+                arr = np.asarray(v[ci])
+                if arr.ndim != 1:
+                    continue        # shape check below errors it out
+                req_lengths[i] = len(arr)
+                if len(arr) < wmax:
+                    v[ci] = np.concatenate(
+                        [arr, np.full(wmax - len(arr),
+                                      self.config.prompt_pad_id,
+                                      arr.dtype)])
         # shape check against the first good request: mismatches error out
         # individually instead of failing np.stack for everyone
         ref_shapes = next((tuple(a.shape for a in v)
                            for v in per_req if v is not None), None)
-        good_reqs, good_ids, good_vals, done_ids = [], [], [], []
-        for r, eid, v in zip(requests, ids, per_req):
+        good_reqs, good_ids, good_vals, good_lens, done_ids = \
+            [], [], [], [], []
+        for r, eid, v, ln in zip(requests, ids, per_req, req_lengths):
             if v is None:
                 done_ids.append(eid)        # error already published
                 continue
@@ -363,11 +408,15 @@ class ClusterServing:
             good_reqs.append(r)
             good_ids.append(eid)
             good_vals.append(v)
+            good_lens.append(ln)
         self._finish_entries(client, done_ids)
         if not good_reqs:
             return None
         arrays = [np.stack([v[ci] for v in good_vals])
                   for ci in range(len(cols))]
+        if self.config.prompt_col and all(
+                ln is not None for ln in good_lens):
+            arrays.append(np.asarray(good_lens, np.int32))
         try:
             waiter = self.model.predict_async(*arrays)
         except Exception as e:

@@ -1,0 +1,148 @@
+"""Generative LM serving tests: ragged prompt batching through
+InferenceModel.load_flax_generator and the Cluster Serving loop
+(prompt_col config).  No reference counterpart — the reference has no
+generative models; this is the serving face of models/lm.generate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.learn.inference_model import InferenceModel
+from analytics_zoo_tpu.models import TransformerLM, generate
+from analytics_zoo_tpu.serving import (
+    ClusterServing, InputQueue, OutputQueue, ServingConfig)
+
+
+def _lm_and_vars(vocab=32, max_position=64):
+    model = TransformerLM(vocab_size=vocab, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=max_position, dtype=jnp.float32)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    return model, model.init(jax.random.key(0), toks)
+
+
+def test_generate_ragged_prompt_len_matches_per_row():
+    """Batched ragged generation == each row generated alone at its own
+    true length."""
+    model, variables = _lm_and_vars()
+    rng = np.random.default_rng(0)
+    P = 10
+    prompts = rng.integers(1, 32, (3, P)).astype(np.int32)
+    lens = np.asarray([10, 6, 3], np.int32)
+    for i, ln in enumerate(lens):       # right-pad beyond each length
+        prompts[i, ln:] = 0
+    out = np.asarray(generate(model, variables, jnp.asarray(prompts), 5,
+                              prompt_len=jnp.asarray(lens)))
+    for i, ln in enumerate(lens):
+        solo = np.asarray(generate(
+            model, variables, jnp.asarray(prompts[i:i + 1, :ln]), 5))
+        np.testing.assert_array_equal(out[i], solo[0], err_msg=f"row {i}")
+
+
+def test_inference_model_generator_pads_and_infers_lengths():
+    model, variables = _lm_and_vars()
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=4, prompt_buckets=(8, 16),
+        pad_id=0)
+    rng = np.random.default_rng(1)
+    prompts = rng.integers(1, 32, (2, 6)).astype(np.int32)
+    prompts[1, 4:] = 0                  # row 1 true length 4
+    out = im.predict(prompts)
+    assert out.shape == (2, 4)
+    ref0 = np.asarray(generate(model, variables,
+                               jnp.asarray(prompts[0:1]), 4))
+    ref1 = np.asarray(generate(model, variables,
+                               jnp.asarray(prompts[1:2, :4]), 4))
+    np.testing.assert_array_equal(out[0], ref0[0])
+    np.testing.assert_array_equal(out[1], ref1[0])
+    # explicit lengths win over inference
+    out2 = im.predict(prompts, np.asarray([6, 4], np.int32))
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_generator_buckets_respect_max_position():
+    """Buckets above max_position - max_new_tokens are dropped, so a
+    prompt that genuinely fits never fails from bucket padding; no usable
+    bucket at all is a load-time error."""
+    import pytest
+
+    model, variables = _lm_and_vars(max_position=64)
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=8,
+        prompt_buckets=(16, 32, 64, 128))
+    assert im.max_prompt_width == 32    # 64 and 128 don't fit 64 - 8
+    prompts = np.ones((1, 40), np.int32)
+    ref = np.asarray(generate(model, variables, jnp.asarray(prompts), 8))
+    # 40 > largest usable bucket 32: clean per-request error, not a
+    # max_position blowup mid-generate
+    with pytest.raises(ValueError, match="prompt length 40"):
+        im.predict(prompts)
+    with pytest.raises(ValueError, match="no prompt bucket fits"):
+        InferenceModel().load_flax_generator(
+            model, variables, max_new_tokens=60, prompt_buckets=(16,))
+
+
+def test_generator_rejects_empty_prompt():
+    import pytest
+
+    model, variables = _lm_and_vars()
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=4, prompt_buckets=(8,))
+    with pytest.raises(ValueError, match="empty prompt"):
+        im.predict(np.zeros((1, 4), np.int32))
+
+
+def test_serving_overlong_prompt_errors_alone():
+    """An over-long (or empty) prompt gets its own error result; its
+    batchmates still generate."""
+    model, variables = _lm_and_vars(max_position=64)
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=4, prompt_buckets=(8, 16))
+    cfg = ServingConfig(batch_size=8, batch_timeout_ms=50.0,
+                        prompt_col="tokens", prompt_pad_id=0)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        inq = InputQueue(port=serving.port)
+        outq = OutputQueue(port=serving.port)
+        rng = np.random.default_rng(3)
+        good = rng.integers(1, 32, 5).astype(np.int32)
+        too_long = rng.integers(1, 32, 40).astype(np.int32)   # > 16
+        u_bad = inq.enqueue("bad", tokens=too_long)
+        u_good = inq.enqueue("good", tokens=good)
+        r_good = np.asarray(outq.query(u_good, timeout=30))
+        ref = np.asarray(generate(model, variables,
+                                  jnp.asarray(good[None]), 4))
+        np.testing.assert_array_equal(r_good, ref[0])
+        import pytest
+
+        with pytest.raises(RuntimeError, match="prompt length 40"):
+            outq.query(u_bad, timeout=30)
+    finally:
+        serving.stop()
+
+
+def test_cluster_serving_generates_ragged_prompts():
+    """e2e: clients enqueue different-length prompts; the batcher pads,
+    threads lengths, and each client gets its own continuation."""
+    model, variables = _lm_and_vars()
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=4, prompt_buckets=(8, 16),
+        pad_id=0)
+    cfg = ServingConfig(batch_size=8, batch_timeout_ms=30.0,
+                        prompt_col="tokens", prompt_pad_id=0)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        inq = InputQueue(port=serving.port)
+        outq = OutputQueue(port=serving.port)
+        rng = np.random.default_rng(2)
+        plens = [3, 5, 7]
+        prompts = [rng.integers(1, 32, n).astype(np.int32) for n in plens]
+        uris = [inq.enqueue(f"gen-{i}", tokens=p)
+                for i, p in enumerate(prompts)]
+        for i, (uri, p) in enumerate(zip(uris, prompts)):
+            r = np.asarray(outq.query(uri, timeout=30))
+            ref = np.asarray(generate(model, variables,
+                                      jnp.asarray(p[None]), 4))
+            np.testing.assert_array_equal(r, ref[0], err_msg=uri)
+    finally:
+        serving.stop()
