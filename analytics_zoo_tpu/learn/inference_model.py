@@ -56,6 +56,8 @@ class InferenceModel:
         self._takes_train: Optional[str] = None
         # optional host-side input normaliser (generator prompt padding)
         self._pre_pad: Optional[Callable] = None
+        # generator-only serving bounds (load_flax_generator sets it)
+        self.max_prompt_width: Optional[int] = None
 
     # ---- loading -----------------------------------------------------
 
@@ -111,6 +113,7 @@ class InferenceModel:
         self._apply_fn = apply_fn
         self._pre_pad = None    # a stale generator pad hook would corrupt
         #                         plain-model inputs
+        self.max_prompt_width = None    # ditto the serving bounds limit
         self._jit = None        # new model -> stale compiled wrapper
         return self
 

@@ -235,12 +235,14 @@ def generate(model: TransformerLM, variables, prompt,
     if L > model.max_position:
         raise ValueError(f"prompt+new = {L} exceeds max_position "
                          f"{model.max_position}")
-    # plen < 1 has no defined meaning (the scan must start from SOME real
-    # token); clamp so an all-pad row degrades to "prompt = its first
-    # slot" instead of emitting off-by-one garbage.  Callers that can
-    # reject empty prompts per-request (serving) do so before this.
+    # prompt_len outside [1, P] has no defined meaning (the scan must
+    # start from SOME real token, and can't teacher-force past the row):
+    # clamp both ends so bad rows degrade to defined behavior (length-1 /
+    # full-width prompt) instead of off-by-one garbage — values are
+    # traced, so raising is not an option here.  Callers that can reject
+    # bad lengths per-request (serving) do so before this.
     plen = (jnp.full((B,), Pn, jnp.int32) if prompt_len is None
-            else jnp.maximum(jnp.asarray(prompt_len, jnp.int32), 1))
+            else jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, Pn))
     H = model.num_heads
     D = model.hidden_size // H
     cdtype = jnp.dtype(model.dtype)

@@ -367,8 +367,17 @@ class ClusterServing:
             # black-hole its batchmates at dispatch
             limit = getattr(self.model, "max_prompt_width", None)
             for i, (r, v) in enumerate(zip(requests, per_req)):
-                if v is None or np.asarray(v[ci]).ndim != 1:
-                    continue        # shape check below errors non-1D out
+                if v is None:
+                    continue
+                if np.asarray(v[ci]).ndim != 1:
+                    # error it here, not via the generic shape check — a
+                    # malformed prompt as the batch's first request would
+                    # otherwise set ref_shapes and fail valid batchmates
+                    self._publish_error(
+                        r, f"prompt must be a 1-D token array, got shape "
+                           f"{np.asarray(v[ci]).shape}")
+                    per_req[i] = None
+                    continue
                 n = len(v[ci])
                 if n < 1 or (limit is not None and n > limit):
                     self._publish_error(

@@ -72,7 +72,6 @@ def test_generator_buckets_respect_max_position():
         prompt_buckets=(16, 32, 64, 128))
     assert im.max_prompt_width == 32    # 64 and 128 don't fit 64 - 8
     prompts = np.ones((1, 40), np.int32)
-    ref = np.asarray(generate(model, variables, jnp.asarray(prompts), 8))
     # 40 > largest usable bucket 32: clean per-request error, not a
     # max_position blowup mid-generate
     with pytest.raises(ValueError, match="prompt length 40"):

@@ -572,6 +572,12 @@ class TestConsumerGroups:
                 r = outq.query(uri, timeout=20)
                 assert r is not None, uri
                 np.testing.assert_allclose(r, x * 2.0, err_msg=uri)
+            # results become client-visible BEFORE the worker's stats
+            # update (publish pipeline -> ack -> stats); poll briefly so
+            # a busy host doesn't read the counter inside that window
+            deadline = time.time() + 5
+            while serving.stats["requests"] < 40 and time.time() < deadline:
+                time.sleep(0.05)
             assert serving.stats["requests"] == 40
             assert serving.backlog() == 0
         finally:
