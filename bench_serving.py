@@ -60,19 +60,35 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
         feat = np.zeros((1, 224, 224, 3), np.uint8)
         cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=4.0,
                             image_shape=[224, 224], workers=workers)
+    elif model_kind == "lm":
+        # generative serving: ragged token prompts in, 32 greedy tokens
+        # out through the KV-cache scan (models/lm.generate)
+        from analytics_zoo_tpu.models import TransformerLM
+
+        model = TransformerLM(vocab_size=8192, hidden_size=256,
+                              num_layers=4, num_heads=4,
+                              intermediate_size=1024, max_position=128)
+        feat = np.zeros((1, 32), np.int32)
+        cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=4.0,
+                            workers=workers, prompt_col="tokens")
     else:
         raise ValueError(model_kind)
 
     variables = model.init(jax.random.key(0), feat)
     im = InferenceModel(batch_buckets=(1, 8, 32, batch_size))
-    # "-int8": weight-only quantized serving (the OpenVINO int8 role)
-    quant = "int8" if model_kind.endswith("-int8") else None
-    im.load_flax(model, variables, quantize=quant)
+    if model_kind == "lm":
+        im.load_flax_generator(model, variables, max_new_tokens=32,
+                               prompt_buckets=(32,))
+    else:
+        # "-int8": weight-only quantized serving (the OpenVINO int8 role)
+        quant = "int8" if model_kind.endswith("-int8") else None
+        im.load_flax(model, variables, quantize=quant)
     serving = ClusterServing(im, cfg, embedded_broker=True).start()
 
     # warm the jit buckets so compile time is not measured
     for b in (1, 8, 32, batch_size):
-        im.predict(np.zeros((b,) + feat.shape[1:], feat.dtype))
+        x = np.zeros((b,) + feat.shape[1:], feat.dtype)
+        im.predict(x + 1 if model_kind == "lm" else x)
 
     jpegs = []
     if model_kind.startswith("resnet18"):
@@ -103,6 +119,10 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
                 if jpegs:
                     uri = inq.enqueue_image(
                         f"c{idx}-{i}", image=jpegs[(idx + i) % len(jpegs)])
+                elif model_kind == "lm":
+                    toks = rng.integers(
+                        1, 8192, int(rng.integers(8, 33))).astype(np.int32)
+                    uri = inq.enqueue(f"c{idx}-{i}", tokens=toks)
                 else:
                     x = rng.normal(size=(64,)).astype(np.float32)
                     uri = inq.enqueue(f"c{idx}-{i}", x=x)
@@ -168,6 +188,13 @@ def main():
                      batch_size=64)
     print(json.dumps(r))
     out["scenarios"].append(r)
+    # generative LM: ragged prompts -> 32 greedy tokens (no reference
+    # counterpart; the KV-cache scan is the unit of work per batch)
+    for n_clients, rpc in ((1, 20), (16, 10), (64, 5)):
+        r = run_scenario("lm", n_clients, requests_per_client=rpc,
+                         batch_size=32)
+        print(json.dumps(r))
+        out["scenarios"].append(r)
     with open("SERVING_BENCH.json", "w") as f:
         json.dump(out, f, indent=1)
 
