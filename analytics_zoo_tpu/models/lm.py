@@ -219,16 +219,23 @@ def lm_loss(logits, tokens):
 
 
 def generate(model: TransformerLM, variables, prompt,
-             max_new_tokens: int, prompt_len=None) -> jax.Array:
-    """Greedy generation as ONE lax.scan with a threaded KV cache.
+             max_new_tokens: int, prompt_len=None, *,
+             temperature: float = 0.0, top_k: int = 0,
+             rng=None) -> jax.Array:
+    """Generation as ONE lax.scan with a threaded KV cache.
 
     prompt: [B, P] int32; ``prompt_len`` (optional [B] int32) gives each
     row's true prompt length for right-padded ragged batches (the serving
     path) — defaults to the full width P.  Returns [B, max_new_tokens]:
     row i's tokens generated after its own prompt end.  The same scan
     does prompt prefill (positions < prompt_len teacher-force the prompt)
-    and generation (argmax feedback) — no separate prefill program, no
-    dynamic shapes.
+    and generation feedback — no separate prefill program, no dynamic
+    shapes.
+
+    Sampling: ``temperature=0`` (default) is greedy argmax;
+    ``temperature>0`` samples from logits/temperature (pass ``rng``, a
+    ``jax.random`` key — required then), optionally truncated to the
+    ``top_k`` highest-probability tokens.
     """
     B, Pn = prompt.shape
     L = Pn + max_new_tokens
@@ -249,11 +256,25 @@ def generate(model: TransformerLM, variables, prompt,
     ck0 = jnp.zeros((model.num_layers, B, L, H, D), cdtype)
     cv0 = jnp.zeros_like(ck0)
 
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 needs a jax.random key via rng=")
+
+    def pick(logits, t):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = lax.top_k(scaled, top_k)[0][:, -1][:, None]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        key = jax.random.fold_in(rng, t)
+        return jax.random.categorical(key, scaled, axis=-1).astype(
+            jnp.int32)
+
     def step(carry, t):
         tok, ck, cv = carry
         logits, ck, cv = model.apply(
             variables, tok, ck, cv, t, method=TransformerLM.decode_step)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = pick(logits, t)
         # rows still inside their own prompt replay it
         nxt = jnp.where(t + 1 < plen, prompt[:, jnp.minimum(t + 1, Pn - 1)],
                         nxt)

@@ -93,6 +93,34 @@ def test_generate_learned_repetition():
         stop_orca_context()
 
 
+def test_sampling_generation():
+    """temperature>0 samples (reproducible per key, differs across keys,
+    respects top_k support); temperature=0 stays greedy."""
+    model = _tiny_lm()
+    toks = _toks(b=2, t=6)
+    variables = model.init(jax.random.key(0), toks)
+    g0 = generate(model, variables, toks, 8)
+    g0b = generate(model, variables, toks, 8)
+    np.testing.assert_array_equal(np.asarray(g0), np.asarray(g0b))
+
+    s1 = generate(model, variables, toks, 8, temperature=1.0,
+                  rng=jax.random.key(1))
+    s1b = generate(model, variables, toks, 8, temperature=1.0,
+                   rng=jax.random.key(1))
+    s2 = generate(model, variables, toks, 8, temperature=1.0,
+                  rng=jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s1b))
+    assert not np.array_equal(np.asarray(s1), np.asarray(s2))
+
+    # top_k=1 at any temperature is exactly greedy
+    k1 = generate(model, variables, toks, 8, temperature=1.0, top_k=1,
+                  rng=jax.random.key(3))
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(g0))
+
+    with pytest.raises(ValueError, match="needs a jax.random key"):
+        generate(model, variables, toks, 8, temperature=0.5)
+
+
 def test_remat_matches_non_remat():
     """remat=True recomputes in backward — forward AND grads must be
     identical to the stored-activation path."""
