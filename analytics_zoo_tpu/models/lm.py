@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from analytics_zoo_tpu.models.transformer import (
     _constrain_seq, attention_dispatch)
+from analytics_zoo_tpu.parallel.pipeline import pp_stage_rules as _ppsr
 
 LM_PARTITION_RULES = (
     (r"pos_embed/embedding", P()),      # positions replicate (before the
@@ -40,6 +41,34 @@ LM_PARTITION_RULES = (
     (r"ffn_down/kernel", P("tp", None)),
     (r".*", P()),
 )
+
+
+# TransformerLM(pp_stages=N): GPipe-stacked stage params sharded over pp
+# on the stage dim; embeddings/head follow the non-pp rules.  NOTE: no tp
+# entries for the trunk — pipeline stages execute inside shard_map, where
+# a tp-sharded weight would just be all-gathered every tick (memory at
+# rest, zero compute parallelism); combine pp with dp/fsdp instead.
+LM_PP_PARTITION_RULES = _ppsr() + LM_PARTITION_RULES
+
+
+def unstack_pp_params(params):
+    """pp-trained param tree (``trunk/stages/...`` with a leading stage
+    dim) -> the flat ``layer_{i}`` tree a ``pp_stages=0`` TransformerLM
+    expects.  The bridge from pipeline training to cached-decode serving:
+    train with pp, ``unstack_pp_params``, generate on a non-pp model of
+    the same dimensions."""
+    out = {k: v for k, v in params.items() if k != "trunk"}
+    stacked = params["trunk"]["stages"]
+    stage_layers = sorted(
+        (k for k in stacked if k.startswith("layer_")),
+        key=lambda k: int(k.split("_")[1]))
+    k_per = len(stage_layers)
+    S = jax.tree.leaves(stacked)[0].shape[0]
+    for s in range(S):
+        for j, name in enumerate(stage_layers):
+            out[f"layer_{s * k_per + j}"] = jax.tree.map(
+                lambda a: a[s], stacked[name])
+    return out
 
 
 class DecoderAttention(nn.Module):
@@ -140,12 +169,43 @@ class DecoderLayer(nn.Module):
         return x1, ck, cv
 
 
+class _LMStage(nn.Module):
+    """One pipeline stage: a block of consecutive decoder layers with a
+    plain ``x -> x`` signature (the GPipe stage contract)."""
+
+    layers_per_stage: int
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    dtype: jnp.dtype = jnp.bfloat16
+    use_flash: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.layers_per_stage):
+            # stages run inside shard_map: no mesh constraints (manual
+            # SPMD there), no dropout (no rng plumbing through the ticks)
+            x = DecoderLayer(self.hidden_size, self.num_heads,
+                             self.intermediate_size, dropout=0.0,
+                             dtype=self.dtype, mesh=None,
+                             use_flash=self.use_flash,
+                             name=f"layer_{i}")(x, False)
+        return x
+
+
 class TransformerLM(nn.Module):
     """Decoder-only LM with tied embeddings.
 
     ``__call__(tokens)`` -> next-token logits ``[B, T, V]`` (causal);
     ``decode_step`` runs one cached generation step (used by
-    ``generate``)."""
+    ``generate``).
+
+    ``pp_stages > 0`` pipelines the trunk over the mesh's ``pp`` axis
+    (SPMD GPipe, parallel/pipeline.py): ``num_layers`` must divide into
+    ``pp_stages`` equal blocks, dropout must be 0, and generation is a
+    training-cluster non-goal there (``decode_step`` raises — serve a
+    non-pp restore of the same weights instead).
+    """
 
     vocab_size: int
     hidden_size: int = 256
@@ -158,12 +218,40 @@ class TransformerLM(nn.Module):
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
     remat: bool = False
+    pp_stages: int = 0
+    pp_microbatches: int = 4
 
     def setup(self):
         self.embed = nn.Embed(self.vocab_size, self.hidden_size,
                               name="embed")
         self.pos_embed = nn.Embed(self.max_position, self.hidden_size,
                                   name="pos_embed")
+        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        if self.pp_stages > 0:
+            from analytics_zoo_tpu.parallel.pipeline import GPipe
+
+            if self.num_layers % self.pp_stages:
+                raise ValueError(
+                    f"num_layers {self.num_layers} must divide into "
+                    f"pp_stages {self.pp_stages}")
+            if self.dropout:
+                raise ValueError("pp_stages needs dropout=0 (stages run "
+                                 "without rng plumbing)")
+            if self.remat:
+                raise ValueError(
+                    "remat is not applied to pipelined trunks (the GPipe "
+                    "scan already bounds live activations to one "
+                    "microbatch per stage); set remat=False")
+            self.trunk = GPipe(
+                stage=_LMStage(self.num_layers // self.pp_stages,
+                               self.hidden_size, self.num_heads,
+                               self.intermediate_size, dtype=self.dtype,
+                               use_flash=self.use_flash),
+                n_stages=self.pp_stages,
+                n_microbatches=self.pp_microbatches,
+                mesh=self.mesh, name="trunk")
+            self.layers = ()
+            return
         # remat checkpoints each block's training __call__ (recompute in
         # backward instead of storing activations); decode is untouched
         # (no gradients there)
@@ -176,7 +264,6 @@ class TransformerLM(nn.Module):
                       dtype=self.dtype, mesh=self.mesh,
                       use_flash=self.use_flash, name=f"layer_{i}")
             for i in range(self.num_layers)]
-        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
 
     def _logits(self, x):
         # tied head: f32 logits for a stable softmax/CE
@@ -192,13 +279,21 @@ class TransformerLM(nn.Module):
                 "would silently return NaN/clamped rows)")
         x = self.embed(tokens) + self.pos_embed(jnp.arange(T)[None])
         x = _constrain_seq(x.astype(self.dtype), self.mesh)
-        for layer in self.layers:
-            x = layer(x, train)
+        if self.pp_stages > 0:
+            x = self.trunk(x)
+        else:
+            for layer in self.layers:
+                x = layer(x, train)
         return self._logits(self.ln_f(x))
 
     def decode_step(self, tok, caches_k, caches_v, pos):
         """tok: [B] current tokens; caches_k/v: [n_layers, B, L, H, D];
         pos: scalar.  Returns (logits [B, V], caches_k, caches_v)."""
+        if self.pp_stages > 0:
+            raise NotImplementedError(
+                "cached decode is not pipelined; convert the params with "
+                "models.lm.unstack_pp_params and generate on a "
+                "pp_stages=0 TransformerLM of the same dimensions")
         x = self.embed(tok)[:, None] + self.pos_embed(pos)[None, None]
         x = x.astype(self.dtype)
         ks, vs = [], []

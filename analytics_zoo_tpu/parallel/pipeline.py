@@ -15,9 +15,11 @@ M microbatches.  The whole schedule differentiates through scan/ppermute,
 so the SAME code is forward and backward pipelining; XLA overlaps the
 ppermute hop with the next tick's compute.
 
-Composes with the other axes: batch stays sharded over dp/fsdp (each pp
-rank sees its dp-local batch), and stage-internal tensor parallelism works
-by giving stage weights tp-sharded dims via ``pp_stage_rules``.
+Composes with the batch axes: batch stays sharded over dp/fsdp (each pp
+rank sees its dp-local batch).  Stage-INTERNAL tensor parallelism does
+NOT compose: stages execute inside shard_map, where a tp-sharded weight
+is simply all-gathered per tick (at-rest memory, no compute split) — pair
+pp with dp/fsdp, and use tp on the non-pipelined parts of the model.
 """
 
 from __future__ import annotations
