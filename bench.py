@@ -428,16 +428,52 @@ def _run_sub(name: str, timeout: int = 1800):
     return None
 
 
+def _device_preflight(timeout: int = 240):
+    """The tunneled TPU can wedge hard (jax.devices() blocks forever — a
+    lost remote grant; observed in round 3).  Probe it in a subprocess
+    with a timeout so a dead device costs minutes and a clear message,
+    not len(BENCHES) x 1800 s of silent hanging.  Returns (ok, reason);
+    a non-TPU device kind also fails — a silent CPU fallback would
+    otherwise produce fast, wrong 'TPU' numbers."""
+    code = ("import jax; d = jax.devices(); "
+            "import jax.numpy as jnp; float(jnp.ones(2).sum()); "
+            "print('kind:', d[0].device_kind)")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, (f"jax.devices() unresponsive within {timeout}s "
+                       "(wedged device tunnel); no benchmarks ran")
+    if out.returncode != 0:
+        return False, ("device probe crashed (rc="
+                       f"{out.returncode}): {out.stderr[-500:]}")
+    kind = next((l.split("kind:", 1)[1].strip()
+                 for l in out.stdout.splitlines() if "kind:" in l), "")
+    if not kind.startswith("TPU"):
+        return False, (f"probe found device kind {kind!r}, not a TPU — "
+                       "refusing to record CPU-fallback numbers as "
+                       "chip throughput")
+    return True, kind
+
+
 def main():
     if "--bench" in sys.argv:
         name = sys.argv[sys.argv.index("--bench") + 1]
         print(json.dumps(BENCHES[name]()))
         return
-    if "--cpu-baseline" in sys.argv:      # back-compat entry point
+    if "--cpu-baseline" in sys.argv:      # CPU-only: no TPU preflight
         res = bench_bert("cpu")
         res["cpu_samples_per_sec"] = res["samples_per_sec"]  # old key
         print(json.dumps(res))
         return
+    ok, reason = _device_preflight()
+    if not ok:
+        print(json.dumps({
+            "metric": "bert_base_ft_samples_per_sec_per_chip",
+            "value": None, "unit": "samples/sec", "vs_baseline": None,
+            "extra": {"error": f"device preflight failed: {reason}"}}))
+        sys.exit(1)
     bert = _run_sub("bert")
     ncf = _run_sub("ncf")
     resnet = _run_sub("resnet")
