@@ -25,6 +25,7 @@ examples:
 	$(PY) examples/serve_model.py
 	$(PY) examples/multihost_fit.py
 	$(PY) examples/train_moe_pipeline.py --devices 8 --epochs 2
+	$(PY) examples/lm_generate.py --devices 8
 
 # compile the C++ data plane in place (csv parser, zrec store, ring
 # buffer, image decode)
