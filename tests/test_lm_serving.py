@@ -120,6 +120,44 @@ def test_serving_overlong_prompt_errors_alone():
         serving.stop()
 
 
+def test_http_frontend_generates():
+    """REST round-trip for generation: POST /predict with token lists of
+    different lengths; each row gets its own continuation."""
+    import http.client
+    import json
+
+    from analytics_zoo_tpu.serving import HttpFrontend
+
+    model, variables = _lm_and_vars()
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=4, prompt_buckets=(8, 16))
+    cfg = ServingConfig(batch_size=8, batch_timeout_ms=30.0,
+                        prompt_col="tokens", prompt_pad_id=0)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = HttpFrontend(redis_port=serving.port, timeout=30,
+                      serving=serving).start()
+    try:
+        rng = np.random.default_rng(4)
+        p1 = rng.integers(1, 32, 6).astype(np.int32)
+        p2 = rng.integers(1, 32, 3).astype(np.int32)
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port, timeout=40)
+        conn.request("POST", "/predict", json.dumps({
+            "instances": [{"tokens": p1.tolist()},
+                          {"tokens": p2.tolist()}]}),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        preds = json.loads(resp.read())["predictions"]
+        for p, got in zip((p1, p2), preds):
+            ref = np.asarray(generate(model, variables,
+                                      jnp.asarray(p[None]), 4))
+            np.testing.assert_array_equal(np.asarray(got, np.int32),
+                                          ref[0])
+    finally:
+        fe.stop()
+        serving.stop()
+
+
 def test_cluster_serving_generates_ragged_prompts():
     """e2e: clients enqueue different-length prompts; the batcher pads,
     threads lengths, and each client gets its own continuation."""
