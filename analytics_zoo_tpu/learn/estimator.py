@@ -169,8 +169,11 @@ class FlaxEstimator:
             out, mut = self.model.apply(
                 variables, *feats, mutable=["batch_stats", "losses"],
                 rngs=rngs, **kw)
-            aux = sum((jnp.sum(leaf) for leaf in
-                       jax.tree.leaves(mut.get("losses", {}))),
+            leaves = jax.tree.leaves(mut.get("losses", {}))
+            # whether the model sows aux losses is STATIC (trace-time):
+            # models without them never pay a metrics entry
+            self._has_aux_losses = bool(leaves)
+            aux = sum((jnp.sum(leaf) for leaf in leaves),
                       jnp.float32(0.0))
             new_bs = mut["batch_stats"] if has_bs else None
             return out, new_bs, aux
@@ -197,12 +200,16 @@ class FlaxEstimator:
             loss = self.loss_fn(preds, self._labels(batch)) + aux
             if self.param_loss is not None:
                 loss = loss + self.param_loss(params)
-            return loss, (preds, new_bs)
+            return loss, (preds, new_bs, aux)
 
-        (loss, (preds, new_bs)), grads = jax.value_and_grad(
+        (loss, (preds, new_bs, aux)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(state.params)
         new_state = state.apply_gradients(grads=grads, batch_stats=new_bs)
         mets = {"loss": loss}
+        if getattr(self, "_has_aux_losses", False):
+            # observability: the sown component (MoE load balance etc.)
+            # reported beside the total it is already inside of
+            mets["aux_loss"] = aux
         labels = self._labels(batch)
         for name, fn in self.metric_fns:
             mets[name] = fn(preds, labels)
@@ -237,20 +244,21 @@ class FlaxEstimator:
             loss = self.loss_fn(preds, self._labels(mb)) + aux
             if self.param_loss is not None:
                 loss = loss + self.param_loss(params)
-            return loss, (preds, new_bs)
+            return loss, (preds, new_bs, aux)
 
         def body(carry, xs):
-            g_acc, loss_acc, bs = carry
+            g_acc, loss_acc, aux_acc, bs = carry
             mb, i = xs
-            (loss, (preds, new_bs)), grads = jax.value_and_grad(
+            (loss, (preds, new_bs, aux)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(
                 state.params, mb, bs, jax.random.fold_in(rng, i))
             g_acc = jax.tree.map(jnp.add, g_acc, grads)
-            return (g_acc, loss_acc + loss, new_bs), preds
+            return (g_acc, loss_acc + loss, aux_acc + aux, new_bs), preds
 
         zeros = jax.tree.map(jnp.zeros_like, state.params)
-        (g_acc, loss_sum, bs_final), preds = jax.lax.scan(
-            body, (zeros, jnp.float32(0.0), state.batch_stats),
+        (g_acc, loss_sum, aux_sum, bs_final), preds = jax.lax.scan(
+            body, (zeros, jnp.float32(0.0), jnp.float32(0.0),
+                   state.batch_stats),
             (mbs, jnp.arange(accum)))
         grads = jax.tree.map(lambda g: g / accum, g_acc)
         new_state = state.apply_gradients(grads=grads,
@@ -259,6 +267,8 @@ class FlaxEstimator:
         preds = jax.tree.map(
             lambda p: p.reshape((-1,) + p.shape[2:]), preds)
         mets = {"loss": loss_sum / accum}
+        if getattr(self, "_has_aux_losses", False):
+            mets["aux_loss"] = aux_sum / accum
         labels = self._labels(batch)
         for name, fn in self.metric_fns:
             mets[name] = fn(preds, labels)

@@ -134,9 +134,10 @@ def test_http_frontend_generates():
     cfg = ServingConfig(batch_size=8, batch_timeout_ms=30.0,
                         prompt_col="tokens", prompt_pad_id=0)
     serving = ClusterServing(im, cfg, embedded_broker=True).start()
-    fe = HttpFrontend(redis_port=serving.port, timeout=30,
-                      serving=serving).start()
+    fe = None
     try:
+        fe = HttpFrontend(redis_port=serving.port, timeout=30,
+                          serving=serving).start()
         rng = np.random.default_rng(4)
         p1 = rng.integers(1, 32, 6).astype(np.int32)
         p2 = rng.integers(1, 32, 3).astype(np.int32)
@@ -154,7 +155,8 @@ def test_http_frontend_generates():
             np.testing.assert_array_equal(np.asarray(got, np.int32),
                                           ref[0])
     finally:
-        fe.stop()
+        if fe is not None:
+            fe.stop()
         serving.stop()
 
 

@@ -97,8 +97,14 @@ def test_estimator_collects_losses_collection(ctx8):
     hist = est.fit(data, epochs=1, batch_size=32)
     train_loss = hist[0]["loss"]
     eval_loss = est.evaluate(data, batch_size=32)["loss"]
-    # train loss = CE + 3.0 (sown), eval loss = CE alone
+    # train loss = CE + 3.0 (sown), eval loss = CE alone; the sown
+    # component is also reported on its own for observability
     assert train_loss == pytest.approx(eval_loss + 3.0, abs=1e-3)
+    assert hist[0]["aux_loss"] == pytest.approx(3.0, abs=1e-6)
+    # same metric contract under gradient accumulation
+    est.config.accum_steps = 2
+    hist2 = est.fit(data, epochs=1, batch_size=32)
+    assert hist2[0]["aux_loss"] == pytest.approx(3.0, abs=1e-6)
 
 
 def test_ep_sharded_matches_single_device():
@@ -191,6 +197,7 @@ def test_moe_classifier_trains_ep_sharded():
         assert hist[-1]["loss"] < hist[0]["loss"] * 0.9, \
             [h["loss"] for h in hist]
         assert hist[-1]["accuracy"] > 0.65, hist[-1]
+        assert 0 < hist[-1]["aux_loss"] < 0.1, hist[-1]   # ~weight * 1.0
         # expert params actually sharded over ep
         w_up = est.state.params["layer_0"]["moe"]["w_up"]
         spec = w_up.sharding.spec
