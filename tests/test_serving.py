@@ -184,11 +184,13 @@ class TestClusterServing:
         p.write_text(
             "model:\n  path: /models/m\n"
             "redis:\n  src: 10.0.0.5:6380\n"
-            "params:\n  batch_size: 64\n")
+            "params:\n  batch_size: 64\n  prompt_col: tokens\n"
+            "  prompt_pad_id: 3\n")
         cfg = ServingConfig.from_yaml(str(p))
         assert cfg.model_path == "/models/m"
         assert (cfg.redis_host, cfg.redis_port) == ("10.0.0.5", 6380)
         assert cfg.batch_size == 64
+        assert cfg.prompt_col == "tokens" and cfg.prompt_pad_id == 3
 
     def test_config_core_number_is_not_batch_size(self, tmp_path):
         """Reference config.yaml: core_number = CPU cores; a ported config
