@@ -75,6 +75,12 @@ class MoEMLP(nn.Module):
     ``E -> intermediate_size -> E`` computed in ``dtype`` on the MXU.
     Tokens over an expert's capacity are dropped (their contribution is 0 —
     callers keep a residual connection so dropped tokens pass through).
+
+    Capacity-bounded routing makes outputs weakly BATCH-COUPLED: tokens
+    compete for expert slots, so a row's output can shift slightly with
+    its batchmates (including padding rows at serving time).  This is
+    inherent to capacity-style MoE, not a bug; raise ``capacity_factor``
+    where batch-composition independence matters more than compute.
     """
 
     num_experts: int
