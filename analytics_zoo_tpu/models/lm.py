@@ -51,6 +51,98 @@ LM_PARTITION_RULES = (
 LM_PP_PARTITION_RULES = _ppsr() + LM_PARTITION_RULES
 
 
+def beam_search(model: TransformerLM, variables, prompt,
+                max_new_tokens: int, beam_size: int = 4) -> tuple:
+    """Beam-search decoding as one lax.scan (compiler-friendly: the beam
+    lives as an extra leading dim, KV caches reorder on-device with a
+    batched gather instead of host-side bookkeeping).
+
+    prompt: [B, P] int32 (full-width prompts; use generate() for ragged
+    serving).  Returns ``(tokens [B, beam, max_new], scores [B, beam])``
+    with beams sorted best-first; ``scores`` are sum log-probs (all
+    hypotheses share the fixed length, so no length penalty applies).
+
+    Cost note: the prompt prefill runs at full beam width (K identical
+    copies) — one scan keeps the program simple; for very long prompts a
+    width-1 prefill + cache tile would save (K-1)/K of the prefill FLOPs.
+    """
+    B, Pn = prompt.shape
+    K = int(beam_size)
+    L = Pn + max_new_tokens
+    if max_new_tokens <= 0:
+        return (jnp.zeros((B, K, 0), jnp.int32),
+                jnp.zeros((B, K), jnp.float32))
+    if L > model.max_position:
+        raise ValueError(f"prompt+new = {L} exceeds max_position "
+                         f"{model.max_position}")
+    V = model.vocab_size
+    H, D = model.num_heads, model.hidden_size // model.num_heads
+    cdtype = jnp.dtype(model.dtype)
+
+    # beams fold into the batch dim: [B*K, ...] everywhere
+    def bk(x):
+        return x.reshape((B * K,) + x.shape[2:])
+
+    prompt_k = jnp.repeat(prompt[:, None], K, axis=1)        # [B, K, P]
+    ck0 = jnp.zeros((model.num_layers, B * K, L, H, D), cdtype)
+    cv0 = jnp.zeros_like(ck0)
+    # only beam 0 is live at start (identical prompts would otherwise
+    # produce K copies of the same hypothesis)
+    neg = jnp.float32(-1e9)
+    scores0 = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, neg) * \
+        jnp.ones((B, 1))
+    toks0 = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+
+    def step(carry, t):
+        tok, ck, cv, scores, toks = carry
+        logits, ck, cv = model.apply(
+            variables, tok, ck, cv, t, method=TransformerLM.decode_step)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, K, V)
+        in_prompt = t + 1 < Pn
+
+        def prompt_phase():
+            # teacher-force: every beam advances on the prompt token;
+            # scores unchanged, caches already updated by decode_step
+            nxt = prompt_k[:, :, jnp.minimum(t + 1, Pn - 1)]
+            return nxt, scores, toks, jnp.repeat(
+                jnp.arange(K)[None], B, axis=0)
+
+        def gen_phase():
+            cand = scores[:, :, None] + logp              # [B, K, V]
+            flat = cand.reshape(B, K * V)
+            top_s, top_i = lax.top_k(flat, K)             # [B, K]
+            src_beam = top_i // V
+            nxt = (top_i % V).astype(jnp.int32)
+            new_toks = jnp.take_along_axis(
+                toks, src_beam[:, :, None], axis=1)
+            w = jnp.clip(t + 1 - Pn, 0, max_new_tokens - 1)
+            new_toks = lax.dynamic_update_index_in_dim(
+                new_toks.transpose(2, 0, 1), nxt, w, 0).transpose(1, 2, 0)
+            return nxt, top_s, new_toks, src_beam
+
+        nxt, new_scores, new_toks, src_beam = jax.tree.map(
+            lambda a, b: jnp.where(in_prompt, a, b),
+            prompt_phase(), gen_phase())
+        # reorder the KV caches to follow their beams ([n_layers, B*K,...]);
+        # during prefill src_beam is the identity — lax.cond skips the
+        # full-cache gather there (XLA can't prove a dynamic gather is id)
+        gidx = (jnp.arange(B)[:, None] * K + src_beam).reshape(-1)
+        ck, cv = lax.cond(
+            in_prompt, lambda c, v, _: (c, v),
+            lambda c, v, g: (c[:, g], v[:, g]), ck, cv, gidx)
+        return (bk(nxt[:, :, None])[:, 0], ck, cv, new_scores,
+                new_toks), None
+
+    tok0 = bk(prompt_k[:, :, 0, None])[:, 0]
+    carry = (tok0, ck0, cv0, scores0, toks0)
+    (_, _, _, scores, toks), _ = lax.scan(step, carry, jnp.arange(L - 1))
+    order = jnp.argsort(-scores, axis=1)
+    toks = jnp.take_along_axis(toks, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return toks, scores
+
+
 def unstack_pp_params(params):
     """pp-trained param tree (``trunk/stages/...`` with a leading stage
     dim) -> the flat ``layer_{i}`` tree a ``pp_stages=0`` TransformerLM

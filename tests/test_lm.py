@@ -10,6 +10,7 @@ import pytest
 
 from analytics_zoo_tpu.models import (
     TransformerLM, LM_PARTITION_RULES, generate, lm_loss)
+from analytics_zoo_tpu.models.lm import beam_search
 
 
 def _tiny_lm(**kw):
@@ -119,6 +120,53 @@ def test_sampling_generation():
 
     with pytest.raises(ValueError, match="needs a jax.random key"):
         generate(model, variables, toks, 8, temperature=0.5)
+
+
+def test_beam_size_one_equals_greedy():
+    model = _tiny_lm()
+    toks = _toks(b=3, t=5)
+    variables = model.init(jax.random.key(0), toks)
+    greedy = generate(model, variables, toks, 6)
+    beams, scores = beam_search(model, variables, toks, 6, beam_size=1)
+    np.testing.assert_array_equal(np.asarray(beams[:, 0]),
+                                  np.asarray(greedy))
+    assert scores.shape == (3, 1)
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_beam_search_scores_sorted_and_contains_greedy_on_peaked_model():
+    """On a trained (peaked) model the greedy path is the top beam; and
+    beams always come back score-sorted."""
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.learn import Estimator
+    import optax
+
+    init_orca_context("local", mesh_axes={"dp": 8})
+    try:
+        rng = np.random.default_rng(0)
+        n, t, vocab = 512, 10, 16
+        sym = rng.integers(2, vocab, n).astype(np.int32)
+        toks = np.repeat(sym[:, None], t, axis=1)
+        model = _tiny_lm(vocab_size=vocab)
+        est = Estimator.from_flax(
+            model=model, loss=lm_loss, optimizer=optax.adam(3e-3),
+            feature_cols=("tokens",), label_cols=("tokens",),
+            partition_rules=LM_PARTITION_RULES)
+        est.fit({"tokens": toks}, epochs=8, batch_size=128)
+        variables = {"params": jax.device_get(est.state.params)}
+        prompt = np.repeat(np.asarray([[7], [11]], np.int32), 3, axis=1)
+        greedy = np.asarray(generate(model, variables,
+                                     jnp.asarray(prompt), 5))
+        beams, scores = beam_search(model, variables, jnp.asarray(prompt),
+                                    5, beam_size=4)
+        s = np.asarray(scores)
+        assert (np.diff(s, axis=1) <= 1e-6).all(), s   # sorted desc
+        np.testing.assert_array_equal(np.asarray(beams[:, 0]), greedy)
+        # distinct hypotheses, not K copies of one beam
+        assert not np.array_equal(np.asarray(beams[:, 0]),
+                                  np.asarray(beams[:, 1]))
+    finally:
+        stop_orca_context()
 
 
 def test_remat_matches_non_remat():
