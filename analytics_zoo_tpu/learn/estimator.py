@@ -515,6 +515,9 @@ class FlaxEstimator:
         prof = self.config.profile      # (logdir, start_step, n_steps)
         prof_active = False
         history: List[Dict[str, float]] = []
+        for cb in callbacks:
+            # stateful callbacks (EarlyStopping) restart fresh per fit
+            getattr(cb, "reset", lambda: None)()
         log_every = max(1, self.config.log_every_steps)
         debug_nans_was = None
         if self.config.debug_nans:
@@ -603,11 +606,25 @@ class FlaxEstimator:
                                     "epoch": self._epoch, "epoch_end": True,
                                     "metrics": stats}):
                 self._maybe_checkpoint()
+            stop = False
             for cb in callbacks:
-                cb({"epoch": self._epoch, **stats})
+                ret = cb({"epoch": self._epoch, **stats})
+                # only callbacks that OPT IN (requests_stop attr, e.g.
+                # EarlyStopping) may stop training via their return value
+                # — an ordinary logger returning something truthy must
+                # never silently truncate a 50-epoch run
+                if getattr(cb, "requests_stop", False):
+                    stop = bool(ret) or stop
             logger.info("epoch %d: %s", self._epoch,
                         {k: round(v, 5) for k, v in stats.items()})
             history.append(stats)
+            if jax.process_count() > 1:
+                # hosts must agree on the epoch count or the next
+                # collective deadlocks: any host's stop stops everyone
+                stop = bool(_allgather_counts(int(stop))[:, 0].max())
+            if stop:
+                logger.info("early stop at epoch %d", self._epoch)
+                break
         return history
 
     def _check_host_local_source(self, data):

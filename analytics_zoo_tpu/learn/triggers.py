@@ -86,3 +86,62 @@ class _Or(Trigger):
 
     def __call__(self, s):
         return self.a(s) or self.b(s)
+
+
+class EarlyStopping:
+    """Epoch-end callback: stop fit() when a monitored metric hasn't
+    improved for `patience` epochs (keras-parity training control; the
+    reference's closest analog is the MinLoss/MaxScore end triggers).
+
+    Pass an instance in ``fit(callbacks=[EarlyStopping(...)])``; it
+    returns True from its callback to request the stop.  ``best`` and
+    ``stopped_epoch`` are inspectable afterwards.
+    """
+
+    # opt-in marker: fit() only honors stop-requesting return values from
+    # callbacks that declare it (ordinary loggers can't truncate a run)
+    requests_stop = True
+
+    def __init__(self, monitor: str = "val_loss", patience: int = 3,
+                 min_delta: float = 0.0, mode: str = "min"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.monitor = monitor
+        self.patience = max(1, int(patience))
+        self.min_delta = float(min_delta)
+        self.mode = mode
+        self.reset()
+
+    def reset(self):
+        """Fresh tracking state; fit() calls this at train start so an
+        instance can be reused across fit() calls (keras on_train_begin
+        semantics)."""
+        self.best = None
+        self.wait = 0
+        self.stopped_epoch = None
+
+    def _improved(self, v: float) -> bool:
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return v < self.best - self.min_delta
+        return v > self.best + self.min_delta
+
+    def __call__(self, stats: dict):
+        v = stats.get(self.monitor)
+        if v is None:
+            import logging
+
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "EarlyStopping: metric %r not in epoch stats %s",
+                self.monitor, sorted(stats))
+            return False
+        if self._improved(float(v)):
+            self.best = float(v)
+            self.wait = 0
+            return False
+        self.wait += 1
+        if self.wait >= self.patience:
+            self.stopped_epoch = stats.get("epoch")
+            return True
+        return False

@@ -137,9 +137,8 @@ def beam_search(model: TransformerLM, variables, prompt,
     tok0 = bk(prompt_k[:, :, 0, None])[:, 0]
     carry = (tok0, ck0, cv0, scores0, toks0)
     (_, _, _, scores, toks), _ = lax.scan(step, carry, jnp.arange(L - 1))
-    order = jnp.argsort(-scores, axis=1)
-    toks = jnp.take_along_axis(toks, order[:, :, None], axis=1)
-    scores = jnp.take_along_axis(scores, order, axis=1)
+    # already sorted best-first: the final tick is always a gen step
+    # (max_new >= 1) and lax.top_k returns descending values
     return toks, scores
 
 

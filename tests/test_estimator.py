@@ -155,3 +155,44 @@ def test_from_openvino_refuses_with_migration_path():
 
     with pytest.raises(NotImplementedError, match="quantize='int8'"):
         Estimator.from_openvino(model_path="model.xml")
+
+
+def test_early_stopping_callback(ctx8):
+    """EarlyStopping halts fit when the monitored metric stops improving;
+    an unknown metric warns and never stops."""
+    import optax
+
+    from analytics_zoo_tpu.learn import EarlyStopping, Estimator
+
+    class Frozen(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    rng = np.random.default_rng(0)
+    data = {"x": rng.normal(size=(64, 4)).astype(np.float32),
+            "y": rng.integers(0, 2, 64).astype(np.int32)}
+    est = Estimator.from_flax(
+        model=Frozen(), loss="sparse_categorical_crossentropy",
+        optimizer=optax.sgd(0.0),      # lr 0: loss can never improve
+        feature_cols=("x",), label_cols=("y",))
+    est.config.deterministic = True
+    stopper = EarlyStopping(monitor="loss", patience=2)
+    hist = est.fit(data, epochs=10, batch_size=32, callbacks=[stopper])
+    # epoch 1 sets best; epochs 2 and 3 fail to improve -> stop at 3
+    assert len(hist) == 3, [h["loss"] for h in hist]
+    assert stopper.stopped_epoch == 3
+
+    missing = EarlyStopping(monitor="nope", patience=1)
+    hist2 = est.fit(data, epochs=3, batch_size=32, callbacks=[missing])
+    assert len(hist2) == 3 and missing.stopped_epoch is None
+
+    # reuse: fit() resets the stopper's state, so a second run gets its
+    # full patience again instead of dying on epoch 1
+    hist3 = est.fit(data, epochs=10, batch_size=32, callbacks=[stopper])
+    assert len(hist3) == 3
+
+    # ordinary callbacks returning truthy values must NOT stop training
+    hist4 = est.fit(data, epochs=3, batch_size=32,
+                    callbacks=[lambda s: s])
+    assert len(hist4) == 3
