@@ -516,8 +516,11 @@ class FlaxEstimator:
         prof_active = False
         history: List[Dict[str, float]] = []
         for cb in callbacks:
-            # stateful callbacks (EarlyStopping) restart fresh per fit
-            getattr(cb, "reset", lambda: None)()
+            # stateful stop-requesting callbacks (EarlyStopping) restart
+            # fresh per fit; ordinary callbacks are never touched (same
+            # opt-in principle as requests_stop)
+            if getattr(cb, "requests_stop", False):
+                getattr(cb, "reset", lambda: None)()
         log_every = max(1, self.config.log_every_steps)
         debug_nans_was = None
         if self.config.debug_nans:
@@ -618,9 +621,13 @@ class FlaxEstimator:
             logger.info("epoch %d: %s", self._epoch,
                         {k: round(v, 5) for k, v in stats.items()})
             history.append(stats)
-            if jax.process_count() > 1:
+            if jax.process_count() > 1 and any(
+                    getattr(cb, "requests_stop", False)
+                    for cb in callbacks):
                 # hosts must agree on the epoch count or the next
-                # collective deadlocks: any host's stop stops everyone
+                # collective deadlocks: any host's stop stops everyone.
+                # (Gated on a stop-capable callback existing — no
+                # per-epoch barrier for ordinary multihost fits.)
                 stop = bool(_allgather_counts(int(stop))[:, 0].max())
             if stop:
                 logger.info("early stop at epoch %d", self._epoch)

@@ -134,7 +134,8 @@ def scenario_checkpoint(pid, outdir):
                         jax.tree.leaves(est2.state.params)))
     return {"saved_step": saved_step,
             "restored_step": int(est2.state.step),
-            "params_match": bool(same)}
+            "params_match": bool(same),
+            "params": _params_to_lists(est.state.params)}
 
 
 def scenario_disk(pid, outdir):

@@ -173,13 +173,29 @@ def test_multihost_read_csv_disjoint(tmp_path):
     assert len(results[1]["rows"]) == 8
 
 
-def test_multihost_checkpoint_roundtrip(tmp_path):
-    """Orbax save on 2 processes, restore into a diverged estimator."""
+def test_multihost_checkpoint_roundtrip(tmp_path, ctx8):
+    """Orbax save on 2 processes, restore into a diverged estimator —
+    then restore the SAME checkpoint in this single-process parent
+    (cross-process-count portability: resume a 2-host run on 1 host)."""
     results = run_scenario("checkpoint", tmp_path)
     for r in results:
         assert r["saved_step"] == 4          # 64 rows / 16 global batch
         assert r["restored_step"] == 4
         assert r["params_match"] is True
+
+    sys.path.insert(0, os.path.dirname(WORKER))
+    import _multihost_worker as w
+
+    x, y = w.make_data()
+    est = w.make_estimator()
+    est._ensure_state({"x": x, "y": y})
+    est.load_checkpoint(os.path.join(str(tmp_path), "ckpt"))
+    assert int(est.state.step) == 4
+    # params equal the 2-process run's saved params
+    want = results[0]["params"]
+    got = w._params_to_lists(est.state.params)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], atol=1e-7, err_msg=k)
 
 
 def test_multihost_disk_feature_set(tmp_path, ctx8):
