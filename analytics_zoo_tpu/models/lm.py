@@ -53,7 +53,7 @@ LM_PP_PARTITION_RULES = _ppsr() + LM_PARTITION_RULES
 
 def beam_search(model: TransformerLM, variables, prompt,
                 max_new_tokens: int, beam_size: int = 4) -> tuple:
-    """Beam-search decoding as one lax.scan (compiler-friendly: the beam
+    """Beam-search decoding as two lax.scans (compiler-friendly: the beam
     lives as an extra leading dim, KV caches reorder on-device with a
     batched gather instead of host-side bookkeeping).
 
@@ -62,9 +62,10 @@ def beam_search(model: TransformerLM, variables, prompt,
     with beams sorted best-first; ``scores`` are sum log-probs (all
     hypotheses share the fixed length, so no length penalty applies).
 
-    Cost note: the prompt prefill runs at full beam width (K identical
-    copies) — one scan keeps the program simple; for very long prompts a
-    width-1 prefill + cache tile would save (K-1)/K of the prefill FLOPs.
+    Two scans: a width-1 PREFILL over the prompt (beams are identical
+    there — running them K-wide would waste (K-1)/K of the prefill
+    FLOPs), then the cache tiles to beam width and the generation scan
+    expands/reorders hypotheses.
     """
     B, Pn = prompt.shape
     K = int(beam_size)
@@ -79,66 +80,61 @@ def beam_search(model: TransformerLM, variables, prompt,
     H, D = model.num_heads, model.hidden_size // model.num_heads
     cdtype = jnp.dtype(model.dtype)
 
-    # beams fold into the batch dim: [B*K, ...] everywhere
-    def bk(x):
-        return x.reshape((B * K,) + x.shape[2:])
+    # ---- prefill at width 1 over the prompt --------------------------
+    ck1 = jnp.zeros((model.num_layers, B, L, H, D), cdtype)
+    cv1 = jnp.zeros_like(ck1)
 
-    prompt_k = jnp.repeat(prompt[:, None], K, axis=1)        # [B, K, P]
-    ck0 = jnp.zeros((model.num_layers, B * K, L, H, D), cdtype)
-    cv0 = jnp.zeros_like(ck0)
-    # only beam 0 is live at start (identical prompts would otherwise
-    # produce K copies of the same hypothesis)
-    neg = jnp.float32(-1e9)
-    scores0 = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, neg) * \
-        jnp.ones((B, 1))
+    def prefill(carry, t):
+        ck, cv, _ = carry
+        logits, ck, cv = model.apply(
+            variables, prompt[:, t], ck, cv, t,
+            method=TransformerLM.decode_step)
+        # only the LAST position's logits matter: carry them instead of
+        # stacking [Pn, B, V] of throwaway float32 through scan outputs
+        return (ck, cv, logits), None
+
+    (ck1, cv1, last_logits), _ = lax.scan(
+        prefill, (ck1, cv1, jnp.zeros((B, V), jnp.float32)),
+        jnp.arange(Pn))
+
+    # ---- tile to beam width; beams fold into the batch dim -----------
+    def tile(c):        # [layers, B, L, H, D] -> [layers, B*K, L, H, D]
+        return jnp.repeat(c, K, axis=1)
+
+    ck0, cv0 = tile(ck1), tile(cv1)
+    # seed the K beams from the top-K first tokens (a beam-0-only
+    # restriction is unnecessary: this top_k IS the first expansion)
+    logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+    scores0, tok0_k = lax.top_k(logp0, K)            # [B, K]
     toks0 = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+    toks0 = toks0.at[:, :, 0].set(tok0_k)
+
+    if max_new_tokens == 1:
+        return toks0, scores0
 
     def step(carry, t):
         tok, ck, cv, scores, toks = carry
         logits, ck, cv = model.apply(
             variables, tok, ck, cv, t, method=TransformerLM.decode_step)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        logp = logp.reshape(B, K, V)
-        in_prompt = t + 1 < Pn
-
-        def prompt_phase():
-            # teacher-force: every beam advances on the prompt token;
-            # scores unchanged, caches already updated by decode_step
-            nxt = prompt_k[:, :, jnp.minimum(t + 1, Pn - 1)]
-            return nxt, scores, toks, jnp.repeat(
-                jnp.arange(K)[None], B, axis=0)
-
-        def gen_phase():
-            cand = scores[:, :, None] + logp              # [B, K, V]
-            flat = cand.reshape(B, K * V)
-            top_s, top_i = lax.top_k(flat, K)             # [B, K]
-            src_beam = top_i // V
-            nxt = (top_i % V).astype(jnp.int32)
-            new_toks = jnp.take_along_axis(
-                toks, src_beam[:, :, None], axis=1)
-            w = jnp.clip(t + 1 - Pn, 0, max_new_tokens - 1)
-            new_toks = lax.dynamic_update_index_in_dim(
-                new_toks.transpose(2, 0, 1), nxt, w, 0).transpose(1, 2, 0)
-            return nxt, top_s, new_toks, src_beam
-
-        nxt, new_scores, new_toks, src_beam = jax.tree.map(
-            lambda a, b: jnp.where(in_prompt, a, b),
-            prompt_phase(), gen_phase())
-        # reorder the KV caches to follow their beams ([n_layers, B*K,...]);
-        # during prefill src_beam is the identity — lax.cond skips the
-        # full-cache gather there (XLA can't prove a dynamic gather is id)
+        cand = scores[:, :, None] + logp.reshape(B, K, V)
+        flat = cand.reshape(B, K * V)
+        top_s, top_i = lax.top_k(flat, K)            # [B, K]
+        src_beam = top_i // V
+        nxt = (top_i % V).astype(jnp.int32)
+        new_toks = jnp.take_along_axis(toks, src_beam[:, :, None], axis=1)
+        w = t + 1 - Pn                               # 1..max_new-1
+        new_toks = lax.dynamic_update_index_in_dim(
+            new_toks.transpose(2, 0, 1), nxt, w, 0).transpose(1, 2, 0)
+        # reorder KV caches to follow their beams ([layers, B*K, ...])
         gidx = (jnp.arange(B)[:, None] * K + src_beam).reshape(-1)
-        ck, cv = lax.cond(
-            in_prompt, lambda c, v, _: (c, v),
-            lambda c, v, g: (c[:, g], v[:, g]), ck, cv, gidx)
-        return (bk(nxt[:, :, None])[:, 0], ck, cv, new_scores,
+        return (nxt.reshape(B * K), ck[:, gidx], cv[:, gidx], top_s,
                 new_toks), None
 
-    tok0 = bk(prompt_k[:, :, 0, None])[:, 0]
-    carry = (tok0, ck0, cv0, scores0, toks0)
-    (_, _, _, scores, toks), _ = lax.scan(step, carry, jnp.arange(L - 1))
-    # already sorted best-first: the final tick is always a gen step
-    # (max_new >= 1) and lax.top_k returns descending values
+    carry = (tok0_k.reshape(B * K), ck0, cv0, scores0, toks0)
+    (_, _, _, scores, toks), _ = lax.scan(
+        step, carry, Pn + jnp.arange(max_new_tokens - 1))
+    # already sorted best-first: lax.top_k returns descending values
     return toks, scores
 
 
