@@ -101,16 +101,16 @@ def beam_search(model: TransformerLM, variables, prompt,
     def tile(c):        # [layers, B, L, H, D] -> [layers, B*K, L, H, D]
         return jnp.repeat(c, K, axis=1)
 
-    ck0, cv0 = tile(ck1), tile(cv1)
     # seed the K beams from the top-K first tokens (a beam-0-only
     # restriction is unnecessary: this top_k IS the first expansion)
     logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
     scores0, tok0_k = lax.top_k(logp0, K)            # [B, K]
     toks0 = jnp.zeros((B, K, max_new_tokens), jnp.int32)
     toks0 = toks0.at[:, :, 0].set(tok0_k)
-
     if max_new_tokens == 1:
-        return toks0, scores0
+        return toks0, scores0        # before paying the K-wide cache tile
+
+    ck0, cv0 = tile(ck1), tile(cv1)
 
     def step(carry, t):
         tok, ck, cv, scores, toks = carry
@@ -167,6 +167,7 @@ class DecoderAttention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
+    sp_strategy: str = "ring"
 
     def setup(self):
         H = self.num_heads
@@ -183,7 +184,8 @@ class DecoderAttention(nn.Module):
         """Training/scoring: [B, T, E] -> [B, T, E], causal."""
         q, k, v = self.query(x), self.key(x), self.value(x)
         o = attention_dispatch(q, k, v, None, causal=True, mesh=self.mesh,
-                               use_flash=self.use_flash)
+                               use_flash=self.use_flash,
+                               sp_strategy=self.sp_strategy)
         return self.attn_out(o)
 
     def decode(self, x1, cache_k, cache_v, pos):
@@ -224,12 +226,14 @@ class DecoderLayer(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
+    sp_strategy: str = "ring"
 
     def setup(self):
         self.ln_attn = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")
         self.attention = DecoderAttention(
             self.hidden_size, self.num_heads, dtype=self.dtype,
-            mesh=self.mesh, use_flash=self.use_flash, name="attention")
+            mesh=self.mesh, use_flash=self.use_flash,
+            sp_strategy=self.sp_strategy, name="attention")
         self.ln_ffn = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")
         self.ffn_up = nn.Dense(self.intermediate_size, dtype=self.dtype,
                                name="ffn_up")
@@ -307,6 +311,7 @@ class TransformerLM(nn.Module):
     remat: bool = False
     pp_stages: int = 0
     pp_microbatches: int = 4
+    sp_strategy: str = "ring"
 
     def setup(self):
         self.embed = nn.Embed(self.vocab_size, self.hidden_size,
@@ -349,7 +354,8 @@ class TransformerLM(nn.Module):
             layer_cls(self.hidden_size, self.num_heads,
                       self.intermediate_size, self.dropout,
                       dtype=self.dtype, mesh=self.mesh,
-                      use_flash=self.use_flash, name=f"layer_{i}")
+                      use_flash=self.use_flash,
+                      sp_strategy=self.sp_strategy, name=f"layer_{i}")
             for i in range(self.num_layers)]
 
     def _logits(self, x):

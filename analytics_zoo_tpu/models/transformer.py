@@ -70,13 +70,16 @@ def flash_ok(use_flash: Optional[bool], seq_len: int) -> bool:
 
 def attention_dispatch(q, k, v, kv_mask, *, causal: bool,
                        mesh: Optional[Mesh],
-                       use_flash: Optional[bool]) -> jax.Array:
+                       use_flash: Optional[bool],
+                       sp_strategy: str = "ring") -> jax.Array:
     """The three-way attention dispatch every attention layer shares:
-    sp-ring (ppermute) when the mesh shards the sequence, the Pallas flash
+    sequence-parallel attention (ring ppermute or ulysses all_to_all,
+    ``sp_strategy``) when the mesh shards the sequence, the Pallas flash
     kernel where measured to win, XLA full attention otherwise."""
     if mesh is not None and "sp" in mesh.axis_names and \
             mesh.shape["sp"] > 1:
-        return ring_self_attention(q, k, v, mesh, kv_mask, causal=causal)
+        return ring_self_attention(q, k, v, mesh, kv_mask, causal=causal,
+                                   strategy=sp_strategy)
     if flash_ok(use_flash, q.shape[1]):
         from analytics_zoo_tpu.ops import (
             flash_attention, sharded_flash_attention)
@@ -108,6 +111,7 @@ class MultiHeadAttention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
+    sp_strategy: str = "ring"
 
     @nn.compact
     def __call__(self, x, kv_mask=None, train: bool = False):
@@ -117,7 +121,8 @@ class MultiHeadAttention(nn.Module):
             (H, D), dtype=self.dtype, name=name)
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
         o = attention_dispatch(q, k, v, kv_mask, causal=False,
-                               mesh=self.mesh, use_flash=self.use_flash)
+                               mesh=self.mesh, use_flash=self.use_flash,
+                               sp_strategy=self.sp_strategy)
         return nn.DenseGeneral(E, axis=(-2, -1), dtype=self.dtype,
                                name="attn_out")(o)
 
@@ -139,6 +144,7 @@ class TransformerLayer(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    sp_strategy: str = "ring"
 
     @nn.compact
     def __call__(self, x, kv_mask=None, train: bool = False):
@@ -146,6 +152,7 @@ class TransformerLayer(nn.Module):
         D = self.hidden_size // H
         a = MultiHeadAttention(H, D, dtype=self.dtype, mesh=self.mesh,
                                use_flash=self.use_flash,
+                               sp_strategy=self.sp_strategy,
                                name="attention")(x, kv_mask, train)
         a = nn.Dropout(self.dropout, deterministic=not train)(a)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + a)
@@ -189,6 +196,7 @@ class BERT(nn.Module):
     moe_experts: int = 0
     moe_every: int = 2
     moe_top_k: int = 2
+    sp_strategy: str = "ring"
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
@@ -218,6 +226,7 @@ class BERT(nn.Module):
                           dtype=self.dtype, mesh=self.mesh,
                           use_flash=self.use_flash,
                           num_experts=moe, moe_top_k=self.moe_top_k,
+                          sp_strategy=self.sp_strategy,
                           name=f"layer_{i}")(x, kv_mask, train)
         pooled = nn.tanh(nn.Dense(self.hidden_size, dtype=jnp.float32,
                                   name="pooler")(x[:, 0].astype(jnp.float32)))

@@ -142,15 +142,60 @@ def full_attention(q, k, v, kv_mask=None, *, causal: bool = False,
     return (o / denom).astype(q.dtype)
 
 
+def ulysses_attention(q, k, v, kv_mask=None, *, axis_name: str = "sp",
+                      causal: bool = False):
+    """Ulysses-style all-to-all sequence parallelism: exchange the local
+    sequence shard for a head shard (one all_to_all over ICI), run EXACT
+    full attention on the complete sequence for H/sp heads, and exchange
+    back.  The alternative to the ring: 2 all_to_alls total instead of
+    sp-1 ppermute rounds, at the cost of requiring heads % sp == 0 and
+    holding the full sequence per device for the local heads.
+
+    Must be called inside shard_map with `axis_name` bound; per-device
+    shapes q/k/v: [B, T_local, H, D]; kv_mask: [B, T_local] bool.
+    """
+    sp = lax.axis_size(axis_name)
+    if sp == 1:
+        return full_attention(q, k, v, kv_mask, causal=causal)
+    H = q.shape[2]
+    if H % sp:
+        raise ValueError(
+            f"ulysses needs heads ({H}) divisible by the sp axis ({sp}); "
+            "use the ring strategy for this mesh")
+
+    def seq2head(x):    # [B, T/sp, H, D] -> [B, T, H/sp, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    mask = None
+    if kv_mask is not None:
+        mask = lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+    o = full_attention(qg, kg, vg, mask, causal=causal)
+    # [B, T, H/sp, D] -> [B, T/sp, H, D]
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
 def ring_self_attention(q, k, v, mesh: Mesh, kv_mask=None, *,
                         causal: bool = False, batch_axes=("dp", "fsdp"),
-                        seq_axis: str = "sp", head_axis: str = "tp"):
+                        seq_axis: str = "sp", head_axis: str = "tp",
+                        strategy: str = "ring"):
     """shard_map wrapper: global [B, T, H, D] arrays sharded
     (B over dp, T over sp, H over tp) -> exact global attention.
     kv_mask: optional [B, T] bool padding mask.
 
-    Degenerates gracefully: any axis missing from the mesh is ignored.
+    ``strategy``: "ring" (K/V rotate via ppermute, O(T/sp) memory,
+    works for any head count) or "ulysses" (2 all_to_alls exchanging
+    seq-shards for head-shards, full attention locally; needs
+    local heads % sp == 0).  Degenerates gracefully: any axis missing
+    from the mesh is ignored.
     """
+    if strategy not in ("ring", "ulysses"):
+        # validate BEFORE the degenerate early-returns: a typo'd strategy
+        # must fail on the dev box, not first on the production sp mesh
+        raise ValueError(f"unknown sp strategy {strategy!r} "
+                         "(expected 'ring' or 'ulysses')")
     batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     seq = seq_axis if seq_axis in mesh.axis_names else None
     heads = head_axis if head_axis in mesh.axis_names else None
@@ -162,7 +207,9 @@ def ring_self_attention(q, k, v, mesh: Mesh, kv_mask=None, *,
         # sharding of the einsums without manual collectives.
         return full_attention(q, k, v, kv_mask, causal=causal)
 
-    fn = functools.partial(ring_attention, axis_name=seq, causal=causal)
+    fn = functools.partial(
+        ulysses_attention if strategy == "ulysses" else ring_attention,
+        axis_name=seq, causal=causal)
     if kv_mask is None:
         return jax.shard_map(
             lambda q, k, v: fn(q, k, v), mesh=mesh,
