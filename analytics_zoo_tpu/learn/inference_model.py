@@ -61,6 +61,24 @@ class InferenceModel:
 
     # ---- loading -----------------------------------------------------
 
+    def _install_quantized(self, variables, quantize):
+        """Shared weight-quantization staging for every load path:
+        quantize the tree, stage it in device memory ONCE (the numpy
+        leaves quantize_params builds would otherwise be re-uploaded on
+        every predict call), and install the fused dequant."""
+        self.quant_stats = None
+        if quantize:
+            from analytics_zoo_tpu.learn.quantize import (
+                dequantize, quantize_params)
+
+            variables, self.quant_stats = quantize_params(variables,
+                                                          quantize)
+            variables = jax.device_put(variables)
+            self._dequant = dequantize
+        else:
+            self._dequant = None
+        return variables
+
     def load_flax(self, model, variables,
                   quantize: Optional[str] = None) -> "InferenceModel":
         """Serve a flax module with a {'params': ..., [...]} tree.
@@ -73,21 +91,7 @@ class InferenceModel:
         import inspect
 
         self.model = model
-        self.quant_stats = None
-        if quantize:
-            from analytics_zoo_tpu.learn.quantize import (
-                dequantize, quantize_params)
-
-            variables, self.quant_stats = quantize_params(variables,
-                                                          quantize)
-            # stage the quantized tree in device memory ONCE — the numpy
-            # leaves quantize_params builds would otherwise be re-uploaded
-            # on every predict call
-            variables = jax.device_put(variables)
-            self._dequant = dequantize
-        else:
-            self._dequant = None
-        self._variables = variables
+        self._variables = self._install_quantized(variables, quantize)
         self._takes_train = None    # re-derive per model: a stale value
         #                             from a previous load would pass an
         #                             unexpected kwarg into the new model
@@ -120,7 +124,9 @@ class InferenceModel:
     def load_flax_generator(self, model, variables, max_new_tokens: int,
                             prompt_buckets: Sequence[int] = (16, 32, 64,
                                                              128),
-                            pad_id: int = 0) -> "InferenceModel":
+                            pad_id: int = 0,
+                            quantize: Optional[str] = None
+                            ) -> "InferenceModel":
         """Serve autoregressive GENERATION from a TransformerLM: predict
         takes right-padded prompts [B, P] (+ optional per-row lengths [B])
         and returns [B, max_new_tokens] generated token ids.
@@ -129,15 +135,16 @@ class InferenceModel:
         analog of the batch buckets) so the KV-cache generation scan
         compiles a bounded set of shapes.  When lengths are omitted they
         are inferred as the non-``pad_id`` trailing-pad width of each row.
-        No reference counterpart (SURVEY.md §2.5: no generative LM
-        upstream) — this is the serving face of models/lm.generate.
+        ``quantize``: None | "int8" | "bf16" — same weight-only scheme as
+        ``load_flax`` (dequant fused into the jitted scan), covering the
+        int8-LLM-serving role.  No reference counterpart (SURVEY.md §2.5:
+        no generative LM upstream) — the serving face of
+        models/lm.generate.
         """
         from analytics_zoo_tpu.models.lm import generate
 
         self.model = model
-        self.quant_stats = None
-        self._dequant = None
-        self._variables = variables
+        self._variables = self._install_quantized(variables, quantize)
         self._takes_train = None
         # a bucket only counts if the padded prompt + generation still
         # fits the model's position table — otherwise a prompt that
@@ -155,6 +162,8 @@ class InferenceModel:
         self.max_prompt_width = pbuckets[-1]
 
         def apply_fn(variables, prompts, lengths):
+            if self._dequant is not None:
+                variables = self._dequant(variables)
             return generate(model, variables, prompts, max_new_tokens,
                             prompt_len=lengths)
 

@@ -81,6 +81,41 @@ def test_generator_buckets_respect_max_position():
             model, variables, max_new_tokens=60, prompt_buckets=(16,))
 
 
+def test_int8_quantized_generator():
+    """Weight-only int8 generation serving: ~4x weight compression, and
+    on a peaked (trained) model the greedy tokens survive quantization."""
+    import optax
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models import lm_loss
+
+    init_orca_context("local", mesh_axes={"dp": 8})
+    try:
+        rng = np.random.default_rng(0)
+        n, t, vocab = 512, 10, 16
+        sym = rng.integers(2, vocab, n).astype(np.int32)
+        toks = np.repeat(sym[:, None], t, axis=1)
+        model = TransformerLM(vocab_size=vocab, hidden_size=32,
+                              num_layers=2, num_heads=2,
+                              intermediate_size=64, max_position=64,
+                              dtype=jnp.float32)
+        est = Estimator.from_flax(
+            model=model, loss=lm_loss, optimizer=optax.adam(3e-3),
+            feature_cols=("tokens",), label_cols=("tokens",))
+        est.fit({"tokens": toks}, epochs=8, batch_size=128)
+        variables = {"params": jax.device_get(est.state.params)}
+        im = InferenceModel().load_flax_generator(
+            model, variables, max_new_tokens=5, prompt_buckets=(8,),
+            quantize="int8")
+        assert im.quant_stats["compression"] > 3.0, im.quant_stats
+        prompt = np.repeat(np.asarray([[7], [11]], np.int32), 4, axis=1)
+        out = im.predict(prompt)
+        assert (out[0] == 7).all() and (out[1] == 11).all(), out
+    finally:
+        stop_orca_context()
+
+
 def test_generator_rejects_empty_prompt():
     import pytest
 
