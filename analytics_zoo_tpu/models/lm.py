@@ -409,7 +409,7 @@ def lm_loss(logits, tokens):
 def generate(model: TransformerLM, variables, prompt,
              max_new_tokens: int, prompt_len=None, *,
              temperature: float = 0.0, top_k: int = 0,
-             rng=None) -> jax.Array:
+             rng=None, eos_id=None) -> jax.Array:
     """Generation as ONE lax.scan with a threaded KV cache.
 
     prompt: [B, P] int32; ``prompt_len`` (optional [B] int32) gives each
@@ -424,6 +424,10 @@ def generate(model: TransformerLM, variables, prompt,
     ``temperature>0`` samples from logits/temperature (pass ``rng``, a
     ``jax.random`` key — required then), optionally truncated to the
     ``top_k`` highest-probability tokens.
+
+    ``eos_id``: once a row emits it (past its prompt), the rest of the
+    row freezes at eos — the fixed-shape analog of stop-on-EOS (same
+    contract as seq2seq.greedy_generate; output stays [B, max_new]).
     """
     B, Pn = prompt.shape
     L = Pn + max_new_tokens
@@ -459,17 +463,23 @@ def generate(model: TransformerLM, variables, prompt,
             jnp.int32)
 
     def step(carry, t):
-        tok, ck, cv = carry
+        tok, ck, cv, done = carry
         logits, ck, cv = model.apply(
             variables, tok, ck, cv, t, method=TransformerLM.decode_step)
         nxt = pick(logits, t)
+        if eos_id is not None:
+            # frozen-tail EOS: finished rows keep emitting eos (fixed
+            # shapes; the caller trims)
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | ((nxt == eos_id) & (t + 1 >= plen))
         # rows still inside their own prompt replay it
         nxt = jnp.where(t + 1 < plen, prompt[:, jnp.minimum(t + 1, Pn - 1)],
                         nxt)
-        return (nxt, ck, cv), nxt
+        return (nxt, ck, cv, done), nxt
 
-    (_, _, _), toks = lax.scan(
-        step, (prompt[:, 0], ck0, cv0), jnp.arange(L - 1))
+    done0 = jnp.zeros((B,), bool)
+    (_, _, _, _), toks = lax.scan(
+        step, (prompt[:, 0], ck0, cv0, done0), jnp.arange(L - 1))
     # toks[t] is the token at position t+1; row i's generated span is
     # positions [plen_i, plen_i + max_new) -> rows plen_i-1 .. of toks
     toks = toks.transpose(1, 0)                       # [B, L-1]

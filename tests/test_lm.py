@@ -122,6 +122,45 @@ def test_sampling_generation():
         generate(model, variables, toks, 8, temperature=0.5)
 
 
+def test_generate_eos_freezes_tail():
+    """Once a row emits eos_id past its prompt, the rest of the row is
+    eos; rows that never emit it are untouched; eos in the PROMPT does
+    not end generation."""
+    model = _tiny_lm()
+    toks = _toks(b=3, t=5)
+    variables = model.init(jax.random.key(0), toks)
+    base = np.asarray(generate(model, variables, toks, 8))
+    eos = int(base[0, 2])               # force an eos hit on row 0 step 2
+    out = np.asarray(generate(model, variables, toks, 8, eos_id=eos))
+    # row 0: identical up to the first eos, frozen after
+    first = int(np.argmax(base[0] == eos))
+    np.testing.assert_array_equal(out[0, :first + 1], base[0, :first + 1])
+    assert (out[0, first:] == eos).all()
+    # rows that never produce eos are byte-identical to the no-eos run
+    for b in range(1, 3):
+        if eos not in base[b]:
+            np.testing.assert_array_equal(out[b], base[b])
+    # eos inside the prompt must not pre-finish the row: the first
+    # GENERATED token matches the no-eos run exactly (done could not
+    # have latched during prompt replay)
+    p2 = toks.at[:, 1].set(eos)
+    ref2 = np.asarray(generate(model, variables, p2, 4))
+    out2 = np.asarray(generate(model, variables, p2, 4, eos_id=eos))
+    np.testing.assert_array_equal(out2[:, 0], ref2[:, 0])
+    # ragged rows + eos: each row must equal its own SOLO generation at
+    # its true length (catches any plen-vs-Pn confusion in the latch)
+    plens = [2, 5, 3]
+    p3 = np.asarray(toks.at[:, 4].set(eos))   # col 4 pads rows 0 and 2
+    out3 = np.asarray(generate(model, variables, jnp.asarray(p3), 4,
+                               prompt_len=jnp.asarray(plens, jnp.int32),
+                               eos_id=eos))
+    for i, ln in enumerate(plens):
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(p3[i:i + 1, :ln]), 4,
+                                   eos_id=eos))
+        np.testing.assert_array_equal(out3[i], solo[0], err_msg=f"row {i}")
+
+
 def test_beam_size_one_equals_greedy():
     model = _tiny_lm()
     toks = _toks(b=3, t=5)
