@@ -23,7 +23,8 @@ from analytics_zoo_tpu.models.forecast import (
 from analytics_zoo_tpu.models.rnn import RNNStack
 from analytics_zoo_tpu.models.lm import (
     TransformerLM, DecoderLayer, LM_PARTITION_RULES, LM_PP_PARTITION_RULES,
-    lm_loss, generate, beam_search, unstack_pp_params)
+    LM_MOE_PARTITION_RULES, lm_loss, generate, beam_search,
+    unstack_pp_params)
 from analytics_zoo_tpu.models.moe import (
     MoEMLP, MoETransformerLayer, MoETransformerClassifier,
     MOE_PARTITION_RULES, MOE_CLASSIFIER_PARTITION_RULES,
@@ -46,7 +47,8 @@ __all__ = [
     "LSTMNet", "TCN", "MTNet", "Seq2SeqTS",
     "RNNStack",
     "TransformerLM", "DecoderLayer", "LM_PARTITION_RULES",
-    "LM_PP_PARTITION_RULES", "lm_loss", "generate", "beam_search",
+    "LM_PP_PARTITION_RULES", "LM_MOE_PARTITION_RULES", "lm_loss",
+    "generate", "beam_search",
     "unstack_pp_params",
     "MoEMLP", "MoETransformerLayer", "MoETransformerClassifier",
     "MOE_PARTITION_RULES", "MOE_CLASSIFIER_PARTITION_RULES",

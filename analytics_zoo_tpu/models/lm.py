@@ -51,6 +51,13 @@ LM_PARTITION_RULES = (
 LM_PP_PARTITION_RULES = _ppsr() + LM_PARTITION_RULES
 
 
+# MoE-LM (moe_experts > 0): expert weights over ep(+tp) + the LM rules.
+# (moe.py imports no LM/transformer modules at top level — no cycle.)
+from analytics_zoo_tpu.models.moe import MOE_PARTITION_RULES as _MOE_RULES
+
+LM_MOE_PARTITION_RULES = _MOE_RULES + LM_PARTITION_RULES
+
+
 def beam_search(model: TransformerLM, variables, prompt,
                 max_new_tokens: int, beam_size: int = 4) -> tuple:
     """Beam-search decoding as two lax.scans (compiler-friendly: the beam
@@ -227,6 +234,9 @@ class DecoderLayer(nn.Module):
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
     sp_strategy: str = "ring"
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     def setup(self):
         self.ln_attn = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")
@@ -235,14 +245,28 @@ class DecoderLayer(nn.Module):
             mesh=self.mesh, use_flash=self.use_flash,
             sp_strategy=self.sp_strategy, name="attention")
         self.ln_ffn = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")
-        self.ffn_up = nn.Dense(self.intermediate_size, dtype=self.dtype,
-                               name="ffn_up")
-        self.ffn_down = nn.Dense(self.hidden_size, dtype=self.dtype,
-                                 name="ffn_down")
+        if self.num_experts > 0:
+            from analytics_zoo_tpu.models.moe import MoEMLP
+
+            self.moe = MoEMLP(self.num_experts, self.intermediate_size,
+                              top_k=self.moe_top_k,
+                              capacity_factor=self.moe_capacity_factor,
+                              dtype=self.dtype, mesh=self.mesh,
+                              name="moe")
+        else:
+            self.ffn_up = nn.Dense(self.intermediate_size,
+                                   dtype=self.dtype, name="ffn_up")
+            self.ffn_down = nn.Dense(self.hidden_size, dtype=self.dtype,
+                                     name="ffn_down")
         self.drop = nn.Dropout(self.dropout)
 
     def _mlp(self, x, train):
-        h = self.ffn_down(nn.gelu(self.ffn_up(x)))
+        if self.num_experts > 0:
+            # per-token routing: works for the [B, T, E] training forward
+            # AND the [B, 1, E] cached decode step unchanged
+            h = self.moe(x, train)
+        else:
+            h = self.ffn_down(nn.gelu(self.ffn_up(x)))
         return self.drop(h, deterministic=not train)
 
     def __call__(self, x, train: bool = False):
@@ -312,6 +336,14 @@ class TransformerLM(nn.Module):
     pp_stages: int = 0
     pp_microbatches: int = 4
     sp_strategy: str = "ring"
+    # MoE-LM: every moe_every-th layer gets an expert-parallel MoE FFN
+    # (works through cached decode too — routing is per-token)
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    # decode routes only B tokens/step: raise this where batch-coupled
+    # capacity drops matter (MoEMLP docstring)
+    moe_capacity_factor: float = 1.25
 
     def setup(self):
         self.embed = nn.Embed(self.vocab_size, self.hidden_size,
@@ -334,6 +366,11 @@ class TransformerLM(nn.Module):
                     "remat is not applied to pipelined trunks (the GPipe "
                     "scan already bounds live activations to one "
                     "microbatch per stage); set remat=False")
+            if self.moe_experts:
+                raise ValueError(
+                    "moe_experts is not supported with pp_stages (MoE "
+                    "dispatch inside shard_map stages would not see the "
+                    "ep axis); use MoE without pp, or pp without MoE")
             self.trunk = GPipe(
                 stage=_LMStage(self.num_layers // self.pp_stages,
                                self.hidden_size, self.num_heads,
@@ -355,7 +392,13 @@ class TransformerLM(nn.Module):
                       self.intermediate_size, self.dropout,
                       dtype=self.dtype, mesh=self.mesh,
                       use_flash=self.use_flash,
-                      sp_strategy=self.sp_strategy, name=f"layer_{i}")
+                      sp_strategy=self.sp_strategy,
+                      num_experts=(self.moe_experts if self.moe_experts > 0
+                                   and (i + 1) % max(1, self.moe_every) == 0
+                                   else 0),
+                      moe_top_k=self.moe_top_k,
+                      moe_capacity_factor=self.moe_capacity_factor,
+                      name=f"layer_{i}")
             for i in range(self.num_layers)]
 
     def _logits(self, x):
