@@ -262,8 +262,13 @@ class DecoderLayer(nn.Module):
 
     def _mlp(self, x, train):
         if self.num_experts > 0:
-            # per-token routing: works for the [B, T, E] training forward
-            # AND the [B, 1, E] cached decode step unchanged
+            # Per-token routing runs for both the [B, T, E] training
+            # forward and the [B, 1, E] cached decode step.  NOTE: the
+            # capacity pool differs (B*T tokens jointly vs B per decode
+            # step), so under skewed routing decode logits can deviate
+            # slightly from the teacher-forced forward — the same
+            # batch-coupling property documented on MoEMLP; raise
+            # moe_capacity_factor where that matters.
             h = self.moe(x, train)
         else:
             h = self.ffn_down(nn.gelu(self.ffn_up(x)))
@@ -336,8 +341,10 @@ class TransformerLM(nn.Module):
     pp_stages: int = 0
     pp_microbatches: int = 4
     sp_strategy: str = "ring"
-    # MoE-LM: every moe_every-th layer gets an expert-parallel MoE FFN
-    # (works through cached decode too — routing is per-token)
+    # MoE-LM: every moe_every-th layer gets an expert-parallel MoE FFN.
+    # Cached decode routes per step (B tokens) while the forward routes
+    # B*T jointly, so capacity-dropped tokens can differ between the two
+    # under skew — see DecoderLayer._mlp / MoEMLP docstrings.
     moe_experts: int = 0
     moe_every: int = 2
     moe_top_k: int = 2

@@ -428,23 +428,31 @@ def _run_sub(name: str, timeout: int = 1800):
     return None
 
 
-def _device_preflight(timeout: int = 240):
+def _device_preflight(timeout: int = 300, attempts: int = 2):
     """The tunneled TPU can wedge hard (jax.devices() blocks forever — a
-    lost remote grant; observed in round 3).  Probe it in a subprocess
-    with a timeout so a dead device costs minutes and a clear message,
-    not len(BENCHES) x 1800 s of silent hanging.  Returns (ok, reason);
-    a non-TPU device kind also fails — a silent CPU fallback would
+    lost remote grant; observed in round 3, with recovery windows after
+    remote cleanup).  Probe in a subprocess with a timeout, retrying
+    once (grant handoff after a previous holder exits can itself take
+    minutes), so a dead device costs minutes and a clear message, not
+    len(BENCHES) x 1800 s of silent hanging.  Returns (ok, reason); a
+    non-TPU device kind also fails — a silent CPU fallback would
     otherwise produce fast, wrong 'TPU' numbers."""
     code = ("import jax; d = jax.devices(); "
             "import jax.numpy as jnp; float(jnp.ones(2).sum()); "
             "print('kind:', d[0].device_kind)")
-    try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return False, (f"jax.devices() unresponsive within {timeout}s "
-                       "(wedged device tunnel); no benchmarks ran")
+    out = None
+    for i in range(max(1, attempts)):
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=timeout)
+            break
+        except subprocess.TimeoutExpired:
+            out = None
+    if out is None:
+        return False, (f"jax.devices() unresponsive in {attempts} x "
+                       f"{timeout}s probes (wedged device tunnel); no "
+                       "benchmarks ran")
     if out.returncode != 0:
         return False, ("device probe crashed (rc="
                        f"{out.returncode}): {out.stderr[-500:]}")

@@ -304,8 +304,6 @@ def test_moe_lm_trains_and_generates():
     """MoE-LM: interleaved dense/MoE decoder layers train on a
     dp x ep mesh (aux loss reported) and generate through the cached
     decode path (per-token routing works at T=1)."""
-    import optax
-
     from analytics_zoo_tpu import init_orca_context, stop_orca_context
     from analytics_zoo_tpu.learn import Estimator
     from analytics_zoo_tpu.models import LM_MOE_PARTITION_RULES
