@@ -56,8 +56,9 @@ class InferenceModel:
         self._takes_train: Optional[str] = None
         # optional host-side input normaliser (generator prompt padding)
         self._pre_pad: Optional[Callable] = None
-        # generator-only serving bounds (load_flax_generator sets it)
+        # generator-only serving bounds (load_flax_generator sets them)
         self.max_prompt_width: Optional[int] = None
+        self.prompt_pad_id: Optional[int] = None
 
     # ---- loading -----------------------------------------------------
 
@@ -118,6 +119,7 @@ class InferenceModel:
         self._pre_pad = None    # a stale generator pad hook would corrupt
         #                         plain-model inputs
         self.max_prompt_width = None    # ditto the serving bounds limit
+        self.prompt_pad_id = None
         self._jit = None        # new model -> stale compiled wrapper
         return self
 
@@ -157,9 +159,11 @@ class InferenceModel:
                 f"no prompt bucket fits: max_position "
                 f"{model.max_position} - max_new_tokens {max_new_tokens} "
                 f"= {limit} < smallest bucket {min(prompt_buckets)}")
-        # serving batcher reads this to bounds-check ragged prompts
-        # per-request instead of failing whole batches
+        # serving batcher reads these to bounds-check ragged prompts
+        # per-request and to cross-check its own pad id against the
+        # generator's (a mismatch would silently miscount prompt lengths)
         self.max_prompt_width = pbuckets[-1]
+        self.prompt_pad_id = int(pad_id)
 
         def apply_fn(variables, prompts, lengths):
             if self._dequant is not None:

@@ -145,25 +145,30 @@ class MoEMLP(nn.Module):
         ein = xt.astype(self.dtype)
         expert_in = jnp.einsum("nxc,ne->xce", dispatch.astype(self.dtype),
                                ein)                        # [X, C, E]
-        expert_in = self._constrain(expert_in)
+        expert_in = self._constrain(expert_in, tp_last=False)
         h = jnp.einsum("xce,xef->xcf", expert_in,
                        w_up.astype(self.dtype)) + \
             b_up.astype(self.dtype)[:, None, :]
         h = nn.gelu(h)
-        h = self._constrain(h)
+        h = self._constrain(h, tp_last=True)
         out_e = jnp.einsum("xcf,xfe->xce", h,
                            w_down.astype(self.dtype)) + \
             b_down.astype(self.dtype)[:, None, :]
-        out_e = self._constrain(out_e)
+        out_e = self._constrain(out_e, tp_last=False)
         y = jnp.einsum("nxc,xce->ne", combine.astype(self.dtype), out_e)
         return y.reshape(orig_shape).astype(x.dtype)
 
-    def _constrain(self, t):
-        """Expert-major activations: stacked expert dim over ep, last dim
-        over tp (matches the weight layout so einsums stay local)."""
+    def _constrain(self, t, *, tp_last: bool):
+        """Expert-major activations: stacked expert dim over ep.  Only the
+        intermediate ``h`` ([X, C, F]) carries tp on its last dim — its F
+        dim matches w_up's tp-sharded output / w_down's tp-sharded input, so
+        the up-projection shards and the down-projection reduce-scatters
+        over tp.  ``expert_in``/``out_e`` end in the model dim E, which the
+        weights keep replicated; constraining E onto tp would force a
+        reshard collective around every einsum for no compute split."""
         if self.mesh is None or "ep" not in self.mesh.axis_names:
             return t
-        tp = "tp" if "tp" in self.mesh.axis_names else None
+        tp = "tp" if (tp_last and "tp" in self.mesh.axis_names) else None
         return with_sharding_constraint(t, P("ep", None, tp))
 
 

@@ -68,6 +68,16 @@ def pipeline_apply(stage_fn: StageFn, stacked_params: Any, x: jax.Array,
     S = int(mesh.shape[pp_axis]) if pp_axis in mesh.axis_names else 1
     if S == 1:
         return sequential_apply(stage_fn, stacked_params, x)
+    # Each rank consumes exactly one stage of the stacked params; a stack
+    # whose leading dim differs from the pp axis size would silently drop
+    # (or wrap) stages after sharding.
+    shapes = [jnp.shape(leaf) for leaf in jax.tree.leaves(stacked_params)]
+    bad = {s[0] if s else None for s in shapes} - {S}
+    if bad:
+        raise ValueError(
+            f"stacked_params leading dim(s) {sorted(bad, key=str)} != pp "
+            f"axis size {S}; every leaf must stack exactly one slice per "
+            f"pp rank")
     M = int(n_microbatches)
     batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     xspec = P(batch, *([None] * (x.ndim - 1)))

@@ -111,6 +111,7 @@ class ClusterServing:
                  embedded_broker: bool = False):
         self.model = inference_model
         self.config = config or ServingConfig()
+        self._check_pad_agreement(inference_model)
         if self.config.core_number is not None:
             inference_model.set_concurrency(self.config.core_number)
         self.broker: Optional[RespServer] = None
@@ -183,11 +184,30 @@ class ClusterServing:
         attribute assignment — the loop reads ``self.model`` once per
         dispatch, so in-flight batches finish on the old model and the
         next batch runs the new one; no request is dropped."""
+        self._check_pad_agreement(inference_model)
         if self.config.core_number is not None:
             inference_model.set_concurrency(self.config.core_number)
         self.model = inference_model
         logger.info("ClusterServing model hot-reloaded")
         return self
+
+    def _check_pad_agreement(self, inference_model: InferenceModel):
+        """A generator infers prompt lengths from ITS ``pad_id`` when no
+        explicit lengths arrive; the batcher pads ragged prompts with
+        ``config.prompt_pad_id``.  The dispatch path always threads
+        explicit lengths, so a disagreement is harmless HERE — but the
+        same model served outside this batcher (direct ``predict``) would
+        miscount, so surface the inconsistency loudly without failing a
+        live reload."""
+        model_pad = getattr(inference_model, "prompt_pad_id", None)
+        if self.config.prompt_col and model_pad is not None and \
+                model_pad != self.config.prompt_pad_id:
+            logger.warning(
+                "serving prompt_pad_id %d != generator pad_id %d; batched "
+                "serving threads explicit lengths so this is safe here, "
+                "but direct predict() on this model would infer lengths "
+                "from the generator's pad id — configure both the same",
+                self.config.prompt_pad_id, model_pad)
 
     # ---- serving loop -------------------------------------------------
 
@@ -360,7 +380,9 @@ class ClusterServing:
         # BEFORE the shape check, and their true lengths ride along as an
         # extra model input (load_flax_generator contract)
         req_lengths: List[Optional[int]] = [None] * len(requests)
-        if self.config.prompt_col and self.config.prompt_col in cols:
+        prompts_active = bool(self.config.prompt_col) and \
+            self.config.prompt_col in cols
+        if prompts_active:
             ci = cols.index(self.config.prompt_col)
             # per-request bounds check FIRST — an over-long or empty
             # prompt must error alone, not (via the shared pad width)
@@ -423,8 +445,11 @@ class ClusterServing:
             return None
         arrays = [np.stack([v[ci] for v in good_vals])
                   for ci in range(len(cols))]
-        if self.config.prompt_col and all(
-                ln is not None for ln in good_lens):
+        if prompts_active:
+            # every row that survived the prompt checks above has a
+            # length; threading them unconditionally means the model never
+            # falls back to re-inferring lengths from its own pad id
+            assert all(ln is not None for ln in good_lens)
             arrays.append(np.asarray(good_lens, np.int32))
         try:
             waiter = self.model.predict_async(*arrays)

@@ -195,7 +195,10 @@ def main():
             p = subprocess.run(cmd, capture_output=True, text=True,
                                timeout=900)
             r = None
-            for line in p.stdout.splitlines():
+            # the result is the LAST valid JSON line: a library/log line
+            # that happens to start with '{' earlier in stdout must not
+            # be mistaken for the benchmark result
+            for line in reversed(p.stdout.splitlines()):
                 if line.startswith("{"):
                     try:
                         r = json.loads(line)
