@@ -59,20 +59,34 @@ LM_MOE_PARTITION_RULES = _MOE_RULES + LM_PARTITION_RULES
 
 
 def beam_search(model: TransformerLM, variables, prompt,
-                max_new_tokens: int, beam_size: int = 4) -> tuple:
-    """Beam-search decoding as two lax.scans (compiler-friendly: the beam
+                max_new_tokens: int, beam_size: int = 4, *,
+                prompt_len=None, eos_id=None,
+                length_penalty: float = 0.0) -> tuple:
+    """Beam-search decoding as lax.scans (compiler-friendly: the beam
     lives as an extra leading dim, KV caches reorder on-device with a
     batched gather instead of host-side bookkeeping).
 
-    prompt: [B, P] int32 (full-width prompts; use generate() for ragged
-    serving).  Returns ``(tokens [B, beam, max_new], scores [B, beam])``
-    with beams sorted best-first; ``scores`` are sum log-probs (all
-    hypotheses share the fixed length, so no length penalty applies).
+    prompt: [B, P] int32.  ``prompt_len`` (optional [B] int32) gives each
+    row's true length for right-padded ragged batches — same contract as
+    ``generate()``.  Returns ``(tokens [B, beam, max_new], scores
+    [B, beam])`` with beams sorted best-first.
 
-    Two scans: a width-1 PREFILL over the prompt (beams are identical
-    there — running them K-wide would waste (K-1)/K of the prefill
-    FLOPs), then the cache tiles to beam width and the generation scan
-    expands/reorders hypotheses.
+    ``eos_id``: a beam that emits it (past its prompt) FREEZES — its
+    score stops accumulating and its tail fills with eos (fixed shapes;
+    the frozen hypothesis keeps competing in top-k on its final score,
+    the standard finished-beam semantics).  Without EOS handling a beam
+    would keep scoring past end-of-sequence and eos-trained models would
+    rank garbage continuations.
+
+    ``length_penalty`` (alpha): beams are ranked by
+    ``score / ((5 + n_tokens) / 6) ** alpha`` (GNMT), where ``n_tokens``
+    counts real tokens up to and including eos.  ``alpha=0`` (default)
+    ranks by raw sum log-prob; returned ``scores`` are always the
+    ranking scores.
+
+    Uniform prompts run a width-1 PREFILL scan first (K-wide prefill
+    would waste (K-1)/K of the prefill FLOPs); ragged batches run one
+    K-wide scan with per-row teacher-forcing, like ``generate()``.
     """
     B, Pn = prompt.shape
     K = int(beam_size)
@@ -86,62 +100,121 @@ def beam_search(model: TransformerLM, variables, prompt,
     V = model.vocab_size
     H, D = model.num_heads, model.hidden_size // model.num_heads
     cdtype = jnp.dtype(model.dtype)
+    ragged = prompt_len is not None
+    plen = (jnp.full((B,), Pn, jnp.int32) if not ragged
+            else jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, Pn))
 
-    # ---- prefill at width 1 over the prompt --------------------------
-    ck1 = jnp.zeros((model.num_layers, B, L, H, D), cdtype)
-    cv1 = jnp.zeros_like(ck1)
+    def step(carry, t):
+        """One K-wide position step: decode, expand/teacher-force, reorder.
 
-    def prefill(carry, t):
-        ck, cv, _ = carry
+        Rows still inside their prompt (t+1 < plen) teacher-force it on
+        all K identical beams; a row's FIRST expansion (t+1 == plen)
+        draws candidates from beam 0 only (the clones would produce K
+        duplicate hypotheses); after that it's standard K*V expansion.
+        """
+        tok, ck, cv, scores, toks, done, nlen = carry
         logits, ck, cv = model.apply(
-            variables, prompt[:, t], ck, cv, t,
-            method=TransformerLM.decode_step)
-        # only the LAST position's logits matter: carry them instead of
-        # stacking [Pn, B, V] of throwaway float32 through scan outputs
-        return (ck, cv, logits), None
+            variables, tok, ck, cv, t, method=TransformerLM.decode_step)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                  axis=-1).reshape(B, K, V)
+        if eos_id is not None:
+            # frozen beams: the only continuation is eos at logp 0, so
+            # the finished score competes unchanged in top-k
+            frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+            logp = jnp.where(done[:, :, None], frozen[None, None, :], logp)
+        cand = scores[:, :, None] + logp                 # [B, K, V]
+        first = (t + 1 == plen)                          # [B]
+        cand = jnp.where(
+            (first[:, None] & (jnp.arange(K) > 0)[None, :])[:, :, None],
+            -jnp.inf, cand)
+        top_s, top_i = lax.top_k(cand.reshape(B, K * V), K)
+        src_beam = top_i // V
+        nxt = (top_i % V).astype(jnp.int32)
+        # a row is INACTIVE while still teacher-forcing its prompt
+        # (w < 0) and again once its own max_new window is complete
+        # (w >= max_new: ragged batches keep scanning for longer-prompt
+        # rows — a completed row must freeze its scores and beam order,
+        # not keep re-ranking on tokens outside its window)
+        w = t + 1 - plen                # [B] generated-token index
+        teach = w < 0
+        inactive = teach | (w >= max_new_tokens)
+        active = ~inactive
+        # reorder beam state to follow the winning hypotheses; inactive
+        # rows gather identity (no reorder)
+        src_eff = jnp.where(inactive[:, None], jnp.arange(K)[None, :],
+                            src_beam)
+        new_toks = jnp.take_along_axis(toks, src_eff[:, :, None], axis=1)
+        new_done = jnp.take_along_axis(done, src_eff, axis=1)
+        new_len = jnp.take_along_axis(nlen, src_eff, axis=1)
+        gidx = (jnp.arange(B)[:, None] * K + src_eff).reshape(-1)
+        ck, cv = ck[:, gidx], cv[:, gidx]
+        p_tok = prompt[:, jnp.minimum(t + 1, Pn - 1)]    # [B]
+        nxt = jnp.where(teach[:, None], p_tok[:, None], nxt)
+        top_s = jnp.where(inactive[:, None], scores, top_s)
+        new_len = jnp.where(active[:, None] & ~new_done, new_len + 1,
+                            new_len)
+        if eos_id is not None:
+            new_done = new_done | (active[:, None] & (nxt == eos_id))
+        new_toks = lax.dynamic_update_index_in_dim(
+            new_toks.transpose(2, 0, 1), nxt, t, 0).transpose(1, 2, 0)
+        return (nxt.reshape(B * K), ck, cv, top_s, new_toks, new_done,
+                new_len), None
 
-    (ck1, cv1, last_logits), _ = lax.scan(
-        prefill, (ck1, cv1, jnp.zeros((B, V), jnp.float32)),
-        jnp.arange(Pn))
-
-    # ---- tile to beam width; beams fold into the batch dim -----------
     def tile(c):        # [layers, B, L, H, D] -> [layers, B*K, L, H, D]
         return jnp.repeat(c, K, axis=1)
 
-    # seed the K beams from the top-K first tokens (a beam-0-only
-    # restriction is unnecessary: this top_k IS the first expansion)
-    logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
-    scores0, tok0_k = lax.top_k(logp0, K)            # [B, K]
-    toks0 = jnp.zeros((B, K, max_new_tokens), jnp.int32)
-    toks0 = toks0.at[:, :, 0].set(tok0_k)
-    if max_new_tokens == 1:
-        return toks0, scores0        # before paying the K-wide cache tile
+    if not ragged and Pn > 1:
+        # ---- width-1 prefill over the shared prompt ------------------
+        ck1 = jnp.zeros((model.num_layers, B, L, H, D), cdtype)
+        cv1 = jnp.zeros_like(ck1)
 
-    ck0, cv0 = tile(ck1), tile(cv1)
+        def prefill(carry, t):
+            ck, cv = carry
+            _, ck, cv = model.apply(
+                variables, prompt[:, t], ck, cv, t,
+                method=TransformerLM.decode_step)
+            return (ck, cv), None
 
-    def step(carry, t):
-        tok, ck, cv, scores, toks = carry
-        logits, ck, cv = model.apply(
-            variables, tok, ck, cv, t, method=TransformerLM.decode_step)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        cand = scores[:, :, None] + logp.reshape(B, K, V)
-        flat = cand.reshape(B, K * V)
-        top_s, top_i = lax.top_k(flat, K)            # [B, K]
-        src_beam = top_i // V
-        nxt = (top_i % V).astype(jnp.int32)
-        new_toks = jnp.take_along_axis(toks, src_beam[:, :, None], axis=1)
-        w = t + 1 - Pn                               # 1..max_new-1
-        new_toks = lax.dynamic_update_index_in_dim(
-            new_toks.transpose(2, 0, 1), nxt, w, 0).transpose(1, 2, 0)
-        # reorder KV caches to follow their beams ([layers, B*K, ...])
-        gidx = (jnp.arange(B)[:, None] * K + src_beam).reshape(-1)
-        return (nxt.reshape(B * K), ck[:, gidx], cv[:, gidx], top_s,
-                new_toks), None
+        (ck1, cv1), _ = lax.scan(prefill, (ck1, cv1), jnp.arange(Pn - 1))
+        if max_new_tokens == 1:
+            # single-token beams need one more decode step but never the
+            # K-wide cache tile or the generation scan; with every
+            # hypothesis the same length the penalty only rescales
+            logits, _, _ = model.apply(
+                variables, prompt[:, Pn - 1], ck1, cv1, Pn - 1,
+                method=TransformerLM.decode_step)
+            logp0 = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            scores0, tok0_k = lax.top_k(logp0, K)
+            # GNMT lp(1) == 1, so the penalty cannot reorder or rescale
+            return tok0_k[:, :, None], scores0
+        ck0, cv0 = tile(ck1), tile(cv1)
+        t0 = Pn - 1
+        tok0 = jnp.repeat(prompt[:, Pn - 1], K)
+    else:
+        ck0 = jnp.zeros((model.num_layers, B * K, L, H, D), cdtype)
+        cv0 = jnp.zeros_like(ck0)
+        t0 = 0
+        tok0 = jnp.repeat(prompt[:, 0], K)
 
-    carry = (tok0_k.reshape(B * K), ck0, cv0, scores0, toks0)
-    (_, _, _, scores, toks), _ = lax.scan(
-        step, carry, Pn + jnp.arange(max_new_tokens - 1))
-    # already sorted best-first: lax.top_k returns descending values
+    # toks buffer covers every position the scan writes; the per-row
+    # generated window [plen-1, plen-1+max_new) is gathered at the end
+    carry = (tok0, ck0, cv0, jnp.zeros((B, K), jnp.float32),
+             jnp.zeros((B, K, L - 1), jnp.int32),
+             jnp.zeros((B, K), bool), jnp.zeros((B, K), jnp.int32))
+    (_, _, _, scores, toks, done, nlen), _ = lax.scan(
+        step, carry, t0 + jnp.arange(L - 1 - t0))
+    widx = jnp.clip(plen[:, None, None] - 1
+                    + jnp.arange(max_new_tokens)[None, None, :], 0, L - 2)
+    toks = jnp.take_along_axis(toks, jnp.broadcast_to(
+        widx, (B, K, max_new_tokens)), axis=2)
+    if length_penalty:
+        lp = ((5.0 + nlen.astype(jnp.float32)) / 6.0) ** float(
+            length_penalty)
+        scores = scores / lp
+        order = jnp.argsort(-scores, axis=1)
+        toks = jnp.take_along_axis(toks, order[:, :, None], axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+    # without a penalty, lax.top_k already left beams sorted best-first
     return toks, scores
 
 
@@ -187,34 +260,50 @@ class DecoderAttention(nn.Module):
         self.attn_out = nn.DenseGeneral(self.hidden_size, axis=(-2, -1),
                                         dtype=self.dtype, name="attn_out")
 
-    def __call__(self, x, train: bool = False):
-        """Training/scoring: [B, T, E] -> [B, T, E], causal."""
+    def __call__(self, x, train: bool = False, return_kv: bool = False):
+        """Training/scoring: [B, T, E] -> [B, T, E], causal.
+        ``return_kv=True`` also returns this layer's K/V projections
+        ``[B, T, H, D]`` (KV-arena prefill for continuous batching)."""
         q, k, v = self.query(x), self.key(x), self.value(x)
         o = attention_dispatch(q, k, v, None, causal=True, mesh=self.mesh,
                                use_flash=self.use_flash,
                                sp_strategy=self.sp_strategy)
-        return self.attn_out(o)
+        out = self.attn_out(o)
+        return (out, k, v) if return_kv else out
 
     def decode(self, x1, cache_k, cache_v, pos):
         """One cached decode step.
 
         x1: [B, 1, E] current-position hidden; cache_k/v: [B, L, H, D]
-        preallocated; pos: scalar int32 current position.  Returns
+        preallocated; pos: int32 current position — a SCALAR advances the
+        whole batch in lockstep (generate/beam_search); a VECTOR [B]
+        gives each row its own position (the continuous-batching engine,
+        where co-resident requests are at different depths).  Returns
         (y1 [B, 1, E], new_cache_k, new_cache_v).
         """
         B = x1.shape[0]
+        L = cache_k.shape[1]
         q = self.query(x1)                              # [B, 1, H, D]
         k1 = self.key(x1)
         v1 = self.value(x1)
-        cache_k = lax.dynamic_update_slice(
-            cache_k, k1.astype(cache_k.dtype), (0, pos, 0, 0))
-        cache_v = lax.dynamic_update_slice(
-            cache_v, v1.astype(cache_v.dtype), (0, pos, 0, 0))
-        L = cache_k.shape[1]
+        if jnp.ndim(pos) == 0:
+            cache_k = lax.dynamic_update_slice(
+                cache_k, k1.astype(cache_k.dtype), (0, pos, 0, 0))
+            cache_v = lax.dynamic_update_slice(
+                cache_v, v1.astype(cache_v.dtype), (0, pos, 0, 0))
+            mask = (jnp.arange(L) <= pos)[None, None, None, :]
+        else:
+            # per-row scatter: row b writes its K/V at pos[b] and attends
+            # positions <= pos[b] (O(B*L*H*D) masked write — the same
+            # bandwidth the attention read below already pays)
+            hit = (jnp.arange(L)[None, :] == pos[:, None])[:, :, None, None]
+            cache_k = jnp.where(hit, k1.astype(cache_k.dtype), cache_k)
+            cache_v = jnp.where(hit, v1.astype(cache_v.dtype), cache_v)
+            mask = (jnp.arange(L)[None, :]
+                    <= pos[:, None])[:, None, None, :]
         scale = 1.0 / jnp.sqrt(self._d).astype(jnp.float32)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k,
                             preferred_element_type=jnp.float32) * scale
-        mask = (jnp.arange(L) <= pos)[None, None, None, :]
         logits = jnp.where(mask, logits, -jnp.inf)
         w = jax.nn.softmax(logits, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", w.astype(cache_v.dtype), cache_v,
@@ -287,6 +376,18 @@ class DecoderLayer(nn.Module):
         x1 = x1 + a
         x1 = x1 + self._mlp(self.ln_ffn(x1).astype(self.dtype), False)
         return x1, ck, cv
+
+    def forward_kv(self, x, train: bool = False):
+        """``__call__`` that also returns this layer's K/V ``[B, T, H,
+        D]`` — the prompt-prefill payload the continuous-batching engine
+        writes into its KV arena.  Same math as ``__call__`` (constraints
+        included), so prefilled logits equal the training forward's."""
+        a, k, v = self.attention(self.ln_attn(x).astype(self.dtype),
+                                 train, return_kv=True)
+        x = x + self.drop(a, deterministic=not train)
+        x = _constrain_seq(x, self.mesh)
+        x = x + self._mlp(self.ln_ffn(x).astype(self.dtype), train)
+        return _constrain_seq(x, self.mesh), k, v
 
 
 class _LMStage(nn.Module):
@@ -431,13 +532,17 @@ class TransformerLM(nn.Module):
 
     def decode_step(self, tok, caches_k, caches_v, pos):
         """tok: [B] current tokens; caches_k/v: [n_layers, B, L, H, D];
-        pos: scalar.  Returns (logits [B, V], caches_k, caches_v)."""
+        pos: scalar int32 (lockstep batch) or [B] vector (per-row
+        positions, continuous batching).  Returns (logits [B, V],
+        caches_k, caches_v)."""
         if self.pp_stages > 0:
             raise NotImplementedError(
                 "cached decode is not pipelined; convert the params with "
                 "models.lm.unstack_pp_params and generate on a "
                 "pp_stages=0 TransformerLM of the same dimensions")
-        x = self.embed(tok)[:, None] + self.pos_embed(pos)[None, None]
+        pe = (self.pos_embed(pos)[None, None] if jnp.ndim(pos) == 0
+              else self.pos_embed(pos)[:, None])
+        x = self.embed(tok)[:, None] + pe
         x = x.astype(self.dtype)
         ks, vs = [], []
         for i, layer in enumerate(self.layers):
@@ -446,6 +551,29 @@ class TransformerLM(nn.Module):
             vs.append(cv)
         logits = self._logits(self.ln_f(x))[:, 0]
         return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def prefill(self, tokens):
+        """Causal forward that ALSO returns every layer's K/V: ``(logits
+        [B, T, V], ks [n_layers, B, T, H, D], vs)``.  One MXU-friendly
+        forward replaces T sequential decode steps when a new request
+        joins the continuous-batching KV arena."""
+        if self.pp_stages > 0:
+            raise NotImplementedError(
+                "prefill is not pipelined (same restriction as "
+                "decode_step); serve a pp_stages=0 restore instead")
+        B, T = tokens.shape
+        if T > self.max_position:
+            raise ValueError(
+                f"sequence length {T} exceeds max_position "
+                f"{self.max_position}")
+        x = self.embed(tokens) + self.pos_embed(jnp.arange(T)[None])
+        x = _constrain_seq(x.astype(self.dtype), self.mesh)
+        ks, vs = [], []
+        for layer in self.layers:
+            x, k, v = layer.forward_kv(x)
+            ks.append(k)
+            vs.append(v)
+        return self._logits(self.ln_f(x)), jnp.stack(ks), jnp.stack(vs)
 
 
 def lm_loss(logits, tokens):

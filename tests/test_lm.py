@@ -208,6 +208,127 @@ def test_beam_search_scores_sorted_and_contains_greedy_on_peaked_model():
         stop_orca_context()
 
 
+def _exhaustive_beam_oracle(model, variables, prompt, max_new, eos,
+                            alpha):
+    """Enumerate every frozen-tail sequence of `max_new` tokens, score it
+    by teacher-forced forward logp (tokens after the first eos are forced
+    eos and contribute 0), rank by GNMT length penalty.  Returns
+    (sequences [N, max_new], scores [N]) sorted best-first."""
+    V = model.vocab_size
+    import itertools
+
+    seqs = np.asarray(list(itertools.product(range(V), repeat=max_new)),
+                      np.int32)
+    # frozen-tail validity: after the first eos, everything must be eos
+    first_eos = np.where(seqs == eos, np.arange(max_new)[None, :],
+                         max_new).min(axis=1)
+    tail_ok = np.all(
+        (np.arange(max_new)[None, :] <= first_eos[:, None])
+        | (seqs == eos), axis=1)
+    seqs = seqs[tail_ok]
+    first_eos = first_eos[tail_ok]
+    full = np.concatenate(
+        [np.repeat(prompt, len(seqs), axis=0), seqs], axis=1)
+    logits = np.asarray(model.apply(variables, jnp.asarray(full)))
+    logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+    Pn = prompt.shape[1]
+    pos = Pn - 1 + np.arange(max_new)
+    tok_lp = np.take_along_axis(
+        np.asarray(logp)[:, pos, :], seqs[:, :, None], axis=2)[:, :, 0]
+    counted = np.arange(max_new)[None, :] <= first_eos[:, None]
+    raw = (tok_lp * counted).sum(axis=1)
+    n_tok = np.minimum(first_eos + 1, max_new)
+    lp = ((5.0 + n_tok) / 6.0) ** alpha
+    scores = raw / lp
+    order = np.argsort(-scores, kind="stable")
+    return seqs[order], scores[order]
+
+
+def test_beam_search_eos_matches_exhaustive_search():
+    """With beam_size >= V^(max_new-1) the beam holds every hypothesis
+    until the final expansion, so it must EXACTLY reproduce exhaustive
+    frozen-tail search — including eos score freezing and the GNMT
+    length penalty.  THE oracle for the eos/length semantics."""
+    V, max_new, eos, alpha = 5, 3, 2, 0.8
+    model = _tiny_lm(vocab_size=V, hidden_size=16, num_layers=1,
+                     max_position=16)
+    prompt = np.asarray([[3, 1]], np.int32)
+    variables = model.init(jax.random.key(1), jnp.asarray(prompt))
+    K = V ** (max_new - 1)      # 25: exact search
+    beams, scores = beam_search(model, variables, jnp.asarray(prompt),
+                                max_new, beam_size=K, eos_id=eos,
+                                length_penalty=alpha)
+    ref_seqs, ref_scores = _exhaustive_beam_oracle(
+        model, variables, prompt, max_new, eos, alpha)
+    got, gs = np.asarray(beams[0]), np.asarray(scores[0])
+    # the top hypotheses must agree in order and score (ties can permute
+    # equal-score rows; scores disambiguate)
+    np.testing.assert_allclose(gs[:10], ref_scores[:10], rtol=1e-4,
+                               atol=1e-5)
+    for i in range(5):
+        np.testing.assert_array_equal(
+            got[i], ref_seqs[i],
+            err_msg=f"rank {i}: beam {got[i]} != oracle {ref_seqs[i]} "
+                    f"(scores {gs[i]} vs {ref_scores[i]})")
+
+
+def test_beam_search_eos_frozen_tail_and_score_freeze():
+    """A beam that hits eos must emit eos for the rest of the row, and
+    its score must stop accumulating (contributions after eos are 0)."""
+    V, eos = 6, 1
+    model = _tiny_lm(vocab_size=V, hidden_size=16, num_layers=1,
+                     max_position=32)
+    prompt = np.asarray([[4, 2, 5]], np.int32)
+    variables = model.init(jax.random.key(0), jnp.asarray(prompt))
+    b_short, s_short = beam_search(model, variables, jnp.asarray(prompt),
+                                   4, beam_size=4, eos_id=eos)
+    b_long, s_long = beam_search(model, variables, jnp.asarray(prompt),
+                                 8, beam_size=4, eos_id=eos)
+    b_short, b_long = np.asarray(b_short[0]), np.asarray(b_long[0])
+    for row in b_long:
+        hits = np.nonzero(row == eos)[0]
+        if hits.size:
+            assert (row[hits[0]:] == eos).all(), row
+    # any hypothesis finished (eos'd) within 4 tokens keeps the same
+    # frozen score when generation runs longer
+    for bs, ss in zip(b_short, np.asarray(s_short[0])):
+        if eos in bs:
+            j = np.where((b_long[:, :4] == bs).all(axis=1))[0]
+            assert j.size, (bs, b_long)
+            np.testing.assert_allclose(np.asarray(s_long[0])[j[0]], ss,
+                                       rtol=1e-5)
+
+
+def test_beam_search_ragged_prompt_parity():
+    """Each row of a right-padded ragged batch must produce the same
+    beams/scores as a solo run on its trimmed prompt (same contract as
+    generate())."""
+    V, eos = 8, 3
+    model = _tiny_lm(vocab_size=V, hidden_size=16, num_layers=1,
+                     max_position=32)
+    rng = np.random.default_rng(2)
+    plens = [2, 5, 3]
+    Pn = max(plens)
+    prompt = rng.integers(4, V, (3, Pn)).astype(np.int32)  # avoid eos
+    prompt[0, plens[0]:] = 0
+    prompt[2, plens[2]:] = 0
+    variables = model.init(jax.random.key(0), jnp.asarray(prompt))
+    beams, scores = beam_search(
+        model, variables, jnp.asarray(prompt), 4, beam_size=3,
+        prompt_len=jnp.asarray(plens, jnp.int32), eos_id=eos,
+        length_penalty=0.6)
+    for i, ln in enumerate(plens):
+        solo_b, solo_s = beam_search(
+            model, variables, jnp.asarray(prompt[i:i + 1, :ln]), 4,
+            beam_size=3, eos_id=eos, length_penalty=0.6)
+        np.testing.assert_array_equal(np.asarray(beams[i]),
+                                      np.asarray(solo_b[0]),
+                                      err_msg=f"row {i}")
+        np.testing.assert_allclose(np.asarray(scores[i]),
+                                   np.asarray(solo_s[0]), rtol=1e-4,
+                                   atol=1e-5, err_msg=f"row {i}")
+
+
 def test_remat_matches_non_remat():
     """remat=True recomputes in backward — forward AND grads must be
     identical to the stored-activation path."""
