@@ -120,6 +120,7 @@ class InferenceModel:
         #                         plain-model inputs
         self.max_prompt_width = None    # ditto the serving bounds limit
         self.prompt_pad_id = None
+        self._gen_max_new_tokens = None
         self._jit = None        # new model -> stale compiled wrapper
         return self
 
@@ -164,6 +165,9 @@ class InferenceModel:
         # generator's (a mismatch would silently miscount prompt lengths)
         self.max_prompt_width = pbuckets[-1]
         self.prompt_pad_id = int(pad_id)
+        # continuous-batching serving builds its engine from these
+        self._gen_max_new_tokens = int(max_new_tokens)
+        self._gen_prompt_buckets = pbuckets
 
         def apply_fn(variables, prompts, lengths):
             if self._dequant is not None:
@@ -201,6 +205,27 @@ class InferenceModel:
         self._pre_pad = pre_pad
         self._jit = None
         return self
+
+    def make_continuous_engine(self, max_slots: int = 8,
+                               eos_id: Optional[int] = None):
+        """Build a ``serving.continuous.ContinuousEngine`` from a model
+        loaded via ``load_flax_generator`` (quantized weights dequantize
+        once at build — the engine trades the at-rest memory win for
+        per-token speed; keep the batch path for memory-bound serving)."""
+        from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+
+        if getattr(self, "_gen_max_new_tokens", None) is None:
+            raise ValueError("continuous batching needs a model loaded "
+                             "via load_flax_generator")
+        variables = self._variables
+        if self._dequant is not None:
+            variables = jax.device_put(self._dequant(variables))
+        return ContinuousEngine(
+            self.model, variables,
+            max_new_tokens=self._gen_max_new_tokens,
+            max_slots=max_slots,
+            prompt_buckets=self._gen_prompt_buckets,
+            eos_id=eos_id, pad_id=self.prompt_pad_id)
 
     def load_torch(self, module) -> "InferenceModel":
         """ref-parity: InferenceModel.loadTorch — a torch nn.Module (or

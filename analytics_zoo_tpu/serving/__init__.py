@@ -1,7 +1,9 @@
+from analytics_zoo_tpu.serving.continuous import ContinuousEngine
 from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.resp import RespClient, RespServer
 from analytics_zoo_tpu.serving.server import ClusterServing, ServingConfig
 from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
 
-__all__ = ["InputQueue", "OutputQueue", "RespClient", "RespServer",
-           "ClusterServing", "ServingConfig", "HttpFrontend"]
+__all__ = ["ContinuousEngine", "InputQueue", "OutputQueue", "RespClient",
+           "RespServer", "ClusterServing", "ServingConfig",
+           "HttpFrontend"]

@@ -1,0 +1,277 @@
+"""Continuous batching for generative serving.
+
+SURVEY.md §2.6's TPU mapping names "continuous batching" as the serving
+bar; the reference's Flink engine (upstream ``serving/engine/``) stops at
+request-level micro-batching — a batch of prompts runs its whole
+generation before the next batch starts, so a 2-token request convoys
+behind a 32-token neighbour.  This module is the beyond-parity engine:
+
+- A fixed-size **slot arena**: KV caches ``[n_layers, S, L, H, D]`` for
+  ``S`` co-resident requests, allocated once.  Static shapes — the decode
+  step compiles exactly once, no matter how requests come and go.
+- **In-flight joining**: a new request PREFILLS with one MXU-friendly
+  forward (``TransformerLM.prefill``) and its K/V are spliced into a free
+  slot while other slots are mid-generation; the next engine tick decodes
+  all residents together at their own positions (``decode_step`` with a
+  per-row position vector).
+- **Slot recycling**: a request that hits EOS or its token budget frees
+  its slot immediately; the next waiting request takes it on the same
+  tick.  Stale cache entries need no scrubbing — a resident only attends
+  positions ``<= pos`` it has itself written (prompt prefill + its own
+  decode steps), so a recycled slot never reads its predecessor's K/V.
+
+Per-request results match ``models.lm.generate`` run solo: same frozen
+tail EOS semantics, same ``[max_new_tokens]`` output shape (eos-padded),
+greedy or per-request-temperature sampling with ``generate``-compatible
+position-folded rngs.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.learn.inference_model import _next_bucket
+from analytics_zoo_tpu.models.lm import TransformerLM
+
+logger = logging.getLogger("analytics_zoo_tpu")
+
+
+@dataclass
+class _Slot:
+    uri: str
+    plen: int
+    tokens: List[int] = field(default_factory=list)
+    on_done: Optional[Callable] = None
+    temperature: float = 0.0
+    rng_seed: Optional[int] = None
+
+
+class ContinuousEngine:
+    """Slot-arena generation engine over one ``TransformerLM``.
+
+    Host-side control loop + three jitted device programs:
+    ``_step`` (advance every slot one token, per-slot positions),
+    ``_prefill[bucket]`` (one forward for a joining prompt), and
+    ``_insert[bucket]`` (splice prefilled K/V into a slot).  The arena
+    buffers are donated through ``_step``/``_insert`` so XLA updates them
+    in place instead of copying ``S*L`` of KV per token.
+
+    Not thread-safe by itself: ``submit`` may be called from any thread,
+    but ``step``/``drain`` must run on ONE pump thread (the serving loop).
+    """
+
+    def __init__(self, model: TransformerLM, variables, *,
+                 max_new_tokens: int, max_slots: int = 8,
+                 prompt_buckets: Sequence[int] = (16, 32, 64, 128),
+                 eos_id: Optional[int] = None, pad_id: int = 0):
+        if model.pp_stages > 0:
+            raise ValueError("continuous batching serves pp_stages=0 "
+                             "models (models.lm.unstack_pp_params)")
+        self.model = model
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.pad_id = int(pad_id)
+        limit = int(model.max_position) - self.max_new_tokens
+        self.prompt_buckets = tuple(
+            b for b in sorted(set(int(b) for b in prompt_buckets))
+            if b <= limit)
+        if not self.prompt_buckets:
+            raise ValueError(
+                f"no prompt bucket fits: max_position {model.max_position}"
+                f" - max_new_tokens {max_new_tokens} = {limit} < smallest "
+                f"bucket {min(prompt_buckets)}")
+        self.max_prompt_width = self.prompt_buckets[-1]
+        S = int(max_slots)
+        L = self.max_prompt_width + self.max_new_tokens
+        self._S, self._L = S, L
+        H = model.num_heads
+        D = model.hidden_size // H
+        cdtype = jnp.dtype(model.dtype)
+        self._ck = jnp.zeros((model.num_layers, S, L, H, D), cdtype)
+        self._cv = jnp.zeros_like(self._ck)
+        self._variables = variables
+        # host-side per-slot state (device copies travel as step args)
+        self._tok = np.zeros(S, np.int32)
+        self._pos = np.zeros(S, np.int32)
+        self._slots: List[Optional[_Slot]] = [None] * S
+        self._free = collections.deque(range(S))
+        self._lock = threading.Lock()
+        self._waiting: collections.deque = collections.deque()
+        self._step_count = 0
+
+        def step_fn(ck, cv, tok, pos, temps, seeds, use_sample):
+            logits, ck, cv = model.apply(
+                variables, tok, ck, cv, pos,
+                method=TransformerLM.decode_step)
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+            if not use_sample:          # static: greedy-only compile
+                return greedy, ck, cv
+
+            def sample_row(seed, t, lg, p):
+                key = jax.random.fold_in(jax.random.key(seed), p)
+                scaled = lg.astype(jnp.float32) / jnp.maximum(t, 1e-6)
+                return jax.random.categorical(key, scaled).astype(
+                    jnp.int32)
+
+            sampled = jax.vmap(sample_row)(seeds, temps, logits, pos)
+            return jnp.where(temps > 0.0, sampled, greedy), ck, cv
+
+        self._step = jax.jit(partial(step_fn, use_sample=False),
+                             donate_argnums=(0, 1))
+        self._step_sampled = jax.jit(partial(step_fn, use_sample=True),
+                                     donate_argnums=(0, 1))
+
+        def prefill_fn(prompt, plen):
+            logits, ks, vs = model.apply(variables, prompt,
+                                         method=TransformerLM.prefill)
+            return logits[0, plen - 1], ks, vs
+
+        self._prefill = jax.jit(prefill_fn)
+
+        def insert_fn(ck, cv, ks, vs, slot):
+            ck = jax.lax.dynamic_update_slice(
+                ck, ks.astype(ck.dtype), (0, slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cv, vs.astype(cv.dtype), (0, slot, 0, 0, 0))
+            return ck, cv
+
+        self._insert = jax.jit(insert_fn, donate_argnums=(0, 1))
+
+    # ---- submission ---------------------------------------------------
+
+    @property
+    def n_active(self) -> int:
+        return self._S - len(self._free)
+
+    @property
+    def n_waiting(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+    def submit(self, uri: str, prompt: np.ndarray,
+               on_done: Optional[Callable] = None, *,
+               temperature: float = 0.0,
+               rng_seed: Optional[int] = None) -> None:
+        """Queue one request.  ``prompt``: 1-D int32 token array.
+        ``on_done(uri, tokens)`` fires from the pump thread when the
+        request finishes (tokens: ``[max_new_tokens]`` int32, eos-padded
+        frozen tail).  Raises on bounds violations — the serving layer
+        error-publishes per request before calling this."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt must be 1-D, got {prompt.shape}")
+        n = len(prompt)
+        if n < 1 or n > self.max_prompt_width:
+            raise ValueError(
+                f"prompt length {n} outside [1, {self.max_prompt_width}]")
+        if temperature > 0.0 and rng_seed is None:
+            raise ValueError("temperature > 0 needs rng_seed")
+        with self._lock:
+            self._waiting.append(
+                (uri, prompt, on_done, float(temperature), rng_seed))
+
+    # ---- pump ---------------------------------------------------------
+
+    def _admit(self) -> int:
+        """Move waiting requests into free slots (prefill + splice).
+        Returns the number admitted this call."""
+        admitted = 0
+        while self._free:
+            with self._lock:
+                if not self._waiting:
+                    break
+                uri, prompt, on_done, temp, seed = self._waiting.popleft()
+            slot = self._free.popleft()
+            plen = len(prompt)
+            pb = _next_bucket(plen, self.prompt_buckets)
+            padded = np.full((1, pb), self.pad_id, np.int32)
+            padded[0, :plen] = prompt
+            last_logits, ks, vs = self._prefill(jnp.asarray(padded),
+                                                jnp.int32(plen))
+            self._ck, self._cv = self._insert(
+                self._ck, self._cv, ks, vs, jnp.int32(slot))
+            first = self._pick_first(last_logits, plen, temp, seed)
+            st = _Slot(uri=uri, plen=plen, on_done=on_done,
+                       temperature=temp, rng_seed=seed)
+            self._slots[slot] = st
+            self._tok[slot] = first
+            self._pos[slot] = plen
+            admitted += 1
+            self._record_token(slot, int(first))
+        return admitted
+
+    def _pick_first(self, last_logits, plen: int, temp: float,
+                    seed) -> int:
+        """The prefill's last-position logits produce the request's first
+        token — same pick semantics (and rng position-fold) as
+        ``generate``'s step at t = plen-1."""
+        if temp <= 0.0:
+            return int(jnp.argmax(last_logits))
+        key = jax.random.fold_in(jax.random.key(int(seed)), plen - 1)
+        return int(jax.random.categorical(
+            key, last_logits.astype(jnp.float32) / temp))
+
+    def _record_token(self, slot: int, token: int):
+        """Append one generated token; finish + free the slot when done."""
+        st = self._slots[slot]
+        st.tokens.append(token)
+        done = len(st.tokens) >= self.max_new_tokens or \
+            (self.eos_id is not None and token == self.eos_id)
+        if not done:
+            return
+        out = np.full(self.max_new_tokens,
+                      self.eos_id if self.eos_id is not None else 0,
+                      np.int32)
+        out[:len(st.tokens)] = st.tokens      # frozen tail: eos padding
+        self._slots[slot] = None
+        self._free.append(slot)
+        if st.on_done is not None:
+            try:
+                st.on_done(st.uri, out)
+            except Exception:
+                logger.exception("continuous-batching on_done callback "
+                                 "failed for %r", st.uri)
+
+    def step(self) -> int:
+        """One engine tick: admit joiners, then advance every resident
+        one token.  Returns the number of active slots after the tick
+        (0 = idle; the caller decides how to wait for new work)."""
+        self._admit()
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return 0
+        sampled = any(self._slots[i].temperature > 0.0 for i in active)
+        temps = np.zeros(self._S, np.float32)
+        seeds = np.zeros(self._S, np.uint32)
+        for i in active:
+            temps[i] = self._slots[i].temperature
+            seeds[i] = self._slots[i].rng_seed or 0
+        step = self._step_sampled if sampled else self._step
+        nxt, self._ck, self._cv = step(
+            self._ck, self._cv, jnp.asarray(self._tok),
+            jnp.asarray(self._pos), jnp.asarray(temps),
+            jnp.asarray(seeds))
+        nxt = np.asarray(nxt)
+        for i in active:
+            self._tok[i] = nxt[i]
+            self._pos[i] += 1
+            self._record_token(i, int(nxt[i]))
+        self._admit()       # freed slots recycle on the SAME tick
+        return self.n_active
+
+    def drain(self, max_ticks: int = 100_000) -> None:
+        """Run ticks until every submitted request has finished (tests /
+        batch use)."""
+        for _ in range(max_ticks):
+            if self.step() == 0 and self.n_waiting == 0:
+                return
+        raise RuntimeError("drain did not converge")

@@ -65,6 +65,13 @@ class ServingConfig:
     # (prompts, lengths) contract).  None = ordinary fixed-shape serving.
     prompt_col: Optional[str] = None
     prompt_pad_id: int = 0
+    # Continuous batching (generative only): in-flight joining over a
+    # fixed-slot KV arena (serving/continuous.py) instead of convoying
+    # whole generations per micro-batch.  engine_slots co-resident
+    # requests; eos_id frees a slot early when the model emits it.
+    continuous_batching: bool = False
+    engine_slots: int = 8
+    eos_id: Optional[int] = None
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -95,6 +102,12 @@ class ServingConfig:
             cfg.prompt_col = str(params["prompt_col"])
         if "prompt_pad_id" in params:
             cfg.prompt_pad_id = int(params["prompt_pad_id"])
+        if "continuous_batching" in params:
+            cfg.continuous_batching = bool(params["continuous_batching"])
+        if "engine_slots" in params:
+            cfg.engine_slots = int(params["engine_slots"])
+        if "eos_id" in params:
+            cfg.eos_id = int(params["eos_id"])
         return cfg
 
 
@@ -114,6 +127,7 @@ class ClusterServing:
         self._check_pad_agreement(inference_model)
         if self.config.core_number is not None:
             inference_model.set_concurrency(self.config.core_number)
+        self.engine = None      # continuous-batching engine (start())
         self.broker: Optional[RespServer] = None
         if embedded_broker:
             self.broker = RespServer(port=0).start()
@@ -157,16 +171,31 @@ class ClusterServing:
             if "BUSYGROUP" not in str(e):
                 raise
         self._threads = []
-        for w in range(max(1, self.config.workers)):
-            t = threading.Thread(target=self._loop, args=(f"w{w}",),
-                                 daemon=True, name=f"zoo-serving-{w}")
+        if self.config.continuous_batching:
+            # ONE pump thread owns the engine's device arena; horizontal
+            # scale for continuous mode is more engine slots (or more
+            # ClusterServing processes, each with its own arena)
+            self.engine = self.model.make_continuous_engine(
+                max_slots=self.config.engine_slots,
+                eos_id=self.config.eos_id)
+            t = threading.Thread(target=self._loop_continuous,
+                                 args=("w0",), daemon=True,
+                                 name="zoo-serving-cb")
             t.start()
             self._threads.append(t)
+        else:
+            for w in range(max(1, self.config.workers)):
+                t = threading.Thread(target=self._loop, args=(f"w{w}",),
+                                     daemon=True, name=f"zoo-serving-{w}")
+                t.start()
+                self._threads.append(t)
         self._thread = self._threads[0]     # back-compat attribute
         logger.info("ClusterServing up (redis %s:%d, batch<=%d, "
-                    "workers=%d)", self.config.redis_host,
+                    "workers=%d%s)", self.config.redis_host,
                     self.config.redis_port, self.config.batch_size,
-                    len(self._threads))
+                    len(self._threads),
+                    ", continuous" if self.config.continuous_batching
+                    else "")
         return self
 
     def stop(self):
@@ -184,6 +213,11 @@ class ClusterServing:
         attribute assignment — the loop reads ``self.model`` once per
         dispatch, so in-flight batches finish on the old model and the
         next batch runs the new one; no request is dropped."""
+        if self.config.continuous_batching:
+            raise NotImplementedError(
+                "hot reload under continuous batching would orphan the "
+                "in-flight KV arena; drain and restart the serving job "
+                "to swap models")
         self._check_pad_agreement(inference_model)
         if self.config.core_number is not None:
             inference_model.set_concurrency(self.config.core_number)
@@ -299,6 +333,66 @@ class ClusterServing:
         finally:
             client.close()
 
+    def _loop_continuous(self, consumer: str):
+        """Continuous-batching pump: requests stream into the slot-arena
+        engine as they arrive (in-flight joining); each request publishes
+        the moment IT finishes, so a 2-token request never convoys behind
+        a 32-token neighbour admitted earlier."""
+        try:
+            client = RespClient(self.config.redis_host,
+                                self.config.redis_port)
+        except OSError:
+            logger.exception("continuous serving pump could not connect "
+                             "to the broker — not started")
+            return
+        engine = self.engine
+        pcol = self.config.prompt_col or "prompt"
+
+        def publish(uri: str, toks: np.ndarray, eid: bytes, t0: float):
+            client.pipeline([
+                ("HSET", RESULT_PREFIX + uri, "value",
+                 encode_ndarray(toks)),
+                ("XADD", SIGNAL_PREFIX + uri, "*", "ok", "1"),
+                ("SADD", "__result_keys__", uri)])
+            self._finish_entries(client, [eid])
+            dt = (time.perf_counter() - t0) * 1000
+            with self._stats_lock:
+                self.stats["requests"] += 1
+                self.stats["batches"] += 1
+                # continuous mode: predict_ms is the last request's
+                # submit-to-publish latency; fill is arena occupancy
+                self.stats["predict_ms"] = dt
+                self.stats["batch_fill"] = engine.n_active / max(
+                    1, self.config.engine_slots)
+                self._written.append((uri, time.monotonic()))
+
+        try:
+            while not self._stop.is_set():
+                busy = engine.n_active > 0 or engine.n_waiting > 0
+                try:
+                    requests, ids = self._read_batch(
+                        client, consumer, 1 if busy else 200)
+                except (ConnectionError, OSError):
+                    if self._stop.is_set():
+                        break
+                    time.sleep(0.05)
+                    continue
+                for r, eid in zip(requests, ids):
+                    t0 = time.perf_counter()
+                    try:
+                        uri = r["uri"].decode()
+                        prompt = self._decode_value(r[pcol])
+                        engine.submit(
+                            uri, prompt,
+                            on_done=(lambda u, toks, _eid=eid, _t0=t0:
+                                     publish(u, toks, _eid, _t0)))
+                    except Exception as e:
+                        self._publish_error(r, f"submit failed: {e!r}")
+                        self._finish_entries(client, [eid])
+                engine.step()
+        finally:
+            client.close()
+
     def _finish_entries(self, client: RespClient, ids):
         """Ack + delete consumed stream entries (after their results —
         value or error — are published); one pipeline round-trip."""
@@ -405,15 +499,14 @@ class ClusterServing:
                     self._publish_error(
                         r, f"prompt length {n} outside [1, {limit}]")
                     per_req[i] = None
-            widths = [len(v[ci]) for v in per_req
-                      if v is not None and np.asarray(v[ci]).ndim == 1]
+            # every surviving row passed the 1-D check above, so each one
+            # gets a recorded length here — dispatch relies on that
+            widths = [len(v[ci]) for v in per_req if v is not None]
             wmax = max(widths) if widths else 0
             for i, v in enumerate(per_req):
                 if v is None:
                     continue
                 arr = np.asarray(v[ci])
-                if arr.ndim != 1:
-                    continue        # shape check below errors it out
                 req_lengths[i] = len(arr)
                 if len(arr) < wmax:
                     v[ci] = np.concatenate(

@@ -170,6 +170,121 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
     }
 
 
+def run_poisson_scenario(continuous: bool, rate_per_s: float,
+                         n_requests: int, slots: int = 8) -> dict:
+    """Open-loop mixed generative workload: requests arrive at Poisson
+    times (not closed-loop clients), 80% short prompts / 20% long, all
+    wanting 32 tokens.  The metric that separates the two serving modes
+    is SHORT-request p50: under micro-batching a short prompt convoys
+    behind the whole co-batched generation (plus the previous batch),
+    while continuous batching admits it into the running decode arena
+    and publishes it the moment it finishes."""
+    import queue as _q
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, InputQueue, OutputQueue, ServingConfig)
+
+    model = TransformerLM(vocab_size=8192, hidden_size=256, num_layers=4,
+                          num_heads=4, intermediate_size=1024,
+                          max_position=128)
+    variables = model.init(jax.random.key(0), np.zeros((1, 32), np.int32))
+    im = InferenceModel(batch_buckets=(1, 8, slots))
+    im.load_flax_generator(model, variables, max_new_tokens=32,
+                           prompt_buckets=(8, 32))
+    cfg = ServingConfig(prompt_col="tokens", batch_size=slots,
+                        batch_timeout_ms=4.0,
+                        continuous_batching=continuous,
+                        engine_slots=slots)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    inq = InputQueue(port=serving.port)
+    rng = np.random.default_rng(11)
+    short = [rng.integers(1, 8192, int(rng.integers(4, 9))).astype(
+        np.int32) for _ in range(16)]
+    long_ = [rng.integers(1, 8192, int(rng.integers(24, 33))).astype(
+        np.int32) for _ in range(16)]
+
+    # warm both compile paths through the real serving loop
+    wq = OutputQueue(port=serving.port)
+    inq.enqueue("warm-s", tokens=short[0])
+    inq.enqueue("warm-l", tokens=long_[0])
+    wq.query("warm-s", timeout=600)
+    wq.query("warm-l", timeout=600)
+
+    enq_t: dict = {}
+    kinds: dict = {}
+    lat: dict = {}
+    lock = threading.Lock()
+    uris: "_q.Queue" = _q.Queue()
+    errors: list = []
+
+    def waiter():
+        outq = OutputQueue(port=serving.port)
+        try:
+            while True:
+                uri = uris.get()
+                if uri is None:
+                    return
+                r = outq.query(uri, timeout=120, poll_interval=0.001)
+                t1 = time.perf_counter()
+                if r is None:
+                    with lock:
+                        errors.append(f"timeout {uri}")
+                else:
+                    with lock:
+                        lat[uri] = t1 - enq_t[uri]
+        except Exception as e:
+            with lock:
+                errors.append(repr(e))
+        finally:
+            outq.close()
+
+    n_waiters = 16
+    waiters = [threading.Thread(target=waiter) for _ in range(n_waiters)]
+    for w in waiters:
+        w.start()
+    t_start = time.perf_counter()
+    for i in range(n_requests):
+        is_short = rng.random() < 0.8
+        p = (short if is_short else long_)[int(rng.integers(16))]
+        uri = f"r{i}"
+        kinds[uri] = "short" if is_short else "long"
+        enq_t[uri] = time.perf_counter()
+        inq.enqueue(uri, tokens=p)
+        uris.put(uri)
+        time.sleep(float(rng.exponential(1.0 / rate_per_s)))
+    for _ in waiters:
+        uris.put(None)
+    for w in waiters:
+        w.join()
+    wall = time.perf_counter() - t_start
+    serving.stop()
+    inq.close()
+    wq.close()
+    if errors:
+        raise RuntimeError(f"poisson bench failed: {errors[:3]}")
+
+    def pct(sel, q):
+        a = np.asarray([v for u, v in lat.items() if kinds[u] == sel])
+        return round(float(np.percentile(a, q)) * 1e3, 2) if a.size \
+            else None
+
+    return {
+        "model": "lm-poisson-cb" if continuous else "lm-poisson",
+        "mode": "continuous" if continuous else "microbatch",
+        "rate_per_s": rate_per_s,
+        "requests": len(lat),
+        "req_per_sec": round(len(lat) / wall, 1),
+        "short_p50_ms": pct("short", 50),
+        "short_p90_ms": pct("short", 90),
+        "long_p50_ms": pct("long", 50),
+        "long_p90_ms": pct("long", 90),
+    }
+
+
 def main():
     """Each scenario runs in its OWN subprocess: this platform's tunneled
     device link degrades permanently after heavy D2H traffic (bench.py
@@ -186,7 +301,10 @@ def main():
             ("resnet18", 1, 50, 64), ("resnet18", 16, 20, 64),
             ("resnet18", 64, 10, 64),
             ("resnet18-int8", 64, 10, 64),
-            ("lm", 1, 20, 32), ("lm", 16, 10, 32), ("lm", 64, 5, 32)]
+            ("lm", 1, 20, 32), ("lm", 16, 10, 32), ("lm", 64, 5, 32),
+            # open-loop Poisson mixed workload: clients = rate (req/s),
+            # rpc = total requests; convoy vs continuous head-to-head
+            ("lm-poisson", 12, 150, 8), ("lm-poisson-cb", 12, 150, 8)]
     failures = 0
     for kind, clients, rpc, bs in plan:
         cmd = [sys.executable, os.path.abspath(__file__), "--one",
@@ -229,7 +347,12 @@ def _one():
 
     kind, clients, rpc, bs = (sys.argv[2], int(sys.argv[3]),
                               int(sys.argv[4]), int(sys.argv[5]))
-    r = run_scenario(kind, clients, requests_per_client=rpc, batch_size=bs)
+    if kind.startswith("lm-poisson"):
+        r = run_poisson_scenario(kind.endswith("-cb"), rate_per_s=clients,
+                                 n_requests=rpc, slots=bs)
+    else:
+        r = run_scenario(kind, clients, requests_per_client=rpc,
+                         batch_size=bs)
     print(json.dumps(r))
 
 

@@ -1,0 +1,199 @@
+"""Continuous-batching engine tests (serving/continuous.py): per-request
+parity with solo generate(), slot recycling under EOS, sampling, and the
+ClusterServing continuous-mode round trip."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.lm import TransformerLM, generate
+from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+
+
+def _tiny_lm(**kw):
+    cfg = dict(vocab_size=32, hidden_size=32, num_layers=2, num_heads=2,
+               intermediate_size=64, max_position=64, dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = _tiny_lm()
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+def test_engine_matches_solo_generation(lm):
+    """THE correctness contract: every request's tokens equal its own
+    solo generate() run, even when requests share the arena with
+    neighbours at different depths and more requests than slots force
+    queueing + slot recycling."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                           max_slots=3, prompt_buckets=(8, 16))
+    rng = np.random.default_rng(0)
+    prompts = {f"r{i}": rng.integers(1, 32, rng.integers(2, 9)).astype(
+        np.int32) for i in range(7)}
+    results = {}
+    for uri, p in prompts.items():
+        eng.submit(uri, p, on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    assert set(results) == set(prompts)
+    for uri, p in prompts.items():
+        solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                                   5))[0]
+        np.testing.assert_array_equal(results[uri], solo, err_msg=uri)
+
+
+def test_engine_eos_frees_slot_and_matches_generate(lm):
+    """A request that hits EOS frees its slot immediately (a waiting
+    request is admitted on the same tick) and its output carries the
+    frozen eos tail — identical to generate(eos_id=...)."""
+    model, variables = lm
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 32, 4).astype(np.int32) for _ in range(4)]
+    # pick the token the model actually emits first for prompt 0 as eos:
+    # that request finishes after 1 token, deterministically
+    first_tok = int(np.asarray(generate(
+        model, variables, jnp.asarray(prompts[0][None]), 1))[0, 0])
+    eos = first_tok
+    eng = ContinuousEngine(model, variables, max_new_tokens=6,
+                           max_slots=2, prompt_buckets=(8,), eos_id=eos)
+    results = {}
+    order = []
+    for i, p in enumerate(prompts):
+        eng.submit(f"r{i}", p,
+                   on_done=lambda u, t: (results.__setitem__(u, t),
+                                         order.append(u)))
+    eng.drain()
+    for i, p in enumerate(prompts):
+        solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                                   6, eos_id=eos))[0]
+        np.testing.assert_array_equal(results[f"r{i}"], solo,
+                                      err_msg=f"r{i}")
+    # r0 finished on its first token: frozen tail is all eos
+    assert results["r0"][0] == eos and (results["r0"] == eos).all()
+    assert order[0] == "r0"      # it finished before the long requests
+
+
+def test_engine_in_flight_joining_mid_generation(lm):
+    """A request submitted while another is mid-generation joins the
+    running arena (no convoy) and both still match solo runs."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=8,
+                           max_slots=4, prompt_buckets=(8,))
+    results = {}
+    p1 = np.asarray([5, 9, 11], np.int32)
+    p2 = np.asarray([7, 3], np.int32)
+    eng.submit("a", p1, on_done=lambda u, t: results.__setitem__(u, t))
+    for _ in range(3):          # a is 3+1 tokens deep when b joins
+        eng.step()
+    assert eng.n_active == 1 and "a" not in results
+    eng.submit("b", p2, on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    for uri, p in (("a", p1), ("b", p2)):
+        solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                                   8))[0]
+        np.testing.assert_array_equal(results[uri], solo, err_msg=uri)
+
+
+def test_engine_temperature_sampling(lm):
+    """Sampled requests run alongside greedy ones; same seed reproduces,
+    different seeds diverge (distribution sanity, not exact parity with
+    the batch sampler)."""
+    model, variables = lm
+    p = np.asarray([5, 9, 11, 2], np.int32)
+
+    def run(seed):
+        eng = ContinuousEngine(model, variables, max_new_tokens=8,
+                               max_slots=2, prompt_buckets=(8,))
+        results = {}
+        eng.submit("s", p, temperature=1.5, rng_seed=seed,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        eng.submit("g", p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        eng.drain()
+        return results
+
+    r1, r2, r3 = run(7), run(7), run(123)
+    np.testing.assert_array_equal(r1["s"], r2["s"])     # reproducible
+    np.testing.assert_array_equal(r1["g"], r2["g"])
+    assert not np.array_equal(r1["s"], r3["s"])          # seed matters
+    solo_greedy = np.asarray(generate(model, variables,
+                                      jnp.asarray(p[None]), 8))[0]
+    np.testing.assert_array_equal(r1["g"], solo_greedy)
+
+
+def test_engine_bounds_rejection(lm):
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8,))
+    with pytest.raises(ValueError, match="outside"):
+        eng.submit("x", np.arange(9, dtype=np.int32))   # > bucket max
+    with pytest.raises(ValueError, match="outside"):
+        eng.submit("x", np.zeros(0, np.int32))
+    with pytest.raises(ValueError, match="1-D"):
+        eng.submit("x", np.zeros((2, 3), np.int32))
+    with pytest.raises(ValueError, match="rng_seed"):
+        eng.submit("x", np.arange(3, dtype=np.int32), temperature=1.0)
+
+
+def test_cluster_serving_continuous_round_trip(lm):
+    """e2e: continuous-batching ClusterServing serves ragged prompts from
+    the queue; each result equals the solo generation."""
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           OutputQueue, ServingConfig)
+
+    model, variables = lm
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=6, prompt_buckets=(8, 16))
+    cfg = ServingConfig(prompt_col="prompt", continuous_batching=True,
+                        engine_slots=3)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        rng = np.random.default_rng(3)
+        prompts = {f"q{i}": rng.integers(1, 32, rng.integers(2, 9)).astype(
+            np.int32) for i in range(6)}
+        for uri, p in prompts.items():
+            iq.enqueue(uri, prompt=p)
+        for uri, p in prompts.items():
+            got = oq.query(uri, timeout=60)
+            solo = np.asarray(generate(model, variables,
+                                       jnp.asarray(p[None]), 6))[0]
+            np.testing.assert_array_equal(np.asarray(got), solo,
+                                          err_msg=uri)
+        # malformed request errors individually, loop survives
+        iq.enqueue("bad", prompt=np.zeros((2, 2), np.int32))
+        with pytest.raises(RuntimeError, match="serving error"):
+            oq.query("bad", timeout=30)
+        iq.enqueue("after", prompt=prompts["q0"])
+        got = oq.query("after", timeout=30)
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(prompts["q0"][None]), 6))[0]
+        np.testing.assert_array_equal(np.asarray(got), solo)
+    finally:
+        srv.stop()
+
+
+def test_continuous_reload_refused(lm):
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving import ClusterServing, ServingConfig
+
+    model, variables = lm
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=4, prompt_buckets=(8,))
+    cfg = ServingConfig(prompt_col="prompt", continuous_batching=True)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        with pytest.raises(NotImplementedError, match="drain"):
+            srv.reload_model(im)
+    finally:
+        srv.stop()
