@@ -15,6 +15,8 @@ from analytics_zoo_tpu.parallel.partition import (
 from analytics_zoo_tpu.parallel.pipeline import (
     GPipe,
     pipeline_apply,
+    pipeline_value_and_grad,
+    pipeline_1f1b_stats,
     sequential_apply,
     pp_stage_rules,
 )
@@ -32,6 +34,8 @@ __all__ = [
     "with_sharding_constraint",
     "GPipe",
     "pipeline_apply",
+    "pipeline_value_and_grad",
+    "pipeline_1f1b_stats",
     "sequential_apply",
     "pp_stage_rules",
 ]

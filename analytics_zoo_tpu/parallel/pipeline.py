@@ -15,6 +15,15 @@ M microbatches.  The whole schedule differentiates through scan/ppermute,
 so the SAME code is forward and backward pipelining; XLA overlaps the
 ppermute hop with the next tick's compute.
 
+Two training schedules: autodiff through ``pipeline_apply`` yields GPipe
+(all-forward-then-all-backward, activation residency grows with M), and
+``pipeline_value_and_grad`` runs flat 1F1B (interleaved forward/backward
+ticks, residency bounded at 2S microbatches per rank via stage-level
+remat).  The trade is explicit: the lockstep 1F1B schedule idles
+(2S-2)/(M+2S-2) of its slots — about twice GPipe's bubble at equal M —
+but its O(S) memory bound is what lets M grow to amortise the bubble
+where GPipe's O(M) residency cannot (``pipeline_1f1b_stats``).
+
 Composes with the batch axes: batch stays sharded over dp/fsdp (each pp
 rank sees its dp-local batch).  Stage-INTERNAL tensor parallelism does
 NOT compose: stages execute inside shard_map, where a tp-sharded weight
@@ -131,6 +140,202 @@ def pipeline_apply(stage_fn: StageFn, stacked_params: Any, x: jax.Array,
 
     return jax.shard_map(ranked, mesh=mesh, in_specs=(pspec, xspec),
                          out_specs=xspec)(stacked_params, x)
+
+
+def pipeline_1f1b_stats(n_stages: int, n_microbatches: int) -> dict:
+    """Static schedule facts for ``pipeline_value_and_grad`` (asserted by
+    tests, cited in docs).  The lockstep combined-tick schedule runs
+    ``M + 2S - 2`` ticks (each tick does one forward AND one backward
+    unit per rank) and keeps at most ``2S`` microbatch activations
+    resident per rank — versus the GPipe-autodiff path, whose transposed
+    scan stores all ``M``.  Honest accounting: a rank does useful work in
+    M of its M+2S-2 forward slots and M of its backward slots, so the
+    idle fraction is ``(2S-2)/(M+2S-2)`` — about TWICE GPipe's
+    ``(S-1)/(M+S-1)`` at the same M.  This schedule buys the O(S) memory
+    bound by paying bubble, and the memory bound is exactly what lets M
+    grow to amortise it (``gpipe_bubble_fraction`` included for the
+    comparison)."""
+    S, M = int(n_stages), int(n_microbatches)
+    return {
+        "ticks": M + 2 * S - 2,
+        "residual_slots": 2 * S,
+        "gpipe_resident_microbatches": M,
+        "bubble_fraction": (2 * S - 2) / (M + 2 * S - 2),
+        "gpipe_bubble_fraction": (S - 1) / (M + S - 1),
+    }
+
+
+def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
+                            x: jax.Array, labels, mesh: Mesh,
+                            n_microbatches: int, *,
+                            batch_axes: Sequence[str] = ("dp", "fsdp"),
+                            pp_axis: str = "pp"):
+    """One interleaved-1F1B training tick-schedule: loss AND gradients of
+    ``mean(loss_fn(stage_S(...stage_1(x)), labels))`` in a single
+    shard_map scan.
+
+    Why not just ``jax.grad(pipeline_apply)``?  Autodiff transposes the
+    forward scan into an all-forward-then-all-backward schedule (GPipe):
+    every one of the M microbatches' stage activations stays resident
+    until its backward runs, so peak memory grows with M — and M is
+    exactly the knob one raises to shrink the bubble.  1F1B starts
+    microbatch m's backward as soon as its last-stage forward finishes,
+    bounding resident activations at 2S per rank regardless of M
+    (``pipeline_1f1b_stats``).  The backward unit recomputes its stage
+    forward from the saved stage INPUT (stage-level remat — the
+    standard trade), so each (microbatch, stage) costs fwd + fwd + vjp
+    instead of fwd + vjp.
+
+    Schedule (flat/non-interleaved 1F1B, combined F+B ticks): rank r
+    forwards microbatch ``m`` at tick ``m + r`` and backwards it at tick
+    ``m + 2S - 2 - r``; the last rank's backward fuses with its forward
+    (same tick), activations hop r->r+1 and activation-grads hop r->r-1
+    via ``lax.ppermute`` each tick.
+
+    Args mirror ``pipeline_apply`` plus ``labels`` ([B, ...], same
+    leading batch dim as x) and ``loss_fn(y_mb, label_mb) -> scalar``
+    (MEAN over the microbatch).  Returns ``(loss, grads, dx)`` where
+    ``grads`` matches ``stacked_params`` (sharded P(pp) like the
+    params) and ``dx`` is the loss gradient w.r.t. ``x`` (feeds
+    embedding/pre-trunk backward when composed manually).
+    """
+    S = int(mesh.shape[pp_axis]) if pp_axis in mesh.axis_names else 1
+    if S == 1:
+        def seq_loss(p, xx):
+            return loss_fn(sequential_apply(stage_fn, p, xx), labels)
+
+        loss, (gp, gx) = jax.value_and_grad(seq_loss, argnums=(0, 1))(
+            stacked_params, x)
+        return loss, gp, gx
+    bad = {jnp.shape(leaf)[0] if jnp.shape(leaf) else None
+           for leaf in jax.tree.leaves(stacked_params)} - {S}
+    if bad:
+        raise ValueError(
+            f"stacked_params leading dim(s) {sorted(bad, key=str)} != pp "
+            f"axis size {S}")
+    M = int(n_microbatches)
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    xspec = P(batch, *([None] * (x.ndim - 1)))
+    lspec = P(batch, *([None] * (jnp.ndim(labels) - 1)))
+    pspec = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+
+    def ranked(params, xl, ll):
+        idx = lax.axis_index(pp_axis)
+        b = xl.shape[0]
+        m_eff = math.gcd(M, b)
+        mb = xl.reshape((m_eff, b // m_eff) + xl.shape[1:])
+        lb = ll.reshape((m_eff, b // m_eff) + ll.shape[1:])
+        R = 2 * S                        # residual ring slots
+        ticks = m_eff + 2 * S - 2
+
+        def vary(z):
+            # Two reasons to mark values device-varying: (1) scan carries
+            # pick up pp-varying (ppermute/axis_index) and batch-varying
+            # (dp-sharded activations) values, and an invariant->varying
+            # carry fails shard_map's vma typecheck; (2) params must be
+            # batch-VARYING before jax.vjp, else autodiff auto-psums the
+            # param cotangent across dp on EVERY tick (one all-reduce per
+            # tick, and it double-counts a later mean) — varied params get
+            # per-rank cotangents we reduce ONCE at the end.
+            for ax in (pp_axis,) + tuple(batch or ()):
+                try:
+                    z = lax.pcast(z, ax, to="varying")
+                except (AttributeError, TypeError):
+                    # no lax.pcast on this JAX: force variance on THIS
+                    # axis arithmetically and keep looping — falling out
+                    # early would leave params batch-invariant, and the
+                    # vjp transpose would then psum param cotangents
+                    # across dp every tick (n_dp-scaled grads)
+                    z = z + (lax.axis_index(ax) * 0).astype(z.dtype)
+                except ValueError:
+                    pass        # already varying on ax
+            return z
+
+        p_local = jax.tree.map(lambda a: vary(a[0]), params)
+
+        def head(y, lbl):
+            """Last rank: per-microbatch loss + dL/dy."""
+            return jax.value_and_grad(lambda yy: loss_fn(yy, lbl))(y)
+
+        def tick(carry, t):
+            act_in, gract_in, resbuf, gacc, dxbuf, lossbuf = carry
+            m_f = t - idx                       # fwd microbatch index
+            m_b = t - (2 * S - 2 - idx)         # bwd microbatch index
+            valid_f = (m_f >= 0) & (m_f < m_eff)
+            valid_b = (m_b >= 0) & (m_b < m_eff)
+            mfc = jnp.clip(m_f, 0, m_eff - 1)
+            mbc = jnp.clip(m_b, 0, m_eff - 1)
+            # ---- forward unit ----
+            inject = lax.dynamic_index_in_dim(mb, mfc, 0, keepdims=False)
+            cur = jnp.where(idx == 0, inject, act_in)
+            y = stage_fn(p_local, cur)
+            # save this stage's INPUT for the recompute-backward
+            slot_f = mfc % R
+            old = lax.dynamic_index_in_dim(resbuf, slot_f, 0,
+                                           keepdims=False)
+            resbuf = lax.dynamic_update_index_in_dim(
+                resbuf, jnp.where(valid_f, cur, old), slot_f, 0)
+            # last rank: loss + dL/dy for the microbatch it JUST forwarded
+            lbl = lax.dynamic_index_in_dim(lb, mfc, 0, keepdims=False)
+            loss_m, gy = head(y, lbl)
+            # ---- backward unit (stage-level remat) ----
+            a_saved = lax.dynamic_index_in_dim(resbuf, mbc % R, 0,
+                                               keepdims=False)
+            g_use = jnp.where(idx == S - 1, gy.astype(gract_in.dtype),
+                              gract_in)
+            _, vjp = jax.vjp(stage_fn, p_local, a_saved)
+            dp, da = vjp(g_use.astype(y.dtype))
+            gacc = jax.tree.map(
+                lambda g, d: g + jnp.where(valid_b, d, 0.0).astype(g.dtype),
+                gacc, dp)
+            # rank 0's da is dL/dx for microbatch m_b
+            dslot = lax.dynamic_index_in_dim(dxbuf, mbc, 0, keepdims=False)
+            dxbuf = lax.dynamic_update_index_in_dim(
+                dxbuf, jnp.where((idx == 0) & valid_b, da, dslot), mbc, 0)
+            lslot = lax.dynamic_index_in_dim(lossbuf, mfc, 0,
+                                             keepdims=False)
+            lossbuf = lax.dynamic_update_index_in_dim(
+                lossbuf, jnp.where((idx == S - 1) & valid_f, loss_m,
+                                   lslot), mfc, 0)
+            # ---- hops: activations r->r+1, activation-grads r->r-1 ----
+            act_out = lax.ppermute(y, pp_axis,
+                                   [(i, i + 1) for i in range(S - 1)])
+            gract_out = lax.ppermute(da, pp_axis,
+                                     [(i + 1, i) for i in range(S - 1)])
+            return (act_out, gract_out, resbuf, gacc, dxbuf,
+                    lossbuf), None
+
+        z_mb = jnp.zeros_like(mb[0])
+        carry = (vary(z_mb), vary(z_mb),
+                 vary(jnp.zeros((R,) + z_mb.shape, z_mb.dtype)),
+                 jax.tree.map(lambda p: vary(jnp.zeros_like(p)), p_local),
+                 vary(jnp.zeros_like(mb)),
+                 vary(jnp.zeros((m_eff,), jnp.float32)))
+        (_, _, _, gacc, dxbuf, lossbuf), _ = lax.scan(
+            tick, carry, jnp.arange(ticks))
+        # per-microbatch means -> global mean; grads scale by 1/M
+        n_b = 1
+        for ax in (batch or ()):
+            n_b *= int(mesh.shape[ax])
+        loss = lax.psum(jnp.where(idx == S - 1, jnp.sum(lossbuf), 0.0),
+                        pp_axis) / m_eff
+        # d(global mean)/dx on this rank = (1/n_dp) d(local mean)/dx
+        dx = lax.psum(jnp.where(idx == 0, dxbuf, 0.0),
+                      pp_axis).reshape(xl.shape) / (m_eff * n_b)
+        grads = jax.tree.map(lambda g: g / m_eff, gacc)
+        if batch:
+            # each data-parallel rank saw its own local batch: the global
+            # mean loss/grad is the mean across them (dx stays sharded —
+            # it IS per-rank)
+            loss = lax.pmean(loss, batch)
+            grads = jax.tree.map(lambda g: lax.pmean(g, batch), grads)
+        grads = jax.tree.map(lambda g: g[None], grads)
+        return loss, grads, dx.astype(xl.dtype)
+
+    loss, grads, dx = jax.shard_map(
+        ranked, mesh=mesh, in_specs=(pspec, xspec, lspec),
+        out_specs=(P(), pspec, xspec))(stacked_params, x, labels)
+    return loss, grads, dx
 
 
 def pp_stage_rules(inner: PartitionRules = ()) -> PartitionRules:
