@@ -81,6 +81,16 @@ class MoEMLP(nn.Module):
     its batchmates (including padding rows at serving time).  This is
     inherent to capacity-style MoE, not a bug; raise ``capacity_factor``
     where batch-composition independence matters more than compute.
+
+    Measured bound (tests/test_moe.py::
+    test_moe_decode_capacity_agreement_bound — skew-trained MoE-LM,
+    decode pools B=32 tokens/step vs the forward's B*T jointly): greedy
+    decode-vs-forward max |logit delta| is 1.98 at capacity_factor=0.25
+    and 1.19 at 1.0, yet greedy-token agreement stayed 100% (residuals
+    absorb the drops); at capacity_factor=2.0 both paths serve every
+    token and the logits are IDENTICAL (delta 0.0).  So CF=2 is the
+    "exact decode parity" setting for skewed routing, not just a >=99%
+    heuristic.
     """
 
     num_experts: int
