@@ -228,6 +228,50 @@ def scenario_pp_ep(pid, outdir):
             "moe_spec": str(moe_spec.sharding.spec)}
 
 
+def scenario_elastic(pid, outdir):
+    """Failure detection: both hosts fit one epoch and checkpoint; then a
+    longer fit starts and host 1 SIGKILLs itself after its first epoch
+    completes.  The JAX coordination service must detect the lost
+    heartbeat and ABORT host 0 within its heartbeat window (the
+    documented crash-and-restart failure model — the survivor terminates
+    with the coordination-service diagnostic, it does not hang in the
+    dead peer's collective).  The parent asserts on exit codes, timing,
+    and the diagnostic text; recovery is scenario_elastic_resume."""
+    import signal
+
+    x, y = make_data()
+    est = make_estimator()
+    est.fit({"x": x, "y": y}, epochs=1, batch_size=16)
+    ckdir = os.path.join(outdir, "ckpt")
+    est.save_checkpoint(ckdir)
+    # marker for the parent: phase A (checkpoint) completed on this host
+    with open(os.path.join(outdir, f"phase_a_{pid}"), "w") as f:
+        f.write("ok")
+
+    def suicide(stats):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    est.fit({"x": x, "y": y}, epochs=40, batch_size=16,
+            callbacks=(suicide,) if pid == 1 else ())
+    # unreachable on both hosts: 1 SIGKILLs itself, 0 is aborted by the
+    # runtime's failure detector mid-fit
+    return {"unexpected_survival": True}
+
+
+def scenario_elastic_resume(pid, outdir):
+    """Recovery: a FRESH 2-host incarnation restores the pre-failure
+    checkpoint and continues training; the parent asserts the loss
+    trajectory continues the single-process reference exactly."""
+    x, y = make_data()
+    est = make_estimator()
+    est._ensure_state({"x": x, "y": y})
+    est.load_checkpoint(os.path.join(outdir, "ckpt"))
+    restored = int(est.state.step)
+    hist = est.fit({"x": x, "y": y}, epochs=2, batch_size=16)
+    return {"restored_step": restored,
+            "loss": [h["loss"] for h in hist]}
+
+
 SCENARIOS = {
     "fit": scenario_fit,
     "predict": scenario_predict,
@@ -235,6 +279,8 @@ SCENARIOS = {
     "checkpoint": scenario_checkpoint,
     "disk": scenario_disk,
     "pp_ep": scenario_pp_ep,
+    "elastic": scenario_elastic,
+    "elastic_resume": scenario_elastic_resume,
 }
 
 SCENARIO_MESH = {

@@ -31,7 +31,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def run_scenario(scenario: str, tmp_path, timeout=420):
+def run_scenario(scenario: str, tmp_path, timeout=420, nprocs=NPROCS):
     port = _free_port()
     env = dict(os.environ)
     # children pick their own platform/device config in-process
@@ -39,10 +39,10 @@ def run_scenario(scenario: str, tmp_path, timeout=420):
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, scenario, str(i), str(NPROCS),
+            [sys.executable, WORKER, scenario, str(i), str(nprocs),
              str(port), str(tmp_path)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
-        for i in range(NPROCS)
+        for i in range(nprocs)
     ]
     try:
         outs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
@@ -56,7 +56,7 @@ def run_scenario(scenario: str, tmp_path, timeout=420):
         assert p.returncode == 0, \
             f"worker {i} failed:\n{outs[i][-4000:]}"
     results = []
-    for i in range(NPROCS):
+    for i in range(nprocs):
         with open(os.path.join(str(tmp_path), f"out_{i}.json")) as f:
             results.append(json.load(f))
     return results
@@ -80,7 +80,7 @@ def _interleaved(x: np.ndarray, per_host: int, n_hosts: int) -> np.ndarray:
     return x[np.asarray(order)]
 
 
-def _reference_fit(epochs=3, batch=16):
+def _reference_fit(epochs=3, batch=16, nprocs=NPROCS):
     import optax
 
     from analytics_zoo_tpu.common.config import TrainConfig
@@ -90,8 +90,8 @@ def _reference_fit(epochs=3, batch=16):
     import _multihost_worker as w
 
     x, y = w.make_data()
-    x2 = _interleaved(x, batch // NPROCS, NPROCS)
-    y2 = _interleaved(y, batch // NPROCS, NPROCS)
+    x2 = _interleaved(x, batch // nprocs, nprocs)
+    y2 = _interleaved(y, batch // nprocs, nprocs)
     est = Estimator.from_flax(
         model=w.make_model(), loss="mse", optimizer=optax.sgd(0.1),
         config=TrainConfig(deterministic=True, seed=0))
@@ -113,6 +113,22 @@ def test_multihost_fit_matches_single_process(tmp_path, ctx8):
     # params identical across hosts (one global model, not two)
     for k, v in results[0]["params"].items():
         np.testing.assert_allclose(v, results[1]["params"][k], rtol=1e-6)
+
+
+def test_multihost_fit_4proc_matches_single_process(tmp_path, ctx8):
+    """VERDICT r3 weak #7: the multihost doctrine at NPROCS=4, not just
+    2 — four jax.distributed hosts (16 virtual devices total) training
+    one global model must reproduce the single-process trajectory and
+    agree exactly with each other."""
+    results = run_scenario("fit", tmp_path, timeout=600, nprocs=4)
+    for r in results[1:]:
+        np.testing.assert_allclose(results[0]["loss"], r["loss"],
+                                   rtol=1e-6)
+    assert results[0]["num_samples"] == [64.0, 64.0, 64.0]
+    _, ref_loss = _reference_fit(nprocs=4)
+    np.testing.assert_allclose(results[0]["loss"], ref_loss, rtol=2e-4)
+    for k, v in results[0]["params"].items():
+        np.testing.assert_allclose(v, results[3]["params"][k], rtol=1e-6)
 
 
 def test_multihost_predict_row_order(tmp_path, ctx8):
@@ -237,6 +253,67 @@ def test_multihost_disk_feature_set(tmp_path, ctx8):
     assert p0.shape == (32, 1) and p1.shape == (24, 1)
     np.testing.assert_allclose(p0, ref[:32], atol=1e-5)
     np.testing.assert_allclose(p1, ref[32:], atol=1e-5)
+
+
+def test_multihost_kill_worker_fails_fast_then_resumes(tmp_path, ctx8):
+    """Elastic recovery (SURVEY §5 failure detection): SIGKILL one of two
+    hosts mid-fit — the survivor must surface an ERROR quickly (not hang
+    in the dead peer's collective), and a fresh 2-host incarnation must
+    resume from the last checkpoint with the exact reference loss
+    trajectory.  Runbook: docs/architecture.md 'Failure recovery'."""
+    import time
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, "elastic", str(i), str(NPROCS),
+             str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for i in range(NPROCS)
+    ]
+    t0 = time.monotonic()
+    try:
+        outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        outs = ["", ""]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # the survivor must TERMINATE (crash-and-restart model), not hang
+    # until the harness timeout
+    assert not timed_out, "survivor hung instead of failing fast"
+    elapsed = time.monotonic() - t0
+    # worker 1 SIGKILLed itself; worker 0 was aborted by the JAX
+    # coordination service once heartbeats stopped — a detected failure,
+    # not a clean exit and not a hang
+    assert procs[1].returncode == -9, outs[1][-2000:]
+    assert procs[0].returncode not in (0, None), outs[0][-4000:]
+    assert "unhealthy" in outs[0] or "heartbeat" in outs[0] \
+        or "distributed service detected fatal errors" in outs[0], \
+        outs[0][-4000:]
+    # both hosts completed phase A (checkpoint) before the failure
+    for i in range(NPROCS):
+        assert os.path.exists(os.path.join(str(tmp_path),
+                                           f"phase_a_{i}"))
+    assert elapsed < 360, elapsed       # bounded detection latency
+
+    # fresh incarnation restores the pre-failure checkpoint and continues
+    results = run_scenario("elastic_resume", tmp_path)
+    for r in results:
+        assert r["restored_step"] == 4
+    np.testing.assert_allclose(results[0]["loss"], results[1]["loss"],
+                               rtol=1e-6)
+    # deterministic config: the resumed trajectory must CONTINUE the
+    # single-process reference (epochs 2-3 of an uninterrupted run)
+    _, ref_loss = _reference_fit(epochs=3)
+    np.testing.assert_allclose(results[0]["loss"], ref_loss[1:],
+                               rtol=2e-4)
 
 
 def test_multihost_pp_ep(tmp_path):
