@@ -13,6 +13,19 @@ without calibration data (the reason the reference needed a calibration
 set was quantized *activations*; weight-only needs none).  ``bf16`` mode
 is the cheaper half-measure: cast weights to bfloat16 (2x smaller,
 bit-level TPU-native).
+
+**Scope — a MEMORY-CAPACITY knob, not a throughput knob** (measured,
+SERVING_BENCH.json: resnet18 int8 91 req/s vs 139 fp @64 clients, 3.97x
+weight compression).  The fused dequant adds work to every forward, so
+int8 TRADES ~35% throughput for ~4x model capacity; it wins when HBM is
+the binding constraint — more co-resident models per chip, weights that
+otherwise would not fit, bigger KV arenas beside the weights — and
+loses when raw req/s on a single resident model is all that matters
+(serve fp/bf16 there).  True on-MXU int8 (quantized activations,
+int8xint8->int32 `dot_general`) would need per-layer activation scale
+calibration and model-surgery on the matmul call sites; that is a
+deliberate non-goal for the GENERIC param-tree path here, which must
+quantize any loaded model without touching its module code.
 """
 
 from __future__ import annotations

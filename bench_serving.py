@@ -156,6 +156,10 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
     extra = {}
     if im.quant_stats:
         extra["weight_compression"] = im.quant_stats["compression"]
+        extra["int8_role"] = (
+            "memory-capacity knob, not throughput: the fused dequant "
+            "taxes every forward (~35% req/s vs fp measured) and buys "
+            "~4x model capacity per chip; see docs/architecture.md")
     return {
         **extra,
         "workers": workers,
