@@ -15,6 +15,7 @@ from analytics_zoo_tpu.parallel.partition import (
 from analytics_zoo_tpu.parallel.pipeline import (
     GPipe,
     pipeline_apply,
+    pipeline_apply_1f1b,
     pipeline_value_and_grad,
     pipeline_1f1b_stats,
     sequential_apply,
@@ -34,6 +35,7 @@ __all__ = [
     "with_sharding_constraint",
     "GPipe",
     "pipeline_apply",
+    "pipeline_apply_1f1b",
     "pipeline_value_and_grad",
     "pipeline_1f1b_stats",
     "sequential_apply",

@@ -165,6 +165,109 @@ def pipeline_1f1b_stats(n_stages: int, n_microbatches: int) -> dict:
     }
 
 
+def _make_vary(pp_axis, batch):
+    """Device-variance marker shared by the 1F1B paths.  Two reasons to
+    mark values varying: (1) scan carries pick up pp-varying (ppermute/
+    axis_index) and batch-varying (dp-sharded activations) values, and
+    an invariant->varying carry fails shard_map's vma typecheck; (2)
+    params must be batch-VARYING before jax.vjp, else autodiff
+    auto-psums the param cotangent across dp on EVERY tick (one
+    all-reduce per tick, and n_dp-scaled grads after a later mean)."""
+    from jax import lax
+
+    def vary(z):
+        for ax in (pp_axis,) + tuple(batch or ()):
+            try:
+                z = lax.pcast(z, ax, to="varying")
+            except (AttributeError, TypeError):
+                # no lax.pcast on this JAX: force variance on THIS axis
+                # arithmetically and keep looping — falling out early
+                # would leave params batch-invariant (see (2) above)
+                z = z + (lax.axis_index(ax) * 0).astype(z.dtype)
+            except ValueError:
+                pass        # already varying on ax
+        return z
+
+    return vary
+
+
+def _f1b_ticks(stage_fn, p_local, mb, aux, S, m_eff, idx, pp_axis, vary,
+               head):
+    """The shared flat-1F1B tick engine (both ``pipeline_value_and_grad``
+    and ``pipeline_apply_1f1b``'s backward run it): rank r forwards
+    microbatch m at tick m+r and backwards it at tick m+2S-2-r, with
+    the last rank's backward fused into its forward tick; activations
+    hop r->r+1 and activation-grads r->r-1 via ppermute; backward units
+    recompute their stage forward from the saved stage INPUT
+    (stage-level remat, residual ring of 2S slots).
+
+    ``aux``: per-microbatch rows consumed by ``head(y, aux_row) ->
+    (loss_scalar, gy_seed)`` — the loss head for value_and_grad, or a
+    passthrough of the stored output cotangent for the custom-vjp
+    backward.  Evaluated at the last rank's fwd microbatch (where
+    m_b == m_f, so the seed aligns with the backward unit).
+
+    Returns ``(gacc, dxbuf, lossbuf)``: raw per-rank sums over this
+    rank's microbatches — ALL scaling (1/M, dp mean vs sum) belongs to
+    the caller."""
+    R = 2 * S
+    ticks = m_eff + 2 * S - 2
+
+    def tick(carry, t):
+        act_in, gract_in, resbuf, gacc, dxbuf, lossbuf = carry
+        m_f = t - idx                       # fwd microbatch index
+        m_b = t - (2 * S - 2 - idx)         # bwd microbatch index
+        valid_f = (m_f >= 0) & (m_f < m_eff)
+        valid_b = (m_b >= 0) & (m_b < m_eff)
+        mfc = jnp.clip(m_f, 0, m_eff - 1)
+        mbc = jnp.clip(m_b, 0, m_eff - 1)
+        # ---- forward unit ----
+        inject = lax.dynamic_index_in_dim(mb, mfc, 0, keepdims=False)
+        cur = jnp.where(idx == 0, inject, act_in)
+        y = stage_fn(p_local, cur)
+        # save this stage's INPUT for the recompute-backward
+        slot_f = mfc % R
+        old = lax.dynamic_index_in_dim(resbuf, slot_f, 0, keepdims=False)
+        resbuf = lax.dynamic_update_index_in_dim(
+            resbuf, jnp.where(valid_f, cur, old), slot_f, 0)
+        arow = lax.dynamic_index_in_dim(aux, mfc, 0, keepdims=False)
+        loss_m, gy = head(y, arow)
+        # ---- backward unit (stage-level remat) ----
+        a_saved = lax.dynamic_index_in_dim(resbuf, mbc % R, 0,
+                                           keepdims=False)
+        g_use = jnp.where(idx == S - 1, gy.astype(gract_in.dtype),
+                          gract_in)
+        _, vjp = jax.vjp(stage_fn, p_local, a_saved)
+        dp, da = vjp(g_use.astype(y.dtype))
+        gacc = jax.tree.map(
+            lambda g, d: g + jnp.where(valid_b, d, 0.0).astype(g.dtype),
+            gacc, dp)
+        # rank 0's da is the input cotangent for microbatch m_b
+        dslot = lax.dynamic_index_in_dim(dxbuf, mbc, 0, keepdims=False)
+        dxbuf = lax.dynamic_update_index_in_dim(
+            dxbuf, jnp.where((idx == 0) & valid_b, da, dslot), mbc, 0)
+        lslot = lax.dynamic_index_in_dim(lossbuf, mfc, 0, keepdims=False)
+        lossbuf = lax.dynamic_update_index_in_dim(
+            lossbuf, jnp.where((idx == S - 1) & valid_f, loss_m, lslot),
+            mfc, 0)
+        # ---- hops: activations r->r+1, activation-grads r->r-1 ----
+        act_out = lax.ppermute(y, pp_axis,
+                               [(i, i + 1) for i in range(S - 1)])
+        gract_out = lax.ppermute(da, pp_axis,
+                                 [(i + 1, i) for i in range(S - 1)])
+        return (act_out, gract_out, resbuf, gacc, dxbuf, lossbuf), None
+
+    z_mb = jnp.zeros_like(mb[0])
+    carry = (vary(z_mb), vary(z_mb),
+             vary(jnp.zeros((R,) + z_mb.shape, z_mb.dtype)),
+             jax.tree.map(lambda p: vary(jnp.zeros_like(p)), p_local),
+             vary(jnp.zeros_like(mb)),
+             vary(jnp.zeros((m_eff,), jnp.float32)))
+    (_, _, _, gacc, dxbuf, lossbuf), _ = lax.scan(
+        tick, carry, jnp.arange(ticks))
+    return gacc, dxbuf, lossbuf
+
+
 def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
                             x: jax.Array, labels, mesh: Mesh,
                             n_microbatches: int, *,
@@ -225,94 +328,15 @@ def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
         m_eff = math.gcd(M, b)
         mb = xl.reshape((m_eff, b // m_eff) + xl.shape[1:])
         lb = ll.reshape((m_eff, b // m_eff) + ll.shape[1:])
-        R = 2 * S                        # residual ring slots
-        ticks = m_eff + 2 * S - 2
-
-        def vary(z):
-            # Two reasons to mark values device-varying: (1) scan carries
-            # pick up pp-varying (ppermute/axis_index) and batch-varying
-            # (dp-sharded activations) values, and an invariant->varying
-            # carry fails shard_map's vma typecheck; (2) params must be
-            # batch-VARYING before jax.vjp, else autodiff auto-psums the
-            # param cotangent across dp on EVERY tick (one all-reduce per
-            # tick, and it double-counts a later mean) — varied params get
-            # per-rank cotangents we reduce ONCE at the end.
-            for ax in (pp_axis,) + tuple(batch or ()):
-                try:
-                    z = lax.pcast(z, ax, to="varying")
-                except (AttributeError, TypeError):
-                    # no lax.pcast on this JAX: force variance on THIS
-                    # axis arithmetically and keep looping — falling out
-                    # early would leave params batch-invariant, and the
-                    # vjp transpose would then psum param cotangents
-                    # across dp every tick (n_dp-scaled grads)
-                    z = z + (lax.axis_index(ax) * 0).astype(z.dtype)
-                except ValueError:
-                    pass        # already varying on ax
-            return z
-
+        vary = _make_vary(pp_axis, batch)
         p_local = jax.tree.map(lambda a: vary(a[0]), params)
 
         def head(y, lbl):
             """Last rank: per-microbatch loss + dL/dy."""
             return jax.value_and_grad(lambda yy: loss_fn(yy, lbl))(y)
 
-        def tick(carry, t):
-            act_in, gract_in, resbuf, gacc, dxbuf, lossbuf = carry
-            m_f = t - idx                       # fwd microbatch index
-            m_b = t - (2 * S - 2 - idx)         # bwd microbatch index
-            valid_f = (m_f >= 0) & (m_f < m_eff)
-            valid_b = (m_b >= 0) & (m_b < m_eff)
-            mfc = jnp.clip(m_f, 0, m_eff - 1)
-            mbc = jnp.clip(m_b, 0, m_eff - 1)
-            # ---- forward unit ----
-            inject = lax.dynamic_index_in_dim(mb, mfc, 0, keepdims=False)
-            cur = jnp.where(idx == 0, inject, act_in)
-            y = stage_fn(p_local, cur)
-            # save this stage's INPUT for the recompute-backward
-            slot_f = mfc % R
-            old = lax.dynamic_index_in_dim(resbuf, slot_f, 0,
-                                           keepdims=False)
-            resbuf = lax.dynamic_update_index_in_dim(
-                resbuf, jnp.where(valid_f, cur, old), slot_f, 0)
-            # last rank: loss + dL/dy for the microbatch it JUST forwarded
-            lbl = lax.dynamic_index_in_dim(lb, mfc, 0, keepdims=False)
-            loss_m, gy = head(y, lbl)
-            # ---- backward unit (stage-level remat) ----
-            a_saved = lax.dynamic_index_in_dim(resbuf, mbc % R, 0,
-                                               keepdims=False)
-            g_use = jnp.where(idx == S - 1, gy.astype(gract_in.dtype),
-                              gract_in)
-            _, vjp = jax.vjp(stage_fn, p_local, a_saved)
-            dp, da = vjp(g_use.astype(y.dtype))
-            gacc = jax.tree.map(
-                lambda g, d: g + jnp.where(valid_b, d, 0.0).astype(g.dtype),
-                gacc, dp)
-            # rank 0's da is dL/dx for microbatch m_b
-            dslot = lax.dynamic_index_in_dim(dxbuf, mbc, 0, keepdims=False)
-            dxbuf = lax.dynamic_update_index_in_dim(
-                dxbuf, jnp.where((idx == 0) & valid_b, da, dslot), mbc, 0)
-            lslot = lax.dynamic_index_in_dim(lossbuf, mfc, 0,
-                                             keepdims=False)
-            lossbuf = lax.dynamic_update_index_in_dim(
-                lossbuf, jnp.where((idx == S - 1) & valid_f, loss_m,
-                                   lslot), mfc, 0)
-            # ---- hops: activations r->r+1, activation-grads r->r-1 ----
-            act_out = lax.ppermute(y, pp_axis,
-                                   [(i, i + 1) for i in range(S - 1)])
-            gract_out = lax.ppermute(da, pp_axis,
-                                     [(i + 1, i) for i in range(S - 1)])
-            return (act_out, gract_out, resbuf, gacc, dxbuf,
-                    lossbuf), None
-
-        z_mb = jnp.zeros_like(mb[0])
-        carry = (vary(z_mb), vary(z_mb),
-                 vary(jnp.zeros((R,) + z_mb.shape, z_mb.dtype)),
-                 jax.tree.map(lambda p: vary(jnp.zeros_like(p)), p_local),
-                 vary(jnp.zeros_like(mb)),
-                 vary(jnp.zeros((m_eff,), jnp.float32)))
-        (_, _, _, gacc, dxbuf, lossbuf), _ = lax.scan(
-            tick, carry, jnp.arange(ticks))
+        gacc, dxbuf, lossbuf = _f1b_ticks(
+            stage_fn, p_local, mb, lb, S, m_eff, idx, pp_axis, vary, head)
         # per-microbatch means -> global mean; grads scale by 1/M
         n_b = 1
         for ax in (batch or ()):
@@ -336,6 +360,80 @@ def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
         ranked, mesh=mesh, in_specs=(pspec, xspec, lspec),
         out_specs=(P(), pspec, xspec))(stacked_params, x, labels)
     return loss, grads, dx
+
+
+def pipeline_apply_1f1b(stage_fn: StageFn, stacked_params, x: jax.Array,
+                        mesh: Mesh, n_microbatches: int, *,
+                        batch_axes: Sequence[str] = ("dp", "fsdp"),
+                        pp_axis: str = "pp") -> jax.Array:
+    """``pipeline_apply`` with an O(S)-residency BACKWARD, composable
+    with ordinary autodiff (``jax.grad`` through models that embed the
+    pipelined trunk, e.g. the Estimator's train step).
+
+    custom_vjp shape: the forward is the plain forward pipeline and
+    saves ONLY ``(stacked_params, x)`` across the autodiff boundary —
+    no per-microbatch activations.  The backward replays the forward
+    interleaved with backward units (the ``pipeline_value_and_grad``
+    tick schedule, seeded by the incoming output cotangent instead of a
+    loss head), so resident activations stay bounded at 2S microbatches
+    per rank while autodiff through ``pipeline_apply`` would hold all
+    M.  Compute cost: one extra forward per (microbatch, stage) versus
+    the stored-activation path — the remat trade, paid where M is large
+    precisely because memory no longer scales with it."""
+    S = int(mesh.shape[pp_axis]) if pp_axis in mesh.axis_names else 1
+    if S == 1:
+        return sequential_apply(stage_fn, stacked_params, x)
+    M = int(n_microbatches)
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+
+    @jax.custom_vjp
+    def apply(params, xx):
+        return pipeline_apply(stage_fn, params, xx, mesh, M,
+                              batch_axes=batch_axes, pp_axis=pp_axis)
+
+    def fwd(params, xx):
+        return apply(params, xx), (params, xx)
+
+    def bwd(res, gy):
+        params, xx = res
+        xspec = P(batch, *([None] * (xx.ndim - 1)))
+        pspec = jax.tree.map(lambda _: P(pp_axis), params)
+
+        def ranked(p_stk, xl, gl):
+            idx = lax.axis_index(pp_axis)
+            b = xl.shape[0]
+            m_eff = math.gcd(M, b)
+            mb = xl.reshape((m_eff, b // m_eff) + xl.shape[1:])
+            gb = gl.reshape((m_eff, b // m_eff) + gl.shape[1:])
+            vary = _make_vary(pp_axis, batch)
+            p_local = jax.tree.map(lambda a: vary(a[0]), p_stk)
+
+            def head(y, g_seed):
+                # the last rank seeds its backward from the STORED output
+                # cotangent of the microbatch it just forwarded (m_b ==
+                # m_f there); no loss is computed in the bwd pass
+                return jnp.float32(0.0), g_seed
+
+            gacc, dxbuf, _ = _f1b_ticks(
+                stage_fn, p_local, mb, gb, S, m_eff, idx, pp_axis, vary,
+                head)
+            # gy already carries the outer scaling (e.g. the loss mean):
+            # dparams is the raw SUM of contributions — across this
+            # rank's microbatches, and across dp ranks for the
+            # dp-replicated params
+            if batch:
+                gacc = jax.tree.map(lambda g: lax.psum(g, batch), gacc)
+            grads = jax.tree.map(lambda g: g[None], gacc)
+            dx = lax.psum(jnp.where(idx == 0, dxbuf, 0.0),
+                          pp_axis).reshape(xl.shape)
+            return grads, dx.astype(xl.dtype)
+
+        return jax.shard_map(
+            ranked, mesh=mesh, in_specs=(pspec, xspec, xspec),
+            out_specs=(pspec, xspec))(params, xx, gy)
+
+    apply.defvjp(fwd, bwd)
+    return apply(stacked_params, x)
 
 
 def pp_stage_rules(inner: PartitionRules = ()) -> PartitionRules:
@@ -363,9 +461,18 @@ class GPipe(nn.Module):
     n_stages: int
     n_microbatches: int = 4
     mesh: Optional[Mesh] = None
+    # "gpipe": autodiff through the forward scan (activation residency
+    # grows with n_microbatches); "1f1b": custom-vjp interleaved
+    # backward, residency bounded at 2S microbatches per rank at one
+    # extra recompute-forward per (microbatch, stage)
+    schedule: str = "gpipe"
 
     @nn.compact
     def __call__(self, x):
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got "
+                f"{self.schedule!r}")
         template = self.stage.clone(parent=None)
 
         def init_stacked(rng) -> Any:
@@ -382,6 +489,7 @@ class GPipe(nn.Module):
         if self.mesh is not None and \
                 self.mesh.shape.get("pp", 1) == self.n_stages and \
                 self.n_stages > 1:
-            return pipeline_apply(fn, params, x, self.mesh,
-                                  self.n_microbatches)
+            run = (pipeline_apply_1f1b if self.schedule == "1f1b"
+                   else pipeline_apply)
+            return run(fn, params, x, self.mesh, self.n_microbatches)
         return sequential_apply(fn, params, x)

@@ -228,3 +228,93 @@ def test_1f1b_single_stage_mesh_falls_back():
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7), gp, ref_gp)
+
+
+@pytest.mark.parametrize("mesh_axes,micro", [
+    ({"pp": 4, "dp": 2}, 4),
+    ({"pp": 2, "dp": 4}, 8),
+])
+def test_1f1b_custom_vjp_grads_match_gpipe_autodiff(mesh_axes, micro):
+    """pipeline_apply_1f1b composes with ORDINARY autodiff: jax.grad
+    through a loss over it equals jax.grad through pipeline_apply (and
+    the sequential oracle) — the schedule is invisible to callers."""
+    from analytics_zoo_tpu.parallel import pipeline_apply_1f1b
+
+    mesh = make_mesh(axes=mesh_axes)
+    width, B = 16, 16
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(B, width)).astype(np.float32))
+    lbl = jnp.asarray(rng.normal(size=(B, width)).astype(np.float32))
+    S = mesh_axes["pp"]
+    params = _stacked_params(S, width, x[:1])
+    fn = _stage_fn(width)
+
+    def loss_1f1b(p, xx):
+        y = pipeline_apply_1f1b(fn, p, xx, mesh, micro)
+        return jnp.mean((y - lbl) ** 2)
+
+    def loss_gpipe(p, xx):
+        y = pipeline_apply(fn, p, xx, mesh, micro)
+        return jnp.mean((y - lbl) ** 2)
+
+    def loss_seq(p, xx):
+        return jnp.mean((sequential_apply(fn, p, xx) - lbl) ** 2)
+
+    l1, (gp1, gx1) = jax.value_and_grad(loss_1f1b, argnums=(0, 1))(
+        params, x)
+    l2, (gp2, gx2) = jax.value_and_grad(loss_seq, argnums=(0, 1))(
+        params, x)
+    l3, (gp3, gx3) = jax.value_and_grad(loss_gpipe, argnums=(0, 1))(
+        params, x)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(float(l1), float(l3), rtol=1e-5)
+    for ref_gp, ref_gx in ((gp2, gx2), (gp3, gx3)):
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+            gp1, ref_gp)
+        np.testing.assert_allclose(np.asarray(gx1), np.asarray(ref_gx),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_gpipe_1f1b_schedule_trains_in_estimator():
+    """GPipe(schedule='1f1b') under the full Estimator train step (jit +
+    partition rules + optimizer): identical loss trajectory to the
+    default GPipe schedule — the memory schedule never changes math."""
+    import optax
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.common.config import TrainConfig
+    from analytics_zoo_tpu.learn import Estimator
+    from jax.sharding import PartitionSpec as P
+
+    def run(schedule):
+        init_orca_context("local", mesh_axes={"pp": 2, "dp": 4})
+        try:
+            from analytics_zoo_tpu.common.context import OrcaContext
+
+            mesh = OrcaContext.get_context().mesh
+
+            class Net(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    x = nn.Dense(16, name="embed")(x)
+                    x = GPipe(stage=Block(16), n_stages=2,
+                              n_microbatches=4, mesh=mesh,
+                              schedule=schedule, name="trunk")(x)
+                    return nn.Dense(2, name="head")(x)
+
+            rng = np.random.default_rng(0)
+            xs = rng.normal(size=(256, 8)).astype(np.float32)
+            ys = (xs.sum(-1) > 0).astype(np.int32)
+            est = Estimator.from_flax(
+                model=Net(), loss="sparse_categorical_crossentropy",
+                optimizer=optax.adam(3e-3),
+                feature_cols=("x",), label_cols=("y",),
+                partition_rules=pp_stage_rules() + ((r".*", P()),),
+                config=TrainConfig(deterministic=True, seed=0))
+            hist = est.fit({"x": xs, "y": ys}, epochs=3, batch_size=64)
+            return [h["loss"] for h in hist]
+        finally:
+            stop_orca_context()
+
+    np.testing.assert_allclose(run("1f1b"), run("gpipe"), rtol=2e-4)
