@@ -207,7 +207,8 @@ class InferenceModel:
         return self
 
     def make_continuous_engine(self, max_slots: int = 8,
-                               eos_id: Optional[int] = None):
+                               eos_id: Optional[int] = None,
+                               ticks_per_step: int = 1):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
         loaded via ``load_flax_generator`` (quantized weights dequantize
         once at build — the engine trades the at-rest memory win for
@@ -225,7 +226,8 @@ class InferenceModel:
             max_new_tokens=self._gen_max_new_tokens,
             max_slots=max_slots,
             prompt_buckets=self._gen_prompt_buckets,
-            eos_id=eos_id, pad_id=self.prompt_pad_id)
+            eos_id=eos_id, pad_id=self.prompt_pad_id,
+            ticks_per_step=ticks_per_step)
 
     def load_torch(self, module) -> "InferenceModel":
         """ref-parity: InferenceModel.loadTorch — a torch nn.Module (or

@@ -441,6 +441,9 @@ class TransformerLM(nn.Module):
     remat: bool = False
     pp_stages: int = 0
     pp_microbatches: int = 4
+    # "gpipe" | "1f1b": training schedule for the pipelined trunk (see
+    # parallel/pipeline.py — 1f1b bounds activation residency at O(S))
+    pp_schedule: str = "gpipe"
     sp_strategy: str = "ring"
     # MoE-LM: every moe_every-th layer gets an expert-parallel MoE FFN.
     # Cached decode routes per step (B tokens) while the forward routes
@@ -486,6 +489,7 @@ class TransformerLM(nn.Module):
                                use_flash=self.use_flash),
                 n_stages=self.pp_stages,
                 n_microbatches=self.pp_microbatches,
+                schedule=self.pp_schedule,
                 mesh=self.mesh, name="trunk")
             self.layers = ()
             return

@@ -173,7 +173,6 @@ def _make_vary(pp_axis, batch):
     params must be batch-VARYING before jax.vjp, else autodiff
     auto-psums the param cotangent across dp on EVERY tick (one
     all-reduce per tick, and n_dp-scaled grads after a later mean)."""
-    from jax import lax
 
     def vary(z):
         for ax in (pp_axis,) + tuple(batch or ()):

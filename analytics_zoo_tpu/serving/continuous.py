@@ -58,12 +58,13 @@ class _Slot:
 class ContinuousEngine:
     """Slot-arena generation engine over one ``TransformerLM``.
 
-    Host-side control loop + three jitted device programs:
-    ``_step`` (advance every slot one token, per-slot positions),
-    ``_prefill[bucket]`` (one forward for a joining prompt), and
-    ``_insert[bucket]`` (splice prefilled K/V into a slot).  The arena
-    buffers are donated through ``_step``/``_insert`` so XLA updates them
-    in place instead of copying ``S*L`` of KV per token.
+    Host-side control loop + three jitted device programs: the step
+    program (advance every slot ``ticks_per_step`` tokens at per-slot
+    positions in one lax.scan call; compiled per (n_ticks, sampled) via
+    ``_get_step``), the bucketed batched prefill (one forward for ALL
+    joiners sharing a prompt bucket), and the per-slot K/V splice.  The
+    arena buffers are donated through step/insert so XLA updates them in
+    place instead of copying ``S*L`` of KV per token.
 
     Not thread-safe by itself: ``submit`` may be called from any thread,
     but ``step``/``drain`` must run on ONE pump thread (the serving loop).
@@ -72,7 +73,8 @@ class ContinuousEngine:
     def __init__(self, model: TransformerLM, variables, *,
                  max_new_tokens: int, max_slots: int = 8,
                  prompt_buckets: Sequence[int] = (16, 32, 64, 128),
-                 eos_id: Optional[int] = None, pad_id: int = 0):
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 ticks_per_step: int = 1):
         if model.pp_stages > 0:
             raise ValueError("continuous batching serves pp_stages=0 "
                              "models (models.lm.unstack_pp_params)")
@@ -99,41 +101,79 @@ class ContinuousEngine:
         self._ck = jnp.zeros((model.num_layers, S, L, H, D), cdtype)
         self._cv = jnp.zeros_like(self._ck)
         self._variables = variables
+        self.ticks_per_step = max(1, int(ticks_per_step))
         # host-side per-slot state (device copies travel as step args)
         self._tok = np.zeros(S, np.int32)
         self._pos = np.zeros(S, np.int32)
+        self._done = np.zeros(S, bool)
         self._slots: List[Optional[_Slot]] = [None] * S
         self._free = collections.deque(range(S))
         self._lock = threading.Lock()
         self._waiting: collections.deque = collections.deque()
         self._step_count = 0
 
-        def step_fn(ck, cv, tok, pos, temps, seeds, use_sample):
-            logits, ck, cv = model.apply(
-                variables, tok, ck, cv, pos,
-                method=TransformerLM.decode_step)
-            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
-            if not use_sample:          # static: greedy-only compile
-                return greedy, ck, cv
+        Lmax = L
 
-            def sample_row(seed, t, lg, p):
-                key = jax.random.fold_in(jax.random.key(seed), p)
-                scaled = lg.astype(jnp.float32) / jnp.maximum(t, 1e-6)
-                return jax.random.categorical(key, scaled).astype(
-                    jnp.int32)
+        def step_fn(ck, cv, tok, pos, done, temps, seeds, n_ticks,
+                    use_sample):
+            """Advance every slot ``n_ticks`` tokens in ONE device call
+            (a lax.scan) — each extra tick saves a host round-trip,
+            which dominates per-token cost on tunneled devices.  A slot
+            that hits EOS mid-chunk freezes exactly like generate()'s
+            frozen tail: it keeps stepping, fed eos.  Returns tokens
+            [n_ticks, S] in emission order."""
 
-            sampled = jax.vmap(sample_row)(seeds, temps, logits, pos)
-            return jnp.where(temps > 0.0, sampled, greedy), ck, cv
+            def one(carry, _):
+                tok, pos, done, ck, cv = carry
+                logits, ck, cv = model.apply(
+                    variables, tok, ck, cv, pos,
+                    method=TransformerLM.decode_step)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                if use_sample:          # static: greedy-only compile
 
-        self._step = jax.jit(partial(step_fn, use_sample=False),
-                             donate_argnums=(0, 1))
-        self._step_sampled = jax.jit(partial(step_fn, use_sample=True),
-                                     donate_argnums=(0, 1))
+                    def sample_row(seed, t, lg, p):
+                        key = jax.random.fold_in(jax.random.key(seed), p)
+                        scaled = lg.astype(jnp.float32) / jnp.maximum(
+                            t, 1e-6)
+                        return jax.random.categorical(key, scaled).astype(
+                            jnp.int32)
 
-        def prefill_fn(prompt, plen):
-            logits, ks, vs = model.apply(variables, prompt,
+                    sampled = jax.vmap(sample_row)(seeds, temps, logits,
+                                                   pos)
+                    nxt = jnp.where(temps > 0.0, sampled, nxt)
+                if eos_id is not None:
+                    nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                    done = done | (nxt == eos_id)
+                pos = jnp.minimum(pos + 1, Lmax - 1)
+                return (nxt, pos, done, ck, cv), nxt
+
+            (tok, pos, done, ck, cv), toks = jax.lax.scan(
+                one, (tok, pos, done, ck, cv), None, length=n_ticks)
+            return toks, tok, pos, done, ck, cv
+
+        # one compiled program per (n_ticks, sampled) pair — n_ticks is
+        # bounded by ticks_per_step, so the cache stays small
+        self._step_cache: Dict[Tuple[int, bool], Callable] = {}
+
+        def get_step(n: int, sampled: bool) -> Callable:
+            key = (n, sampled)
+            if key not in self._step_cache:
+                self._step_cache[key] = jax.jit(
+                    partial(step_fn, n_ticks=n, use_sample=sampled),
+                    donate_argnums=(0, 1))
+            return self._step_cache[key]
+
+        self._get_step = get_step
+
+        def prefill_fn(prompts, plens):
+            """Batched joiner prefill: [k, Pb] prompts in ONE forward
+            (bursts amortise the admission cost k-fold); returns each
+            row's last-real-position logits + stacked K/V."""
+            logits, ks, vs = model.apply(variables, prompts,
                                          method=TransformerLM.prefill)
-            return logits[0, plen - 1], ks, vs
+            last = jnp.take_along_axis(
+                logits, (plens - 1)[:, None, None], axis=1)[:, 0]
+            return last, ks, vs
 
         self._prefill = jax.jit(prefill_fn)
 
@@ -182,31 +222,49 @@ class ContinuousEngine:
     # ---- pump ---------------------------------------------------------
 
     def _admit(self) -> int:
-        """Move waiting requests into free slots (prefill + splice).
-        Returns the number admitted this call."""
+        """Move waiting requests into free slots.  Joiners sharing a
+        prompt bucket prefill TOGETHER in one forward (row count padded
+        to a power of two so a burst costs a handful of compiles, not
+        one per burst size); their K/V splice into slots one
+        dynamic_update_slice each.  Returns the number admitted."""
         admitted = 0
         while self._free:
             with self._lock:
-                if not self._waiting:
-                    break
-                uri, prompt, on_done, temp, seed = self._waiting.popleft()
-            slot = self._free.popleft()
-            plen = len(prompt)
-            pb = _next_bucket(plen, self.prompt_buckets)
-            padded = np.full((1, pb), self.pad_id, np.int32)
-            padded[0, :plen] = prompt
-            last_logits, ks, vs = self._prefill(jnp.asarray(padded),
-                                                jnp.int32(plen))
-            self._ck, self._cv = self._insert(
-                self._ck, self._cv, ks, vs, jnp.int32(slot))
-            first = self._pick_first(last_logits, plen, temp, seed)
-            st = _Slot(uri=uri, plen=plen, on_done=on_done,
-                       temperature=temp, rng_seed=seed)
-            self._slots[slot] = st
-            self._tok[slot] = first
-            self._pos[slot] = plen
-            admitted += 1
-            self._record_token(slot, int(first))
+                grab = min(len(self._free), len(self._waiting))
+                batch = [self._waiting.popleft() for _ in range(grab)]
+            if not batch:
+                break
+            by_bucket: Dict[int, list] = {}
+            for req in batch:
+                pb = _next_bucket(len(req[1]), self.prompt_buckets)
+                by_bucket.setdefault(pb, []).append(req)
+            for pb, reqs in by_bucket.items():
+                k = len(reqs)
+                kb = 1 << (k - 1).bit_length()      # pad rows to pow2
+                padded = np.full((kb, pb), self.pad_id, np.int32)
+                plens = np.ones(kb, np.int32)       # dummy rows: len 1
+                for i, (_, prompt, _, _, _) in enumerate(reqs):
+                    padded[i, :len(prompt)] = prompt
+                    plens[i] = len(prompt)
+                last_logits, ks, vs = self._prefill(jnp.asarray(padded),
+                                                    jnp.asarray(plens))
+                for i, (uri, prompt, on_done, temp, seed) in \
+                        enumerate(reqs):
+                    slot = self._free.popleft()
+                    self._ck, self._cv = self._insert(
+                        self._ck, self._cv, ks[:, i:i + 1],
+                        vs[:, i:i + 1], jnp.int32(slot))
+                    plen = len(prompt)
+                    first = self._pick_first(last_logits[i], plen, temp,
+                                             seed)
+                    self._slots[slot] = _Slot(
+                        uri=uri, plen=plen, on_done=on_done,
+                        temperature=temp, rng_seed=seed)
+                    self._tok[slot] = first
+                    self._pos[slot] = plen
+                    self._done[slot] = False
+                    admitted += 1
+                    self._record_token(slot, int(first))
         return admitted
 
     def _pick_first(self, last_logits, plen: int, temp: float,
@@ -242,9 +300,15 @@ class ContinuousEngine:
                                  "failed for %r", st.uri)
 
     def step(self) -> int:
-        """One engine tick: admit joiners, then advance every resident
-        one token.  Returns the number of active slots after the tick
-        (0 = idle; the caller decides how to wait for new work)."""
+        """One engine iteration: admit joiners, then advance every
+        resident by up to ``ticks_per_step`` tokens in one device call
+        (capped by the smallest remaining token budget among residents,
+        so no slot overruns its window; EOS mid-chunk freezes on-device
+        like generate()'s frozen tail).  Returns the number of active
+        slots afterwards (0 = idle; the caller decides how to wait).
+        Higher ``ticks_per_step`` trades admission latency granularity
+        for fewer host round-trips — the dominant per-token cost on
+        tunneled devices."""
         self._admit()
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
@@ -255,17 +319,27 @@ class ContinuousEngine:
         for i in active:
             temps[i] = self._slots[i].temperature
             seeds[i] = self._slots[i].rng_seed or 0
-        step = self._step_sampled if sampled else self._step
-        nxt, self._ck, self._cv = step(
+        n_eff = max(1, min(
+            self.ticks_per_step,
+            min(self.max_new_tokens - len(self._slots[i].tokens)
+                for i in active)))
+        step = self._get_step(n_eff, sampled)
+        toks, tok, pos, done, self._ck, self._cv = step(
             self._ck, self._cv, jnp.asarray(self._tok),
-            jnp.asarray(self._pos), jnp.asarray(temps),
-            jnp.asarray(seeds))
-        nxt = np.asarray(nxt)
+            jnp.asarray(self._pos), jnp.asarray(self._done),
+            jnp.asarray(temps), jnp.asarray(seeds))
+        toks = np.asarray(toks)                     # [n_eff, S]
+        # np.asarray of a jax array is a read-only view; _admit writes
+        # per-slot entries, so take mutable copies
+        self._tok = np.array(tok)
+        self._pos = np.array(pos)
+        self._done = np.array(done)
         for i in active:
-            self._tok[i] = nxt[i]
-            self._pos[i] += 1
-            self._record_token(i, int(nxt[i]))
-        self._admit()       # freed slots recycle on the SAME tick
+            for j in range(n_eff):
+                if self._slots[i] is None:
+                    break       # finished mid-chunk; the rest is frozen
+                self._record_token(i, int(toks[j, i]))
+        self._admit()       # freed slots recycle on the SAME iteration
         return self.n_active
 
     def drain(self, max_ticks: int = 100_000) -> None:

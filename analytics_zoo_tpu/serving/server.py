@@ -72,6 +72,9 @@ class ServingConfig:
     continuous_batching: bool = False
     engine_slots: int = 8
     eos_id: Optional[int] = None
+    # tokens decoded per device call: >1 trades admission-latency
+    # granularity for fewer host round-trips (tunneled-device win)
+    engine_ticks: int = 1
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -108,6 +111,8 @@ class ServingConfig:
             cfg.engine_slots = int(params["engine_slots"])
         if "eos_id" in params:
             cfg.eos_id = int(params["eos_id"])
+        if "engine_ticks" in params:
+            cfg.engine_ticks = int(params["engine_ticks"])
         return cfg
 
 
@@ -177,7 +182,8 @@ class ClusterServing:
             # ClusterServing processes, each with its own arena)
             self.engine = self.model.make_continuous_engine(
                 max_slots=self.config.engine_slots,
-                eos_id=self.config.eos_id)
+                eos_id=self.config.eos_id,
+                ticks_per_step=self.config.engine_ticks)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
                                  name="zoo-serving-cb")

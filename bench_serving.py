@@ -202,7 +202,10 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     cfg = ServingConfig(prompt_col="tokens", batch_size=slots,
                         batch_timeout_ms=4.0,
                         continuous_batching=continuous,
-                        engine_slots=slots)
+                        engine_slots=slots,
+                        # 4 tokens per device call: admission granularity
+                        # vs host round-trips (tunneled-device win)
+                        engine_ticks=4)
     serving = ClusterServing(im, cfg, embedded_broker=True).start()
     inq = InputQueue(port=serving.port)
     rng = np.random.default_rng(11)

@@ -197,3 +197,81 @@ def test_continuous_reload_refused(lm):
             srv.reload_model(im)
     finally:
         srv.stop()
+
+
+@pytest.mark.parametrize("ticks", [2, 4, 7])
+def test_engine_multi_tick_matches_single_tick(lm, ticks):
+    """ticks_per_step is a pure round-trip optimisation: every request's
+    tokens equal solo generate() regardless of the chunk size, including
+    mixed prompt lengths and slot recycling."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=6,
+                           max_slots=2, prompt_buckets=(8,),
+                           ticks_per_step=ticks)
+    rng = np.random.default_rng(5)
+    prompts = {f"m{i}": rng.integers(1, 32, rng.integers(2, 8)).astype(
+        np.int32) for i in range(5)}
+    results = {}
+    for uri, p in prompts.items():
+        eng.submit(uri, p, on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    for uri, p in prompts.items():
+        solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                                   6))[0]
+        np.testing.assert_array_equal(results[uri], solo,
+                                      err_msg=f"{uri} ticks={ticks}")
+
+
+def test_engine_multi_tick_eos_mid_chunk(lm):
+    """A request hitting EOS in the middle of a multi-tick chunk freezes
+    on-device (frozen eos tail) and still equals generate(eos_id=...)."""
+    model, variables = lm
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, 32, 4).astype(np.int32) for _ in range(3)]
+    # choose eos = the second greedy token of prompt 0 so it fires at
+    # in-chunk position 1 of a 4-tick chunk
+    toks0 = np.asarray(generate(model, variables,
+                                jnp.asarray(prompts[0][None]), 2))[0]
+    eos = int(toks0[1])
+    eng = ContinuousEngine(model, variables, max_new_tokens=8,
+                           max_slots=3, prompt_buckets=(8,), eos_id=eos,
+                           ticks_per_step=4)
+    results = {}
+    for i, p in enumerate(prompts):
+        eng.submit(f"e{i}", p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    for i, p in enumerate(prompts):
+        solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                                   8, eos_id=eos))[0]
+        np.testing.assert_array_equal(results[f"e{i}"], solo,
+                                      err_msg=f"e{i}")
+
+
+def test_engine_multi_tick_sampling_reproducible(lm):
+    """The SAMPLED multi-tick path: chunked decoding folds each row's rng
+    on its advancing position, so results are seed-reproducible and
+    identical across ticks_per_step settings."""
+    model, variables = lm
+    p = np.asarray([5, 9, 11, 2], np.int32)
+
+    def run(ticks, seed):
+        eng = ContinuousEngine(model, variables, max_new_tokens=8,
+                               max_slots=2, prompt_buckets=(8,),
+                               ticks_per_step=ticks)
+        results = {}
+        eng.submit("s", p, temperature=1.5, rng_seed=seed,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        eng.submit("g", p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        eng.drain()
+        return results
+
+    a, b = run(4, 7), run(4, 7)
+    np.testing.assert_array_equal(a["s"], b["s"])       # reproducible
+    c = run(1, 7)
+    # chunk size is a pure round-trip optimisation for sampling too
+    np.testing.assert_array_equal(a["s"], c["s"])
+    np.testing.assert_array_equal(a["g"], c["g"])
+    d = run(4, 99)
+    assert not np.array_equal(a["s"], d["s"])           # seed matters

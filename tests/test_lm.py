@@ -454,3 +454,40 @@ def test_moe_lm_trains_and_generates():
         assert (out[0] == 5).all() and (out[1] == 9).all(), out
     finally:
         stop_orca_context()
+
+
+def test_pp_lm_1f1b_schedule_matches_gpipe():
+    """TransformerLM(pp_schedule='1f1b'): identical deterministic loss
+    trajectory to the default GPipe schedule through Estimator.fit — the
+    memory schedule is invisible to the model."""
+    import optax
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.common.config import TrainConfig
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models import LM_PP_PARTITION_RULES
+
+    def run(schedule):
+        init_orca_context("local", mesh_axes={"pp": 2, "dp": 4})
+        try:
+            from analytics_zoo_tpu.common.context import OrcaContext
+
+            mesh = OrcaContext.get_context().mesh
+            rng = np.random.default_rng(0)
+            n, t, vocab = 128, 8, 16
+            sym = rng.integers(2, vocab, n).astype(np.int32)
+            toks = np.repeat(sym[:, None], t, axis=1)
+            model = _tiny_lm(vocab_size=vocab, num_layers=4, mesh=mesh,
+                             pp_stages=2, pp_microbatches=2,
+                             pp_schedule=schedule)
+            est = Estimator.from_flax(
+                model=model, loss=lm_loss, optimizer=optax.adam(3e-3),
+                feature_cols=("tokens",), label_cols=("tokens",),
+                partition_rules=LM_PP_PARTITION_RULES,
+                config=TrainConfig(deterministic=True, seed=0))
+            hist = est.fit({"tokens": toks}, epochs=3, batch_size=64)
+            return [h["loss"] for h in hist]
+        finally:
+            stop_orca_context()
+
+    np.testing.assert_allclose(run("1f1b"), run("gpipe"), rtol=2e-4)

@@ -185,12 +185,16 @@ class TestClusterServing:
             "model:\n  path: /models/m\n"
             "redis:\n  src: 10.0.0.5:6380\n"
             "params:\n  batch_size: 64\n  prompt_col: tokens\n"
-            "  prompt_pad_id: 3\n")
+            "  prompt_pad_id: 3\n  continuous_batching: true\n"
+            "  engine_slots: 16\n  eos_id: 2\n  engine_ticks: 4\n")
         cfg = ServingConfig.from_yaml(str(p))
         assert cfg.model_path == "/models/m"
         assert (cfg.redis_host, cfg.redis_port) == ("10.0.0.5", 6380)
         assert cfg.batch_size == 64
         assert cfg.prompt_col == "tokens" and cfg.prompt_pad_id == 3
+        assert cfg.continuous_batching is True
+        assert cfg.engine_slots == 16
+        assert cfg.eos_id == 2 and cfg.engine_ticks == 4
 
     def test_config_core_number_is_not_batch_size(self, tmp_path):
         """Reference config.yaml: core_number = CPU cores; a ported config
