@@ -1129,26 +1129,32 @@ class Estimator:
     from_bigdl = from_flax
 
     @staticmethod
-    def from_openvino(*, model_path: Optional[str] = None, **kw):
+    def from_openvino(*, model_path: Optional[str] = None,
+                      bin_path: Optional[str] = None, **kw):
         """ref-parity name: zoo.orca.learn.openvino.Estimator.from_openvino
         (batch inference with OpenVINO IR over Spark partitions).
 
-        OpenVINO's IR format and IE runtime are x86-specific and not
-        present in this environment; the ROLE (optimized batched
-        inference, optionally int8) is served natively:
+        The IR's ``.xml + .bin`` FORMAT is read directly
+        (net/openvino_ir.py translates the graph to one XLA-compiled
+        function; no IE runtime involved) and served by the same
+        predict/evaluate machinery as every other estimator.  Like the
+        reference's OpenVINO estimator, this one is INFERENCE-ONLY:
+        ``fit`` raises (an IR is a frozen deployment artifact — train
+        the original model instead)."""
+        from analytics_zoo_tpu.net.openvino_ir import OpenVINONet
 
-          * TF SavedModel / frozen graph -> ``Net.load_tf`` ->
-            ``InferenceModel.load_flax``
-          * torch module -> ``InferenceModel.load_torch``
-          * int8: ``InferenceModel.load_flax(..., quantize="int8")``
-            (weight-only, measured ~4x smaller, no calibration set)
+        if not model_path:
+            raise ValueError("from_openvino needs model_path=<model.xml>")
+        net = OpenVINONet.from_ir(model_path, bin_path)
+        est = FlaxEstimator(net, kw.pop("loss", None) or "mse",
+                            optax.sgd(0.0), **kw)
 
-        Re-export the original model (IR files cannot be converted back
-        without the OpenVINO toolchain).
-        """
-        raise NotImplementedError(
-            "OpenVINO IR needs the x86 IE runtime, which this TPU "
-            "environment does not ship. Serve the ORIGINAL model instead: "
-            "Net.load_tf(saved_model) or InferenceModel.load_torch(module), "
-            "then InferenceModel.load_flax(..., quantize='int8') for the "
-            "int8 role (see learn/quantize.py)")
+        def _no_fit(*a, **k):
+            raise NotImplementedError(
+                "OpenVINO estimators are inference-only (the IR is a "
+                "frozen artifact — ref parity with "
+                "zoo.orca.learn.openvino); use predict/evaluate, or "
+                "train the original model via from_flax/from_torch")
+
+        est.fit = _no_fit
+        return est

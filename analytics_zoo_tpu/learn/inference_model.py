@@ -229,6 +229,17 @@ class InferenceModel:
             eos_id=eos_id, pad_id=self.prompt_pad_id,
             ticks_per_step=ticks_per_step)
 
+    def load_openvino(self, xml_path: str, bin_path: str = None,
+                      quantize: Optional[str] = None) -> "InferenceModel":
+        """ref-parity: InferenceModel.loadOpenVINO — an OpenVINO IR
+        (.xml + .bin) served on TPU via the net/openvino_ir.py
+        translator; ``quantize="int8"`` covers the IR int8-calibration
+        role (weight-only, no calibration set needed)."""
+        from analytics_zoo_tpu.net.openvino_ir import OpenVINONet
+
+        net = OpenVINONet.from_ir(xml_path, bin_path)
+        return self.load_flax(net, net.init(None), quantize=quantize)
+
     def load_torch(self, module) -> "InferenceModel":
         """ref-parity: InferenceModel.loadTorch — a torch nn.Module (or
         path torch.load can read) served on TPU via TorchNet conversion."""

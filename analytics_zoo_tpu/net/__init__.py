@@ -78,6 +78,18 @@ class Net:
         return TFNet.from_concrete_function(path_or_fn)
 
     @staticmethod
+    def load_openvino(xml_path, bin_path=None) -> "object":
+        """ref-parity: load an OpenVINO IR (.xml + .bin) — the graph is
+        translated to one pure JAX function (net/openvino_ir.py), no IE
+        runtime involved.  Forward-only."""
+        from analytics_zoo_tpu.net.openvino_ir import OpenVINONet
+
+        p = os.fspath(xml_path)
+        if _is_local_path(p) and not os.path.exists(p):
+            raise FileNotFoundError(f"no such IR xml: {p!r}")
+        return OpenVINONet.from_ir(p, bin_path)
+
+    @staticmethod
     def load_bigdl(*a, **kw):
         raise NotImplementedError(
             "BigDL JVM models are not loadable without a JVM; rebuild the "
@@ -92,4 +104,6 @@ class Net:
             "Net.load_torch")
 
 
-__all__ = ["TorchNet", "TFNet", "Net"]
+from analytics_zoo_tpu.net.openvino_ir import OpenVINONet  # noqa: E402
+
+__all__ = ["TorchNet", "TFNet", "OpenVINONet", "Net"]

@@ -1,0 +1,361 @@
+"""OpenVINO IR import — the reference's `.xml + .bin` inference artifact.
+
+Reference surface (SURVEY.md §2.3; ref: pipeline/inference/
+OpenVinoInferenceSupportive + zoo.orca.learn.openvino.Estimator): load an
+OpenVINO Intermediate Representation and serve batched inference from it.
+Earlier rounds answered this with "re-export your model" (the x86 IE
+RUNTIME is genuinely absent here); this module removes the remaining gap
+by reading the IR FORMAT directly — no OpenVINO toolchain involved:
+
+- the ``.xml`` graph (opset-v10+ layer/edge schema) parses with stdlib
+  ElementTree;
+- ``Const`` payloads are sliced out of the ``.bin`` at their
+  ``offset/size`` and become the param tree (so ``quantize="int8"``
+  covers the IR int8-calibration role too);
+- each supported layer type lowers to the jax/lax op with the same
+  NCHW semantics OpenVINO defines, and the whole graph becomes ONE pure
+  function compiled by XLA — the TPU-native replacement for the IE
+  executable network.
+
+Curated op set (the layers OpenVINO's own model-optimizer emits for the
+reference's CV/recommendation zoos): Parameter, Const, Result,
+Convolution, GroupConvolution, MatMul, Add, Subtract, Multiply, Divide,
+Maximum, Minimum, ReLU, PReLU, Sigmoid, Tanh, Clamp, Gelu, Exp, Sqrt,
+Softmax, MaxPool, AvgPool, ReduceMean, Reshape, Squeeze, Unsqueeze,
+Transpose, Concat, BatchNormInference.  Anything else raises with the
+layer type named — a loud subset, not a silent wrong answer.
+"""
+
+from __future__ import annotations
+
+import os
+import xml.etree.ElementTree as ET
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_ELEMENT_TYPES = {
+    "f32": np.float32, "f16": np.float16, "f64": np.float64,
+    "i64": np.int64, "i32": np.int32, "i16": np.int16, "i8": np.int8,
+    "u64": np.uint64, "u32": np.uint32, "u16": np.uint16, "u8": np.uint8,
+    "boolean": np.bool_,
+}
+
+# ops whose listed input positions are SHAPE-LIKE: their producers must
+# be Const and are resolved at build time (a traced reshape target or
+# transpose permutation cannot exist under jit)
+_STATIC_INPUTS = {
+    "Reshape": (1,), "Transpose": (1,), "Squeeze": (1,),
+    "Unsqueeze": (1,), "ReduceMean": (1,),
+}
+
+
+def _ints(s: str) -> Tuple[int, ...]:
+    s = (s or "").strip()
+    return tuple(int(v) for v in s.split(",")) if s else ()
+
+
+class _Layer:
+    def __init__(self, el):
+        self.id = el.get("id")
+        self.type = el.get("type")
+        self.name = el.get("name") or f"layer_{self.id}"
+        d = el.find("data")
+        self.attrs = dict(d.attrib) if d is not None else {}
+        self.in_ports: List[str] = []
+        self.out_ports: List[str] = []
+        inp = el.find("input")
+        if inp is not None:
+            self.in_ports = [p.get("id") for p in inp.findall("port")]
+        out = el.find("output")
+        if out is not None:
+            self.out_ports = [p.get("id") for p in out.findall("port")]
+
+
+def _parse_ir(xml_path: str):
+    root = ET.parse(xml_path).getroot()
+    layers = {}
+    order = []
+    for el in root.find("layers").findall("layer"):
+        ly = _Layer(el)
+        layers[ly.id] = ly
+        order.append(ly.id)
+    producer: Dict[Tuple[str, str], Tuple[str, str]] = {}
+    edges = root.find("edges")
+    if edges is not None:
+        for e in edges.findall("edge"):
+            producer[(e.get("to-layer"), e.get("to-port"))] = (
+                e.get("from-layer"), e.get("from-port"))
+    return layers, order, producer
+
+
+def _read_const(ly: _Layer, blob: bytes) -> np.ndarray:
+    dt = _ELEMENT_TYPES[ly.attrs["element_type"]]
+    shape = _ints(ly.attrs.get("shape", ""))
+    off = int(ly.attrs["offset"])
+    size = int(ly.attrs["size"])
+    arr = np.frombuffer(blob[off:off + size], dtype=dt)
+    return arr.reshape(shape) if shape else arr.reshape(())
+
+
+def _pool(x, kernel, strides, pads_b, pads_e, kind, exclude_pad):
+    """NCHW reduce-window pooling with explicit pads."""
+    window = (1, 1) + kernel
+    stride = (1, 1) + strides
+    pads = ((0, 0), (0, 0)) + tuple(zip(pads_b, pads_e))
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, stride, pads)
+    s = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window,
+                          stride, pads)
+    if exclude_pad:
+        ones = jnp.ones(x.shape[2:], jnp.float32)[None, None]
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, stride, pads)
+        return (s / jnp.maximum(cnt, 1.0)).astype(x.dtype)
+    return (s / float(np.prod(kernel))).astype(x.dtype)
+
+
+class OpenVINONet:
+    """An OpenVINO IR translated to a pure JAX function + param tree.
+
+    Same flax init/apply protocol as TFNet/TorchNet, so it serves through
+    ``InferenceModel`` and predicts through the Estimator:
+
+        net = OpenVINONet.from_ir("/models/m.xml")
+        y = net(net.params, x)
+        InferenceModel().load_flax(net, net.init(None), quantize="int8")
+
+    Forward-only by design (an IR is an inference artifact)."""
+
+    def __init__(self, fn: Callable, params: Dict[str, np.ndarray],
+                 input_names: List[str], output_names: List[str]):
+        self._fn = fn
+        self.params = params
+        self.input_names = input_names
+        self.output_names = output_names
+
+    def __call__(self, params, *inputs):
+        return self._fn(params, *inputs)
+
+    # -- flax protocol ---------------------------------------------------
+    def init(self, rngs, *inputs, **kw):
+        return {"params": self.params}
+
+    def apply(self, variables, *inputs, mutable=None, rngs=None, **kw):
+        out = self._fn(variables["params"], *inputs)
+        if mutable:
+            return out, {}
+        return out
+
+    # -- importer --------------------------------------------------------
+    @staticmethod
+    def from_ir(xml_path: str,
+                bin_path: Optional[str] = None) -> "OpenVINONet":
+        if bin_path is None:
+            bin_path = os.path.splitext(xml_path)[0] + ".bin"
+        with open(bin_path, "rb") as f:
+            blob = f.read()
+        layers, order, producer = _parse_ir(xml_path)
+
+        const_vals: Dict[str, np.ndarray] = {}
+        pnames: Dict[str, str] = {}     # layer id -> param key
+        params: Dict[str, np.ndarray] = {}
+        inputs: List[str] = []
+        results: List[str] = []
+        for lid in order:
+            ly = layers[lid]
+            if ly.type == "Const":
+                const_vals[lid] = _read_const(ly, blob)
+            elif ly.type == "Parameter":
+                inputs.append(lid)
+            elif ly.type == "Result":
+                results.append(lid)
+
+        # which Const ids are consumed ONLY as static (shape-like) inputs?
+        tensor_consts = set()
+        for lid in order:
+            ly = layers[lid]
+            static_slots = _STATIC_INPUTS.get(ly.type, ())
+            for slot, port in enumerate(ly.in_ports):
+                src = producer.get((lid, port))
+                if src and src[0] in const_vals and \
+                        slot not in static_slots:
+                    tensor_consts.add(src[0])
+        for lid in sorted(tensor_consts, key=int):
+            key = layers[lid].name
+            if key in params:       # name collision: disambiguate by id
+                key = f"{key}_{lid}"
+            pnames[lid] = key
+            # jax canonicalizes i64->i32 under disabled x64; pre-cast so
+            # the param tree round-trips through device_put unchanged
+            v = const_vals[lid]
+            params[key] = v.astype(jax.dtypes.canonicalize_dtype(v.dtype))
+
+        # resolve every shape-like input NOW (build time): the values
+        # must be static under jit anyway, and copying just these few
+        # small arrays lets const_vals/blob (np.frombuffer views pinning
+        # the whole .bin in host RAM) be garbage-collected — params
+        # already hold their own copies of the tensor Consts
+        static_vals: Dict[Tuple[str, int], np.ndarray] = {}
+        for lid in order:
+            ly = layers[lid]
+            for slot in _STATIC_INPUTS.get(ly.type, ()):
+                src = producer.get((lid, ly.in_ports[slot]))
+                if not src or src[0] not in const_vals:
+                    raise NotImplementedError(
+                        f"{ly.type} '{ly.name}': input {slot} must be a "
+                        f"Const (shape-like inputs are resolved at load "
+                        f"time)")
+                static_vals[(lid, slot)] = const_vals[src[0]].copy()
+        del const_vals, blob
+
+        def static_in(lid, slot):
+            return static_vals[(lid, slot)]
+
+        def forward(p, *xs):
+            env: Dict[Tuple[str, str], jax.Array] = {}
+            for lid, x in zip(inputs, xs):
+                env[(lid, layers[lid].out_ports[0])] = x
+            for lid in order:
+                ly = layers[lid]
+                if ly.type in ("Parameter", "Result"):
+                    continue
+                if ly.type == "Const":
+                    if lid in pnames:
+                        env[(lid, ly.out_ports[0])] = p[pnames[lid]]
+                    continue
+                static_slots = _STATIC_INPUTS.get(ly.type, ())
+                ins = []
+                for slot, port in enumerate(ly.in_ports):
+                    if slot in static_slots:
+                        # shape-like input: resolved at build time via
+                        # static_in, never a traced value
+                        ins.append(None)
+                        continue
+                    src = producer[(lid, port)]
+                    ins.append(env[src])
+                env[(lid, ly.out_ports[0])] = _lower(ly, ins, static_in)
+            outs = []
+            for lid in results:
+                src = producer[(lid, layers[lid].in_ports[0])]
+                outs.append(env[src])
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        def _lower(ly, ins, static_in):
+            t = ly.type
+            a = ly.attrs
+            if t in ("ReLU", "Relu"):
+                return jax.nn.relu(ins[0])
+            if t == "Sigmoid":
+                return jax.nn.sigmoid(ins[0])
+            if t == "Tanh":
+                return jnp.tanh(ins[0])
+            if t == "Exp":
+                return jnp.exp(ins[0])
+            if t == "Sqrt":
+                return jnp.sqrt(ins[0])
+            if t == "Gelu":
+                approx = a.get("approximation_mode", "ERF").upper()
+                return jax.nn.gelu(ins[0], approximate=approx != "ERF")
+            if t == "Clamp":
+                return jnp.clip(ins[0], float(a["min"]), float(a["max"]))
+            if t == "PReLU":
+                slope = ins[1]
+                if jnp.ndim(slope) == 1 and jnp.ndim(ins[0]) > 1:
+                    # OpenVINO: a 1-D slope of length C is CHANNEL-wise
+                    # on NCHW data, not trailing-axis numpy broadcast
+                    slope = slope.reshape(
+                        (1, -1) + (1,) * (jnp.ndim(ins[0]) - 2))
+                return jnp.where(ins[0] >= 0, ins[0], ins[0] * slope)
+            if t in ("Add", "Subtract", "Multiply", "Divide", "Maximum",
+                     "Minimum"):
+                f = {"Add": jnp.add, "Subtract": jnp.subtract,
+                     "Multiply": jnp.multiply, "Divide": jnp.divide,
+                     "Maximum": jnp.maximum, "Minimum": jnp.minimum}[t]
+                return f(ins[0], ins[1])
+            if t == "MatMul":
+                x, w = ins
+                if a.get("transpose_a", "false") == "true":
+                    x = jnp.swapaxes(x, -1, -2)
+                if a.get("transpose_b", "false") == "true":
+                    w = jnp.swapaxes(w, -1, -2)
+                return jnp.matmul(x, w)
+            if t == "Softmax":
+                return jax.nn.softmax(ins[0], axis=int(a.get("axis", 1)))
+            if t in ("Convolution", "GroupConvolution"):
+                x, w = ins
+                strides = _ints(a.get("strides", "1,1"))
+                pb = _ints(a.get("pads_begin", "0,0"))
+                pe = _ints(a.get("pads_end", "0,0"))
+                dil = _ints(a.get("dilations", "1,1"))
+                groups = 1
+                if t == "GroupConvolution":
+                    # IR group weights: [G, O/G, I/G, kH, kW] -> OIHW
+                    g = w.shape[0]
+                    w = w.reshape((w.shape[0] * w.shape[1],) + w.shape[2:])
+                    groups = g
+                return lax.conv_general_dilated(
+                    x, w, window_strides=strides,
+                    padding=tuple(zip(pb, pe)), rhs_dilation=dil,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                    feature_group_count=groups)
+            if t == "MaxPool":
+                return _pool(ins[0], _ints(a["kernel"]),
+                             _ints(a.get("strides", "1,1")),
+                             _ints(a.get("pads_begin", "0,0")),
+                             _ints(a.get("pads_end", "0,0")), "max", True)
+            if t == "AvgPool":
+                return _pool(ins[0], _ints(a["kernel"]),
+                             _ints(a.get("strides", "1,1")),
+                             _ints(a.get("pads_begin", "0,0")),
+                             _ints(a.get("pads_end", "0,0")), "avg",
+                             a.get("exclude-pad",
+                                   a.get("exclude_pad",
+                                         "true")) == "true")
+            if t == "ReduceMean":
+                axes = tuple(int(v) for v in
+                             np.ravel(static_in(ly.id, 1)))
+                keep = a.get("keep_dims", "true") == "true"
+                return jnp.mean(ins[0], axis=axes, keepdims=keep)
+            if t == "Reshape":
+                target = [int(v) for v in np.ravel(static_in(ly.id, 1))]
+                if a.get("special_zero", "true") == "true":
+                    target = [ins[0].shape[i] if v == 0 else v
+                              for i, v in enumerate(target)]
+                return jnp.reshape(ins[0], target)
+            if t == "Squeeze":
+                axes = tuple(int(v) for v in
+                             np.ravel(static_in(ly.id, 1)))
+                return jnp.squeeze(ins[0], axis=axes)
+            if t == "Unsqueeze":
+                axes = sorted(int(v) for v in
+                              np.ravel(static_in(ly.id, 1)))
+                out = ins[0]
+                for ax in axes:
+                    out = jnp.expand_dims(out, ax)
+                return out
+            if t == "Transpose":
+                perm = tuple(int(v) for v in
+                             np.ravel(static_in(ly.id, 1)))
+                return jnp.transpose(ins[0], perm)
+            if t == "Concat":
+                return jnp.concatenate(ins, axis=int(a.get("axis", 0)))
+            if t == "BatchNormInference":
+                x, gamma, beta, mean, var = ins
+                eps = float(a.get("epsilon", a.get("eps", 1e-5)))
+                shape = (1, -1) + (1,) * (x.ndim - 2)
+                return (x - mean.reshape(shape)) * gamma.reshape(shape) \
+                    / jnp.sqrt(var.reshape(shape) + eps) \
+                    + beta.reshape(shape)
+            raise NotImplementedError(
+                f"OpenVINO layer type {t!r} ('{ly.name}') is outside the "
+                f"supported subset — see net/openvino_ir.py's module "
+                f"docstring for the curated op list")
+
+        in_names = [layers[i].name for i in inputs]
+        out_names = [layers[i].name for i in results]
+        return OpenVINONet(forward, params, in_names, out_names)

@@ -1,0 +1,251 @@
+"""OpenVINO IR import tests (net/openvino_ir.py).
+
+No OpenVINO toolchain exists in this environment, so the IRs under test
+are handcrafted to the opset-v10 schema (layers/ports/edges XML + raw
+.bin Const payloads) with known weights — the numerics oracle is a plain
+numpy/jax recomputation of the same math.
+"""
+
+import os
+import xml.etree.ElementTree as ET
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.net import Net, OpenVINONet
+
+
+class _IRBuilder:
+    """Minimal opset-v10 IR writer: add layers/edges, emit .xml/.bin."""
+
+    def __init__(self):
+        self.layers = []
+        self.edges = []
+        self.blob = b""
+
+    def layer(self, type_, name=None, data=None, n_in=0, n_out=1):
+        lid = str(len(self.layers))
+        self.layers.append({
+            "id": lid, "type": type_, "name": name or f"{type_}_{lid}",
+            "data": data or {}, "n_in": n_in, "n_out": n_out})
+        return lid
+
+    def const(self, arr, name=None):
+        arr = np.ascontiguousarray(arr)
+        et = {np.dtype(np.float32): "f32", np.dtype(np.int64): "i64",
+              np.dtype(np.int32): "i32"}[arr.dtype]
+        lid = self.layer("Const", name=name, data={
+            "element_type": et,
+            "shape": ",".join(str(d) for d in arr.shape),
+            "offset": str(len(self.blob)),
+            "size": str(arr.nbytes)})
+        self.blob += arr.tobytes()
+        return lid
+
+    def edge(self, src, dst, dst_port):
+        # out ports are numbered after in ports in our writer: a layer
+        # with k inputs exposes ports 0..k-1 (in) and k.. (out)
+        src_out_port = str(self.layers[int(src)]["n_in"])
+        self.edges.append((src, src_out_port, dst, str(dst_port)))
+
+    def write(self, tmpdir, name="model"):
+        net = ET.Element("net", {"name": name, "version": "10"})
+        lys = ET.SubElement(net, "layers")
+        for ly in self.layers:
+            el = ET.SubElement(lys, "layer", {
+                "id": ly["id"], "type": ly["type"], "name": ly["name"],
+                "version": "opset1"})
+            if ly["data"]:
+                ET.SubElement(el, "data", ly["data"])
+            if ly["n_in"]:
+                inp = ET.SubElement(el, "input")
+                for i in range(ly["n_in"]):
+                    ET.SubElement(inp, "port", {"id": str(i)})
+            if ly["n_out"]:
+                out = ET.SubElement(el, "output")
+                for i in range(ly["n_out"]):
+                    ET.SubElement(out, "port",
+                                  {"id": str(ly["n_in"] + i)})
+        egs = ET.SubElement(net, "edges")
+        for f, fp, t, tp in self.edges:
+            ET.SubElement(egs, "edge", {
+                "from-layer": f, "from-port": fp,
+                "to-layer": t, "to-port": tp})
+        xml_path = os.path.join(str(tmpdir), f"{name}.xml")
+        ET.ElementTree(net).write(xml_path)
+        with open(os.path.join(str(tmpdir), f"{name}.bin"), "wb") as fh:
+            fh.write(self.blob)
+        return xml_path
+
+
+def _mlp_ir(tmpdir, rng):
+    """Parameter[ B,4] -> MatMul w[4,8] -> Add b[1,8] -> ReLU ->
+    MatMul w[8,3] -> Softmax -> Result.  Returns (xml, weights)."""
+    w1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(1, 8)).astype(np.float32)
+    w2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b = _IRBuilder()
+    x = b.layer("Parameter", name="input")
+    cw1 = b.const(w1, "w1")
+    mm1 = b.layer("MatMul", data={"transpose_a": "false",
+                                  "transpose_b": "false"}, n_in=2)
+    b.edge(x, mm1, 0), b.edge(cw1, mm1, 1)
+    cb1 = b.const(b1, "b1")
+    add = b.layer("Add", n_in=2)
+    b.edge(mm1, add, 0), b.edge(cb1, add, 1)
+    relu = b.layer("ReLU", n_in=1)
+    b.edge(add, relu, 0)
+    cw2 = b.const(w2, "w2")
+    mm2 = b.layer("MatMul", data={"transpose_a": "false",
+                                  "transpose_b": "false"}, n_in=2)
+    b.edge(relu, mm2, 0), b.edge(cw2, mm2, 1)
+    sm = b.layer("Softmax", data={"axis": "1"}, n_in=1)
+    b.edge(mm2, sm, 0)
+    res = b.layer("Result", n_in=1, n_out=0)
+    b.edge(sm, res, 0)
+    return b.write(tmpdir), (w1, b1, w2)
+
+
+def test_ir_mlp_matches_numpy(tmp_path):
+    rng = np.random.default_rng(0)
+    xml, (w1, b1, w2) = _mlp_ir(tmp_path, rng)
+    net = OpenVINONet.from_ir(xml)
+    assert net.input_names == ["input"]
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    got = np.asarray(net(net.params, jnp.asarray(x)))
+    h = np.maximum(x @ w1 + b1, 0.0)
+    ref = jax.nn.softmax(jnp.asarray(h @ w2), axis=1)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5,
+                               atol=1e-6)
+    # weights became the param tree (quantizable/loadable like any net)
+    assert set(net.params) == {"w1", "b1", "w2"}
+
+
+def test_ir_conv_pool_reshape_pipeline(tmp_path):
+    """Conv(NCHW, pads 1) -> Add(bias) -> ReLU -> MaxPool 2x2/2 ->
+    ReduceMean(H,W) -> Reshape -> MatMul: the CV-shaped layer chain."""
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32) * 0.3
+    bias = rng.normal(size=(1, 4, 1, 1)).astype(np.float32)
+    wf = rng.normal(size=(4, 2)).astype(np.float32)
+    b = _IRBuilder()
+    x = b.layer("Parameter", name="pixels")
+    cw = b.const(w, "convw")
+    conv = b.layer("Convolution", data={
+        "strides": "1,1", "pads_begin": "1,1", "pads_end": "1,1",
+        "dilations": "1,1"}, n_in=2)
+    b.edge(x, conv, 0), b.edge(cw, conv, 1)
+    cb = b.const(bias, "convb")
+    add = b.layer("Add", n_in=2)
+    b.edge(conv, add, 0), b.edge(cb, add, 1)
+    relu = b.layer("ReLU", n_in=1)
+    b.edge(add, relu, 0)
+    mp = b.layer("MaxPool", data={"kernel": "2,2", "strides": "2,2",
+                                  "pads_begin": "0,0",
+                                  "pads_end": "0,0"}, n_in=1)
+    b.edge(relu, mp, 0)
+    axes = b.const(np.asarray([2, 3], np.int64), "axes")
+    rm = b.layer("ReduceMean", data={"keep_dims": "false"}, n_in=2)
+    b.edge(mp, rm, 0), b.edge(axes, rm, 1)
+    shp = b.const(np.asarray([0, 4], np.int64), "shape")
+    rs = b.layer("Reshape", data={"special_zero": "true"}, n_in=2)
+    b.edge(rm, rs, 0), b.edge(shp, rs, 1)
+    cwf = b.const(wf, "head")
+    mm = b.layer("MatMul", data={"transpose_a": "false",
+                                 "transpose_b": "false"}, n_in=2)
+    b.edge(rs, mm, 0), b.edge(cwf, mm, 1)
+    res = b.layer("Result", n_in=1, n_out=0)
+    b.edge(mm, res, 0)
+    xml = b.write(tmp_path, "cv")
+
+    net = OpenVINONet.from_ir(xml)
+    xin = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    got = np.asarray(net(net.params, jnp.asarray(xin)))
+
+    from jax import lax
+    y = lax.conv_general_dilated(
+        jnp.asarray(xin), jnp.asarray(w), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    y = jax.nn.relu(y + bias)
+    y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 1, 2, 2),
+                          (1, 1, 2, 2), "VALID")
+    y = jnp.mean(y, axis=(2, 3))
+    ref = np.asarray(y @ wf)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # shape-like Consts (axes/reshape target) resolve statically and do
+    # NOT appear in the trainable/quantizable tree
+    assert set(net.params) == {"convw", "convb", "head"}
+
+
+def test_ir_through_inference_model_and_quantize(tmp_path):
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    rng = np.random.default_rng(2)
+    xml, (w1, b1, w2) = _mlp_ir(tmp_path, rng)
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    ref = np.asarray(OpenVINONet.from_ir(xml)(
+        OpenVINONet.from_ir(xml).params, jnp.asarray(x)))
+
+    im = InferenceModel().load_openvino(xml)
+    np.testing.assert_allclose(np.asarray(im.predict(x)), ref,
+                               rtol=1e-5, atol=1e-6)
+    imq = InferenceModel().load_openvino(xml, quantize="int8")
+    got = np.asarray(imq.predict(x))
+    # int8 weight-only: small deviation, same argmax classes
+    np.testing.assert_array_equal(got.argmax(1), ref.argmax(1))
+
+
+def test_estimator_from_openvino_predicts_and_refuses_fit(tmp_path):
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.learn import Estimator
+
+    rng = np.random.default_rng(3)
+    xml, _ = _mlp_ir(tmp_path, rng)
+    init_orca_context("local", mesh_axes={"dp": 8})
+    try:
+        est = Estimator.from_openvino(model_path=xml,
+                                      feature_cols=("x",),
+                                      label_cols=("y",))
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        preds = np.asarray(est.predict({"x": x}, batch_size=8))
+        net = OpenVINONet.from_ir(xml)
+        ref = np.asarray(net(net.params, jnp.asarray(x)))
+        np.testing.assert_allclose(preds, ref, rtol=1e-5, atol=1e-6)
+        with pytest.raises(NotImplementedError, match="inference-only"):
+            est.fit({"x": x, "y": x[:, :3]}, epochs=1, batch_size=8)
+    finally:
+        stop_orca_context()
+
+
+def test_ir_unsupported_layer_raises_loudly(tmp_path):
+    b = _IRBuilder()
+    x = b.layer("Parameter", name="in")
+    bad = b.layer("ROIAlign", n_in=1)
+    b.edge(x, bad, 0)
+    res = b.layer("Result", n_in=1, n_out=0)
+    b.edge(bad, res, 0)
+    xml = b.write(tmp_path, "bad")
+    net = Net.load_openvino(xml)
+    with pytest.raises(NotImplementedError, match="ROIAlign"):
+        net(net.params, jnp.zeros((1, 4), jnp.float32))
+
+
+def test_ir_prelu_channelwise_slope(tmp_path):
+    """A 1-D PReLU slope of length C applies per-CHANNEL on NCHW data
+    (OpenVINO semantics), not numpy trailing-axis broadcast."""
+    slope = np.asarray([0.1, 0.5, 2.0], np.float32)
+    b = _IRBuilder()
+    x = b.layer("Parameter", name="in")
+    cs = b.const(slope, "slope")
+    pr = b.layer("PReLU", n_in=2)
+    b.edge(x, pr, 0), b.edge(cs, pr, 1)
+    res = b.layer("Result", n_in=1, n_out=0)
+    b.edge(pr, res, 0)
+    xml = b.write(tmp_path, "prelu")
+    net = OpenVINONet.from_ir(xml)
+    xin = -np.ones((1, 3, 2, 2), np.float32)    # W=2 != C=3: must not
+    got = np.asarray(net(net.params, jnp.asarray(xin)))   # crash
+    ref = -slope[None, :, None, None] * np.ones((1, 3, 2, 2), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
