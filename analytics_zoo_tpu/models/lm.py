@@ -35,6 +35,9 @@ from analytics_zoo_tpu.parallel.pipeline import pp_stage_rules as _ppsr
 LM_PARTITION_RULES = (
     (r"pos_embed/embedding", P()),      # positions replicate (before the
     (r"embed/embedding", P("tp", None)),   # vocab rule can re.search-match)
+    # NOTE (GQA): key/value kernels have num_kv_heads on the sharded
+    # head dim — keep num_kv_heads a multiple of the tp size (or
+    # override these two rules to P()) when sharding narrow-KV models
     (r"(query|key|value)/kernel", P(None, "tp")),
     (r"attn_out/kernel", P("tp", None)),
     (r"ffn_up/kernel", P(None, "tp")),
@@ -98,7 +101,8 @@ def beam_search(model: TransformerLM, variables, prompt,
         raise ValueError(f"prompt+new = {L} exceeds max_position "
                          f"{model.max_position}")
     V = model.vocab_size
-    H, D = model.num_heads, model.hidden_size // model.num_heads
+    H = model.kv_heads                  # GQA: cache stores KV heads only
+    D = model.hidden_size // model.num_heads
     cdtype = jnp.dtype(model.dtype)
     ragged = prompt_len is not None
     plen = (jnp.full((B,), Pn, jnp.int32) if not ragged
@@ -240,10 +244,19 @@ def unstack_pp_params(params):
 
 class DecoderAttention(nn.Module):
     """Causal self-attention with a training path and a cached decode path
-    sharing the same projections (setup-style module)."""
+    sharing the same projections (setup-style module).
+
+    ``num_kv_heads < num_heads`` is grouped-query attention (MQA at 1):
+    K/V project to fewer heads, shared by groups of query heads.  The
+    TRAINING forward broadcasts K/V up to full width (same FLOPs as MHA
+    — flash/ring paths work unchanged); the win is the DECODE cache,
+    which stores only ``num_kv_heads`` heads: H/KV_H times smaller KV
+    per token, which multiplies continuous-serving arena capacity and
+    long-generation memory headroom the same way."""
 
     hidden_size: int
     num_heads: int
+    num_kv_heads: Optional[int] = None
     dtype: jnp.dtype = jnp.bfloat16
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
@@ -251,21 +264,34 @@ class DecoderAttention(nn.Module):
 
     def setup(self):
         H = self.num_heads
+        KH = self.num_kv_heads or H
+        if H % KH:
+            raise ValueError(
+                f"num_heads {H} must be a multiple of num_kv_heads {KH}")
         D = self.hidden_size // H
-        self._h, self._d = H, D
-        dense = lambda name: nn.DenseGeneral((H, D), dtype=self.dtype,
-                                             name=name)
-        self.query, self.key, self.value = (
-            dense("query"), dense("key"), dense("value"))
+        self._h, self._kh, self._d = H, KH, D
+        self.query = nn.DenseGeneral((H, D), dtype=self.dtype,
+                                     name="query")
+        self.key = nn.DenseGeneral((KH, D), dtype=self.dtype, name="key")
+        self.value = nn.DenseGeneral((KH, D), dtype=self.dtype,
+                                     name="value")
         self.attn_out = nn.DenseGeneral(self.hidden_size, axis=(-2, -1),
                                         dtype=self.dtype, name="attn_out")
+
+    def _expand_kv(self, t):
+        """[B, T, KH, D] -> [B, T, H, D] by repeating each KV head over
+        its query group (training path: keeps flash/ring unchanged)."""
+        if self._kh == self._h:
+            return t
+        return jnp.repeat(t, self._h // self._kh, axis=2)
 
     def __call__(self, x, train: bool = False, return_kv: bool = False):
         """Training/scoring: [B, T, E] -> [B, T, E], causal.
         ``return_kv=True`` also returns this layer's K/V projections
-        ``[B, T, H, D]`` (KV-arena prefill for continuous batching)."""
+        ``[B, T, KV_H, D]`` (KV-arena prefill for continuous batching)."""
         q, k, v = self.query(x), self.key(x), self.value(x)
-        o = attention_dispatch(q, k, v, None, causal=True, mesh=self.mesh,
+        o = attention_dispatch(q, self._expand_kv(k), self._expand_kv(v),
+                               None, causal=True, mesh=self.mesh,
                                use_flash=self.use_flash,
                                sp_strategy=self.sp_strategy)
         out = self.attn_out(o)
@@ -274,40 +300,46 @@ class DecoderAttention(nn.Module):
     def decode(self, x1, cache_k, cache_v, pos):
         """One cached decode step.
 
-        x1: [B, 1, E] current-position hidden; cache_k/v: [B, L, H, D]
-        preallocated; pos: int32 current position — a SCALAR advances the
-        whole batch in lockstep (generate/beam_search); a VECTOR [B]
+        x1: [B, 1, E] current-position hidden; cache_k/v: [B, L, KV_H,
+        D] preallocated; pos: int32 current position — a SCALAR advances
+        the whole batch in lockstep (generate/beam_search); a VECTOR [B]
         gives each row its own position (the continuous-batching engine,
         where co-resident requests are at different depths).  Returns
         (y1 [B, 1, E], new_cache_k, new_cache_v).
         """
         B = x1.shape[0]
         L = cache_k.shape[1]
+        KH = self._kh
+        G = self._h // KH                   # query heads per KV head
         q = self.query(x1)                              # [B, 1, H, D]
-        k1 = self.key(x1)
+        k1 = self.key(x1)                               # [B, 1, KH, D]
         v1 = self.value(x1)
         if jnp.ndim(pos) == 0:
             cache_k = lax.dynamic_update_slice(
                 cache_k, k1.astype(cache_k.dtype), (0, pos, 0, 0))
             cache_v = lax.dynamic_update_slice(
                 cache_v, v1.astype(cache_v.dtype), (0, pos, 0, 0))
-            mask = (jnp.arange(L) <= pos)[None, None, None, :]
+            mask = (jnp.arange(L) <= pos)[None, None, None, None, :]
         else:
             # per-row scatter: row b writes its K/V at pos[b] and attends
-            # positions <= pos[b] (O(B*L*H*D) masked write — the same
+            # positions <= pos[b] (O(B*L*KH*D) masked write — the same
             # bandwidth the attention read below already pays)
             hit = (jnp.arange(L)[None, :] == pos[:, None])[:, :, None, None]
             cache_k = jnp.where(hit, k1.astype(cache_k.dtype), cache_k)
             cache_v = jnp.where(hit, v1.astype(cache_v.dtype), cache_v)
             mask = (jnp.arange(L)[None, :]
-                    <= pos[:, None])[:, None, None, :]
+                    <= pos[:, None])[:, None, None, None, :]
         scale = 1.0 / jnp.sqrt(self._d).astype(jnp.float32)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k,
+        # grouped attention: q regroups [B, 1, KH, G, D] so each KV head
+        # serves its G query heads without materialising expanded KV
+        qg = q.reshape(B, 1, KH, G, self._d)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, cache_k,
                             preferred_element_type=jnp.float32) * scale
         logits = jnp.where(mask, logits, -jnp.inf)
         w = jax.nn.softmax(logits, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", w.astype(cache_v.dtype), cache_v,
-                       preferred_element_type=jnp.float32)
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", w.astype(cache_v.dtype),
+                       cache_v, preferred_element_type=jnp.float32)
+        o = o.reshape(B, 1, self._h, self._d)
         return self.attn_out(o.astype(self.dtype)), cache_k, cache_v
 
 
@@ -326,11 +358,13 @@ class DecoderLayer(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    num_kv_heads: Optional[int] = None
 
     def setup(self):
         self.ln_attn = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")
         self.attention = DecoderAttention(
-            self.hidden_size, self.num_heads, dtype=self.dtype,
+            self.hidden_size, self.num_heads,
+            num_kv_heads=self.num_kv_heads, dtype=self.dtype,
             mesh=self.mesh, use_flash=self.use_flash,
             sp_strategy=self.sp_strategy, name="attention")
         self.ln_ffn = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")
@@ -400,6 +434,7 @@ class _LMStage(nn.Module):
     intermediate_size: int
     dtype: jnp.dtype = jnp.bfloat16
     use_flash: Optional[bool] = None
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):
@@ -410,6 +445,7 @@ class _LMStage(nn.Module):
                              self.intermediate_size, dropout=0.0,
                              dtype=self.dtype, mesh=None,
                              use_flash=self.use_flash,
+                             num_kv_heads=self.num_kv_heads,
                              name=f"layer_{i}")(x, False)
         return x
 
@@ -455,6 +491,17 @@ class TransformerLM(nn.Module):
     # decode routes only B tokens/step: raise this where batch-coupled
     # capacity drops matter (MoEMLP docstring)
     moe_capacity_factor: float = 1.25
+    # Grouped-query attention: K/V project to this many heads (must
+    # divide num_heads; None = MHA, 1 = MQA).  Training FLOPs are
+    # unchanged (K/V broadcast up); the DECODE KV cache shrinks
+    # num_heads/num_kv_heads-fold — allocate caches with `.kv_heads`.
+    num_kv_heads: Optional[int] = None
+
+    @property
+    def kv_heads(self) -> int:
+        """Heads actually stored in the KV cache (GQA-aware; every cache
+        allocation site — generate/beam/engine — sizes with this)."""
+        return self.num_kv_heads or self.num_heads
 
     def setup(self):
         self.embed = nn.Embed(self.vocab_size, self.hidden_size,
@@ -486,7 +533,8 @@ class TransformerLM(nn.Module):
                 stage=_LMStage(self.num_layers // self.pp_stages,
                                self.hidden_size, self.num_heads,
                                self.intermediate_size, dtype=self.dtype,
-                               use_flash=self.use_flash),
+                               use_flash=self.use_flash,
+                               num_kv_heads=self.num_kv_heads),
                 n_stages=self.pp_stages,
                 n_microbatches=self.pp_microbatches,
                 schedule=self.pp_schedule,
@@ -510,6 +558,7 @@ class TransformerLM(nn.Module):
                                    else 0),
                       moe_top_k=self.moe_top_k,
                       moe_capacity_factor=self.moe_capacity_factor,
+                      num_kv_heads=self.num_kv_heads,
                       name=f"layer_{i}")
             for i in range(self.num_layers)]
 
@@ -535,10 +584,11 @@ class TransformerLM(nn.Module):
         return self._logits(self.ln_f(x))
 
     def decode_step(self, tok, caches_k, caches_v, pos):
-        """tok: [B] current tokens; caches_k/v: [n_layers, B, L, H, D];
-        pos: scalar int32 (lockstep batch) or [B] vector (per-row
-        positions, continuous batching).  Returns (logits [B, V],
-        caches_k, caches_v)."""
+        """tok: [B] current tokens; caches_k/v: [n_layers, B, L,
+        kv_heads, D] (GQA models cache only their KV heads); pos: scalar
+        int32 (lockstep batch) or [B] vector (per-row positions,
+        continuous batching).  Returns (logits [B, V], caches_k,
+        caches_v)."""
         if self.pp_stages > 0:
             raise NotImplementedError(
                 "cached decode is not pipelined; convert the params with "
@@ -624,8 +674,8 @@ def generate(model: TransformerLM, variables, prompt,
     # bad lengths per-request (serving) do so before this.
     plen = (jnp.full((B,), Pn, jnp.int32) if prompt_len is None
             else jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, Pn))
-    H = model.num_heads
-    D = model.hidden_size // H
+    H = model.kv_heads                  # GQA: cache stores KV heads only
+    D = model.hidden_size // model.num_heads
     cdtype = jnp.dtype(model.dtype)
     ck0 = jnp.zeros((model.num_layers, B, L, H, D), cdtype)
     cv0 = jnp.zeros_like(ck0)

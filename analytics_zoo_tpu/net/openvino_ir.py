@@ -204,6 +204,9 @@ class OpenVINONet:
         for lid in order:
             ly = layers[lid]
             for slot in _STATIC_INPUTS.get(ly.type, ()):
+                if slot >= len(ly.in_ports):
+                    continue    # optional input omitted (e.g. axis-less
+                    #             Squeeze) — the op handles its absence
                 src = producer.get((lid, ly.in_ports[slot]))
                 if not src or src[0] not in const_vals:
                     raise NotImplementedError(
@@ -213,7 +216,9 @@ class OpenVINONet:
                 static_vals[(lid, slot)] = const_vals[src[0]].copy()
         del const_vals, blob
 
-        def static_in(lid, slot):
+        def static_in(lid, slot, default=None):
+            if (lid, slot) not in static_vals:
+                return default
             return static_vals[(lid, slot)]
 
         def forward(p, *xs):
@@ -288,6 +293,14 @@ class OpenVINONet:
                 return jax.nn.softmax(ins[0], axis=int(a.get("axis", 1)))
             if t in ("Convolution", "GroupConvolution"):
                 x, w = ins
+                ap = a.get("auto_pad", "explicit")
+                if ap not in ("explicit", "notset", "NOTSET"):
+                    # same_upper/same_lower ignore pads_begin/end per the
+                    # spec; lowering them as explicit would be silently
+                    # wrong — loud subset, not wrong answers
+                    raise NotImplementedError(
+                        f"{t} '{ly.name}': auto_pad={ap!r} is not "
+                        f"supported (re-export with explicit pads)")
                 strides = _ints(a.get("strides", "1,1"))
                 pb = _ints(a.get("pads_begin", "0,0"))
                 pe = _ints(a.get("pads_end", "0,0"))
@@ -303,12 +316,25 @@ class OpenVINONet:
                     padding=tuple(zip(pb, pe)), rhs_dilation=dil,
                     dimension_numbers=("NCHW", "OIHW", "NCHW"),
                     feature_group_count=groups)
-            if t == "MaxPool":
-                return _pool(ins[0], _ints(a["kernel"]),
-                             _ints(a.get("strides", "1,1")),
-                             _ints(a.get("pads_begin", "0,0")),
-                             _ints(a.get("pads_end", "0,0")), "max", True)
-            if t == "AvgPool":
+            if t in ("MaxPool", "AvgPool"):
+                ap = a.get("auto_pad", "explicit")
+                if ap not in ("explicit", "notset", "NOTSET"):
+                    raise NotImplementedError(
+                        f"{t} '{ly.name}': auto_pad={ap!r} is not "
+                        f"supported (re-export with explicit pads)")
+                if a.get("rounding_type", "floor") != "floor":
+                    # lax.reduce_window floors the output extent; a ceil
+                    # pool would silently compute different windows
+                    raise NotImplementedError(
+                        f"{t} '{ly.name}': rounding_type="
+                        f"{a['rounding_type']!r} is not supported (only "
+                        f"floor)")
+                if t == "MaxPool":
+                    return _pool(ins[0], _ints(a["kernel"]),
+                                 _ints(a.get("strides", "1,1")),
+                                 _ints(a.get("pads_begin", "0,0")),
+                                 _ints(a.get("pads_end", "0,0")), "max",
+                                 True)
                 return _pool(ins[0], _ints(a["kernel"]),
                              _ints(a.get("strides", "1,1")),
                              _ints(a.get("pads_begin", "0,0")),
@@ -328,8 +354,10 @@ class OpenVINONet:
                               for i, v in enumerate(target)]
                 return jnp.reshape(ins[0], target)
             if t == "Squeeze":
-                axes = tuple(int(v) for v in
-                             np.ravel(static_in(ly.id, 1)))
+                ax_arr = static_in(ly.id, 1)
+                if ax_arr is None:      # optional input: drop ALL 1-dims
+                    return jnp.squeeze(ins[0])
+                axes = tuple(int(v) for v in np.ravel(ax_arr))
                 return jnp.squeeze(ins[0], axis=axes)
             if t == "Unsqueeze":
                 axes = sorted(int(v) for v in

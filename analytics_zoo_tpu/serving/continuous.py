@@ -49,6 +49,7 @@ logger = logging.getLogger("analytics_zoo_tpu")
 class _Slot:
     uri: str
     plen: int
+    max_new: int
     tokens: List[int] = field(default_factory=list)
     on_done: Optional[Callable] = None
     temperature: float = 0.0
@@ -95,8 +96,11 @@ class ContinuousEngine:
         S = int(max_slots)
         L = self.max_prompt_width + self.max_new_tokens
         self._S, self._L = S, L
-        H = model.num_heads
-        D = model.hidden_size // H
+        # GQA models store only kv_heads in the cache: the arena shrinks
+        # num_heads/kv_heads-fold, which is more co-resident requests
+        # for the same HBM
+        H = getattr(model, "kv_heads", model.num_heads)
+        D = model.hidden_size // model.num_heads
         cdtype = jnp.dtype(model.dtype)
         self._ck = jnp.zeros((model.num_layers, S, L, H, D), cdtype)
         self._cv = jnp.zeros_like(self._ck)
@@ -200,12 +204,16 @@ class ContinuousEngine:
     def submit(self, uri: str, prompt: np.ndarray,
                on_done: Optional[Callable] = None, *,
                temperature: float = 0.0,
-               rng_seed: Optional[int] = None) -> None:
+               rng_seed: Optional[int] = None,
+               max_new: Optional[int] = None) -> None:
         """Queue one request.  ``prompt``: 1-D int32 token array.
         ``on_done(uri, tokens)`` fires from the pump thread when the
-        request finishes (tokens: ``[max_new_tokens]`` int32, eos-padded
-        frozen tail).  Raises on bounds violations — the serving layer
-        error-publishes per request before calling this."""
+        request finishes (tokens: ``[max_new]`` int32, eos-padded frozen
+        tail).  ``max_new`` (default: the engine budget) caps THIS
+        request's tokens — slot-level budgets are a capability the
+        whole-batch path structurally lacks (its one scan runs every
+        row to the same length).  Raises on bounds violations — the
+        serving layer error-publishes per request before calling this."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be 1-D, got {prompt.shape}")
@@ -215,9 +223,13 @@ class ContinuousEngine:
                 f"prompt length {n} outside [1, {self.max_prompt_width}]")
         if temperature > 0.0 and rng_seed is None:
             raise ValueError("temperature > 0 needs rng_seed")
+        mn = self.max_new_tokens if max_new is None else int(max_new)
+        if not 1 <= mn <= self.max_new_tokens:
+            raise ValueError(
+                f"max_new {mn} outside [1, {self.max_new_tokens}]")
         with self._lock:
             self._waiting.append(
-                (uri, prompt, on_done, float(temperature), rng_seed))
+                (uri, prompt, on_done, float(temperature), rng_seed, mn))
 
     # ---- pump ---------------------------------------------------------
 
@@ -243,12 +255,12 @@ class ContinuousEngine:
                 kb = 1 << (k - 1).bit_length()      # pad rows to pow2
                 padded = np.full((kb, pb), self.pad_id, np.int32)
                 plens = np.ones(kb, np.int32)       # dummy rows: len 1
-                for i, (_, prompt, _, _, _) in enumerate(reqs):
-                    padded[i, :len(prompt)] = prompt
-                    plens[i] = len(prompt)
+                for i, req in enumerate(reqs):
+                    padded[i, :len(req[1])] = req[1]
+                    plens[i] = len(req[1])
                 last_logits, ks, vs = self._prefill(jnp.asarray(padded),
                                                     jnp.asarray(plens))
-                for i, (uri, prompt, on_done, temp, seed) in \
+                for i, (uri, prompt, on_done, temp, seed, mn) in \
                         enumerate(reqs):
                     slot = self._free.popleft()
                     self._ck, self._cv = self._insert(
@@ -258,7 +270,7 @@ class ContinuousEngine:
                     first = self._pick_first(last_logits[i], plen, temp,
                                              seed)
                     self._slots[slot] = _Slot(
-                        uri=uri, plen=plen, on_done=on_done,
+                        uri=uri, plen=plen, max_new=mn, on_done=on_done,
                         temperature=temp, rng_seed=seed)
                     self._tok[slot] = first
                     self._pos[slot] = plen
@@ -282,15 +294,16 @@ class ContinuousEngine:
         """Append one generated token; finish + free the slot when done."""
         st = self._slots[slot]
         st.tokens.append(token)
-        done = len(st.tokens) >= self.max_new_tokens or \
+        done = len(st.tokens) >= st.max_new or \
             (self.eos_id is not None and token == self.eos_id)
         if not done:
             return
-        out = np.full(self.max_new_tokens,
+        out = np.full(st.max_new,
                       self.eos_id if self.eos_id is not None else 0,
                       np.int32)
         out[:len(st.tokens)] = st.tokens      # frozen tail: eos padding
         self._slots[slot] = None
+        self._done[slot] = True     # terminal state until readmission
         self._free.append(slot)
         if st.on_done is not None:
             try:
@@ -321,7 +334,7 @@ class ContinuousEngine:
             seeds[i] = self._slots[i].rng_seed or 0
         n_eff = max(1, min(
             self.ticks_per_step,
-            min(self.max_new_tokens - len(self._slots[i].tokens)
+            min(self._slots[i].max_new - len(self._slots[i].tokens)
                 for i in active)))
         step = self._get_step(n_eff, sampled)
         toks, tok, pos, done, self._ck, self._cv = step(

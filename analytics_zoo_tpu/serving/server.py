@@ -388,10 +388,24 @@ class ClusterServing:
                     try:
                         uri = r["uri"].decode()
                         prompt = self._decode_value(r[pcol])
+                        # optional per-request generation controls (a
+                        # capability the whole-batch path cannot offer:
+                        # its one scan runs every row identically)
+                        kw = {}
+                        if "max_new" in r:
+                            kw["max_new"] = int(np.asarray(
+                                self._decode_value(r["max_new"])))
+                        if "temperature" in r:
+                            kw["temperature"] = float(np.asarray(
+                                self._decode_value(r["temperature"])))
+                        if "seed" in r:
+                            kw["rng_seed"] = int(np.asarray(
+                                self._decode_value(r["seed"])))
                         engine.submit(
                             uri, prompt,
                             on_done=(lambda u, toks, _eid=eid, _t0=t0:
-                                     publish(u, toks, _eid, _t0)))
+                                     publish(u, toks, _eid, _t0)),
+                            **kw)
                     except Exception as e:
                         self._publish_error(r, f"submit failed: {e!r}")
                         self._finish_entries(client, [eid])

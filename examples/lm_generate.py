@@ -77,6 +77,24 @@ def main():
     ragged[1, :4] = (10 + 3 * np.arange(4)) % vocab   # shorter prompt
     out = im.predict(ragged, np.asarray([6, 4], np.int32))
     print(f"served : {out.tolist()}")
+
+    # continuous batching: requests join the RUNNING decode arena
+    # in-flight (no convoying behind the longest co-batched generation),
+    # each with its own token budget / sampling controls
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, InputQueue, OutputQueue, ServingConfig)
+
+    cfg = ServingConfig(prompt_col="prompt", continuous_batching=True,
+                        engine_slots=4, engine_ticks=4)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    iq, oq = InputQueue(port=srv.port), OutputQueue(port=srv.port)
+    iq.enqueue("greedy", prompt=prompt[0])
+    iq.enqueue("short", prompt=ragged[1, :4], max_new=np.int32(3))
+    iq.enqueue("sampled", prompt=prompt[0],
+               temperature=np.float32(0.8), seed=np.int32(7))
+    for uri in ("greedy", "short", "sampled"):
+        print(f"cb[{uri}]: {np.asarray(oq.query(uri, timeout=120)).tolist()}")
+    srv.stop()
     zoo.stop_orca_context()
 
 

@@ -275,3 +275,60 @@ def test_engine_multi_tick_sampling_reproducible(lm):
     np.testing.assert_array_equal(a["g"], c["g"])
     d = run(4, 99)
     assert not np.array_equal(a["s"], d["s"])           # seed matters
+
+
+def test_engine_per_request_max_new(lm):
+    """Per-slot token budgets: each request equals its own solo
+    generate() at ITS length, and shorter-budget requests finish + free
+    their slot earlier."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=8,
+                           max_slots=2, prompt_buckets=(8,),
+                           ticks_per_step=3)
+    rng = np.random.default_rng(8)
+    specs = {"short": 2, "mid": 5, "full": 8}
+    prompts = {k: rng.integers(1, 32, 4).astype(np.int32) for k in specs}
+    results, order = {}, []
+    for k, p in prompts.items():
+        eng.submit(k, p, max_new=specs[k],
+                   on_done=lambda u, t: (results.__setitem__(u, t),
+                                         order.append(u)))
+    eng.drain()
+    for k, p in prompts.items():
+        solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                                   specs[k]))[0]
+        assert results[k].shape == (specs[k],)
+        np.testing.assert_array_equal(results[k], solo, err_msg=k)
+    assert order[0] == "short"          # budget frees the slot early
+    with pytest.raises(ValueError, match="max_new"):
+        eng.submit("bad", prompts["short"], max_new=9)
+
+
+def test_serving_per_request_controls(lm):
+    """Queue protocol: max_new / temperature / seed ride as optional
+    request fields through continuous serving."""
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           OutputQueue, ServingConfig)
+
+    model, variables = lm
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=8, prompt_buckets=(8,))
+    cfg = ServingConfig(prompt_col="prompt", continuous_batching=True,
+                        engine_slots=2)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        p = np.asarray([5, 9, 11], np.int32)
+        iq.enqueue("short", prompt=p, max_new=np.int32(3))
+        iq.enqueue("sampled", prompt=p, temperature=np.float32(1.5),
+                   seed=np.int32(42), max_new=np.int32(4))
+        got = np.asarray(oq.query("short", timeout=60))
+        solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                                   3))[0]
+        np.testing.assert_array_equal(got, solo)
+        samp = np.asarray(oq.query("sampled", timeout=60))
+        assert samp.shape == (4,)
+    finally:
+        srv.stop()

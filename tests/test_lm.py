@@ -491,3 +491,55 @@ def test_pp_lm_1f1b_schedule_matches_gpipe():
             stop_orca_context()
 
     np.testing.assert_allclose(run("1f1b"), run("gpipe"), rtol=2e-4)
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_decode_matches_forward(kv_heads):
+    """GQA/MQA cache correctness: the grouped cached decode reproduces
+    the (KV-broadcast) full causal forward at every position, with the
+    cache holding only kv_heads heads."""
+    model = _tiny_lm(num_heads=4, num_kv_heads=kv_heads)
+    toks = _toks(b=2, t=10)
+    variables = model.init(jax.random.key(0), toks)
+    ref = model.apply(variables, toks)
+
+    B, T = toks.shape
+    D = model.hidden_size // model.num_heads
+    assert model.kv_heads == kv_heads
+    ck = jnp.zeros((model.num_layers, B, T, kv_heads, D), jnp.float32)
+    cv = jnp.zeros_like(ck)
+    outs = []
+    for t in range(T):
+        logits, ck, cv = model.apply(
+            variables, toks[:, t], ck, cv, jnp.int32(t),
+            method=TransformerLM.decode_step)
+        outs.append(logits)
+    np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
+                               np.asarray(ref), rtol=2e-4, atol=2e-4)
+    # K/V projections really are narrow (the cache-size win is real)
+    k_kernel = variables["params"]["layer_0"]["attention"]["key"][
+        "kernel"]
+    assert k_kernel.shape[-2] == kv_heads
+
+
+def test_gqa_generate_beam_and_engine_parity():
+    """The whole decoding stack works on a GQA model: generate,
+    beam_search, and the continuous engine agree with each other and
+    allocate kv_heads-sized caches."""
+    from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+
+    model = _tiny_lm(num_heads=4, num_kv_heads=2, vocab_size=24)
+    prompt = np.asarray([[5, 9, 2, 7]], np.int32)
+    variables = model.init(jax.random.key(1), jnp.asarray(prompt))
+    g = np.asarray(generate(model, variables, jnp.asarray(prompt), 6))
+    beams, _ = beam_search(model, variables, jnp.asarray(prompt), 6,
+                           beam_size=1)
+    np.testing.assert_array_equal(np.asarray(beams[:, 0]), g)
+
+    eng = ContinuousEngine(model, variables, max_new_tokens=6,
+                           max_slots=2, prompt_buckets=(8,))
+    assert eng._ck.shape[3] == 2        # arena stores KV heads only
+    results = {}
+    eng.submit("q", prompt[0], on_done=lambda u, t: results.update({u: t}))
+    eng.drain()
+    np.testing.assert_array_equal(results["q"], g[0])
