@@ -242,6 +242,31 @@ def unstack_pp_params(params):
     return out
 
 
+def _apply_rope(x, pos, base: float):
+    """Rotary position embedding (rotate-half convention).
+
+    x: [..., T, H, D] (D even); pos: positions broadcastable against the
+    T axis — ``arange(T)`` for the training forward, a scalar-as-[1] or
+    per-row [B] vector for cached decode.  K is stored POST-rotation in
+    the KV cache (absolute rotation per position; the relative-offset
+    property emerges in the q.k dot product), so decode and forward see
+    identical keys."""
+    D = x.shape[-1]
+    if D % 2:
+        raise ValueError(
+            f"rotary positions need an even head dim, got {D} "
+            f"(hidden_size must be divisible by 2*num_heads)")
+    half = D // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) * 2.0 / D)
+    ang = pos[..., None].astype(jnp.float32) * freqs    # [..., T, half]
+    cos = jnp.cos(ang)[..., None, :]                    # [..., T, 1, half]
+    sin = jnp.sin(ang)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 class DecoderAttention(nn.Module):
     """Causal self-attention with a training path and a cached decode path
     sharing the same projections (setup-style module).
@@ -261,6 +286,11 @@ class DecoderAttention(nn.Module):
     mesh: Optional[Mesh] = None
     use_flash: Optional[bool] = None
     sp_strategy: str = "ring"
+    # "learned": the LM adds position embeddings before the trunk;
+    # "rope": q/k rotate here (applied pre-dispatch on global positions,
+    # so flash/ring/GQA paths run unchanged)
+    pos_encoding: str = "learned"
+    rope_base: float = 10000.0
 
     def setup(self):
         H = self.num_heads
@@ -290,6 +320,10 @@ class DecoderAttention(nn.Module):
         ``return_kv=True`` also returns this layer's K/V projections
         ``[B, T, KV_H, D]`` (KV-arena prefill for continuous batching)."""
         q, k, v = self.query(x), self.key(x), self.value(x)
+        if self.pos_encoding == "rope":
+            t_pos = jnp.arange(x.shape[1])
+            q = _apply_rope(q, t_pos, self.rope_base)
+            k = _apply_rope(k, t_pos, self.rope_base)
         o = attention_dispatch(q, self._expand_kv(k), self._expand_kv(v),
                                None, causal=True, mesh=self.mesh,
                                use_flash=self.use_flash,
@@ -314,6 +348,13 @@ class DecoderAttention(nn.Module):
         q = self.query(x1)                              # [B, 1, H, D]
         k1 = self.key(x1)                               # [B, 1, KH, D]
         v1 = self.value(x1)
+        if self.pos_encoding == "rope":
+            # rotate at the CURRENT position; the cache already holds
+            # post-rotation keys for earlier positions
+            p = (jnp.reshape(pos, (1,)) if jnp.ndim(pos) == 0
+                 else pos[:, None])
+            q = _apply_rope(q, p, self.rope_base)
+            k1 = _apply_rope(k1, p, self.rope_base)
         if jnp.ndim(pos) == 0:
             cache_k = lax.dynamic_update_slice(
                 cache_k, k1.astype(cache_k.dtype), (0, pos, 0, 0))
@@ -359,6 +400,8 @@ class DecoderLayer(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     num_kv_heads: Optional[int] = None
+    pos_encoding: str = "learned"
+    rope_base: float = 10000.0
 
     def setup(self):
         self.ln_attn = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")
@@ -366,7 +409,9 @@ class DecoderLayer(nn.Module):
             self.hidden_size, self.num_heads,
             num_kv_heads=self.num_kv_heads, dtype=self.dtype,
             mesh=self.mesh, use_flash=self.use_flash,
-            sp_strategy=self.sp_strategy, name="attention")
+            sp_strategy=self.sp_strategy,
+            pos_encoding=self.pos_encoding, rope_base=self.rope_base,
+            name="attention")
         self.ln_ffn = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")
         if self.num_experts > 0:
             from analytics_zoo_tpu.models.moe import MoEMLP
@@ -435,6 +480,8 @@ class _LMStage(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     use_flash: Optional[bool] = None
     num_kv_heads: Optional[int] = None
+    pos_encoding: str = "learned"
+    rope_base: float = 10000.0
 
     @nn.compact
     def __call__(self, x):
@@ -446,6 +493,8 @@ class _LMStage(nn.Module):
                              dtype=self.dtype, mesh=None,
                              use_flash=self.use_flash,
                              num_kv_heads=self.num_kv_heads,
+                             pos_encoding=self.pos_encoding,
+                             rope_base=self.rope_base,
                              name=f"layer_{i}")(x, False)
         return x
 
@@ -496,6 +545,10 @@ class TransformerLM(nn.Module):
     # unchanged (K/V broadcast up); the DECODE KV cache shrinks
     # num_heads/num_kv_heads-fold — allocate caches with `.kv_heads`.
     num_kv_heads: Optional[int] = None
+    # "learned" (ref-style absolute table) | "rope" (rotary q/k — no
+    # position table; max_position still bounds sequence/cache length)
+    pos_encoding: str = "learned"
+    rope_base: float = 10000.0
 
     @property
     def kv_heads(self) -> int:
@@ -504,10 +557,17 @@ class TransformerLM(nn.Module):
         return self.num_kv_heads or self.num_heads
 
     def setup(self):
+        if self.pos_encoding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_encoding must be 'learned' or 'rope', got "
+                f"{self.pos_encoding!r}")
         self.embed = nn.Embed(self.vocab_size, self.hidden_size,
                               name="embed")
-        self.pos_embed = nn.Embed(self.max_position, self.hidden_size,
-                                  name="pos_embed")
+        # rope rotates q/k inside attention: no absolute position table
+        self.pos_embed = (
+            nn.Embed(self.max_position, self.hidden_size,
+                     name="pos_embed")
+            if self.pos_encoding == "learned" else None)
         self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
         if self.pp_stages > 0:
             from analytics_zoo_tpu.parallel.pipeline import GPipe
@@ -534,7 +594,9 @@ class TransformerLM(nn.Module):
                                self.hidden_size, self.num_heads,
                                self.intermediate_size, dtype=self.dtype,
                                use_flash=self.use_flash,
-                               num_kv_heads=self.num_kv_heads),
+                               num_kv_heads=self.num_kv_heads,
+                               pos_encoding=self.pos_encoding,
+                               rope_base=self.rope_base),
                 n_stages=self.pp_stages,
                 n_microbatches=self.pp_microbatches,
                 schedule=self.pp_schedule,
@@ -559,6 +621,8 @@ class TransformerLM(nn.Module):
                       moe_top_k=self.moe_top_k,
                       moe_capacity_factor=self.moe_capacity_factor,
                       num_kv_heads=self.num_kv_heads,
+                      pos_encoding=self.pos_encoding,
+                      rope_base=self.rope_base,
                       name=f"layer_{i}")
             for i in range(self.num_layers)]
 
@@ -574,7 +638,9 @@ class TransformerLM(nn.Module):
                 f"sequence length {T} exceeds max_position "
                 f"{self.max_position} (out-of-range position lookups "
                 "would silently return NaN/clamped rows)")
-        x = self.embed(tokens) + self.pos_embed(jnp.arange(T)[None])
+        x = self.embed(tokens)
+        if self.pos_embed is not None:
+            x = x + self.pos_embed(jnp.arange(T)[None])
         x = _constrain_seq(x.astype(self.dtype), self.mesh)
         if self.pp_stages > 0:
             x = self.trunk(x)
@@ -594,9 +660,11 @@ class TransformerLM(nn.Module):
                 "cached decode is not pipelined; convert the params with "
                 "models.lm.unstack_pp_params and generate on a "
                 "pp_stages=0 TransformerLM of the same dimensions")
-        pe = (self.pos_embed(pos)[None, None] if jnp.ndim(pos) == 0
-              else self.pos_embed(pos)[:, None])
-        x = self.embed(tok)[:, None] + pe
+        x = self.embed(tok)[:, None]
+        if self.pos_embed is not None:
+            x = x + (self.pos_embed(pos)[None, None]
+                     if jnp.ndim(pos) == 0
+                     else self.pos_embed(pos)[:, None])
         x = x.astype(self.dtype)
         ks, vs = [], []
         for i, layer in enumerate(self.layers):
@@ -620,7 +688,9 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"sequence length {T} exceeds max_position "
                 f"{self.max_position}")
-        x = self.embed(tokens) + self.pos_embed(jnp.arange(T)[None])
+        x = self.embed(tokens)
+        if self.pos_embed is not None:
+            x = x + self.pos_embed(jnp.arange(T)[None])
         x = _constrain_seq(x.astype(self.dtype), self.mesh)
         ks, vs = [], []
         for layer in self.layers:
