@@ -69,3 +69,69 @@ def test_moe_accum_pack_checkpoint_serve_chain(tmp_path, ctx8):
     # --- and training continues from the restored state -----------------
     hist2 = est2.fit(data, epochs=1, batch_size=32)
     assert np.isfinite(hist2[-1]["loss"])
+
+
+def test_rope_gqa_moe_lm_train_checkpoint_continuous_serve_chain(
+        tmp_path, ctx8):
+    """Round-4 capstone: a RoPE + GQA + MoE causal LM trained on a
+    dp x ep mesh, checkpointed, restored mesh-free, and served through
+    CONTINUOUS batching with per-request budgets — every request equal
+    to its solo generate() on the restored weights."""
+    from analytics_zoo_tpu.models import (LM_MOE_PARTITION_RULES,
+                                          generate, lm_loss)
+    from analytics_zoo_tpu.models.lm import TransformerLM
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           OutputQueue, ServingConfig)
+
+    def build(mesh):
+        return TransformerLM(
+            vocab_size=32, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, pos_encoding="rope", intermediate_size=64,
+            max_position=64, dtype=jnp.float32, mesh=mesh,
+            moe_experts=4, moe_every=2, moe_capacity_factor=2.0)
+
+    rng = np.random.default_rng(0)
+    sym = rng.integers(2, 32, 256).astype(np.int32)
+    toks = np.repeat(sym[:, None], 10, axis=1)
+
+    mesh = make_mesh(axes={"dp": 4, "ep": 2})
+    est = Estimator.from_flax(
+        model=build(mesh), loss=lm_loss, optimizer=optax.adam(3e-3),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_MOE_PARTITION_RULES, mesh=mesh)
+    hist = est.fit({"tokens": toks}, epochs=6, batch_size=64)
+    assert hist[-1]["loss"] < hist[0]["loss"] * 0.5
+    assert hist[-1]["aux_loss"] > 0
+    est.save_checkpoint(str(tmp_path / "lmck"))
+
+    # restore mesh-free (serving shape) and check decode quality
+    mesh2 = make_mesh(axes={"dp": 8})
+    est2 = Estimator.from_flax(
+        model=build(None), loss=lm_loss, optimizer=optax.adam(3e-3),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=DP_RULES, mesh=mesh2)
+    est2._ensure_state({"tokens": toks})
+    est2.load_checkpoint(str(tmp_path / "lmck"))
+    model = build(None)
+    params = {"params": jax.device_get(est2.state.params)}
+    prompt = np.asarray([[7, 7], [9, 9]], np.int32)
+    solo = np.asarray(generate(model, params, jnp.asarray(prompt), 5))
+    assert (solo[0] == 7).all() and (solo[1] == 9).all(), solo
+
+    # continuous serving over the restored weights (CF=2.0 => decode
+    # logits identical to forward even with skewed MoE routing)
+    im = InferenceModel().load_flax_generator(
+        model, params, max_new_tokens=5, prompt_buckets=(8,))
+    cfg = ServingConfig(prompt_col="prompt", continuous_batching=True,
+                        engine_slots=2, engine_ticks=2)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq, oq = InputQueue(port=srv.port), OutputQueue(port=srv.port)
+        iq.enqueue("a", prompt=prompt[0])
+        iq.enqueue("b", prompt=prompt[1], max_new=np.int32(3))
+        np.testing.assert_array_equal(
+            np.asarray(oq.query("a", timeout=60)), solo[0])
+        np.testing.assert_array_equal(
+            np.asarray(oq.query("b", timeout=60)), solo[1][:3])
+    finally:
+        srv.stop()

@@ -148,13 +148,16 @@ def test_changing_cols_invalidates_jit(ctx8):
     assert acc_b != acc_a  # all-zero features can't match trained accuracy
 
 
-def test_from_openvino_refuses_with_migration_path():
-    """ref-parity entry point: the OpenVINO IR runtime cannot exist here;
-    the refusal must name the native routes (TFNet/torch + int8 quant)."""
+def test_from_openvino_requires_model_path():
+    """ref-parity entry point: from_openvino now LOADS IRs directly
+    (net/openvino_ir.py, tests/test_openvino.py covers the real paths);
+    calling without a model path still fails loudly."""
     from analytics_zoo_tpu.learn import Estimator
 
-    with pytest.raises(NotImplementedError, match="quantize='int8'"):
-        Estimator.from_openvino(model_path="model.xml")
+    with pytest.raises(ValueError, match="model_path"):
+        Estimator.from_openvino()
+    with pytest.raises(FileNotFoundError):
+        Estimator.from_openvino(model_path="/no/such/model.xml")
 
 
 def test_early_stopping_callback(ctx8):
