@@ -35,6 +35,24 @@ def _next_bucket(n: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
+def filter_prompt_buckets(prompt_buckets: Sequence[int],
+                          max_position: int,
+                          max_new_tokens: int) -> Tuple[int, ...]:
+    """Prompt buckets usable by a generator: a bucket only counts if the
+    padded prompt + generation still fits the model's position table.
+    Shared by load_flax_generator and ContinuousEngine so the two entry
+    paths can never disagree about which prompts are servable."""
+    limit = int(max_position) - int(max_new_tokens)
+    out = tuple(b for b in sorted(set(int(b) for b in prompt_buckets))
+                if b <= limit)
+    if not out:
+        raise ValueError(
+            f"no prompt bucket fits: max_position {max_position} - "
+            f"max_new_tokens {max_new_tokens} = {limit} < smallest "
+            f"bucket {min(prompt_buckets)}")
+    return out
+
+
 class InferenceModel:
     """ref-parity methods: load / predict / (doLoadTF etc. collapse to
     ``load``).
@@ -153,13 +171,9 @@ class InferenceModel:
         # fits the model's position table — otherwise a prompt that
         # genuinely fits would fail generate()'s length check after
         # bucket padding
-        limit = int(model.max_position) - int(max_new_tokens)
-        pbuckets = tuple(b for b in sorted(prompt_buckets) if b <= limit)
-        if not pbuckets:
-            raise ValueError(
-                f"no prompt bucket fits: max_position "
-                f"{model.max_position} - max_new_tokens {max_new_tokens} "
-                f"= {limit} < smallest bucket {min(prompt_buckets)}")
+        pbuckets = filter_prompt_buckets(prompt_buckets,
+                                         model.max_position,
+                                         max_new_tokens)
         # serving batcher reads these to bounds-check ragged prompts
         # per-request and to cross-check its own pad id against the
         # generator's (a mismatch would silently miscount prompt lengths)

@@ -360,8 +360,13 @@ class OpenVINONet:
                 axes = tuple(int(v) for v in np.ravel(ax_arr))
                 return jnp.squeeze(ins[0], axis=axes)
             if t == "Unsqueeze":
-                axes = sorted(int(v) for v in
-                              np.ravel(static_in(ly.id, 1)))
+                raw = [int(v) for v in np.ravel(static_in(ly.id, 1))]
+                # axes are OUTPUT-rank positions and may be negative:
+                # normalise against the output rank BEFORE sorting, or
+                # mixed/negative axes land in the wrong positions
+                out_rank = jnp.ndim(ins[0]) + len(raw)
+                axes = sorted(ax if ax >= 0 else ax + out_rank
+                              for ax in raw)
                 out = ins[0]
                 for ax in axes:
                     out = jnp.expand_dims(out, ax)

@@ -47,6 +47,20 @@ from analytics_zoo_tpu.parallel.partition import PartitionRules
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
+def _check_stacked(stacked_params, S: int) -> None:
+    """Each rank consumes exactly one stage of the stacked params; a
+    stack whose leading dim differs from the pp axis size would silently
+    drop (or wrap) stages after sharding.  Shared by every pipelined
+    entry point so validation can never drift between them."""
+    shapes = [jnp.shape(leaf) for leaf in jax.tree.leaves(stacked_params)]
+    bad = {s[0] if s else None for s in shapes} - {S}
+    if bad:
+        raise ValueError(
+            f"stacked_params leading dim(s) {sorted(bad, key=str)} != pp "
+            f"axis size {S}; every leaf must stack exactly one slice per "
+            f"pp rank")
+
+
 def sequential_apply(stage_fn: StageFn, stacked_params: Any,
                      x: jax.Array) -> jax.Array:
     """Reference semantics: apply the S stacked stages in order (what the
@@ -77,16 +91,7 @@ def pipeline_apply(stage_fn: StageFn, stacked_params: Any, x: jax.Array,
     S = int(mesh.shape[pp_axis]) if pp_axis in mesh.axis_names else 1
     if S == 1:
         return sequential_apply(stage_fn, stacked_params, x)
-    # Each rank consumes exactly one stage of the stacked params; a stack
-    # whose leading dim differs from the pp axis size would silently drop
-    # (or wrap) stages after sharding.
-    shapes = [jnp.shape(leaf) for leaf in jax.tree.leaves(stacked_params)]
-    bad = {s[0] if s else None for s in shapes} - {S}
-    if bad:
-        raise ValueError(
-            f"stacked_params leading dim(s) {sorted(bad, key=str)} != pp "
-            f"axis size {S}; every leaf must stack exactly one slice per "
-            f"pp rank")
+    _check_stacked(stacked_params, S)
     M = int(n_microbatches)
     batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     xspec = P(batch, *([None] * (x.ndim - 1)))
@@ -309,12 +314,7 @@ def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
         loss, (gp, gx) = jax.value_and_grad(seq_loss, argnums=(0, 1))(
             stacked_params, x)
         return loss, gp, gx
-    bad = {jnp.shape(leaf)[0] if jnp.shape(leaf) else None
-           for leaf in jax.tree.leaves(stacked_params)} - {S}
-    if bad:
-        raise ValueError(
-            f"stacked_params leading dim(s) {sorted(bad, key=str)} != pp "
-            f"axis size {S}")
+    _check_stacked(stacked_params, S)
     M = int(n_microbatches)
     batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     xspec = P(batch, *([None] * (x.ndim - 1)))

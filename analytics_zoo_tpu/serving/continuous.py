@@ -39,7 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from analytics_zoo_tpu.learn.inference_model import _next_bucket
+from analytics_zoo_tpu.learn.inference_model import (
+    _next_bucket, filter_prompt_buckets)
 from analytics_zoo_tpu.models.lm import TransformerLM
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -83,15 +84,8 @@ class ContinuousEngine:
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
-        limit = int(model.max_position) - self.max_new_tokens
-        self.prompt_buckets = tuple(
-            b for b in sorted(set(int(b) for b in prompt_buckets))
-            if b <= limit)
-        if not self.prompt_buckets:
-            raise ValueError(
-                f"no prompt bucket fits: max_position {model.max_position}"
-                f" - max_new_tokens {max_new_tokens} = {limit} < smallest "
-                f"bucket {min(prompt_buckets)}")
+        self.prompt_buckets = filter_prompt_buckets(
+            prompt_buckets, model.max_position, max_new_tokens)
         self.max_prompt_width = self.prompt_buckets[-1]
         S = int(max_slots)
         L = self.max_prompt_width + self.max_new_tokens
@@ -223,6 +217,10 @@ class ContinuousEngine:
                 f"prompt length {n} outside [1, {self.max_prompt_width}]")
         if temperature > 0.0 and rng_seed is None:
             raise ValueError("temperature > 0 needs rng_seed")
+        if rng_seed is not None:
+            # mask into uint32 range: an out-of-range client seed must
+            # not crash the pump thread at the np.uint32 staging array
+            rng_seed = int(rng_seed) & 0xFFFFFFFF
         mn = self.max_new_tokens if max_new is None else int(max_new)
         if not 1 <= mn <= self.max_new_tokens:
             raise ValueError(

@@ -354,12 +354,25 @@ class ClusterServing:
         engine = self.engine
         pcol = self.config.prompt_col or "prompt"
 
-        def publish(uri: str, toks: np.ndarray, eid: bytes, t0: float):
-            client.pipeline([
-                ("HSET", RESULT_PREFIX + uri, "value",
-                 encode_ndarray(toks)),
-                ("XADD", SIGNAL_PREFIX + uri, "*", "ok", "1"),
-                ("SADD", "__result_keys__", uri)])
+        def publish(uri: str, toks: np.ndarray, eid: bytes, t0: float,
+                    req):
+            try:
+                client.pipeline([
+                    ("HSET", RESULT_PREFIX + uri, "value",
+                     encode_ndarray(toks)),
+                    ("XADD", SIGNAL_PREFIX + uri, "*", "ok", "1"),
+                    ("SADD", "__result_keys__", uri)])
+            except Exception as e:
+                # the slot is already freed: a swallowed publish failure
+                # would be a silent vanish (client blocks to timeout).
+                # Fall back to an error result on the OTHER connection so
+                # the client fails fast; finish the entry either way.
+                logger.exception("continuous publish failed for %r", uri)
+                try:
+                    self._publish_error(req, f"publish failed: {e!r}")
+                except Exception:
+                    logger.exception("error-publish also failed for %r",
+                                     uri)
             self._finish_entries(client, [eid])
             dt = (time.perf_counter() - t0) * 1000
             with self._stats_lock:
@@ -403,13 +416,23 @@ class ClusterServing:
                                 self._decode_value(r["seed"])))
                         engine.submit(
                             uri, prompt,
-                            on_done=(lambda u, toks, _eid=eid, _t0=t0:
-                                     publish(u, toks, _eid, _t0)),
+                            on_done=(lambda u, toks, _eid=eid, _t0=t0,
+                                     _r=r: publish(u, toks, _eid, _t0,
+                                                   _r)),
                             **kw)
                     except Exception as e:
                         self._publish_error(r, f"submit failed: {e!r}")
                         self._finish_entries(client, [eid])
-                engine.step()
+                try:
+                    engine.step()
+                except Exception:
+                    # a device/engine error must not silently kill the
+                    # sole pump thread — every queued client would hang
+                    # to timeout with no log.  Log, breathe, keep
+                    # serving (admission of new work may still succeed;
+                    # a persistent fault keeps logging loudly).
+                    logger.exception("continuous engine step failed")
+                    time.sleep(0.2)
         finally:
             client.close()
 

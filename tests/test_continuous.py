@@ -332,3 +332,27 @@ def test_serving_per_request_controls(lm):
         assert samp.shape == (4,)
     finally:
         srv.stop()
+
+
+def test_engine_out_of_range_seed_does_not_crash(lm):
+    """A client seed outside uint32 (negative or huge) must not crash
+    the pump at the staging array — it masks into range and still
+    reproduces deterministically for the same masked value."""
+    model, variables = lm
+    p = np.asarray([5, 9, 11], np.int32)
+
+    def run(seed):
+        eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                               max_slots=1, prompt_buckets=(8,))
+        results = {}
+        eng.submit("s", p, temperature=1.2, rng_seed=seed,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        eng.drain()
+        return results["s"]
+
+    a = run(-1)
+    b = run(0xFFFFFFFF)         # -1 & 0xFFFFFFFF == 0xFFFFFFFF
+    np.testing.assert_array_equal(a, b)
+    c = run(2 ** 35 + 17)       # masks to 17
+    d = run(17)
+    np.testing.assert_array_equal(c, d)
