@@ -222,7 +222,8 @@ class InferenceModel:
 
     def make_continuous_engine(self, max_slots: int = 8,
                                eos_id: Optional[int] = None,
-                               ticks_per_step: int = 1):
+                               ticks_per_step: int = 1,
+                               cache_dtype=None):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
         loaded via ``load_flax_generator`` (quantized weights dequantize
         once at build — the engine trades the at-rest memory win for
@@ -241,7 +242,7 @@ class InferenceModel:
             max_slots=max_slots,
             prompt_buckets=self._gen_prompt_buckets,
             eos_id=eos_id, pad_id=self.prompt_pad_id,
-            ticks_per_step=ticks_per_step)
+            ticks_per_step=ticks_per_step, cache_dtype=cache_dtype)
 
     def load_openvino(self, xml_path: str, bin_path: str = None,
                       quantize: Optional[str] = None) -> "InferenceModel":

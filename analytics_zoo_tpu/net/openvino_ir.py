@@ -22,8 +22,8 @@ reference's CV/recommendation zoos): Parameter, Const, Result,
 Convolution, GroupConvolution, MatMul, Add, Subtract, Multiply, Divide,
 Maximum, Minimum, ReLU, PReLU, Sigmoid, Tanh, Clamp, Gelu, Exp, Sqrt,
 Softmax, MaxPool, AvgPool, ReduceMean, Reshape, Squeeze, Unsqueeze,
-Transpose, Concat, BatchNormInference.  Anything else raises with the
-layer type named — a loud subset, not a silent wrong answer.
+Transpose, Concat, Gather, BatchNormInference.  Anything else raises
+with the layer type named — a loud subset, not a silent wrong answer.
 """
 
 from __future__ import annotations
@@ -49,7 +49,7 @@ _ELEMENT_TYPES = {
 # transpose permutation cannot exist under jit)
 _STATIC_INPUTS = {
     "Reshape": (1,), "Transpose": (1,), "Squeeze": (1,),
-    "Unsqueeze": (1,), "ReduceMean": (1,),
+    "Unsqueeze": (1,), "ReduceMean": (1,), "Gather": (2,),
 }
 
 
@@ -377,6 +377,18 @@ class OpenVINONet:
                 return jnp.transpose(ins[0], perm)
             if t == "Concat":
                 return jnp.concatenate(ins, axis=int(a.get("axis", 0)))
+            if t == "Gather":
+                # opset Gather: (data, indices, axis) — axis arrives as
+                # a Const third input; the embedding-lookup workhorse of
+                # recommendation IRs
+                if int(a.get("batch_dims", 0)) != 0:
+                    raise NotImplementedError(
+                        f"Gather '{ly.name}': batch_dims != 0 is not "
+                        f"supported")
+                axis = int(np.ravel(static_in(ly.id, 2,
+                                              np.zeros(1, np.int64)))[0])
+                return jnp.take(ins[0], ins[1].astype(jnp.int32),
+                                axis=axis)
             if t == "BatchNormInference":
                 x, gamma, beta, mean, var = ins
                 eps = float(a.get("epsilon", a.get("eps", 1e-5)))

@@ -53,6 +53,7 @@ class _Slot:
     max_new: int
     tokens: List[int] = field(default_factory=list)
     on_done: Optional[Callable] = None
+    on_error: Optional[Callable] = None
     temperature: float = 0.0
     rng_seed: Optional[int] = None
 
@@ -76,7 +77,8 @@ class ContinuousEngine:
                  max_new_tokens: int, max_slots: int = 8,
                  prompt_buckets: Sequence[int] = (16, 32, 64, 128),
                  eos_id: Optional[int] = None, pad_id: int = 0,
-                 ticks_per_step: int = 1):
+                 ticks_per_step: int = 1,
+                 cache_dtype=None):
         if model.pp_stages > 0:
             raise ValueError("continuous batching serves pp_stages=0 "
                              "models (models.lm.unstack_pp_params)")
@@ -92,10 +94,13 @@ class ContinuousEngine:
         self._S, self._L = S, L
         # GQA models store only kv_heads in the cache: the arena shrinks
         # num_heads/kv_heads-fold, which is more co-resident requests
-        # for the same HBM
+        # for the same HBM.  cache_dtype narrows it further (e.g.
+        # bfloat16 arena under an f32 model: 2x more slots; attention
+        # reads upcast via the einsums' f32 accumulation).
         H = getattr(model, "kv_heads", model.num_heads)
         D = model.hidden_size // model.num_heads
-        cdtype = jnp.dtype(model.dtype)
+        cdtype = jnp.dtype(cache_dtype) if cache_dtype is not None \
+            else jnp.dtype(model.dtype)
         self._ck = jnp.zeros((model.num_layers, S, L, H, D), cdtype)
         self._cv = jnp.zeros_like(self._ck)
         self._variables = variables
@@ -186,6 +191,29 @@ class ContinuousEngine:
 
     # ---- submission ---------------------------------------------------
 
+    def capacity_report(self) -> dict:
+        """Concrete arena economics (what GQA/cache_dtype actually buy):
+        bytes per slot, total arena bytes, and the multiplier vs a
+        full-head model-dtype arena of the same geometry."""
+        m = self.model
+        H_full = m.num_heads
+        H = self._ck.shape[3]
+        D = self._ck.shape[4]
+        per_slot = 2 * m.num_layers * self._L * H * D * \
+            self._ck.dtype.itemsize
+        full = 2 * m.num_layers * self._L * H_full * D * \
+            jnp.dtype(m.dtype).itemsize
+        return {
+            "slots": self._S,
+            "cache_len": self._L,
+            "kv_heads": H,
+            "cache_dtype": str(self._ck.dtype),
+            "bytes_per_slot": per_slot,
+            "arena_bytes": per_slot * self._S,
+            "capacity_multiplier_vs_mha_model_dtype":
+                round(full / per_slot, 2),
+        }
+
     @property
     def n_active(self) -> int:
         return self._S - len(self._free)
@@ -197,13 +225,16 @@ class ContinuousEngine:
 
     def submit(self, uri: str, prompt: np.ndarray,
                on_done: Optional[Callable] = None, *,
+               on_error: Optional[Callable] = None,
                temperature: float = 0.0,
                rng_seed: Optional[int] = None,
                max_new: Optional[int] = None) -> None:
         """Queue one request.  ``prompt``: 1-D int32 token array.
         ``on_done(uri, tokens)`` fires from the pump thread when the
         request finishes (tokens: ``[max_new]`` int32, eos-padded frozen
-        tail).  ``max_new`` (default: the engine budget) caps THIS
+        tail); ``on_error(uri, exc)`` fires if admission (prefill/
+        splice) fails after the request left the waiting queue — without
+        it a device error there would silently swallow the request.  ``max_new`` (default: the engine budget) caps THIS
         request's tokens — slot-level budgets are a capability the
         whole-batch path structurally lacks (its one scan runs every
         row to the same length).  Raises on bounds violations — the
@@ -227,7 +258,8 @@ class ContinuousEngine:
                 f"max_new {mn} outside [1, {self.max_new_tokens}]")
         with self._lock:
             self._waiting.append(
-                (uri, prompt, on_done, float(temperature), rng_seed, mn))
+                (uri, prompt, on_done, on_error, float(temperature),
+                 rng_seed, mn))
 
     # ---- pump ---------------------------------------------------------
 
@@ -249,33 +281,66 @@ class ContinuousEngine:
                 pb = _next_bucket(len(req[1]), self.prompt_buckets)
                 by_bucket.setdefault(pb, []).append(req)
             for pb, reqs in by_bucket.items():
-                k = len(reqs)
-                kb = 1 << (k - 1).bit_length()      # pad rows to pow2
-                padded = np.full((kb, pb), self.pad_id, np.int32)
-                plens = np.ones(kb, np.int32)       # dummy rows: len 1
+                # a failed prefill/splice must not swallow requests that
+                # already left the waiting queue: surface each one to
+                # its error callback and keep admitting other groups
+                try:
+                    k = len(reqs)
+                    kb = 1 << (k - 1).bit_length()  # pad rows to pow2
+                    padded = np.full((kb, pb), self.pad_id, np.int32)
+                    plens = np.ones(kb, np.int32)   # dummy rows: len 1
+                    for i, req in enumerate(reqs):
+                        padded[i, :len(req[1])] = req[1]
+                        plens[i] = len(req[1])
+                    pre = self._prefill(jnp.asarray(padded),
+                                        jnp.asarray(plens))
+                except Exception as e:
+                    logger.exception(
+                        "prefill failed for %d request(s), bucket %d",
+                        len(reqs), pb)
+                    for req in reqs:
+                        self._req_error(req[0], req[3], e)
+                    continue
                 for i, req in enumerate(reqs):
-                    padded[i, :len(req[1])] = req[1]
-                    plens[i] = len(req[1])
-                last_logits, ks, vs = self._prefill(jnp.asarray(padded),
-                                                    jnp.asarray(plens))
-                for i, (uri, prompt, on_done, temp, seed, mn) in \
-                        enumerate(reqs):
-                    slot = self._free.popleft()
-                    self._ck, self._cv = self._insert(
-                        self._ck, self._cv, ks[:, i:i + 1],
-                        vs[:, i:i + 1], jnp.int32(slot))
-                    plen = len(prompt)
-                    first = self._pick_first(last_logits[i], plen, temp,
-                                             seed)
-                    self._slots[slot] = _Slot(
-                        uri=uri, plen=plen, max_new=mn, on_done=on_done,
-                        temperature=temp, rng_seed=seed)
-                    self._tok[slot] = first
-                    self._pos[slot] = plen
-                    self._done[slot] = False
-                    admitted += 1
-                    self._record_token(slot, int(first))
+                    try:
+                        self._splice_one(pre, i, req)
+                        admitted += 1
+                    except Exception as e:
+                        logger.exception("splice failed for %r", req[0])
+                        self._req_error(req[0], req[3], e)
         return admitted
+
+    @staticmethod
+    def _req_error(uri, on_error, exc):
+        if on_error is None:
+            return
+        try:
+            on_error(uri, exc)
+        except Exception:
+            logger.exception("on_error callback failed for %r", uri)
+
+    def _splice_one(self, pre, i: int, req) -> None:
+        """Insert one prefetched joiner into a free slot; the slot goes
+        back to the free list if the splice fails."""
+        last_logits, ks, vs = pre
+        uri, prompt, on_done, on_error, temp, seed, mn = req
+        slot = self._free.popleft()
+        try:
+            self._ck, self._cv = self._insert(
+                self._ck, self._cv, ks[:, i:i + 1], vs[:, i:i + 1],
+                jnp.int32(slot))
+            plen = len(prompt)
+            first = self._pick_first(last_logits[i], plen, temp, seed)
+        except Exception:
+            self._free.append(slot)
+            raise
+        self._slots[slot] = _Slot(
+            uri=uri, plen=plen, max_new=mn, on_done=on_done,
+            on_error=on_error, temperature=temp, rng_seed=seed)
+        self._tok[slot] = first
+        self._pos[slot] = plen
+        self._done[slot] = False
+        self._record_token(slot, int(first))
 
     def _pick_first(self, last_logits, plen: int, temp: float,
                     seed) -> int:

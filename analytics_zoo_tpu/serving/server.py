@@ -75,6 +75,8 @@ class ServingConfig:
     # tokens decoded per device call: >1 trades admission-latency
     # granularity for fewer host round-trips (tunneled-device win)
     engine_ticks: int = 1
+    # narrow the KV arena ("bfloat16" under an f32 model = 2x slots)
+    engine_cache_dtype: Optional[str] = None
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -113,6 +115,8 @@ class ServingConfig:
             cfg.eos_id = int(params["eos_id"])
         if "engine_ticks" in params:
             cfg.engine_ticks = int(params["engine_ticks"])
+        if "engine_cache_dtype" in params:
+            cfg.engine_cache_dtype = str(params["engine_cache_dtype"])
         return cfg
 
 
@@ -183,7 +187,8 @@ class ClusterServing:
             self.engine = self.model.make_continuous_engine(
                 max_slots=self.config.engine_slots,
                 eos_id=self.config.eos_id,
-                ticks_per_step=self.config.engine_ticks)
+                ticks_per_step=self.config.engine_ticks,
+                cache_dtype=self.config.engine_cache_dtype)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
                                  name="zoo-serving-cb")
@@ -414,11 +419,22 @@ class ClusterServing:
                         if "seed" in r:
                             kw["rng_seed"] = int(np.asarray(
                                 self._decode_value(r["seed"])))
+                        # capture only the uri, not the whole request
+                        # dict (it holds the encoded prompt payload —
+                        # a needless second copy for the generation's
+                        # lifetime)
+                        ureq = {"uri": r["uri"]}
                         engine.submit(
                             uri, prompt,
                             on_done=(lambda u, toks, _eid=eid, _t0=t0,
-                                     _r=r: publish(u, toks, _eid, _t0,
-                                                   _r)),
+                                     _r=ureq: publish(u, toks, _eid,
+                                                      _t0, _r)),
+                            on_error=(lambda u, exc, _eid=eid, _r=ureq:
+                                      (self._publish_error(
+                                          _r, f"admission failed: "
+                                              f"{exc!r}"),
+                                       self._finish_entries(client,
+                                                            [_eid]))),
                             **kw)
                     except Exception as e:
                         self._publish_error(r, f"submit failed: {e!r}")

@@ -356,3 +356,70 @@ def test_engine_out_of_range_seed_does_not_crash(lm):
     c = run(2 ** 35 + 17)       # masks to 17
     d = run(17)
     np.testing.assert_array_equal(c, d)
+
+
+def test_engine_capacity_report_and_cache_dtype(lm):
+    """The arena economics are concrete: GQA and a narrower cache_dtype
+    multiply slot capacity, and a bf16 arena under an f32 model still
+    produces the same greedy tokens on this peaked-free random model."""
+    from analytics_zoo_tpu.models.lm import TransformerLM
+
+    gqa = TransformerLM(vocab_size=32, hidden_size=32, num_layers=2,
+                        num_heads=4, num_kv_heads=1,
+                        intermediate_size=64, max_position=64,
+                        dtype=jnp.float32)
+    v = gqa.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    eng = ContinuousEngine(gqa, v, max_new_tokens=4, max_slots=2,
+                           prompt_buckets=(8,),
+                           cache_dtype=jnp.bfloat16)
+    rep = eng.capacity_report()
+    assert rep["kv_heads"] == 1 and rep["cache_dtype"] == "bfloat16"
+    # MQA (4x) x bf16-under-f32 (2x) = 8x capacity vs MHA model-dtype
+    assert rep["capacity_multiplier_vs_mha_model_dtype"] == 8.0
+    assert rep["arena_bytes"] == rep["bytes_per_slot"] * rep["slots"]
+
+    model, variables = lm                   # f32 MHA model
+    e16 = ContinuousEngine(model, variables, max_new_tokens=5,
+                           max_slots=2, prompt_buckets=(8,),
+                           cache_dtype=jnp.bfloat16)
+    results = {}
+    p = np.asarray([5, 9, 11], np.int32)
+    e16.submit("x", p, on_done=lambda u, t: results.__setitem__(u, t))
+    e16.drain()
+    solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                               5))[0]
+    np.testing.assert_array_equal(results["x"], solo)
+
+
+def test_engine_admission_failure_calls_on_error(lm, monkeypatch):
+    """A device error during prefill must surface through on_error (not
+    silently swallow the popped requests), leave the free list intact,
+    and let later admissions succeed."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8,))
+    boom = RuntimeError("injected prefill failure")
+    real_prefill = eng._prefill
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise boom
+        return real_prefill(*a, **k)
+
+    eng._prefill = flaky
+    errors, results = {}, {}
+    p = np.asarray([5, 9], np.int32)
+    eng.submit("dead", p,
+               on_done=lambda u, t: results.__setitem__(u, t),
+               on_error=lambda u, e: errors.__setitem__(u, e))
+    eng.step()
+    assert isinstance(errors.get("dead"), RuntimeError)
+    assert eng.n_active == 0 and len(eng._free) == 2
+    # the engine still serves afterwards
+    eng.submit("ok", p, on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                               4))[0]
+    np.testing.assert_array_equal(results["ok"], solo)

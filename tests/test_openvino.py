@@ -249,3 +249,22 @@ def test_ir_prelu_channelwise_slope(tmp_path):
     got = np.asarray(net(net.params, jnp.asarray(xin)))   # crash
     ref = -slope[None, :, None, None] * np.ones((1, 3, 2, 2), np.float32)
     np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_ir_gather_embedding_lookup(tmp_path):
+    """Gather (data, indices, axis-Const) — the embedding-lookup
+    workhorse of recommendation IRs."""
+    table = np.arange(20, dtype=np.float32).reshape(5, 4)
+    b = _IRBuilder()
+    ct = b.const(table, "table")
+    idx = b.layer("Parameter", name="ids")
+    ax = b.const(np.asarray([0], np.int64), "axis")
+    g = b.layer("Gather", n_in=3)
+    b.edge(ct, g, 0), b.edge(idx, g, 1), b.edge(ax, g, 2)
+    res = b.layer("Result", n_in=1, n_out=0)
+    b.edge(g, res, 0)
+    xml = b.write(tmp_path, "gather")
+    net = OpenVINONet.from_ir(xml)
+    ids = np.asarray([3, 0, 4], np.int32)
+    got = np.asarray(net(net.params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, table[ids], rtol=1e-6)
