@@ -179,10 +179,10 @@ def scenario_disk(pid, outdir):
 
 def scenario_pp_ep(pid, outdir):
     """Pipeline + expert parallelism ACROSS the host boundary: a
-    pp=2 x dp=2 x ep=2 mesh over 2 processes x 4 devices, so the GPipe
-    ppermute hops and the MoE dispatch all_to_alls ride the gloo
-    cross-process transport.  Both hosts must observe the identical
-    (global) loss trajectory."""
+    pp=2 x dp=N x ep=2 mesh over N processes x 4 devices (dp fills the
+    device count — see SCENARIO_MESH), so the GPipe ppermute hops and
+    the MoE dispatch all_to_alls ride the gloo cross-process transport.
+    Every host must observe the identical (global) loss trajectory."""
     import flax.linen as nn
     import jax.numpy as jnp
     import optax
@@ -284,7 +284,9 @@ SCENARIOS = {
 }
 
 SCENARIO_MESH = {
-    "pp_ep": {"pp": 2, "dp": 2, "ep": 2},
+    # dp absorbs whatever device count the process count provides
+    # (2 procs x 4 devs -> dp=2; 4 procs -> dp=4)
+    "pp_ep": {"pp": 2, "dp": -1, "ep": 2},
 }
 
 

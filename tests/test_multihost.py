@@ -316,18 +316,22 @@ def test_multihost_kill_worker_fails_fast_then_resumes(tmp_path, ctx8):
                                rtol=2e-4)
 
 
-def test_multihost_pp_ep(tmp_path):
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_multihost_pp_ep(tmp_path, nprocs):
     """Pipeline + expert parallelism across the host boundary: GPipe
-    ppermute hops and MoE dispatch collectives ride gloo between the two
-    processes; both hosts observe the same finite, decreasing global
-    loss and the pp/ep shardings."""
-    results = run_scenario("pp_ep", tmp_path)
+    ppermute hops and MoE dispatch collectives ride gloo between the
+    processes (2- and 4-host variants — at 4 hosts every pp rank pair
+    sits on a different process); all hosts observe the same finite,
+    decreasing global loss and the pp/ep shardings."""
+    results = run_scenario("pp_ep", tmp_path, timeout=600,
+                           nprocs=nprocs)
     for r in results:
-        assert r["mesh"] == {"pp": 2, "dp": 2, "ep": 2}
+        assert r["mesh"] == {"pp": 2, "dp": nprocs, "ep": 2}
         assert "'pp'" in r["stage_spec"], r["stage_spec"]
         assert "'ep'" in r["moe_spec"], r["moe_spec"]
         assert all(np.isfinite(v) for v in r["loss"])
         assert r["loss"][-1] < r["loss"][0]
     # the loss is a global computation: hosts must agree exactly
-    np.testing.assert_allclose(results[0]["loss"], results[1]["loss"],
-                               rtol=1e-6)
+    for r in results[1:]:
+        np.testing.assert_allclose(results[0]["loss"], r["loss"],
+                                   rtol=1e-6)
