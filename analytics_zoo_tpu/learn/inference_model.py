@@ -255,6 +255,17 @@ class InferenceModel:
         net = OpenVINONet.from_ir(xml_path, bin_path)
         return self.load_flax(net, net.init(None), quantize=quantize)
 
+    def load_tf(self, path_or_fn, signature: str = "serving_default",
+                quantize: Optional[str] = None) -> "InferenceModel":
+        """ref-parity: InferenceModel.loadTF — a SavedModel dir (local or
+        remote gs://, s3://, hdfs://; TF's filesystem layer resolves it),
+        keras file, or concrete tf.function served on TPU via the TFNet
+        translator."""
+        from analytics_zoo_tpu.net import Net
+
+        net = Net.load_tf(path_or_fn, signature=signature)
+        return self.load_flax(net, net.init(None), quantize=quantize)
+
     def load_torch(self, module) -> "InferenceModel":
         """ref-parity: InferenceModel.loadTorch — a torch nn.Module (or
         path torch.load can read) served on TPU via TorchNet conversion."""

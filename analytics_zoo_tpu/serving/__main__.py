@@ -1,0 +1,51 @@
+"""``python -m analytics_zoo_tpu.serving config.yaml`` — the
+``cluster-serving-start`` entry point (ref: scripts/cluster-serving/
+cluster-serving-start reading config.yaml): parse the config, load the
+model artifact it names, start the serving loop, block until SIGINT.
+
+``--embedded-broker`` runs the bundled RESP broker in-process (local/
+single-box deployments); without it the config's redis host:port must
+already be running.
+"""
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m analytics_zoo_tpu.serving",
+        description="Start a Cluster Serving job from a config.yaml")
+    ap.add_argument("config", help="path to config.yaml")
+    ap.add_argument("--embedded-broker", action="store_true",
+                    help="run the bundled RESP broker in-process")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform (e.g. cpu) — env vars "
+                         "are too late once sitecustomize imports jax")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from analytics_zoo_tpu.serving import ClusterServing
+
+    # handlers FIRST: a supervisor may signal the instant it sees the
+    # banner, and that must mean graceful shutdown, not SIGTERM default
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    serving = ClusterServing.from_config(
+        args.config, embedded_broker=args.embedded_broker).start()
+    print(f"serving up on {serving.config.redis_host}:"
+          f"{serving.port} (Ctrl-C to stop)", flush=True)
+    stop.wait()
+    serving.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

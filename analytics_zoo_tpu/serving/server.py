@@ -164,6 +164,50 @@ class ClusterServing:
 
     GROUP = b"serving"
 
+    @classmethod
+    def from_config(cls, config_path: str,
+                    embedded_broker: bool = False) -> "ClusterServing":
+        """ref-parity: the ``cluster-serving-start`` entry — one
+        config.yaml names the broker, the knobs, and a SELF-DESCRIBING
+        model artifact; the serving job assembles itself from it.
+
+        ``model.path`` routes by artifact type: ``*.xml`` loads an
+        OpenVINO IR, a SavedModel directory (local or remote gs://,
+        s3://, hdfs:// — TF's filesystem layer resolves those) loads
+        through TFNet, and ``*.pt``/``*.pth`` loads a torch module.
+        (Flax/orbax exports need their module class and therefore the
+        Python API — ``ClusterServing(InferenceModel().load_flax(...),
+        cfg)``.)"""
+        import os
+        import re
+
+        cfg = ServingConfig.from_yaml(config_path)
+        path = cfg.model_path
+        if not path:
+            raise ValueError(
+                f"{config_path}: model.path is required (a .xml IR, a "
+                f"SavedModel dir, or a .pt torch module)")
+        im = InferenceModel()
+        remote = re.match(r"^[A-Za-z][A-Za-z0-9+.-]*://", path)
+        if path.endswith(".xml"):
+            im.load_openvino(path)
+        elif path.endswith((".pt", ".pth")):
+            im.load_torch(path)
+        elif remote or os.path.isdir(path):
+            im.load_tf(path)
+        elif not os.path.exists(path):
+            # distinguish a typo'd path from an unrecognised format —
+            # 'cannot infer' would gaslight a user whose dir name is
+            # simply misspelled
+            raise FileNotFoundError(
+                f"{config_path}: model.path {path!r} does not exist")
+        else:
+            raise ValueError(
+                f"cannot infer the model format of {path!r}: expected "
+                f"an OpenVINO .xml, a TF SavedModel directory, or a "
+                f"torch .pt/.pth")
+        return cls(im, cfg, embedded_broker=embedded_broker)
+
     def start(self) -> "ClusterServing":
         self.client = RespClient(self.config.redis_host,
                                  self.config.redis_port)

@@ -588,3 +588,56 @@ class TestConsumerGroups:
             assert serving.backlog() == 0
         finally:
             serving.stop()
+
+
+class TestFromConfig:
+    def test_from_config_openvino_round_trip(self, tmp_path):
+        """cluster-serving-start parity: one config.yaml naming an IR
+        artifact assembles the whole serving job."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from test_openvino import _mlp_ir
+
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        xml, (w1, b1, w2) = _mlp_ir(tmp_path, rng)
+        cfgp = tmp_path / "config.yaml"
+        cfgp.write_text(
+            f"model:\n  path: {xml}\n"
+            "params:\n  batch_size: 16\n")
+        serving = ClusterServing.from_config(str(cfgp),
+                                             embedded_broker=True).start()
+        try:
+            iq = InputQueue(port=serving.port)
+            oq = OutputQueue(port=serving.port)
+            x = rng.normal(size=(4,)).astype(np.float32)
+            iq.enqueue("cfg-req", x=x)
+            got = np.asarray(oq.query("cfg-req", timeout=30))
+            h = np.maximum(x[None] @ w1 + b1, 0.0)
+            import jax
+
+            ref = np.asarray(jax.nn.softmax(
+                jnp.asarray(h @ w2), axis=1))[0]
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        finally:
+            serving.stop()
+
+    def test_from_config_rejects_unknown_artifact(self, tmp_path):
+        cfgp = tmp_path / "config.yaml"
+        # existing file with unrecognised format -> cannot infer
+        blob = tmp_path / "weights.bin"
+        blob.write_bytes(b"\0" * 8)
+        cfgp.write_text(f"model:\n  path: {blob}\n")
+        with pytest.raises(ValueError, match="cannot infer"):
+            ClusterServing.from_config(str(cfgp))
+        # nonexistent path -> file-not-found, NOT 'cannot infer' (a
+        # typo'd SavedModel dir must read as a typo)
+        cfgp.write_text("model:\n  path: /models/typo_dir\n")
+        with pytest.raises(FileNotFoundError, match="does not exist"):
+            ClusterServing.from_config(str(cfgp))
+        cfgp.write_text("model:\n  path: ''\n")
+        with pytest.raises(ValueError, match="model.path"):
+            ClusterServing.from_config(str(cfgp))
