@@ -33,13 +33,16 @@ def main(argv=None):
 
     from analytics_zoo_tpu.serving import ClusterServing
 
-    # handlers FIRST: a supervisor may signal the instant it sees the
-    # banner, and that must mean graceful shutdown, not SIGTERM default
+    # default signal behavior DURING assembly (a hung model load or
+    # broker connect must stay killable with Ctrl-C/SIGTERM); graceful
+    # handlers go in after start() but BEFORE the banner, so a
+    # supervisor signalling the instant it sees the banner still gets a
+    # clean shutdown rather than the SIGTERM default
+    serving = ClusterServing.from_config(
+        args.config, embedded_broker=args.embedded_broker).start()
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    serving = ClusterServing.from_config(
-        args.config, embedded_broker=args.embedded_broker).start()
     print(f"serving up on {serving.config.redis_host}:"
           f"{serving.port} (Ctrl-C to stop)", flush=True)
     stop.wait()

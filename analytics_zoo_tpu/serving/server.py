@@ -179,7 +179,8 @@ class ClusterServing:
         Python API — ``ClusterServing(InferenceModel().load_flax(...),
         cfg)``.)"""
         import os
-        import re
+
+        from analytics_zoo_tpu.net import _is_local_path
 
         cfg = ServingConfig.from_yaml(config_path)
         path = cfg.model_path
@@ -187,20 +188,19 @@ class ClusterServing:
             raise ValueError(
                 f"{config_path}: model.path is required (a .xml IR, a "
                 f"SavedModel dir, or a .pt torch module)")
+        # existence FIRST for local paths: a typo'd path of ANY
+        # extension must read as a typo, not as 'cannot infer the
+        # format' or a derived-file error from deeper in a loader
+        if _is_local_path(path) and not os.path.exists(path):
+            raise FileNotFoundError(
+                f"{config_path}: model.path {path!r} does not exist")
         im = InferenceModel()
-        remote = re.match(r"^[A-Za-z][A-Za-z0-9+.-]*://", path)
         if path.endswith(".xml"):
             im.load_openvino(path)
         elif path.endswith((".pt", ".pth")):
             im.load_torch(path)
-        elif remote or os.path.isdir(path):
+        elif not _is_local_path(path) or os.path.isdir(path):
             im.load_tf(path)
-        elif not os.path.exists(path):
-            # distinguish a typo'd path from an unrecognised format —
-            # 'cannot infer' would gaslight a user whose dir name is
-            # simply misspelled
-            raise FileNotFoundError(
-                f"{config_path}: model.path {path!r} does not exist")
         else:
             raise ValueError(
                 f"cannot infer the model format of {path!r}: expected "

@@ -220,3 +220,48 @@ def test_cluster_serving_generates_ragged_prompts():
             np.testing.assert_array_equal(r, ref[0], err_msg=uri)
     finally:
         serving.stop()
+
+
+def test_http_frontend_continuous_with_controls():
+    """REST round-trip in CONTINUOUS mode with per-request generation
+    controls riding as plain JSON fields (max_new caps one instance's
+    tokens; the other runs the engine default)."""
+    import http.client
+    import json
+
+    from analytics_zoo_tpu.serving import HttpFrontend
+
+    model, variables = _lm_and_vars()
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=6, prompt_buckets=(8,))
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=2, engine_ticks=2)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = None
+    try:
+        fe = HttpFrontend(redis_port=serving.port, timeout=60,
+                          serving=serving).start()
+        rng = np.random.default_rng(6)
+        p1 = rng.integers(1, 32, 5).astype(np.int32)
+        p2 = rng.integers(1, 32, 3).astype(np.int32)
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=90)
+        conn.request("POST", "/predict", json.dumps({
+            "instances": [{"tokens": p1.tolist(), "max_new": 2},
+                          {"tokens": p2.tolist()}]}),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        preds = json.loads(resp.read())["predictions"]
+        ref1 = np.asarray(generate(model, variables,
+                                   jnp.asarray(p1[None]), 2))[0]
+        ref2 = np.asarray(generate(model, variables,
+                                   jnp.asarray(p2[None]), 6))[0]
+        np.testing.assert_array_equal(np.asarray(preds[0], np.int32),
+                                      ref1)
+        np.testing.assert_array_equal(np.asarray(preds[1], np.int32),
+                                      ref2)
+    finally:
+        if fe is not None:
+            fe.stop()
+        serving.stop()

@@ -634,10 +634,12 @@ class TestFromConfig:
         with pytest.raises(ValueError, match="cannot infer"):
             ClusterServing.from_config(str(cfgp))
         # nonexistent path -> file-not-found, NOT 'cannot infer' (a
-        # typo'd SavedModel dir must read as a typo)
-        cfgp.write_text("model:\n  path: /models/typo_dir\n")
-        with pytest.raises(FileNotFoundError, match="does not exist"):
-            ClusterServing.from_config(str(cfgp))
+        # typo'd path of ANY extension must read as a typo)
+        for typo in ("/models/typo_dir", "/models/typo.xml",
+                     "/models/typo.pt"):
+            cfgp.write_text(f"model:\n  path: {typo}\n")
+            with pytest.raises(FileNotFoundError, match="does not exist"):
+                ClusterServing.from_config(str(cfgp))
         cfgp.write_text("model:\n  path: ''\n")
         with pytest.raises(ValueError, match="model.path"):
             ClusterServing.from_config(str(cfgp))
