@@ -1,0 +1,26 @@
+"""Import-level smoke for the driver-run artifacts: a syntax error or
+broken import in bench.py / bench_serving.py / __graft_entry__.py would
+otherwise surface only in the driver's end-of-round run, as an opaque
+error artifact."""
+
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_bench_modules_import_and_expose_entries():
+    bench = importlib.import_module("bench")
+    assert callable(bench.main)
+    # every bench the plan names exists
+    for name in ("bench_bert", "bench_ncf", "bench_resnet50",
+                 "bench_wide_and_deep", "bench_forecast", "bench_lm"):
+        assert callable(getattr(bench, name)), name
+
+    bs = importlib.import_module("bench_serving")
+    assert callable(bs.main) and callable(bs.run_scenario)
+    assert callable(bs.run_poisson_scenario)
+
+    ge = importlib.import_module("__graft_entry__")
+    assert callable(ge.entry) and callable(ge.dryrun_multichip)
