@@ -161,6 +161,23 @@ class OpenVINONet:
             blob = f.read()
         layers, order, producer = _parse_ir(xml_path)
 
+        # only out_ports[0] of each layer is registered in the forward
+        # env; an edge consuming any OTHER output port (e.g. MaxPool-8's
+        # indices output) must fail HERE with the curated error, not as
+        # a raw KeyError at trace time
+        for (dst, _), (src, src_port) in producer.items():
+            src_ly = layers.get(src)
+            if src_ly is None or not src_ly.out_ports:
+                continue
+            if src_port != src_ly.out_ports[0]:
+                dst_ly = layers.get(dst)
+                dst_name = dst_ly.name if dst_ly is not None else dst
+                raise NotImplementedError(
+                    f"{src_ly.type} '{src_ly.name}': output port "
+                    f"{src_port} is consumed by layer "
+                    f"'{dst_name}', but only the first output "
+                    f"port of a layer is supported")
+
         const_vals: Dict[str, np.ndarray] = {}
         pnames: Dict[str, str] = {}     # layer id -> param key
         params: Dict[str, np.ndarray] = {}

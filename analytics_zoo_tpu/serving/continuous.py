@@ -378,9 +378,11 @@ class ContinuousEngine:
     def step(self) -> int:
         """One engine iteration: admit joiners, then advance every
         resident by up to ``ticks_per_step`` tokens in one device call
-        (capped by the smallest remaining token budget among residents,
-        so no slot overruns its window; EOS mid-chunk freezes on-device
-        like generate()'s frozen tail).  Returns the number of active
+        (capped by the largest remaining token budget among residents —
+        a nearly-finished slot must not throttle the arena to 1-tick
+        device calls; its surplus tokens are dropped host-side in
+        ``_record_token``, and EOS mid-chunk freezes on-device like
+        generate()'s frozen tail).  Returns the number of active
         slots afterwards (0 = idle; the caller decides how to wait).
         Higher ``ticks_per_step`` trades admission latency granularity
         for fewer host round-trips — the dominant per-token cost on
@@ -397,7 +399,7 @@ class ContinuousEngine:
             seeds[i] = self._slots[i].rng_seed or 0
         n_eff = max(1, min(
             self.ticks_per_step,
-            min(self._slots[i].max_new - len(self._slots[i].tokens)
+            max(self._slots[i].max_new - len(self._slots[i].tokens)
                 for i in active)))
         step = self._get_step(n_eff, sampled)
         toks, tok, pos, done, self._ck, self._cv = step(

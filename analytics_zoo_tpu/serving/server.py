@@ -183,6 +183,17 @@ class ClusterServing:
         from analytics_zoo_tpu.net import _is_local_path
 
         cfg = ServingConfig.from_yaml(config_path)
+        if cfg.continuous_batching:
+            # none of the config-routable artifacts (IR / SavedModel /
+            # torch) is a generator; fail at assembly time, pointing at
+            # the knob, instead of from deep inside start()
+            raise ValueError(
+                f"{config_path}: continuous_batching: true requires a "
+                f"generative model loaded via the Python API "
+                f"(InferenceModel().load_flax_generator(...) + "
+                f"ClusterServing(model, cfg)); config-file artifacts "
+                f"(.xml IR / SavedModel / .pt) cannot serve in "
+                f"continuous mode")
         path = cfg.model_path
         if not path:
             raise ValueError(

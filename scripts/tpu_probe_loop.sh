@@ -1,0 +1,27 @@
+#!/bin/bash
+# Continuous TPU-tunnel probe (VERDICT r4 ask #1a).
+# Probes the tunneled TPU every ~120s with a hard timeout; appends one JSON
+# line per attempt to tpu_probe_log.jsonl. On the first success it touches
+# TPU_ALIVE so an opportunistic bench can be fired immediately.
+LOG=/root/repo/tpu_probe_log.jsonl
+FLAG=/root/repo/TPU_ALIVE
+while true; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  OUT=$(timeout 120 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256,256), jnp.bfloat16)
+y = (x@x).sum()
+print('OK', d[0].platform, d[0].device_kind, float(y))
+" 2>&1 | tail -1)
+  RC=$?
+  if [ $RC -eq 0 ] && [[ "$OUT" == OK* ]]; then
+    echo "{\"ts\": \"$TS\", \"ok\": true, \"out\": \"$OUT\"}" >> "$LOG"
+    touch "$FLAG"
+  else
+    SAFE=$(echo "$OUT" | tr -d '"\\' | head -c 200)
+    echo "{\"ts\": \"$TS\", \"ok\": false, \"rc\": $RC, \"out\": \"$SAFE\"}" >> "$LOG"
+    rm -f "$FLAG"
+  fi
+  sleep 120
+done

@@ -423,3 +423,31 @@ def test_engine_admission_failure_calls_on_error(lm, monkeypatch):
     solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
                                4))[0]
     np.testing.assert_array_equal(results["ok"], solo)
+
+
+def test_step_not_throttled_by_nearly_finished_slot(lm):
+    """A slot with 1 token of budget left must not cap the whole arena
+    to 1-tick device calls: step() runs full ticks_per_step chunks and
+    drops the finished slot's surplus host-side (ADVICE r4)."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=9,
+                           max_slots=2, prompt_buckets=(8,),
+                           ticks_per_step=3)
+    rng = np.random.default_rng(11)
+    p_short = rng.integers(1, 32, 4).astype(np.int32)
+    p_long = rng.integers(1, 32, 4).astype(np.int32)
+    results = {}
+    eng.submit("short", p_short, max_new=1,
+               on_done=lambda u, t: results.__setitem__(u, t))
+    eng.submit("long", p_long, max_new=9,
+               on_done=lambda u, t: results.__setitem__(u, t))
+    steps = 0
+    while eng.step() > 0:
+        steps += 1
+    # prefill emits token 1 of each; 8 remain for "long" -> ceil(8/3)=3
+    # chunks. The old global-min cap would have needed 8 steps.
+    assert steps <= 4, f"arena throttled: {steps} steps"
+    for uri, p, mn in (("short", p_short, 1), ("long", p_long, 9)):
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(p[None]), mn))[0]
+        np.testing.assert_array_equal(results[uri], solo, err_msg=uri)

@@ -268,3 +268,22 @@ def test_ir_gather_embedding_lookup(tmp_path):
     ids = np.asarray([3, 0, 4], np.int32)
     got = np.asarray(net(net.params, jnp.asarray(ids)))
     np.testing.assert_allclose(got, table[ids], rtol=1e-6)
+
+
+def test_ir_secondary_output_port_rejected_at_build(tmp_path):
+    """Only out_ports[0] of a layer is lowered; an IR that consumes a
+    SECONDARY output port (e.g. MaxPool-8's indices) must fail at
+    from_ir time with the curated unsupported-layer error, not a raw
+    KeyError mid-trace."""
+    b = _IRBuilder()
+    x = b.layer("Parameter", name="x")
+    mp = b.layer("MaxPool", name="pool", n_in=1, n_out=2,
+                 data={"kernel": "2", "strides": "2",
+                       "pads_begin": "0", "pads_end": "0"})
+    b.edge(x, mp, 0)
+    res = b.layer("Result", n_in=1, n_out=0)
+    # consume the SECOND output port (indices): port id = n_in + 1
+    b.edges.append((mp, str(2), res, "0"))
+    xml = b.write(tmp_path, "twoport")
+    with pytest.raises(NotImplementedError, match="output port"):
+        OpenVINONet.from_ir(xml)

@@ -643,3 +643,16 @@ class TestFromConfig:
         cfgp.write_text("model:\n  path: ''\n")
         with pytest.raises(ValueError, match="model.path"):
             ClusterServing.from_config(str(cfgp))
+
+    def test_from_config_rejects_continuous_batching(self, tmp_path):
+        """continuous_batching needs a load_flax_generator model, which
+        no config-routable artifact is — from_config must say so at
+        assembly time, pointing at the knob (ADVICE r4)."""
+        blob = tmp_path / "weights.xml"
+        blob.write_bytes(b"<net/>")
+        cfgp = tmp_path / "config.yaml"
+        cfgp.write_text(
+            f"model:\n  path: {blob}\n"
+            "params:\n  continuous_batching: true\n")
+        with pytest.raises(ValueError, match="load_flax_generator"):
+            ClusterServing.from_config(str(cfgp))
