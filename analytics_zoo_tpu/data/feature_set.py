@@ -80,19 +80,35 @@ class FeatureSet:
 
     def to_disk(self, path: Optional[str] = None,
                 block_rows: int = BLOCK_ROWS_DEFAULT) -> "DiskFeatureSet":
-        """Spill to the DISK tier: write row-blocks to a ZREC record file."""
+        """Spill to the DISK tier: write row-blocks to a ZREC record file.
+
+        ``path`` may be a remote URI (gs://, s3://, memory://; a
+        ``{host}`` placeholder composes — each host uploads its own
+        shard object): the file is written locally and pushed out, and
+        the returned DiskFeatureSet streams from the primed local cache,
+        not back over the wire."""
         from analytics_zoo_tpu import native
+
+        from analytics_zoo_tpu.common import fs
 
         if path is None:
             fd, path = tempfile.mkstemp(suffix=".zrec")
             os.close(fd)
         path = _host_path(path)
+        local = path
+        if fs.is_remote(path):
+            fd, local = tempfile.mkstemp(suffix=".zrec")
+            os.close(fd)
         n = len(self)
-        with native.RecordWriter(path) as w:
+        with native.RecordWriter(local) as w:
             for lo in range(0, n, block_rows):
                 block = {k: v[lo:lo + block_rows]
                          for k, v in self.arrays.items()}
                 w.write(native.pack_batch(block))
+        if fs.is_remote(path):
+            fs.upload(local, path)
+            fs.prime_cache(local, path)
+            os.remove(local)    # the cache copy is now the local source
         return DiskFeatureSet(path)
 
 
@@ -116,10 +132,16 @@ class DiskFeatureSet:
     def __init__(self, path: str, *, ring_mb: int = 128):
         from analytics_zoo_tpu import native
 
+        from analytics_zoo_tpu.common import fs
+
         path = _host_path(path)
         self.path = path
         self._native = native
-        self.reader = native.RecordReader(path)
+        # remote shard URIs (each host downloads only ITS {host} shard)
+        # materialise through the per-process cache: the native reader
+        # mmaps a real local file — streaming ZREC over object-store
+        # range reads would serialise the prefetch thread on the wire
+        self.reader = native.RecordReader(fs.local_copy(path))
         self.ring_bytes = ring_mb << 20
         meta = native.unpack_batch(self.reader.get(0)) if len(self.reader) \
             else {}

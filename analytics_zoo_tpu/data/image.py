@@ -145,7 +145,13 @@ def _read_image(path: str) -> np.ndarray:
     Prefers the C++ data plane (libjpeg/libpng, GIL released — SURVEY §2.3
     native-decode obligation); PIL covers the long tail of formats (bmp,
     gif, webp, CMYK jpegs) and hosts whose .so was built without image
-    support."""
+    support.  Remote URIs (gs://, s3://, memory://) fetch bytes through
+    common.fs and share the bytes-input decode path."""
+    from analytics_zoo_tpu.common import fs
+
+    if fs.is_remote(path):
+        with fs.open(path, "rb") as f:
+            return decode_image_bytes(f.read())
     from analytics_zoo_tpu import native
 
     try:
@@ -183,23 +189,26 @@ class ImageSet:
     @staticmethod
     def read(path: str, num_shards: int = 1,
              with_label: bool = False) -> "ImageSet":
-        """Read images under `path`. with_label: subdir name = class."""
+        """Read images under `path` (local dir or remote gs://, s3://,
+        memory:// URI). with_label: subdir name = class."""
+        from analytics_zoo_tpu.common import fs
+
         records: List[Tuple[str, int]] = []
         class_names: Optional[List[str]] = None
         if with_label:
             class_names = sorted(
-                d for d in os.listdir(path)
-                if os.path.isdir(os.path.join(path, d)))
+                d for d in fs.listdir(path)
+                if fs.isdir(fs.join(path, d)))
             for ci, cname in enumerate(class_names):
-                cdir = os.path.join(path, cname)
-                for f in sorted(os.listdir(cdir)):
+                cdir = fs.join(path, cname)
+                for f in sorted(fs.listdir(cdir)):
                     if f.lower().endswith(IMAGE_EXTS):
-                        records.append((os.path.join(cdir, f), ci))
+                        records.append((fs.join(cdir, f), ci))
         else:
-            for root, _, files in sorted(os.walk(path)):
+            for root, _, files in fs.walk(path):
                 for f in sorted(files):
                     if f.lower().endswith(IMAGE_EXTS):
-                        records.append((os.path.join(root, f), -1))
+                        records.append((fs.join(root, f), -1))
         if not records:
             raise FileNotFoundError(f"no images under {path}")
 

@@ -7,16 +7,20 @@ SparkXShards of pandas DataFrames, partitioned across Spark executors.
 Here files are partitioned across TPU-VM *hosts* (deterministic round-robin
 by sorted path so every host sees a disjoint set), then each host reads its
 files into local shards — one shard per file, or `shards_per_host` re-split.
+
+Paths may be remote URIs (``gs://``, ``s3://``, ``hdfs://``,
+``memory://`` — the reference read HDFS/S3 through Spark, ref: pyzoo/
+zoo/orca/data/pandas/preprocessing.py); common.fs dispatches by scheme
+and plain local paths keep the native C++ CSV fast path.
 """
 
 from __future__ import annotations
 
-import glob
-import os
 from typing import Callable, List, Optional
 
 import jax
 
+from analytics_zoo_tpu.common import fs
 from analytics_zoo_tpu.data.shards import XShards
 
 
@@ -26,12 +30,12 @@ def _expand(path_or_glob) -> List[str]:
         for p in path_or_glob:
             out.extend(_expand(p))
         return sorted(set(out))
-    if os.path.isdir(path_or_glob):
+    if fs.isdir(path_or_glob):
         return sorted(
-            os.path.join(path_or_glob, f) for f in os.listdir(path_or_glob)
+            fs.join(path_or_glob, f) for f in fs.listdir(path_or_glob)
             if not f.startswith(("_", ".")))
-    matches = sorted(glob.glob(path_or_glob))
-    if not matches and os.path.exists(path_or_glob):
+    matches = fs.glob(path_or_glob)
+    if not matches and fs.exists(path_or_glob):
         matches = [path_or_glob]
     if not matches:
         raise FileNotFoundError(f"no files match {path_or_glob!r}")
@@ -79,10 +83,18 @@ def _read_csv_one(path, backend: str = "auto", **pandas_kwargs):
         try:
             from analytics_zoo_tpu import native
 
-            return pd.DataFrame(native.read_csv_native(path))
+            # remote URIs materialise through the per-process cache —
+            # the C++ parser wants a real file (and a numeric-CSV
+            # download is usually cheaper than row-wise remote reads)
+            return pd.DataFrame(native.read_csv_native(fs.local_copy(path)))
         except Exception:
             if backend == "native":
                 raise
+    # pandas resolves fsspec URIs (gs://, s3://, memory://) natively —
+    # but if the native attempt above already downloaded the file, parse
+    # the cached copy instead of paying the transfer twice
+    if fs.is_remote(path) and backend != "pandas" and not pandas_kwargs:
+        path = fs.local_copy(path)
     return pd.read_csv(path, **pandas_kwargs)
 
 

@@ -169,7 +169,12 @@ def load_glove(path: str, word_index: Dict[str, int],
     weights = rng.normal(0, 0.1, (vocab_rows, embed_dim)).astype(np.float32)
     weights[TextSet.PAD_ID] = 0.0
     hits = 0
-    with open(path, encoding="utf-8") as f:
+    import io
+
+    from analytics_zoo_tpu.common import fs
+
+    with fs.open(path, "rb") as raw, \
+            io.TextIOWrapper(raw, encoding="utf-8") as f:
         for line in f:
             parts = line.rstrip().split(" ")
             if len(parts) != embed_dim + 1:

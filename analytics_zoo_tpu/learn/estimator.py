@@ -910,6 +910,13 @@ class FlaxEstimator:
 def _abs(path: str) -> str:
     import os
 
+    from analytics_zoo_tpu.common import fs
+
+    # remote checkpoint dirs (gs://...) pass through verbatim — orbax
+    # resolves the scheme via etils/tensorstore; os.path.abspath would
+    # mangle the URI into a local path and silently checkpoint to disk
+    if fs.is_remote(path):
+        return path
     return os.path.abspath(path)
 
 

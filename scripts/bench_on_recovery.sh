@@ -1,0 +1,21 @@
+#!/bin/bash
+# One-shot: waits for TPU_ALIVE (touched by tpu_probe_loop.sh), then runs
+# the prioritized bench capture (bench.py checkpoints BENCH_PARTIAL.json
+# after every config) followed by the serving bench. BENCH_RUNNING pauses
+# the probe loop so probe processes don't contend for the device grant.
+cd /root/repo || exit 1
+trap 'rm -f BENCH_RUNNING' EXIT INT TERM
+while true; do
+  if [ -f TPU_ALIVE ]; then
+    TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+    echo "recovery detected at $TS - firing prioritized bench" >> bench_recovery.log
+    touch BENCH_RUNNING
+    timeout 10800 python bench.py > BENCH_SESSION_r05.json 2>> bench_recovery.log
+    echo "bench.py rc=$? at $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> bench_recovery.log
+    timeout 5400 python bench_serving.py >> bench_recovery.log 2>&1
+    echo "bench_serving.py rc=$? at $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> bench_recovery.log
+    rm -f BENCH_RUNNING
+    break
+  fi
+  sleep 60
+done

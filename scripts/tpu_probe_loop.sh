@@ -6,20 +6,24 @@
 LOG=/root/repo/tpu_probe_log.jsonl
 FLAG=/root/repo/TPU_ALIVE
 while true; do
+  if [ -f /root/repo/BENCH_RUNNING ]; then
+    sleep 120; continue   # don't contend for the grant mid-bench
+  fi
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  OUT=$(timeout 120 python -c "
+  RAW=$(timeout 120 python -c "
 import jax, jax.numpy as jnp
 d = jax.devices()
 x = jnp.ones((256,256), jnp.bfloat16)
 y = (x@x).sum()
-print('OK', d[0].platform, d[0].device_kind, float(y))
-" 2>&1 | tail -1)
+print('PROBE_OK', d[0].platform, d[0].device_kind, float(y))
+" 2>&1)
   RC=$?
-  if [ $RC -eq 0 ] && [[ "$OUT" == OK* ]]; then
+  OUT=$(echo "$RAW" | grep PROBE_OK | head -1)
+  if [ -n "$OUT" ]; then
     echo "{\"ts\": \"$TS\", \"ok\": true, \"out\": \"$OUT\"}" >> "$LOG"
     touch "$FLAG"
   else
-    SAFE=$(echo "$OUT" | tr -d '"\\' | head -c 200)
+    SAFE=$(echo "$RAW" | tail -1 | tr -d '"\\' | head -c 160)
     echo "{\"ts\": \"$TS\", \"ok\": false, \"rc\": $RC, \"out\": \"$SAFE\"}" >> "$LOG"
     rm -f "$FLAG"
   fi

@@ -1,0 +1,246 @@
+"""Remote-storage ingestion (common/fs.py; VERDICT r4 ask #2).
+
+The reference's data layer read HDFS/S3 natively through Spark (ref:
+pyzoo/zoo/orca/data/pandas/preprocessing.py); the rebuild reads object
+stores through fsspec.  These tests exercise every ingestion surface
+against fsspec's in-memory filesystem — the same dispatch path gs:// and
+s3:// take, minus the network."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common import fs
+
+fsspec = pytest.importorskip("fsspec")
+
+
+@pytest.fixture()
+def memfs():
+    m = fsspec.filesystem("memory")
+    # MemoryFileSystem is a process-wide singleton: start clean
+    m.store.clear()
+    yield m
+    m.store.clear()
+
+
+def _put(memfs, path, data: bytes):
+    with memfs.open(path, "wb") as f:
+        f.write(data)
+
+
+# ---------------------------------------------------------------------------
+# fs primitives
+# ---------------------------------------------------------------------------
+
+def test_is_remote_and_join():
+    assert fs.is_remote("gs://bucket/x.csv")
+    assert fs.is_remote("hdfs://nn:9000/data")
+    assert fs.is_remote("memory://a/b")
+    assert not fs.is_remote("/tmp/x.csv")
+    assert not fs.is_remote("rel/path.csv")
+    assert not fs.is_remote("C:/windows/style")     # no scheme://
+    assert fs.join("gs://b/dir", "f.csv") == "gs://b/dir/f.csv"
+    assert fs.join("gs://b/dir/", "sub", "f") == "gs://b/dir/sub/f"
+    assert fs.join("/local/dir", "f.csv") == os.path.join(
+        "/local/dir", "f.csv")
+
+
+def test_glob_preserves_scheme(memfs):
+    for n in ("a", "b"):
+        _put(memfs, f"/g/{n}.csv", b"x\n1\n")
+    got = fs.glob("memory://g/*.csv")
+    assert len(got) == 2
+    assert all(p.startswith("memory://") for p in got)
+    with fs.open(got[0], "rb") as f:
+        assert f.read() == b"x\n1\n"
+
+
+def test_listdir_walk_isdir(memfs):
+    _put(memfs, "/root_d/sub/one.txt", b"1")
+    _put(memfs, "/root_d/two.txt", b"2")
+    assert fs.isdir("memory://root_d")
+    assert not fs.isdir("memory://root_d/two.txt")
+    assert fs.listdir("memory://root_d") == ["sub", "two.txt"]
+    walked = fs.walk("memory://root_d")
+    files = [f for _, _, fls in walked for f in fls]
+    assert set(files) == {"one.txt", "two.txt"}
+
+
+def test_local_copy_caches_and_upload_round_trip(memfs, tmp_path):
+    _put(memfs, "/c/data.bin", b"payload")
+    p1 = fs.local_copy("memory://c/data.bin")
+    assert open(p1, "rb").read() == b"payload"
+    # second call reuses the same local file (no re-download)
+    assert fs.local_copy("memory://c/data.bin") == p1
+    # local paths pass through with zero copies
+    local = tmp_path / "x.bin"
+    local.write_bytes(b"z")
+    assert fs.local_copy(str(local)) == str(local)
+    # upload + prime_cache: the artifact exists remotely AND reads back
+    # locally without a download
+    out = tmp_path / "up.bin"
+    out.write_bytes(b"uploaded")
+    fs.upload(str(out), "memory://c/up.bin")
+    fs.prime_cache(str(out), "memory://c/up.bin")
+    assert memfs.cat("/c/up.bin") == b"uploaded"
+    assert open(fs.local_copy("memory://c/up.bin"), "rb").read() \
+        == b"uploaded"
+
+
+def test_missing_driver_fails_loud():
+    # s3fs is not in this image: the error must NAME the fix, and no
+    # silent local fallback may occur.  (gcsfs IS baked in — gs://
+    # resolves to the real driver and fails only at the network, which
+    # is exactly the TPU-VM deployment contract.)
+    with pytest.raises(ImportError, match="s3"):
+        fs.exists("s3://some-bucket/file")
+    # hdfs needs libjvm; driver-load OSErrors surface as the same loud
+    # ImportError naming the scheme
+    with pytest.raises(ImportError, match="hdfs"):
+        fs.exists("hdfs://namenode:9000/data")
+
+
+# ---------------------------------------------------------------------------
+# readers
+# ---------------------------------------------------------------------------
+
+def test_read_csv_remote_glob(memfs):
+    from analytics_zoo_tpu.data.readers import read_csv
+
+    for i in range(3):
+        _put(memfs, f"/ds/part{i}.csv",
+             f"a,b\n{i},{i * 10}\n{i + 100},{i}\n".encode())
+    import pandas as pd
+
+    xs = read_csv("memory://ds/*.csv", host_index=0, num_hosts=1)
+    df = pd.concat(xs.collect())
+    assert len(df) == 6
+    assert set(df.columns) == {"a", "b"}
+    # host partitioning composes: 2 hosts see disjoint files
+    n0 = sum(len(s) for s in read_csv("memory://ds/*.csv", host_index=0,
+                                      num_hosts=2).collect())
+    n1 = sum(len(s) for s in read_csv("memory://ds/*.csv", host_index=1,
+                                      num_hosts=2).collect())
+    assert n0 + n1 == 6 and n0 and n1
+
+
+def test_read_csv_remote_native_backend(memfs):
+    """backend='native' must work on remote URIs (C++ parser over the
+    cached local copy)."""
+    pytest.importorskip("analytics_zoo_tpu.native")
+    from analytics_zoo_tpu.data.readers import read_csv
+
+    _put(memfs, "/nat/n.csv", b"x,y\n1.5,2\n3.5,4\n")
+    try:
+        df = read_csv("memory://nat/n.csv", backend="native",
+                      host_index=0, num_hosts=1).collect()[0]
+    except Exception as e:      # toolchainless host: loud, not silent
+        pytest.skip(f"native parser unavailable: {e}")
+    assert df["x"].tolist() == [1.5, 3.5]
+
+
+def test_read_json_and_parquet_remote(memfs):
+    import pandas as pd
+
+    from analytics_zoo_tpu.data.readers import read_json, read_parquet
+
+    pdf = pd.DataFrame({"k": [1, 2], "v": [0.5, 1.5]})
+    _put(memfs, "/j/d.json", pdf.to_json().encode())
+    got = read_json("memory://j/d.json", host_index=0,
+                    num_hosts=1).collect()[0]
+    assert got["v"].tolist() == [0.5, 1.5]
+    buf = io.BytesIO()
+    pdf.to_parquet(buf)
+    _put(memfs, "/p/d.parquet", buf.getvalue())
+    got = read_parquet("memory://p/d.parquet", host_index=0,
+                       num_hosts=1).collect()[0]
+    assert got["k"].tolist() == [1, 2]
+
+
+def test_read_csv_remote_missing_is_loud(memfs):
+    from analytics_zoo_tpu.data.readers import read_csv
+
+    with pytest.raises(FileNotFoundError):
+        read_csv("memory://nowhere/*.csv", host_index=0, num_hosts=1)
+
+
+# ---------------------------------------------------------------------------
+# DiskFeatureSet
+# ---------------------------------------------------------------------------
+
+def test_feature_set_remote_spill_and_stream(memfs):
+    pytest.importorskip("analytics_zoo_tpu.native")
+    from analytics_zoo_tpu.data.feature_set import FeatureSet
+
+    rng = np.random.default_rng(0)
+    arrays = {"x": rng.normal(size=(300, 4)).astype(np.float32),
+              "y": rng.integers(0, 2, 300).astype(np.int32)}
+    dfs = FeatureSet.from_arrays(arrays).to_disk(
+        "memory://tier/shard_{host}.zrec", block_rows=64)
+    # {host} composed with the remote prefix (single-process: host 0)
+    assert dfs.path == "memory://tier/shard_0.zrec"
+    assert memfs.exists("/tier/shard_0.zrec")
+    assert len(dfs) == 300
+    got = np.concatenate([b["x"] for b in dfs.batches(
+        50, shuffle=False, drop_remainder=False)])
+    np.testing.assert_allclose(got, arrays["x"], rtol=1e-6)
+    # reopening from the URI alone streams via the cache/download path
+    from analytics_zoo_tpu.data.feature_set import DiskFeatureSet
+
+    dfs2 = DiskFeatureSet("memory://tier/shard_{host}.zrec")
+    assert len(dfs2) == 300
+    dfs.close(), dfs2.close()
+
+
+# ---------------------------------------------------------------------------
+# ImageSet
+# ---------------------------------------------------------------------------
+
+def _png_bytes(color, size=(6, 6)) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", size, color).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_imageset_read_remote_with_labels(memfs):
+    from analytics_zoo_tpu.data.image import ImageResize, ImageSet
+
+    _put(memfs, "/imgs/cat/a.png", _png_bytes((255, 0, 0)))
+    _put(memfs, "/imgs/cat/b.png", _png_bytes((250, 0, 0)))
+    _put(memfs, "/imgs/dog/c.png", _png_bytes((0, 0, 255)))
+    iset = ImageSet.read("memory://imgs", with_label=True)
+    assert iset.class_names == ["cat", "dog"]
+    d = iset.transform(ImageResize(4, 4)).to_numpy_dict()
+    assert d["x"].shape == (3, 4, 4, 3)
+    assert sorted(d["y"].tolist()) == [0, 0, 1]
+    # red-ish images are class 0 (cat dirs sort first)
+    red = d["x"][d["y"] == 0]
+    assert (red[..., 0] > 200).all()
+
+
+# ---------------------------------------------------------------------------
+# GloVe + checkpoints
+# ---------------------------------------------------------------------------
+
+def test_glove_remote(memfs):
+    from analytics_zoo_tpu.data.text import TextSet, load_glove
+
+    _put(memfs, "/emb/glove.txt",
+         b"hello 1.0 2.0\nworld 3.0 4.0\n")
+    wi = {"hello": TextSet.FIRST_WORD_ID,
+          "world": TextSet.FIRST_WORD_ID + 1}
+    w, hits = load_glove("memory://emb/glove.txt", wi, embed_dim=2)
+    assert hits == 2
+    np.testing.assert_allclose(w[TextSet.FIRST_WORD_ID], [1.0, 2.0])
+
+
+def test_checkpoint_dir_uri_passthrough():
+    from analytics_zoo_tpu.learn.estimator import _abs
+
+    assert _abs("gs://ckpts/run1") == "gs://ckpts/run1"
+    assert os.path.isabs(_abs("local/run1"))
