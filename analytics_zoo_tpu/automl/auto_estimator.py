@@ -62,7 +62,8 @@ class AutoEstimator:
     def fit(self, data, validation_data=None, *, search_space: Dict,
             n_sampling: int = 4, epochs: int = 1, metric: str = "loss",
             mode: str = "min", batch_size: int = 32,
-            early_stop: bool = True, seed: int = 0) -> "AutoEstimator":
+            early_stop: bool = True, seed: int = 0,
+            distributed: bool = False) -> "AutoEstimator":
         """Search, then retain the best estimator (already trained).
 
         ``batch_size``/``epochs`` may also live in the search space under
@@ -86,13 +87,19 @@ class AutoEstimator:
         scheduler = MedianStopper(mode=mode) if early_stop else None
         engine = SearchEngine(trainable, search_space, metric=metric,
                               mode=mode, n_sampling=n_sampling, seed=seed,
-                              scheduler=scheduler)
+                              scheduler=scheduler, distributed=distributed)
         best = engine.run()
         self.best_trial = best
         self.best_config = best.config
         # retrain the winner if its estimator isn't the last one stashed
-        # (later trials overwrote the stash).
+        # (later trials overwrote the stash).  Distributed mode NEVER
+        # reuses the stash: only the process that ran the winning trial
+        # holds it (trained on its local mesh), and all processes must
+        # enter the global-mesh retrain fit together or the reusing
+        # process deadlocks its peers' collectives.
         est, cfg = getattr(trainable, "_last", (None, None))
+        if distributed and SearchEngine._nprocs() > 1:
+            cfg = None
         if cfg is not best.config:
             est = self._build(best.config)
             est.fit(data, epochs=int(best.config.get("epochs", epochs)),

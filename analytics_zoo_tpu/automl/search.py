@@ -38,6 +38,10 @@ class Trial:
     error: Optional[str] = None
     duration_s: float = 0.0
     history: List[float] = dataclasses.field(default_factory=list)
+    # (epoch, value) pairs as reported — epochs may be arbitrary keys
+    # (step counts, non-contiguous); distributed mode exchanges THESE so
+    # peer MedianStopper merges land in the right bucket
+    reports: List[Any] = dataclasses.field(default_factory=list)
 
 
 class MedianStopper:
@@ -78,7 +82,9 @@ class SearchEngine:
                  metric: str = "loss", mode: str = "min",
                  n_sampling: int = 1, seed: int = 0,
                  max_concurrent: int = 1,
-                 scheduler: Optional[MedianStopper] = None):
+                 scheduler: Optional[MedianStopper] = None,
+                 distributed: bool = False,
+                 history_pad: int = 64):
         self.trainable = trainable
         self.space = search_space
         self.metric = metric
@@ -87,6 +93,8 @@ class SearchEngine:
         self.seed = seed
         self.max_concurrent = max(1, max_concurrent)
         self.scheduler = scheduler
+        self.distributed = distributed
+        self.history_pad = history_pad
         self.trials: List[Trial] = []
 
     class StopTrial(Exception):
@@ -107,6 +115,7 @@ class SearchEngine:
 
         def report(epoch: int, value: float):
             trial.history.append(float(value))
+            trial.reports.append((float(epoch), float(value)))
             if self.scheduler is not None:
                 self.scheduler.record(epoch, float(value))
                 if self.scheduler.should_stop(epoch, float(value)):
@@ -134,6 +143,9 @@ class SearchEngine:
     def run(self) -> Trial:
         configs = self._configs()
         self.trials = [Trial(i, c) for i, c in enumerate(configs)]
+        if self.distributed and self._nprocs() > 1:
+            self._run_distributed()
+            return self.best_trial()
         if self.max_concurrent == 1:
             for t in self.trials:
                 self._run_one(t)
@@ -145,9 +157,120 @@ class SearchEngine:
                 list(pool.map(self._run_one, self.trials))
         return self.best_trial()
 
+    # -- cluster-distributed trials (ref: RayTuneSearchEngine ran trials
+    # -- as Ray actors across the cluster, SURVEY §3.6) -----------------
+    @staticmethod
+    def _nprocs() -> int:
+        import jax
+
+        return jax.process_count()
+
+    _ST_CODE = {"done": 0.0, "pruned": 1.0, "error": 2.0}
+    _CODE_ST = {0: "done", 1: "pruned", 2: "error", 3: "noop"}
+
+    def _run_distributed(self):
+        """Round-based SPMD trial schedule over `jax.process_count()`
+        processes: every process builds the SAME deterministic trial
+        queue (same seed), round r assigns trial ``r*P + pid`` to
+        process ``pid``, and one `process_allgather` per round merges
+        (status, metric, per-epoch history) so (a) every process ends
+        with the full trial table — `best_trial()` agrees everywhere
+        with no driver — and (b) the MedianStopper prunes round r+1
+        against the merged history of ALL processes' earlier trials,
+        not just the local ones.
+
+        The collective is per-round, not per-epoch: processes run their
+        trial of a round at full speed and synchronise once, trading
+        stopper freshness within a round for zero mid-trial barriers
+        (a straggler trial can never deadlock a peer's collective)."""
+        import jax
+        from jax.experimental import multihost_utils
+
+        P = self._nprocs()
+        pid = jax.process_index()
+        n = len(self.trials)
+        pad = self.history_pad
+        # row layout: [status, has_metric, metric, n_reports,
+        #              ep0, v0, ep1, v1, ...] — has_metric is a separate
+        # flag (NOT NaN-in-band) so a legitimately-NaN metric from a
+        # diverged trial survives the exchange as NaN, and reports travel
+        # as (epoch, value) PAIRS so arbitrary epoch keys (step counts,
+        # non-contiguous) land in the right MedianStopper bucket on peers
+        rounds = (n + P - 1) // P
+        for r in range(rounds):
+            tid = r * P + pid
+            mine = self.trials[tid] if tid < n else None
+            if mine is not None:
+                # trial isolation (the Ray-actor-resources analog): the
+                # trainable sees a process-LOCAL mesh and single-host
+                # semantics — estimators inside trials must not emit
+                # cross-process collectives while peers run different
+                # configs at different speeds
+                from analytics_zoo_tpu.common.context import (
+                    OrcaContext, local_process_scope)
+
+                try:
+                    OrcaContext.get_context()
+                    scope = local_process_scope()
+                except RuntimeError:        # no context: pure-fn trainable
+                    import contextlib
+
+                    scope = contextlib.nullcontext()
+                with scope:
+                    self._run_one(mine)
+                logger.info("[proc %d] trial %d/%d %s %s=%s (%.1fs)",
+                            pid, tid + 1, n, mine.status, self.metric,
+                            mine.metric, mine.duration_s)
+            row = np.zeros(4 + 2 * pad, np.float64)
+            if mine is None:
+                row[0] = 3.0                        # noop pad slot
+            else:
+                row[0] = self._ST_CODE.get(mine.status, 2.0)
+                if mine.metric is not None:
+                    row[1], row[2] = 1.0, mine.metric
+                if len(mine.reports) > pad:
+                    logger.warning(
+                        "trial %d reported %d times but history_pad=%d; "
+                        "later reports are dropped from the exchange "
+                        "(raise SearchEngine(history_pad=...))",
+                        mine.trial_id, len(mine.reports), pad)
+                row[3] = len(mine.reports)
+                for j, (ep, v) in enumerate(mine.reports[:pad]):
+                    row[4 + 2 * j], row[5 + 2 * j] = ep, v
+            table = np.atleast_2d(np.asarray(
+                multihost_utils.process_allgather(row)))
+            for q in range(P):
+                tid_q, st = r * P + q, int(table[q, 0])
+                if st == 3 or tid_q >= n:
+                    continue
+                t = self.trials[tid_q]
+                # own trials too: the gathered row is float32 (x64 off),
+                # so adopting it everywhere keeps every process's trial
+                # table BIT-identical — best_trial() can never disagree
+                # on a tie that local float64 precision would break
+                t.status = self._CODE_ST.get(st, "error")
+                t.metric = float(table[q, 2]) if table[q, 1] else None
+                stored = min(int(table[q, 3]), pad)
+                t.reports = [(float(table[q, 4 + 2 * j]),
+                              float(table[q, 5 + 2 * j]))
+                             for j in range(stored)]
+                t.history = [v for _, v in t.reports]
+                t.metrics = {self.metric: t.metric} \
+                    if t.metric is not None else {}
+                if self.scheduler is not None and q != pid:
+                    # merge the peer's reports (at their TRUE epoch keys)
+                    # so the NEXT round's pruning medians see the whole
+                    # cluster (own reports were recorded live)
+                    for ep, v in t.reports:
+                        self.scheduler.record(ep, v)
+
     def best_trial(self) -> Trial:
+        # a diverged trial may legitimately report metric=NaN ('done',
+        # but incomparable) — exclude it or min()/max() returns NaN-
+        # poisoned garbage depending on trial order
         done = [t for t in self.trials
-                if t.status == "done" and t.metric is not None]
+                if t.status == "done" and t.metric is not None
+                and not np.isnan(t.metric)]
         if not done:
             errs = [t.error for t in self.trials if t.error]
             raise RuntimeError(

@@ -22,6 +22,7 @@ they raise with a pointer to `multihost`.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 from typing import Any, Dict, Optional
@@ -165,3 +166,59 @@ def stop_orca_context() -> None:
     """
     with _OrcaContextMeta._lock:
         _OrcaContextMeta._ctx = None
+
+
+# ---------------------------------------------------------------------------
+# process-local execution scope (distributed HPO trial isolation)
+# ---------------------------------------------------------------------------
+
+# Deliberately PROCESS-wide, not thread-local: the scope must be visible
+# to worker threads the scoped code spawns (device_prefetch's H2D thread
+# calls make_global_batch, whose multihost branch keys on
+# effective_process_count()).  Distributed HPO runs one scoped trial at a
+# time per process, so a process-wide flag cannot leak across trials.
+_LOCAL_SCOPE = {"on": False}
+
+
+def in_local_process_scope() -> bool:
+    return _LOCAL_SCOPE["on"]
+
+
+def effective_process_count() -> int:
+    """``jax.process_count()``, except inside :func:`local_process_scope`
+    where it is 1 — multihost code paths (data splitting, row-count
+    allgathers, early-stop agreement) must treat a scoped trial as a
+    single-host program or concurrent per-process trials would issue
+    mismatched cross-process collectives and deadlock."""
+    return 1 if in_local_process_scope() else jax.process_count()
+
+
+def effective_process_index() -> int:
+    return 0 if in_local_process_scope() else jax.process_index()
+
+
+@contextlib.contextmanager
+def local_process_scope(mesh_axes: Optional[Dict[str, int]] = None):
+    """Re-scope the framework to THIS process for the duration: the
+    context mesh covers only ``jax.local_devices()`` and every
+    process-count-dependent branch acts single-host.
+
+    This is the trial-isolation analog of the reference giving each Ray
+    Tune trial its own actor + resources (ref: SURVEY §3.6
+    RayTuneSearchEngine): during distributed HPO each process trains a
+    DIFFERENT config concurrently, so nothing inside a trial may
+    synchronise with peers.  File-path conventions (``{host}`` shard
+    naming) intentionally keep the REAL process index."""
+    ctx = OrcaContext.get_context()
+    old_mesh = ctx.mesh
+    from analytics_zoo_tpu.common.config import MeshConfig as _MC
+
+    local = mesh_lib.make_mesh(_MC(axes=dict(mesh_axes or {"dp": -1})),
+                               devices=jax.local_devices())
+    _LOCAL_SCOPE["on"] = True
+    ctx.mesh = local
+    try:
+        yield ctx
+    finally:
+        ctx.mesh = old_mesh
+        _LOCAL_SCOPE["on"] = False

@@ -19,6 +19,9 @@ from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
+
+from analytics_zoo_tpu.common.context import \
+    effective_process_count as _nhosts
 from jax.sharding import Mesh, NamedSharding
 
 from analytics_zoo_tpu.data.shards import XShards, shard_len
@@ -102,7 +105,7 @@ def make_global_batch(mesh: Mesh, batch: Dict[str, np.ndarray],
         packed = _pack_rows(batch)
         if packed is not None:
             buf, spec = packed
-            if jax.process_count() == 1:
+            if _nhosts() == 1:
                 gbuf = jax.device_put(buf, sh)
             else:
                 gbuf = jax.make_array_from_process_local_data(sh, buf)
@@ -113,7 +116,7 @@ def make_global_batch(mesh: Mesh, batch: Dict[str, np.ndarray],
                     (k, (gbuf.shape[0],) + shape[1:], dt, rb)
                     for (k, shape, dt, rb) in spec)
             return _unpacker(spec)(gbuf)
-    if jax.process_count() == 1:
+    if _nhosts() == 1:
         return {k: jax.device_put(v, sh) for k, v in batch.items()}
     return {k: jax.make_array_from_process_local_data(sh, v)
             for k, v in batch.items()}

@@ -21,6 +21,9 @@ from typing import Callable, List, Optional
 import jax
 
 from analytics_zoo_tpu.common import fs
+from analytics_zoo_tpu.common.context import (
+    effective_process_count as _nhosts,
+    effective_process_index as _hidx)
 from analytics_zoo_tpu.data.shards import XShards
 
 
@@ -44,8 +47,8 @@ def _expand(path_or_glob) -> List[str]:
 
 def _host_slice(files: List[str], host_index: Optional[int],
                 num_hosts: Optional[int]) -> List[str]:
-    hi = jax.process_index() if host_index is None else host_index
-    nh = jax.process_count() if num_hosts is None else num_hosts
+    hi = _hidx() if host_index is None else host_index
+    nh = _nhosts() if num_hosts is None else num_hosts
     # Hosts beyond len(files) naturally get an empty list — never duplicate
     # a file across hosts.
     return files[hi::nh]
@@ -58,8 +61,8 @@ def _read_files(reader: Callable, path, shards_per_host, host_index,
     shards = [reader(f, **kwargs) for f in mine]
     xs = XShards(
         shards,
-        num_hosts=jax.process_count() if num_hosts is None else num_hosts,
-        host_index=jax.process_index() if host_index is None else host_index)
+        num_hosts=_nhosts() if num_hosts is None else num_hosts,
+        host_index=_hidx() if host_index is None else host_index)
     if shards_per_host and shards:
         xs = xs.repartition(shards_per_host)
     return xs

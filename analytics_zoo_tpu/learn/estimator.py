@@ -32,6 +32,9 @@ import optax
 
 from analytics_zoo_tpu.common.config import TrainConfig
 from analytics_zoo_tpu.common.context import OrcaContext
+from analytics_zoo_tpu.common.context import (
+    effective_process_count as _nhosts,
+    effective_process_index as _hidx)
 from analytics_zoo_tpu.common.log import MetricLogger, logger
 from analytics_zoo_tpu.data.loader import (
     DataCreator, NumpyBatchIterator, device_prefetch, make_global_batch)
@@ -436,7 +439,7 @@ class FlaxEstimator:
         if validation_data is None:
             validation_data = getattr(data, "val", None)
         self._set_cols(feature_cols, label_cols)
-        n_hosts = jax.process_count()
+        n_hosts = _nhosts()
         n_groups, my_group, _ = self._data_groups
         if batch_size < 1 or batch_size % n_groups:
             raise ValueError(f"global batch {batch_size} must be positive "
@@ -621,7 +624,7 @@ class FlaxEstimator:
             logger.info("epoch %d: %s", self._epoch,
                         {k: round(v, 5) for k, v in stats.items()})
             history.append(stats)
-            if jax.process_count() > 1 and any(
+            if _nhosts() > 1 and any(
                     getattr(cb, "requests_stop", False)
                     for cb in callbacks):
                 # hosts must agree on the epoch count or the next
@@ -644,7 +647,7 @@ class FlaxEstimator:
         from analytics_zoo_tpu.data.shards import XShards
 
         n_groups = self._data_groups[0]
-        n_hosts = jax.process_count()
+        n_hosts = _nhosts()
         if n_groups != n_hosts and isinstance(
                 data, (DiskFeatureSet, XShards)):
             raise ValueError(
@@ -698,7 +701,7 @@ class FlaxEstimator:
         ``global_counts[j]`` is the true row total of chunk j across hosts,
         or None on a single host.
         """
-        if jax.process_count() == 1:
+        if _nhosts() == 1:
             return None
         counts = _allgather_counts(n_local)[:, 0]
         if counts.min() == 0:
@@ -787,7 +790,7 @@ class FlaxEstimator:
         self._ensure_state(sample)
         self._build_jits()
         outs, window = [], []
-        single_host = jax.process_count() == 1
+        single_host = _nhosts() == 1
         stream = self._local_eval_stream(data, per_host, arrays)
         for chunk in _padded_chunks(stream, plan and plan[0], sample):
             chunk = {k: v for k, v in chunk.items()
@@ -1041,9 +1044,9 @@ def _host_local(data, groups=None) -> Dict[str, np.ndarray]:
     from analytics_zoo_tpu.data.shards import XShards
 
     arrays = DataCreator.to_arrays(data)
-    ngroups, gi, _ = groups or (jax.process_count(), jax.process_index(),
+    ngroups, gi, _ = groups or (_nhosts(), _hidx(),
                                 None)
-    if jax.process_count() == 1 or ngroups == 1 or \
+    if _nhosts() == 1 or ngroups == 1 or \
             isinstance(data, XShards):
         return arrays
     n = len(next(iter(arrays.values())))
@@ -1068,7 +1071,7 @@ def _pad_batch(batch: Dict[str, np.ndarray], to: int):
 def _local_rows(preds) -> Any:
     """Fetch this host's rows of a (possibly sharded) prediction pytree."""
     def one(a):
-        if jax.process_count() == 1:
+        if _nhosts() == 1:
             return np.asarray(a)
         # multihost: concatenate this host's row shards in order, deduping
         # replicas (a replicated dim yields one shard per device with the

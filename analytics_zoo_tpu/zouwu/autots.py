@@ -129,8 +129,8 @@ class AutoTSTrainer:
         self.space = search_space or dict(_DEFAULT_SPACE)
 
     def fit(self, train_df, validation_df=None, *, n_sampling: int = 6,
-            epochs: int = 2, metric: str = "mse",
-            seed: int = 0) -> TSPipeline:
+            epochs: int = 2, metric: str = "mse", seed: int = 0,
+            distributed: bool = False) -> TSPipeline:
         transformer = TimeSequenceFeatureTransformer(
             dt_col=self.dt_col, target_col=self.target_col,
             extra_feature_cols=self.extra, lookback=self.lookback,
@@ -157,13 +157,20 @@ class AutoTSTrainer:
 
         engine = SearchEngine(trainable, self.space, metric=metric,
                               mode="min", n_sampling=n_sampling, seed=seed,
-                              scheduler=MedianStopper())
+                              scheduler=MedianStopper(),
+                              distributed=distributed)
         best = engine.run()
         logger.info("AutoTS best config=%s %s=%.5f", best.config,
                     metric, best.metric)
         # reuse the winner's trained forecaster if it was the last trial
         # run; otherwise retrain it (later trials overwrote the stash).
+        # Distributed mode never reuses the stash: only the winning
+        # process holds it (local-mesh-trained), and every process must
+        # enter the global-mesh retrain together or the reusing process
+        # deadlocks its peers' collectives.
         forecaster, cfg = getattr(trainable, "_last", (None, None))
+        if distributed and SearchEngine._nprocs() > 1:
+            cfg = None
         if cfg is not best.config:
             forecaster = _MODEL_BUILDERS[best.config.get("model", "tcn")](
                 best.config, self.horizon)

@@ -272,6 +272,51 @@ def scenario_elastic_resume(pid, outdir):
             "loss": [h["loss"] for h in hist]}
 
 
+def scenario_hpo(pid, outdir):
+    """Distributed HPO (ref: RayTuneSearchEngine scheduled trials across
+    the cluster): both processes pull trials from the same deterministic
+    queue, run them CONCURRENTLY on different configs, and converge on
+    the same best via the per-round result allgather.
+
+    Two planted signals: (a) a pure quadratic with its optimum at
+    lr=0.05 — every process must find it and agree; (b) each trial
+    additionally runs a REAL Estimator.fit inside the trial scope,
+    which would deadlock in a cross-process collective if trial
+    isolation (local_process_scope) were broken, since the peers train
+    different configs at different step counts."""
+    from analytics_zoo_tpu.automl import hp
+    from analytics_zoo_tpu.automl.search import MedianStopper, SearchEngine
+
+    x, y = make_data()
+    ran_here = []
+
+    def trainable(config, report):
+        # real per-trial training on the LOCAL mesh (different epochs per
+        # config -> different collective counts across processes)
+        est = make_estimator()
+        est.fit({"x": x, "y": y}, epochs=1 + (len(ran_here) % 2),
+                batch_size=16)
+        ran_here.append(config["lr"])
+        score = (config["lr"] - 0.05) ** 2
+        for ep in range(3):
+            report(ep, score * (3 - ep))
+        return {"loss": score}
+
+    engine = SearchEngine(
+        trainable, {"lr": hp.grid_search([0.2, 0.1, 0.05, 0.01, 0.3,
+                                          0.15])},
+        metric="loss", mode="min", scheduler=MedianStopper(),
+        distributed=True)
+    best = engine.run()
+    return {
+        "best_lr": best.config["lr"],
+        "best_metric": best.metric,
+        "ran_here": ran_here,
+        "statuses": [t.status for t in engine.trials],
+        "metrics": [t.metric for t in engine.trials],
+    }
+
+
 SCENARIOS = {
     "fit": scenario_fit,
     "predict": scenario_predict,
@@ -281,6 +326,7 @@ SCENARIOS = {
     "pp_ep": scenario_pp_ep,
     "elastic": scenario_elastic,
     "elastic_resume": scenario_elastic_resume,
+    "hpo": scenario_hpo,
 }
 
 SCENARIO_MESH = {

@@ -175,3 +175,52 @@ def test_tspipeline_predicts_true_future(tmp_path):
     assert preds.shape == (1, 1)  # one window -> one forecast
     # longer df: one prediction per window incl. the end-of-series one
     assert len(pipe.predict(df)) == len(df) - 12 + 1
+
+
+def test_local_process_scope_single_host(ctx8):
+    """Trial isolation: inside the scope the mesh is local devices only
+    and process-count-dependent branches act single-host; on exit the
+    global mesh is restored."""
+    import jax
+
+    from analytics_zoo_tpu.common.context import (
+        OrcaContext, effective_process_count, local_process_scope)
+
+    ctx = OrcaContext.get_context()
+    outer = ctx.mesh
+    with local_process_scope() as scoped:
+        assert effective_process_count() == 1
+        assert scoped.mesh.devices.size == len(jax.local_devices())
+        # an estimator built inside the scope trains on the scoped mesh
+        import numpy as np
+        import optax
+        import flax.linen as nn
+
+        from analytics_zoo_tpu.learn import Estimator
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)
+
+        est = Estimator.from_flax(model=M(), loss="mse",
+                                  optimizer=optax.sgd(0.1))
+        assert est.mesh is scoped.mesh
+        est.fit({"x": np.ones((32, 4), np.float32),
+                 "y": np.zeros((32, 1), np.float32)},
+                epochs=1, batch_size=8)
+    assert ctx.mesh is outer
+    assert effective_process_count() == jax.process_count()
+
+
+def test_distributed_engine_single_process_fallback():
+    """distributed=True on one process runs the plain sequential path."""
+    from analytics_zoo_tpu.automl import hp
+    from analytics_zoo_tpu.automl.search import SearchEngine
+
+    eng = SearchEngine(
+        lambda cfg, report: (cfg["a"] - 2) ** 2,
+        {"a": hp.grid_search([1, 2, 3])}, metric="loss", mode="min",
+        distributed=True)
+    best = eng.run()
+    assert best.config["a"] == 2

@@ -335,3 +335,27 @@ def test_multihost_pp_ep(tmp_path, nprocs):
     for r in results[1:]:
         np.testing.assert_allclose(results[0]["loss"], r["loss"],
                                    rtol=1e-6)
+
+
+def test_multihost_hpo_distributed_trials(tmp_path):
+    """Distributed HPO (ref: RayTuneSearchEngine scheduling trials across
+    the cluster, SURVEY §3.6): 2 processes drain one deterministic trial
+    queue concurrently — disjoint trials, per-round result allgather,
+    both agree on the planted best config — while each trial runs a REAL
+    Estimator.fit under trial isolation (a broken local_process_scope
+    would deadlock the gloo collectives and time the workers out)."""
+    results = run_scenario("hpo", tmp_path)
+    for r in results:
+        assert r["best_lr"] == pytest.approx(0.05)
+        assert r["best_metric"] == pytest.approx(0.0)
+        assert all(s in ("done", "pruned") for s in r["statuses"]), \
+            r["statuses"]
+    # all 6 grid trials have merged metrics on every process
+    assert results[0]["metrics"] == results[1]["metrics"]
+    assert len(results[0]["metrics"]) == 6
+    # the queue was drained DISJOINTLY and completely: round-robin gives
+    # process p trials p, p+2, p+4
+    ran0 = set(results[0]["ran_here"])
+    ran1 = set(results[1]["ran_here"])
+    assert not (ran0 & ran1)
+    assert len(ran0) == 3 and len(ran1) == 3
