@@ -255,8 +255,16 @@ class SearchEngine:
                               float(table[q, 5 + 2 * j]))
                              for j in range(stored)]
                 t.history = [v for _, v in t.reports]
-                t.metrics = {self.metric: t.metric} \
-                    if t.metric is not None else {}
+                if q == pid and t.metrics:
+                    # the owner keeps its full metrics dict (a dict-
+                    # returning trainable may report secondary metrics
+                    # the row can't carry) — only the optimised key is
+                    # snapped to the exchanged float32 value
+                    if t.metric is not None:
+                        t.metrics[self.metric] = t.metric
+                else:
+                    t.metrics = {self.metric: t.metric} \
+                        if t.metric is not None else {}
                 if self.scheduler is not None and q != pid:
                     # merge the peer's reports (at their TRUE epoch keys)
                     # so the NEXT round's pruning medians see the whole

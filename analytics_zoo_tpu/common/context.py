@@ -119,6 +119,17 @@ def init_context(
     if cluster_mode in ("multihost", "tpu-pod", "distributed"):
         # Replaces: conda-pack + spark-submit + barrier-mode `ray start`
         # (SURVEY.md §3.1). One collective handshake, no subprocesses.
+        # Explicit args > ZOO_* env (set by scripts/run_elastic.py so
+        # training scripts stay supervisor-agnostic) > jax autodetect
+        # from the TPU metadata server.
+        import os as _os
+
+        if coordinator_address is None:
+            coordinator_address = _os.environ.get("ZOO_COORDINATOR")
+        if num_processes is None and "ZOO_NUM_PROCESSES" in _os.environ:
+            num_processes = int(_os.environ["ZOO_NUM_PROCESSES"])
+        if process_id is None and "ZOO_PROCESS_ID" in _os.environ:
+            process_id = int(_os.environ["ZOO_PROCESS_ID"])
         kwargs: Dict[str, Any] = {}
         if coordinator_address is not None:
             kwargs["coordinator_address"] = coordinator_address

@@ -19,11 +19,10 @@ from typing import Any, Callable, Dict, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
+from jax.sharding import Mesh, NamedSharding
 
 from analytics_zoo_tpu.common.context import \
     effective_process_count as _nhosts
-from jax.sharding import Mesh, NamedSharding
-
 from analytics_zoo_tpu.data.shards import XShards, shard_len
 from analytics_zoo_tpu.parallel.partition import data_sharding
 

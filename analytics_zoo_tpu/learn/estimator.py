@@ -31,9 +31,8 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.common.config import TrainConfig
-from analytics_zoo_tpu.common.context import OrcaContext
 from analytics_zoo_tpu.common.context import (
-    effective_process_count as _nhosts,
+    OrcaContext, effective_process_count as _nhosts,
     effective_process_index as _hidx)
 from analytics_zoo_tpu.common.log import MetricLogger, logger
 from analytics_zoo_tpu.data.loader import (
@@ -430,11 +429,19 @@ class FlaxEstimator:
         label_cols: Optional[Sequence[str]] = None,
         checkpoint_trigger: Optional[Trigger] = None,
         callbacks: Sequence[Callable[[Dict], None]] = (),
+        auto_resume: bool = False,
     ) -> List[Dict[str, float]]:
         """Train. `batch_size` is GLOBAL (reference semantics: total across
         the cluster); when omitted it falls back to the data container's
         own batch_size (TFDataset carries one) and then 32. Returns
-        per-epoch stats dicts (reference: Orca runner stats lists)."""
+        per-epoch stats dicts (reference: Orca runner stats lists).
+
+        ``auto_resume=True`` makes the call restart-idempotent (SURVEY §5
+        elastic recovery; pairs with scripts/run_elastic.py): if
+        ``config.checkpoint_dir`` holds a checkpoint, restore it and
+        train only the REMAINING epochs toward the ``epochs`` total —
+        a respawned process group continues where the dead one stopped,
+        with no resume logic in user code."""
         batch_size = _resolve_batch(batch_size, data, "batch_size")
         if validation_data is None:
             validation_data = getattr(data, "val", None)
@@ -504,6 +511,55 @@ class FlaxEstimator:
         if min_steps is not None and min_steps < it.steps_per_epoch():
             it = _StepLimitIterator(it, min_steps)
         self._build_jits()
+        if auto_resume:
+            if not self.config.checkpoint_dir:
+                raise ValueError(
+                    "fit(auto_resume=True) needs config.checkpoint_dir — "
+                    "there is nowhere to resume from")
+            mgr = self._checkpoint_manager(self.config.checkpoint_dir)
+            latest = mgr.latest_step()
+            if n_hosts > 1:
+                # hosts must AGREE on the resume point before any of them
+                # commits to an epoch count (mismatched counts deadlock
+                # the collective program — same reason fit allgathers row
+                # counts).  Disagreement means checkpoint_dir is not the
+                # shared storage the contract requires (e.g. a replaced
+                # VM with an empty local disk): fail the same way on
+                # every host.
+                seen = _allgather_counts(
+                    -1 if latest is None else int(latest))[:, 0]
+                if len(set(seen.tolist())) > 1:
+                    raise ValueError(
+                        f"auto_resume: hosts see different latest "
+                        f"checkpoints {seen.tolist()} under "
+                        f"{self.config.checkpoint_dir!r} — the dir must "
+                        f"be shared storage (gs://...) visible to every "
+                        f"host")
+            if latest is not None:
+                self.load_checkpoint(self.config.checkpoint_dir)
+                logger.info(
+                    "auto-resume: restored step %d (epoch %d) from %s",
+                    self._global_step, self._epoch,
+                    self.config.checkpoint_dir)
+                if self._global_step % max(1, it.steps_per_epoch()):
+                    logger.warning(
+                        "auto-resume: restored step %d is mid-epoch "
+                        "(steps_per_epoch=%d); resume is EPOCH-"
+                        "granular, so the partial epoch's leading "
+                        "batches will be trained again — use an epoch-"
+                        "boundary checkpoint_trigger (EveryEpoch) when "
+                        "exact-once matters", self._global_step,
+                        it.steps_per_epoch())
+            if self._epoch >= epochs:
+                logger.info("auto-resume: %d epochs already complete",
+                            self._epoch)
+                return []
+            epochs = epochs - self._epoch
+            # continue the shuffle-seed schedule where the dead
+            # incarnation stopped (deterministic mode is unaffected)
+            inner = getattr(it, "_it", it)
+            if hasattr(inner, "epoch"):
+                inner.epoch = self._epoch
         # NOTE: _global_step is tracked host-side (incremented per step,
         # synced from device only on checkpoint restore).  Reading
         # int(self.state.step) here would be a D2H fetch before the hot

