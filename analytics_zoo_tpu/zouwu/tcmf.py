@@ -14,6 +14,17 @@ TPU re-design: no Ray actors — the whole alternating objective is jitted:
      on windows of X to predict the next basis step;
   3. forecast: autoregressively roll X forward h steps with the TCN,
      then Ŷ_future = F · X̂ — again one matmul on the MXU.
+
+Reference-scale n (the reason the reference distributed TCMF over Ray):
+``series_block=B`` streams the reconstruction in row blocks so device
+memory is O(B·T + k·T) — Y stays host-side, F (and its Adam state) lives
+host-side per block, only X + one block are resident.  The math is the
+SAME joint step: the loss decomposes over rows, every gradient is taken
+at epoch-start values (∂F_b from the block alone; ∂X accumulated across
+blocks), and Adam is elementwise — so the streamed update equals the
+dense update exactly, up to float summation order (equivalence test:
+tests/test_tcmf.py).  Multi-series scale-out across hosts composes the
+same way the reference's Ray actors did: block ranges per host.
 """
 
 from __future__ import annotations
@@ -38,17 +49,30 @@ class TCMFForecaster:
     """
 
     def __init__(self, rank: int = 16, window: int = 24, l2: float = 1e-4,
-                 tcn_channels=(32, 32), lr: float = 1e-2, seed: int = 0):
+                 tcn_channels=(32, 32), lr: float = 1e-2, seed: int = 0,
+                 series_block: Optional[int] = None,
+                 collect_memory_stats: bool = False):
         self.rank = rank
         self.window = window
         self.l2 = l2
         self.tcn_channels = tuple(tcn_channels)
         self.lr = lr
         self.seed = seed
-        self.F: Optional[jax.Array] = None
-        self.X: Optional[jax.Array] = None
+        # series_block=B streams the factorization in [B, T] row blocks:
+        # device memory O(B*T + k*T) instead of O(n*T) — the path for n
+        # beyond HBM (the reference's distributed-TCMF scale).
+        self.series_block = series_block
+        self.F: Optional[jax.Array] = None      # [n, k] (numpy when
+        #                                         streaming — host-resident)
+        self.X: Optional[jax.Array] = None      # [k, T]
         self._tcn = None
         self._tcn_params = None
+        # opt-in (costs an O(live-arrays) scan per block, and measures
+        # PROCESS-global live arrays — meaningful in a dedicated process
+        # / test, misleading next to unrelated resident models).  Reports
+        # the largest single live device array seen during fit.
+        self.collect_memory_stats = collect_memory_stats
+        self.peak_device_elems: Optional[int] = None
 
     # ------------------------------------------------------------------
 
@@ -66,36 +90,14 @@ class TCMFForecaster:
         k = self.rank
         key = jax.random.key(self.seed)
         kf, kx, kt = jax.random.split(key, 3)
-        mask = jnp.asarray(~np.isnan(y))
-        yj = jnp.nan_to_num(jnp.asarray(y))
         scale = float(np.nanstd(y) or 1.0)
-        F = jax.random.normal(kf, (n, k)) * 0.1
         X = jax.random.normal(kx, (k, T)) * 0.1
-        tx = optax.adam(self.lr)
-        opt = tx.init((F, X))
-
-        def recon_loss(FX):
-            F, X = FX
-            err = jnp.where(mask, yj - F @ X, 0.0)
-            denom = jnp.maximum(1, mask.sum())
-            return (jnp.sum(err * err) / denom / (scale * scale)
-                    + self.l2 * (jnp.mean(F * F) + jnp.mean(X * X)))
-
-        @jax.jit
-        def recon_step(FX, opt):
-            loss, g = jax.value_and_grad(recon_loss)(FX)
-            upd, opt = tx.update(g, opt, FX)
-            return optax.apply_updates(FX, upd), opt, loss
-
-        FX = (F, X)
-        loss = None
-        for ep in range(epochs):
-            FX, opt, loss = recon_step(FX, opt)
-            if verbose and (ep + 1) % 50 == 0:
-                logger.info("tcmf recon %d: %.5f", ep + 1,
-                            float(loss))
-        self.F, self.X = FX
-        recon = float(loss)
+        if self.series_block:
+            recon = self._fit_recon_streamed(y, X, kf, epochs, scale,
+                                             verbose)
+        else:
+            recon = self._fit_recon_dense(y, X, kf, epochs, scale,
+                                          verbose)
 
         # ---- dynamics: TCN over the basis ----------------------------
         from analytics_zoo_tpu.models.forecast import TCN
@@ -132,6 +134,141 @@ class TCMFForecaster:
         return stats
 
     # ------------------------------------------------------------------
+    # reconstruction backends
+    # ------------------------------------------------------------------
+
+    def _fit_recon_dense(self, y, X, kf, epochs, scale, verbose) -> float:
+        """Whole-matrix joint step (n fits in device memory)."""
+        n, T = y.shape
+        k = self.rank
+        mask = jnp.asarray(~np.isnan(y))
+        yj = jnp.nan_to_num(jnp.asarray(y))
+        F = jax.random.normal(kf, (n, k)) * 0.1
+        tx = optax.adam(self.lr)
+        opt = tx.init((F, X))
+
+        def recon_loss(FX):
+            F, X = FX
+            err = jnp.where(mask, yj - F @ X, 0.0)
+            denom = jnp.maximum(1, mask.sum())
+            return (jnp.sum(err * err) / denom / (scale * scale)
+                    + self.l2 * (jnp.mean(F * F) + jnp.mean(X * X)))
+
+        @jax.jit
+        def recon_step(FX, opt):
+            loss, g = jax.value_and_grad(recon_loss)(FX)
+            upd, opt = tx.update(g, opt, FX)
+            return optax.apply_updates(FX, upd), opt, loss
+
+        FX = (F, X)
+        loss = None
+        for ep in range(epochs):
+            FX, opt, loss = recon_step(FX, opt)
+            if verbose and (ep + 1) % 50 == 0:
+                logger.info("tcmf recon %d: %.5f", ep + 1, float(loss))
+        self.F, self.X = FX
+        return float(loss)
+
+    def _fit_recon_streamed(self, y, X, kf, epochs, scale,
+                            verbose) -> float:
+        """Row-block streaming joint step — the SAME update as
+        `_fit_recon_dense` (gradients at epoch-start values; the loss
+        decomposes over row blocks; Adam is elementwise, so per-block
+        Adam state equals the dense state sliced), with device memory
+        O(B·T + k·T).  Y, F and F's Adam moments stay host-side numpy;
+        each epoch streams every block through one jitted kernel,
+        accumulating X's gradient across blocks on device."""
+        n, T = y.shape
+        k, B = self.rank, int(self.series_block)
+        nb = (n + B - 1) // B
+        # global constants of the objective (the dense step's
+        # denominators); the NaN mask is computed ONCE — it never
+        # changes during fit
+        mask_np = ~np.isnan(y)
+        denom = float(max(1, mask_np.sum()))
+        y = np.nan_to_num(y)
+        sc2 = scale * scale
+        # host-resident factor + Adam moments (float32, [n, k] each) —
+        # the moments are the SAME optax.adam state as the dense path,
+        # sliced per block (ScaleByAdamState fields are plain arrays)
+        F = np.asarray(jax.random.normal(kf, (n, k))) * 0.1
+        F = F.astype(np.float32)
+        mF = np.zeros((n, k), np.float32)
+        vF = np.zeros((n, k), np.float32)
+        txF = optax.adam(self.lr)
+        optF_tmpl = txF.init(jnp.zeros((1, k)))     # state STRUCTURE
+        txX = optax.adam(self.lr)
+        optX = txX.init(X)
+
+        @jax.jit
+        def block_grads(Fb, X, yb, maskb):
+            """Loss contribution + gradients of THIS block at epoch-start
+            values.  l2 terms use the dense objective's global means:
+            mean(F*F) decomposes as sum(Fb*Fb)/(n*k)."""
+            err = jnp.where(maskb, yb - Fb @ X, 0.0)
+            part = jnp.sum(err * err) / denom / sc2 \
+                + self.l2 * jnp.sum(Fb * Fb) / (n * k)
+            gFb = (-2.0 / denom / sc2) * (err @ X.T) \
+                + self.l2 * 2.0 * Fb / (n * k)
+            gX_part = (-2.0 / denom / sc2) * (Fb.T @ err)
+            return part, gFb, gX_part
+
+        @jax.jit
+        def adam_block(Fb, gFb, mb, vb, count):
+            """One optimizer definition for both backends: rebuild the
+            optax.adam state from the sliced moments and step it."""
+            st = jax.tree.map(lambda x: x, optF_tmpl)   # copy structure
+            st = (st[0]._replace(count=count, mu=mb, nu=vb),) + st[1:]
+            upd, st = txF.update(gFb, st, Fb)
+            return (optax.apply_updates(Fb, upd),
+                    st[0].mu, st[0].nu)
+
+        @jax.jit
+        def apply_X(X, gX, optX):
+            # the l2 term on X is global — add it once, after the sum
+            gX = gX + self.l2 * 2.0 * X / (k * T)
+            upd, optX = txX.update(gX, optX, X)
+            return optax.apply_updates(X, upd), optX
+
+        peak = 0
+        loss = None
+        for ep in range(epochs):
+            count = jnp.int32(ep)       # optax counts UPDATES SO FAR
+            gX = jnp.zeros_like(X)
+            total = jnp.float32(0.0)
+            for b in range(nb):
+                lo, hi = b * B, min((b + 1) * B, n)
+                Fb_dev = jnp.asarray(F[lo:hi])      # one H2D per block
+                part, gFb, gX_part = block_grads(
+                    Fb_dev, X, jnp.asarray(y[lo:hi]),
+                    jnp.asarray(mask_np[lo:hi]))
+                total = total + part
+                gX = gX + gX_part
+                Fb, mb, vb = adam_block(
+                    Fb_dev, gFb, jnp.asarray(mF[lo:hi]),
+                    jnp.asarray(vF[lo:hi]), count)
+                if self.collect_memory_stats:
+                    # sample while the block's arrays are LIVE — the
+                    # honest transient footprint, not the between-epochs
+                    # floor (largest single array, process-global)
+                    peak = max(peak, max(
+                        (a.size for a in jax.live_arrays()), default=0))
+                F[lo:hi] = np.asarray(Fb)
+                mF[lo:hi] = np.asarray(mb)
+                vF[lo:hi] = np.asarray(vb)
+            # reported loss is at epoch-START values, like the dense
+            # value_and_grad (X's l2 term added before X is updated)
+            loss = float(total) + self.l2 * float(jnp.mean(X * X))
+            X, optX = apply_X(X, gX, optX)
+            if verbose and (ep + 1) % 50 == 0:
+                logger.info("tcmf recon %d (streamed): %.5f", ep + 1,
+                            loss)
+        self.F, self.X = F, X
+        if self.collect_memory_stats:
+            self.peak_device_elems = int(peak)
+        return float(loss)
+
+    # ------------------------------------------------------------------
 
     def predict(self, horizon: int = 24) -> np.ndarray:
         """Roll the basis forward `horizon` steps; return [n, horizon]."""
@@ -145,9 +282,11 @@ class TCMFForecaster:
                                   window[None])[0, -1]    # [k]
             return jnp.concatenate([window[1:], nxt[None]]), nxt
 
-        x_last = self.X.T[-w:]                            # [w, k]
+        x_last = jnp.asarray(self.X).T[-w:]               # [w, k]
         _, xs = jax.lax.scan(roll, x_last, None, length=horizon)
-        return np.asarray(self.F @ xs.T)                  # [n, horizon]
+        # host-side matmul keeps the streamed path's F off-device (block
+        # it if n*horizon ever matters; the output is host numpy anyway)
+        return np.asarray(self.F) @ np.asarray(xs).T      # [n, horizon]
 
     def evaluate(self, y_true: np.ndarray,
                  metrics=("mse",)) -> Dict[str, float]:
@@ -174,7 +313,7 @@ class TCMFForecaster:
 
         os.makedirs(path, exist_ok=True)
         blob = {"cfg": (self.rank, self.window, self.l2, self.tcn_channels,
-                        self.lr, self.seed),
+                        self.lr, self.seed, self.series_block),
                 "F": np.asarray(self.F), "X": np.asarray(self.X),
                 "tcn_params": jax.tree.map(np.asarray, self._tcn_params)}
         with open(os.path.join(path, "tcmf.pkl"), "wb") as f:
@@ -189,10 +328,16 @@ class TCMFForecaster:
 
         with open(os.path.join(path, "tcmf.pkl"), "rb") as f:
             blob = pickle.load(f)
-        rank, window, l2, chans, lr, seed = blob["cfg"]
+        cfg = blob["cfg"]
+        sb = cfg[6] if len(cfg) > 6 else None   # pre-streaming blobs
+        rank, window, l2, chans, lr, seed = cfg[:6]
         fc = TCMFForecaster(rank=rank, window=window, l2=l2,
-                            tcn_channels=chans, lr=lr, seed=seed)
-        fc.F = jnp.asarray(blob["F"])
+                            tcn_channels=chans, lr=lr, seed=seed,
+                            series_block=sb)
+        # F stays HOST-side: predict matmuls it in numpy, and pushing an
+        # AdServer-scale [n, k] to device on load would defeat the
+        # streamed path's memory contract
+        fc.F = np.asarray(blob["F"])
         fc.X = jnp.asarray(blob["X"])
         fc._tcn = TCN(output_dim=rank, horizon=1, dropout=0.0,
                       channels=chans)

@@ -72,3 +72,44 @@ def test_evaluate_and_errors():
     out = fc.evaluate(future, metrics=("mse", "mae", "smape"))
     assert set(out) == {"mse", "mae", "smape"}
     assert all(np.isfinite(v) for v in out.values())
+
+
+def test_streamed_equals_dense():
+    """series_block streams the SAME joint update (gradients at epoch-
+    start values, elementwise Adam per block): final factors match the
+    dense path to float-summation-order tolerance, with NaNs present."""
+    y, _ = _lowrank_series(n=48, T=60)
+    y[3, 7] = np.nan
+    y[40, 55] = np.nan
+    dense = TCMFForecaster(rank=4, window=12, seed=5)
+    dense.fit(y, epochs=60, tcn_epochs=5)
+    streamed = TCMFForecaster(rank=4, window=12, seed=5, series_block=16)
+    streamed.fit(y, epochs=60, tcn_epochs=5)
+    np.testing.assert_allclose(np.asarray(streamed.X),
+                               np.asarray(dense.X), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(streamed.F),
+                               np.asarray(dense.F), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(streamed.predict(8), dense.predict(8),
+                               rtol=5e-3, atol=5e-4)
+
+
+def test_streamed_bounds_device_memory():
+    """The reference distributed TCMF precisely because Y [n, T] outgrows
+    one box (SURVEY §2.5).  With series_block, the largest live device
+    array across the whole reconstruction must stay at block scale —
+    a simulated HBM budget far below the dense n*T footprint."""
+    rng = np.random.default_rng(0)
+    n, T, B = 4096, 96, 128
+    f = rng.normal(size=(n, 3)).astype(np.float32)
+    x = rng.normal(size=(3, T)).astype(np.float32)
+    y = f @ x + 0.01 * rng.normal(size=(n, T)).astype(np.float32)
+    fc = TCMFForecaster(rank=3, window=12, seed=1, series_block=B,
+                    collect_memory_stats=True)
+    fc.fit(y, epochs=3, tcn_epochs=2)
+    assert isinstance(fc.F, np.ndarray)         # host-resident factor
+    assert fc.peak_device_elems is not None
+    # budget: a few block-sized buffers, nowhere near the dense n*T
+    assert fc.peak_device_elems <= 4 * B * T, \
+        (fc.peak_device_elems, n * T)
+    assert fc.peak_device_elems < n * T // 4
+    assert fc.predict(6).shape == (n, 6)
