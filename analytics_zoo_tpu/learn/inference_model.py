@@ -223,11 +223,16 @@ class InferenceModel:
     def make_continuous_engine(self, max_slots: int = 8,
                                eos_id: Optional[int] = None,
                                ticks_per_step: int = 1,
-                               cache_dtype=None):
+                               cache_dtype=None,
+                               mesh=None, partition_rules=None):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
         loaded via ``load_flax_generator`` (quantized weights dequantize
         once at build — the engine trades the at-rest memory win for
-        per-token speed; keep the batch path for memory-bound serving)."""
+        per-token speed; keep the batch path for memory-bound serving).
+
+        ``mesh`` (with a ``tp`` axis) serves models beyond one chip's
+        HBM: weights + KV arena shard over tp (docs/serving.md
+        'tp-sharded generation')."""
         from analytics_zoo_tpu.serving.continuous import ContinuousEngine
 
         if getattr(self, "_gen_max_new_tokens", None) is None:
@@ -242,7 +247,8 @@ class InferenceModel:
             max_slots=max_slots,
             prompt_buckets=self._gen_prompt_buckets,
             eos_id=eos_id, pad_id=self.prompt_pad_id,
-            ticks_per_step=ticks_per_step, cache_dtype=cache_dtype)
+            ticks_per_step=ticks_per_step, cache_dtype=cache_dtype,
+            mesh=mesh, partition_rules=partition_rules)
 
     def load_openvino(self, xml_path: str, bin_path: str = None,
                       quantize: Optional[str] = None) -> "InferenceModel":

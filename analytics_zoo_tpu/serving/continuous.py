@@ -78,7 +78,16 @@ class ContinuousEngine:
                  prompt_buckets: Sequence[int] = (16, 32, 64, 128),
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  ticks_per_step: int = 1,
-                 cache_dtype=None):
+                 cache_dtype=None,
+                 mesh=None, partition_rules=None):
+        """``mesh`` (with a ``tp`` axis) serves a model LARGER than one
+        chip's HBM: weights shard per ``partition_rules`` (default
+        ``LM_PARTITION_RULES`` — Megatron layout), the KV arena shards
+        over tp on the kv-heads axis (each chip holds 1/tp of every
+        slot's cache), and slot bookkeeping (tok/pos/done) replicates.
+        XLA propagates the shardings through the jitted step/prefill/
+        splice programs — decode runs as one SPMD program with the tp
+        collectives the weight layout implies."""
         if model.pp_stages > 0:
             raise ValueError("continuous batching serves pp_stages=0 "
                              "models (models.lm.unstack_pp_params)")
@@ -101,8 +110,39 @@ class ContinuousEngine:
         D = model.hidden_size // model.num_heads
         cdtype = jnp.dtype(cache_dtype) if cache_dtype is not None \
             else jnp.dtype(model.dtype)
-        self._ck = jnp.zeros((model.num_layers, S, L, H, D), cdtype)
-        self._cv = jnp.zeros_like(self._ck)
+        self.mesh = mesh
+        tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+        if tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from analytics_zoo_tpu.models.lm import LM_PARTITION_RULES
+            from analytics_zoo_tpu.parallel.partition import state_sharding
+
+            if H % tp and partition_rules is None:
+                raise ValueError(
+                    f"kv_heads={H} must divide by tp={tp} to shard the "
+                    f"KV arena under the default LM_PARTITION_RULES; "
+                    f"narrow-KV (MQA/GQA) models pass partition_rules "
+                    f"with the key/value kernels replicated (P()) — the "
+                    f"arena then replicates too")
+            rules = partition_rules or LM_PARTITION_RULES
+            variables = jax.device_put(
+                variables, state_sharding(mesh, variables, rules))
+            # arena follows the kv-head geometry: sharded over tp when
+            # the heads divide, replicated for narrow-KV overrides
+            kv_sh = NamedSharding(
+                mesh, P(None, None, None, "tp", None) if H % tp == 0
+                else P())
+            # allocate sharded-from-BIRTH: materialising the full arena
+            # on one chip first would OOM exactly the beyond-one-chip
+            # models this path exists for
+            self._ck = jnp.zeros((model.num_layers, S, L, H, D), cdtype,
+                                 device=kv_sh)
+            self._cv = jnp.zeros((model.num_layers, S, L, H, D), cdtype,
+                                 device=kv_sh)
+        else:
+            self._ck = jnp.zeros((model.num_layers, S, L, H, D), cdtype)
+            self._cv = jnp.zeros_like(self._ck)
         self._variables = variables
         self.ticks_per_step = max(1, int(ticks_per_step))
         # host-side per-slot state (device copies travel as step args)
@@ -203,6 +243,13 @@ class ContinuousEngine:
             self._ck.dtype.itemsize
         full = 2 * m.num_layers * self._L * H_full * D * \
             jnp.dtype(m.dtype).itemsize
+        tp = int(self.mesh.shape.get("tp", 1)) if self.mesh is not None \
+            else 1
+        # per-chip pressure follows the arena's ACTUAL sharding — a
+        # narrow-KV override replicates it, so /tp would overstate
+        spec = getattr(self._ck.sharding, "spec", None)
+        arena_tp = tp if spec is not None and len(spec) > 3 \
+            and spec[3] == "tp" else 1
         return {
             "slots": self._S,
             "cache_len": self._L,
@@ -210,6 +257,10 @@ class ContinuousEngine:
             "cache_dtype": str(self._ck.dtype),
             "bytes_per_slot": per_slot,
             "arena_bytes": per_slot * self._S,
+            # tp shards the arena over chips: HBM pressure per chip is
+            # arena/tp, so tp slots multiply like a narrower dtype does
+            "tp": tp,
+            "arena_bytes_per_chip": per_slot * self._S // arena_tp,
             "capacity_multiplier_vs_mha_model_dtype":
                 round(full / per_slot, 2),
         }

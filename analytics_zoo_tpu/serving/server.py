@@ -130,9 +130,14 @@ class ClusterServing:
 
     def __init__(self, inference_model: InferenceModel,
                  config: Optional[ServingConfig] = None,
-                 embedded_broker: bool = False):
+                 embedded_broker: bool = False,
+                 engine_mesh=None, engine_partition_rules=None):
         self.model = inference_model
         self.config = config or ServingConfig()
+        # continuous batching on a tp mesh (models beyond one chip's
+        # HBM); Python-API only — a mesh is not a config.yaml value
+        self.engine_mesh = engine_mesh
+        self.engine_partition_rules = engine_partition_rules
         self._check_pad_agreement(inference_model)
         if self.config.core_number is not None:
             inference_model.set_concurrency(self.config.core_number)
@@ -243,7 +248,9 @@ class ClusterServing:
                 max_slots=self.config.engine_slots,
                 eos_id=self.config.eos_id,
                 ticks_per_step=self.config.engine_ticks,
-                cache_dtype=self.config.engine_cache_dtype)
+                cache_dtype=self.config.engine_cache_dtype,
+                mesh=self.engine_mesh,
+                partition_rules=self.engine_partition_rules)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
                                  name="zoo-serving-cb")
