@@ -80,20 +80,36 @@ class InferenceModel:
 
     # ---- loading -----------------------------------------------------
 
-    def _install_quantized(self, variables, quantize):
+    def _install_quantized(self, variables, quantize,
+                           allow_mxu: bool = False):
         """Shared weight-quantization staging for every load path:
         quantize the tree, stage it in device memory ONCE (the numpy
         leaves quantize_params builds would otherwise be re-uploaded on
-        every predict call), and install the fused dequant."""
+        every predict call), and install the fused dequant.
+
+        ``int8_mxu`` (on-MXU execution) is only valid where the model is
+        a flax-linen tree the method interceptor can rewrite —
+        ``load_flax`` sets ``allow_mxu``; importer-wrapped models
+        (OpenVINO/TF/torch translators) and the generation scan keep the
+        weight-only modes."""
         self.quant_stats = None
+        self._int8_mxu = False
+        if quantize == "int8_mxu" and not allow_mxu:
+            raise ValueError(
+                "quantize='int8_mxu' is only supported by load_flax "
+                "(flax-linen models); use 'int8' (weight-only) here")
         if quantize:
             from analytics_zoo_tpu.learn.quantize import (
                 dequantize, quantize_params)
 
+            mode = quantize
+            if quantize == "int8_mxu":
+                mode = "int8"           # same storage format
+                self._int8_mxu = True
             variables, self.quant_stats = quantize_params(variables,
-                                                          quantize)
+                                                          mode)
             variables = jax.device_put(variables)
-            self._dequant = dequantize
+            self._dequant = None if self._int8_mxu else dequantize
         else:
             self._dequant = None
         return variables
@@ -104,13 +120,17 @@ class InferenceModel:
 
         quantize: None | "int8" (weight-only symmetric int8, per-channel
         scales, dequant fused into the jitted forward — the reference's
-        OpenVINO int8 role) | "bf16" (cast weights to bfloat16).
+        OpenVINO int8 role; the memory-capacity mode) | "int8_mxu"
+        (on-MXU int8: dynamic per-tensor activation quantization and
+        int8 x int8 -> int32 Dense/Conv — the speed mode, ~2x MXU
+        int8 rate; docs/serving.md) | "bf16" (cast weights to bfloat16).
         ``self.quant_stats`` reports the measured weight-bytes compression.
         """
         import inspect
 
         self.model = model
-        self._variables = self._install_quantized(variables, quantize)
+        self._variables = self._install_quantized(variables, quantize,
+                                                  allow_mxu=True)
         self._takes_train = None    # re-derive per model: a stale value
         #                             from a previous load would pass an
         #                             unexpected kwarg into the new model
@@ -123,6 +143,8 @@ class InferenceModel:
         except (TypeError, ValueError):
             pass
 
+        int8_mxu = self._int8_mxu
+
         def apply_fn(variables, *feats):
             if self._dequant is not None:
                 variables = self._dequant(variables)
@@ -131,6 +153,10 @@ class InferenceModel:
                 kw["train"] = False
             elif self._takes_train == "deterministic":
                 kw["deterministic"] = True
+            if int8_mxu:
+                from analytics_zoo_tpu.learn.quantize import int8_call
+
+                return int8_call(model, variables, *feats, **kw)
             return model.apply(variables, *feats, **kw)
 
         self._apply_fn = apply_fn

@@ -126,12 +126,17 @@ class ContinuousEngine:
                     f"with the key/value kernels replicated (P()) — the "
                     f"arena then replicates too")
             rules = partition_rules or LM_PARTITION_RULES
-            variables = jax.device_put(
-                variables, state_sharding(mesh, variables, rules))
-            # arena follows the kv-head geometry: sharded over tp when
-            # the heads divide, replicated for narrow-KV overrides
+            shardings = state_sharding(mesh, variables, rules)
+            variables = jax.device_put(variables, shardings)
+            # the arena must MATCH what the kv projections emit under
+            # the chosen rules — custom rules that replicate the k/v
+            # kernels (even on a divisible-heads model) need a
+            # replicated arena, or every decode step pays resharding
+            # collectives the layout never required
+            kv_tp = H % tp == 0 and self._kv_kernels_tp_sharded(
+                shardings)
             kv_sh = NamedSharding(
-                mesh, P(None, None, None, "tp", None) if H % tp == 0
+                mesh, P(None, None, None, "tp", None) if kv_tp
                 else P())
             # allocate sharded-from-BIRTH: materialising the full arena
             # on one chip first would OOM exactly the beyond-one-chip
@@ -228,6 +233,24 @@ class ContinuousEngine:
             return ck, cv
 
         self._insert = jax.jit(insert_fn, donate_argnums=(0, 1))
+
+    @staticmethod
+    def _kv_kernels_tp_sharded(shardings) -> bool:
+        """Do the chosen rules put 'tp' on the k/v projection outputs?
+        Inspected from the sharding tree itself so the arena layout can
+        never drift from what the kernels actually emit."""
+        import jax as _jax
+
+        for path, sh in _jax.tree_util.tree_flatten_with_path(
+                shardings)[0]:
+            keys = [str(getattr(p, "key", "")) for p in path]
+            if "kernel" in keys and any(k in ("key", "value")
+                                        for k in keys):
+                spec = getattr(sh, "spec", ())
+                if any(ax == "tp" or (isinstance(ax, tuple)
+                                      and "tp" in ax) for ax in spec):
+                    return True
+        return False
 
     # ---- submission ---------------------------------------------------
 

@@ -74,6 +74,15 @@ class InputQueue:
         for k, v in data.items():
             if isinstance(v, ImageBytes):
                 fields += [k, IMG_MAGIC + bytes(v)]
+            elif isinstance(v, (bytes, bytearray, memoryview)):
+                # np.asarray(bytes) would silently make a |S-string
+                # scalar that explodes much later inside the server's
+                # jit with an inscrutable error — refuse it HERE with
+                # the fix named
+                raise TypeError(
+                    f"field {k!r} is raw bytes; wrap encoded images as "
+                    f"ImageBytes(b) (or use enqueue_image), and send "
+                    f"tensors as ndarrays")
             else:
                 fields += [k, encode_ndarray(np.asarray(v))]
         return self._xadd_capped(uri, fields)

@@ -531,7 +531,16 @@ class ClusterServing:
         optional resize to the configured model input shape; everything
         else is a dense tensor (b64 npy)."""
         if not v.startswith(IMG_MAGIC):
-            return decode_ndarray(v)
+            arr = decode_ndarray(v)
+            if arr.dtype.kind in "SUO":
+                # a byte/object tensor can never feed a jitted model;
+                # fail THIS request with the cause named instead of
+                # crashing the whole batch at dispatch
+                raise ValueError(
+                    f"request field decodes to dtype {arr.dtype} — send "
+                    f"numeric ndarrays, or ImageBytes/enqueue_image for "
+                    f"encoded images")
+            return arr
         from analytics_zoo_tpu.data.image import decode_image_bytes
 
         img = decode_image_bytes(v[len(IMG_MAGIC):])

@@ -80,8 +80,14 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
         im.load_flax_generator(model, variables, max_new_tokens=32,
                                prompt_buckets=(32,))
     else:
-        # "-int8": weight-only quantized serving (the OpenVINO int8 role)
-        quant = "int8" if model_kind.endswith("-int8") else None
+        # "-int8": weight-only quantized serving (the OpenVINO int8
+        # role, memory-capacity mode); "-int8mxu": on-MXU int8 (dynamic
+        # activation quant, int32 accumulation — the speed mode)
+        quant = None
+        if model_kind.endswith("-int8"):
+            quant = "int8"
+        elif model_kind.endswith("-int8mxu"):
+            quant = "int8_mxu"
         im.load_flax(model, variables, quantize=quant)
     serving = ClusterServing(im, cfg, embedded_broker=True).start()
 
@@ -308,6 +314,7 @@ def main():
             ("resnet18", 1, 50, 64), ("resnet18", 16, 20, 64),
             ("resnet18", 64, 10, 64),
             ("resnet18-int8", 64, 10, 64),
+            ("resnet18-int8mxu", 64, 10, 64),
             ("lm", 1, 20, 32), ("lm", 16, 10, 32), ("lm", 64, 5, 32),
             # open-loop Poisson mixed workload: clients = rate (req/s),
             # rpc = total requests; convoy vs continuous head-to-head

@@ -527,3 +527,24 @@ def test_engine_tp_arena_sharding_and_capacity(lm):
 
     solo = np.asarray(_gen(mqa, mv, jnp.asarray([[3, 5, 9]]), 4))[0]
     np.testing.assert_array_equal(got["m0"], solo)
+
+
+def test_engine_tp_arena_follows_custom_rules(lm):
+    """Custom rules that REPLICATE the k/v kernels on a divisible-heads
+    model must give a replicated arena (the arena layout follows what
+    the projections emit, not bare divisibility)."""
+    from jax.sharding import PartitionSpec as P
+
+    from analytics_zoo_tpu.models.lm import LM_PARTITION_RULES
+    from analytics_zoo_tpu.parallel.mesh import make_mesh
+
+    model, variables = lm       # 2 kv heads — divisible by tp=2
+    mesh = make_mesh(axes={"dp": -1, "tp": 2})
+    rules = ((r"(key|value)/kernel", P()),) + LM_PARTITION_RULES
+    eng = ContinuousEngine(model, variables, mesh=mesh,
+                           max_new_tokens=4, max_slots=2,
+                           prompt_buckets=(8,), partition_rules=rules)
+    assert all(ax is None for ax in eng._ck.sharding.spec), \
+        eng._ck.sharding.spec
+    rep = eng.capacity_report()
+    assert rep["arena_bytes_per_chip"] == rep["arena_bytes"]
