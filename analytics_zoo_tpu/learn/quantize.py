@@ -214,7 +214,17 @@ def int8_call(model, variables, *args, **kwargs):
             if kernel is not None:
                 x = iargs[0]
                 if type(mod) is nn.Dense:
-                    return _dense_int8(mod, x, kernel)
+                    # only the plain configuration: a scan/vmap-lifted
+                    # Dense carries a stacked (3-D) kernel, and a custom
+                    # dot_general / non-default precision would be
+                    # silently replaced — both take the float fallback
+                    if kernel[_Q].ndim == 2 \
+                            and getattr(mod, "dot_general", None) is None \
+                            and getattr(mod, "dot_general_cls", None) \
+                            is None \
+                            and getattr(mod, "precision", None) is None:
+                        return _dense_int8(mod, x, kernel)
+                    return next_fun(*iargs, **ikwargs)
                 nsp = kernel[_Q].ndim - 2
                 padding = _canon_padding(mod.padding, nsp)
                 if nsp in (1, 2, 3) and x.ndim == nsp + 2 \

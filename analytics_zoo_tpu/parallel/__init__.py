@@ -18,6 +18,7 @@ from analytics_zoo_tpu.parallel.pipeline import (
     pipeline_apply_1f1b,
     pipeline_value_and_grad,
     pipeline_1f1b_stats,
+    interleaved_1f1b_stats,
     sequential_apply,
     pp_stage_rules,
 )
@@ -38,6 +39,7 @@ __all__ = [
     "pipeline_apply_1f1b",
     "pipeline_value_and_grad",
     "pipeline_1f1b_stats",
+    "interleaved_1f1b_stats",
     "sequential_apply",
     "pp_stage_rules",
 ]

@@ -147,6 +147,41 @@ def pipeline_apply(stage_fn: StageFn, stacked_params: Any, x: jax.Array,
                          out_specs=xspec)(stacked_params, x)
 
 
+def interleaved_1f1b_stats(n_stages: int, n_microbatches: int,
+                           n_chunks: int) -> dict:
+    """Static schedule facts for ``pipeline_value_and_grad(...,
+    n_chunks=v)`` — the interleaved (virtual-stage) 1F1B schedule.
+
+    Each of the S pp ranks holds ``v`` model chunks placed round-robin
+    (logical stage ``j = k*S + r`` is chunk ``k`` of rank ``r``), so a
+    microbatch crosses every rank ``v`` times.  One combined tick does
+    one forward AND one backward unit per rank, but a unit is now a
+    CHUNK — 1/v of a rank's model slice — so a tick costs 1/v of a flat
+    tick.  Ramp-up/down shrinks accordingly: measured in flat-tick
+    equivalents the schedule spends ``M + S + (S-2)/v`` versus flat
+    1F1B's ``M + 2S - 2`` — strictly better for S >= 3, v >= 2, and
+    approaching HALF the flat bubble as v grows.  The price is v×: the
+    residual ring holds ``2*v*S`` chunk inputs per rank (vs 2S), and
+    activations hop ranks v times per microbatch (wrap-around ppermute
+    traffic) instead of once — the standard interleaved-schedule trade
+    (bubble ↓, memory + ICI traffic ↑).  Residency stays M-independent,
+    which is what lets M grow to amortise what bubble remains."""
+    S, M, v = int(n_stages), int(n_microbatches), int(n_chunks)
+    L = v * S
+    g_last, q_last = (M - 1) // S, (M - 1) % S
+    ticks = g_last * L + q_last + 2 * L - 1        # chunk-sized ticks
+    flat = pipeline_1f1b_stats(S, M)
+    return {
+        "ticks": ticks,
+        "flat_tick_equivalents": ticks / v,
+        "flat_1f1b_ticks": flat["ticks"],
+        "bubble_fraction": (ticks - v * M) / ticks,
+        "flat_bubble_fraction": flat["bubble_fraction"],
+        "residual_slots": 2 * L,                   # chunk inputs per rank
+        "flat_residual_slots": flat["residual_slots"],
+    }
+
+
 def pipeline_1f1b_stats(n_stages: int, n_microbatches: int) -> dict:
     """Static schedule facts for ``pipeline_value_and_grad`` (asserted by
     tests, cited in docs).  The lockstep combined-tick schedule runs
@@ -272,11 +307,115 @@ def _f1b_ticks(stage_fn, p_local, mb, aux, S, m_eff, idx, pp_axis, vary,
     return gacc, dxbuf, lossbuf
 
 
+def _f1b_ticks_interleaved(stage_fn, p_chunks, mb, aux, S, v, m_eff, idx,
+                           pp_axis, vary, head):
+    """The interleaved (virtual-stage) 1F1B tick engine.  Rank ``r``
+    holds chunks ``k = 0..v-1`` (stacked leading dim of ``p_chunks``);
+    logical stage ``j = k*S + r`` — round-robin placement, so the
+    rank→rank hop is always one step and wraps S-1 → 0 between chunks.
+
+    Schedule: microbatch ``m = g*S + q`` forwards through logical stage
+    ``j`` at tick ``u_f = g*v*S + k*S + q + r`` and backwards at
+    ``u_b = u_f + 2*(L-1-j)`` (``L = v*S``); the last logical stage's
+    backward fuses with its forward tick.  Both maps are bijections per
+    (rank, tick) — ``u_f - r`` decomposes uniquely base-(S, v, ·) and
+    ``u_b + r - 2L + 2 = (g*v - k)*S + q`` uniquely too — so every rank
+    runs exactly one fwd and one bwd CHUNK unit per tick.  With v = 1
+    this is precisely the flat schedule of ``_f1b_ticks``; kept separate
+    because the flat engine's non-wrapping ppermute and 2S ring are the
+    proven baseline the tests compare against.
+
+    Backward units recompute their chunk forward from the saved chunk
+    INPUT (chunk-level remat) held in a ring of ``2L`` slots — slot
+    ``(u_f - r) mod 2L`` is collision-free because a saved input lives
+    at most ``2(L-1)`` fwd-issues.  Returns raw per-rank ``(gacc [v,...],
+    dxbuf, lossbuf)`` sums; all scaling belongs to the caller."""
+    L = v * S
+    R = 2 * L
+    g_last, q_last = (m_eff - 1) // S, (m_eff - 1) % S
+    ticks = g_last * L + q_last + 2 * L - 1
+
+    def chunk(p, k):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), p)
+
+    def tick(carry, t):
+        act_in, gract_in, resbuf, gacc, dxbuf, lossbuf = carry
+        # ---- forward unit: w = t - r = g*v*S + k*S + q ----
+        w_f = t - idx
+        q_f = jnp.mod(w_f, S)
+        k_f = jnp.mod((w_f - q_f) // S, v)
+        m_f = (w_f // L) * S + q_f
+        valid_f = (w_f >= 0) & (m_f < m_eff)
+        m_fc = jnp.clip(m_f, 0, m_eff - 1)
+        inject = lax.dynamic_index_in_dim(mb, m_fc, 0, keepdims=False)
+        cur = jnp.where((idx == 0) & (k_f == 0), inject, act_in)
+        y = stage_fn(chunk(p_chunks, k_f), cur)
+        slot_f = jnp.mod(w_f, R)
+        old = lax.dynamic_index_in_dim(resbuf, slot_f, 0, keepdims=False)
+        resbuf = lax.dynamic_update_index_in_dim(
+            resbuf, jnp.where(valid_f, cur, old), slot_f, 0)
+        arow = lax.dynamic_index_in_dim(aux, m_fc, 0, keepdims=False)
+        loss_m, gy = head(y, arow)
+        # ---- backward unit: w = t + r - 2L + 2 = (g*v - k)*S + q ----
+        w_b = t + idx - 2 * L + 2
+        q_b = jnp.mod(w_b, S)
+        h = (w_b - q_b) // S
+        k_b = jnp.mod(-h, v)
+        m_b = ((h + k_b) // v) * S + q_b
+        valid_b = (m_b >= 0) & (m_b < m_eff)
+        m_bc = jnp.clip(m_b, 0, m_eff - 1)
+        # where this bwd unit's forward saved its input:
+        # u_f = t - 2*(L-1-j_b), j_b = k_b*S + idx  =>  w = u_f - idx
+        w_fb = t + idx + 2 * k_b * S - 2 * L + 2
+        a_saved = lax.dynamic_index_in_dim(
+            resbuf, jnp.mod(w_fb, R), 0, keepdims=False)
+        is_last_b = (idx == S - 1) & (k_b == v - 1)   # fused with fwd tick
+        g_use = jnp.where(is_last_b, gy.astype(gract_in.dtype), gract_in)
+        _, vjp = jax.vjp(stage_fn, chunk(p_chunks, k_b), a_saved)
+        dp, da = vjp(g_use.astype(y.dtype))
+        gacc = jax.tree.map(
+            lambda g, d: lax.dynamic_update_index_in_dim(
+                g,
+                lax.dynamic_index_in_dim(g, k_b, 0, keepdims=False)
+                + jnp.where(valid_b, d, 0.0).astype(g.dtype),
+                k_b, 0),
+            gacc, dp)
+        dslot = lax.dynamic_index_in_dim(dxbuf, m_bc, 0, keepdims=False)
+        dxbuf = lax.dynamic_update_index_in_dim(
+            dxbuf,
+            jnp.where((idx == 0) & (k_b == 0) & valid_b, da, dslot),
+            m_bc, 0)
+        lslot = lax.dynamic_index_in_dim(lossbuf, m_fc, 0, keepdims=False)
+        lossbuf = lax.dynamic_update_index_in_dim(
+            lossbuf,
+            jnp.where((idx == S - 1) & (k_f == v - 1) & valid_f,
+                      loss_m, lslot),
+            m_fc, 0)
+        # ---- hops: WRAP-AROUND — rank S-1's chunk-k output is rank 0's
+        # chunk-(k+1) input one tick later (and symmetrically backward)
+        act_out = lax.ppermute(y, pp_axis,
+                               [(i, (i + 1) % S) for i in range(S)])
+        gract_out = lax.ppermute(da, pp_axis,
+                                 [((i + 1) % S, i) for i in range(S)])
+        return (act_out, gract_out, resbuf, gacc, dxbuf, lossbuf), None
+
+    z_mb = jnp.zeros_like(mb[0])
+    carry = (vary(z_mb), vary(z_mb),
+             vary(jnp.zeros((R,) + z_mb.shape, z_mb.dtype)),
+             jax.tree.map(lambda p: vary(jnp.zeros_like(p)), p_chunks),
+             vary(jnp.zeros_like(mb)),
+             vary(jnp.zeros((m_eff,), jnp.float32)))
+    (_, _, _, gacc, dxbuf, lossbuf), _ = lax.scan(
+        tick, carry, jnp.arange(ticks))
+    return gacc, dxbuf, lossbuf
+
+
 def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
                             x: jax.Array, labels, mesh: Mesh,
                             n_microbatches: int, *,
                             batch_axes: Sequence[str] = ("dp", "fsdp"),
-                            pp_axis: str = "pp"):
+                            pp_axis: str = "pp", n_chunks: int = 1):
     """One interleaved-1F1B training tick-schedule: loss AND gradients of
     ``mean(loss_fn(stage_S(...stage_1(x)), labels))`` in a single
     shard_map scan.
@@ -305,6 +444,14 @@ def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
     ``grads`` matches ``stacked_params`` (sharded P(pp) like the
     params) and ``dx`` is the loss gradient w.r.t. ``x`` (feeds
     embedding/pre-trunk backward when composed manually).
+
+    ``n_chunks=v > 1`` selects the INTERLEAVED schedule: stacked_params
+    must carry ``v * S`` stages (logical order on the leading dim);
+    stage ``j`` is placed on rank ``j % S`` (round-robin), cutting the
+    bubble from ``2S - 2`` to ``S + (S-2)/v`` flat-tick equivalents at
+    the cost of a ``2vS``-slot residual ring and v× the ppermute
+    traffic (``interleaved_1f1b_stats``).  Math is identical — same
+    oracle, same tests.
     """
     S = int(mesh.shape[pp_axis]) if pp_axis in mesh.axis_names else 1
     if S == 1:
@@ -314,11 +461,18 @@ def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
         loss, (gp, gx) = jax.value_and_grad(seq_loss, argnums=(0, 1))(
             stacked_params, x)
         return loss, gp, gx
-    _check_stacked(stacked_params, S)
+    v = int(n_chunks)
+    if v < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+    _check_stacked(stacked_params, v * S)
     M = int(n_microbatches)
     batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     xspec = P(batch, *([None] * (x.ndim - 1)))
     lspec = P(batch, *([None] * (jnp.ndim(labels) - 1)))
+    if v > 1:
+        return _value_and_grad_interleaved(
+            stage_fn, loss_fn, stacked_params, x, labels, mesh, M, S, v,
+            batch, xspec, lspec, pp_axis)
     pspec = jax.tree.map(lambda _: P(pp_axis), stacked_params)
 
     def ranked(params, xl, ll):
@@ -358,6 +512,55 @@ def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
     loss, grads, dx = jax.shard_map(
         ranked, mesh=mesh, in_specs=(pspec, xspec, lspec),
         out_specs=(P(), pspec, xspec))(stacked_params, x, labels)
+    return loss, grads, dx
+
+
+def _value_and_grad_interleaved(stage_fn, loss_fn, stacked_params, x,
+                                labels, mesh, M, S, v, batch, xspec,
+                                lspec, pp_axis):
+    """Interleaved-schedule body of ``pipeline_value_and_grad``: params
+    [L, ...] reshape to [v, S, ...] so ``P(None, pp)`` realises the
+    round-robin placement (leaf[k, r] = logical stage k*S + r); each
+    rank sees its own [v, ...] chunk stack inside shard_map.  Scaling
+    contract is identical to the flat path."""
+    p_resh = jax.tree.map(
+        lambda a: a.reshape((v, S) + a.shape[1:]), stacked_params)
+    pspec = jax.tree.map(lambda _: P(None, pp_axis), p_resh)
+
+    def ranked(params, xl, ll):
+        idx = lax.axis_index(pp_axis)
+        b = xl.shape[0]
+        m_eff = math.gcd(M, b)
+        mb = xl.reshape((m_eff, b // m_eff) + xl.shape[1:])
+        lb = ll.reshape((m_eff, b // m_eff) + ll.shape[1:])
+        vary = _make_vary(pp_axis, batch)
+        p_chunks = jax.tree.map(lambda a: vary(a[:, 0]), params)
+
+        def head(y, lbl):
+            return jax.value_and_grad(lambda yy: loss_fn(yy, lbl))(y)
+
+        gacc, dxbuf, lossbuf = _f1b_ticks_interleaved(
+            stage_fn, p_chunks, mb, lb, S, v, m_eff, idx, pp_axis, vary,
+            head)
+        n_b = 1
+        for ax in (batch or ()):
+            n_b *= int(mesh.shape[ax])
+        loss = lax.psum(jnp.where(idx == S - 1, jnp.sum(lossbuf), 0.0),
+                        pp_axis) / m_eff
+        dx = lax.psum(jnp.where(idx == 0, dxbuf, 0.0),
+                      pp_axis).reshape(xl.shape) / (m_eff * n_b)
+        grads = jax.tree.map(lambda g: g / m_eff, gacc)
+        if batch:
+            loss = lax.pmean(loss, batch)
+            grads = jax.tree.map(lambda g: lax.pmean(g, batch), grads)
+        grads = jax.tree.map(lambda g: g[:, None], grads)
+        return loss, grads, dx.astype(xl.dtype)
+
+    loss, grads, dx = jax.shard_map(
+        ranked, mesh=mesh, in_specs=(pspec, xspec, lspec),
+        out_specs=(P(), pspec, xspec))(p_resh, x, labels)
+    grads = jax.tree.map(lambda g, a: g.reshape(a.shape), grads,
+                         stacked_params)
     return loss, grads, dx
 
 

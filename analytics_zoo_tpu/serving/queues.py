@@ -74,15 +74,17 @@ class InputQueue:
         for k, v in data.items():
             if isinstance(v, ImageBytes):
                 fields += [k, IMG_MAGIC + bytes(v)]
-            elif isinstance(v, (bytes, bytearray, memoryview)):
-                # np.asarray(bytes) would silently make a |S-string
-                # scalar that explodes much later inside the server's
-                # jit with an inscrutable error — refuse it HERE with
-                # the fix named
+            elif isinstance(v, (bytes, bytearray, memoryview, str)):
+                # np.asarray(bytes/str) would silently make a |S/|U
+                # string scalar that explodes much later inside the
+                # server's jit with an inscrutable error — refuse it
+                # HERE with the fix named
                 raise TypeError(
-                    f"field {k!r} is raw bytes; wrap encoded images as "
-                    f"ImageBytes(b) (or use enqueue_image), and send "
-                    f"tensors as ndarrays")
+                    f"field {k!r} is {type(v).__name__}; wrap encoded "
+                    f"images as ImageBytes(b) (or use enqueue_image), "
+                    f"send tensors as ndarrays, and generative prompts "
+                    f"as 1-D int32 token arrays (the prompt_col "
+                    f"contract)")
             else:
                 fields += [k, encode_ndarray(np.asarray(v))]
         return self._xadd_capped(uri, fields)

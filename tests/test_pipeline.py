@@ -230,6 +230,104 @@ def test_1f1b_single_stage_mesh_falls_back():
         np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7), gp, ref_gp)
 
 
+# ---- interleaved (virtual-stage) 1F1B ----------------------------------
+
+
+@pytest.mark.parametrize("mesh_axes,micro,v", [
+    ({"pp": 4, "dp": 2}, 4, 2),     # L=8 logical stages over 4 ranks
+    ({"pp": 2, "dp": 4}, 8, 3),     # L=6 over 2 ranks, v=3
+    ({"pp": 8}, 8, 2),              # pp-only mesh, L=16
+    ({"pp": 2, "dp": 2, "tp": 2}, 4, 2),
+])
+def test_interleaved_1f1b_matches_sequential(mesh_axes, micro, v):
+    """The interleaved-schedule oracle: with v chunks per rank (stacked
+    params carry v*S logical stages), loss / param grads / input grads
+    equal jax.value_and_grad of the sequential composition — the
+    schedule is a pure wall-clock/memory transform."""
+    from analytics_zoo_tpu.parallel import pipeline_value_and_grad
+
+    mesh = make_mesh(axes=mesh_axes)
+    width, B = 16, 24
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(B, width)).astype(np.float32))
+    lbl = jnp.asarray(rng.normal(size=(B, width)).astype(np.float32))
+    S = mesh_axes["pp"]
+    params = _stacked_params(v * S, width, x[:1], seed=5)
+    fn = _stage_fn(width)
+
+    def ref(p, xx):
+        return _mse(sequential_apply(fn, p, xx), lbl)
+
+    ref_loss, (ref_gp, ref_gx) = jax.value_and_grad(
+        ref, argnums=(0, 1))(params, x)
+    loss, gp, gx = jax.jit(
+        lambda p, xx, ll: pipeline_value_and_grad(
+            fn, _mse, p, xx, ll, mesh, micro, n_chunks=v))(params, x, lbl)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), gp, ref_gp)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(ref_gx),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_interleaved_1f1b_partial_group():
+    """m_eff not divisible by S exercises the masked partial microbatch
+    group (the schedule decomposition stays a bijection; trailing units
+    are invalid-masked, costing bubble, never correctness)."""
+    from analytics_zoo_tpu.parallel import pipeline_value_and_grad
+
+    mesh = make_mesh(axes={"pp": 4, "dp": 2})
+    width = 8
+    rng = np.random.default_rng(9)
+    # 3 rows per dp rank -> m_eff = gcd(6, 3) = 3, not divisible by S=4
+    x = jnp.asarray(rng.normal(size=(6, width)).astype(np.float32))
+    lbl = jnp.asarray(rng.normal(size=(6, width)).astype(np.float32))
+    params = _stacked_params(8, width, x[:1], seed=2)
+    fn = _stage_fn(width)
+
+    def ref(p, xx):
+        return _mse(sequential_apply(fn, p, xx), lbl)
+
+    ref_loss, (ref_gp, ref_gx) = jax.value_and_grad(
+        ref, argnums=(0, 1))(params, x)
+    loss, gp, gx = jax.jit(
+        lambda p, xx, ll: pipeline_value_and_grad(
+            fn, _mse, p, xx, ll, mesh, 6, n_chunks=2))(params, x, lbl)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), gp, ref_gp)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(ref_gx),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_interleaved_stats_beat_flat_at_equal_m():
+    """The point of interleaving (VERDICT r4 ask #9): at EQUAL M the
+    interleaved schedule spends fewer flat-tick equivalents than flat
+    1F1B — bubble S + (S-2)/v vs 2S - 2 — and the gap widens with v;
+    residency stays M-independent (the property that lets M grow)."""
+    from analytics_zoo_tpu.parallel import (interleaved_1f1b_stats,
+                                            pipeline_1f1b_stats)
+
+    S, M = 4, 8
+    flat = pipeline_1f1b_stats(S, M)
+    il2 = interleaved_1f1b_stats(S, M, n_chunks=2)
+    il4 = interleaved_1f1b_stats(S, M, n_chunks=4)
+    # v=2, S=4, M=8: ticks = vM + (v+1)S - 2 = 26 -> 13 flat-equivalents
+    assert il2["ticks"] == 2 * M + 3 * S - 2
+    assert il2["flat_tick_equivalents"] == pytest.approx(13.0)
+    assert flat["ticks"] == M + 2 * S - 2 == 14
+    assert il2["flat_tick_equivalents"] < flat["ticks"]
+    assert il4["flat_tick_equivalents"] < il2["flat_tick_equivalents"]
+    # bubble in flat-tick equivalents: S + (S-2)/v, monotone in v,
+    # floor S vs flat's 2S-2
+    assert il2["flat_tick_equivalents"] - M == pytest.approx(
+        S + (S - 2) / 2)
+    # residency: v x flat's ring, still independent of M
+    assert il2["residual_slots"] == 2 * 2 * S
+    assert interleaved_1f1b_stats(S, 256, 2)["residual_slots"] == \
+        il2["residual_slots"]
+
+
 @pytest.mark.parametrize("mesh_axes,micro", [
     ({"pp": 4, "dp": 2}, 4),
     ({"pp": 2, "dp": 4}, 8),

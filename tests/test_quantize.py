@@ -163,6 +163,55 @@ def test_int8_mxu_conv_resnet_through_inference_model(ctx8):
     assert (got.argmax(1) == ref.argmax(1)).mean() >= 0.75
 
 
+def test_int8_mxu_scan_lifted_dense_falls_back_to_float():
+    """A nn.scan-lifted Dense carries a STACKED (3-D) int8 kernel; the
+    interceptor must take the float fallback (weight-only semantics),
+    not feed the stacked kernel to the 2-D int8 matmul (which crashes
+    at trace time — the documented robustness contract)."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.learn.quantize import int8_call
+
+    class Blk(nn.Module):
+        @nn.compact
+        def __call__(self, x, _):
+            return nn.gelu(nn.Dense(x.shape[-1])(x)), None
+
+    class Scanned(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(64, name="inproj")(x)      # plain: int8 path
+            stack = nn.scan(Blk, variable_axes={"params": 0},
+                            split_rngs={"params": True}, length=3)
+            x, _ = stack(name="layers")(x, None)    # stacked: fallback
+            return nn.Dense(10, name="head")(x)
+
+    model = Scanned()
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(8, 32)).astype(np.float32)
+    variables = model.init(jax.random.key(0), x[:1])
+    qv, _ = quantize_params(variables, "int8")
+    ref = np.asarray(model.apply(variables, x))
+    got = np.asarray(jax.jit(lambda v, a: int8_call(model, v, a))(qv, x))
+    rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6)
+    assert rel < 0.1, rel
+    # the plain Denses still ride the MXU int8 path
+    jxp = str(jax.make_jaxpr(lambda v, a: int8_call(model, v, a))(qv, x))
+    assert "preferred_element_type=int32" in jxp
+
+
+def test_enqueue_rejects_str_fields():
+    """Strings would become |U ndarrays and fail deep inside the server;
+    the enqueue-side guard names the fix immediately (same contract as
+    raw bytes)."""
+    from analytics_zoo_tpu.serving.queues import InputQueue
+
+    q = InputQueue.__new__(InputQueue)      # no broker needed: the
+    q.max_backlog = 0                       # guard fires before I/O
+    with pytest.raises(TypeError, match="str"):
+        q.enqueue("u1", x="hello")
+
+
 def test_int8_mxu_rejected_outside_load_flax():
     from analytics_zoo_tpu.models.lm import TransformerLM
 
