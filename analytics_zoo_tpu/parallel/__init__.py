@@ -16,6 +16,7 @@ from analytics_zoo_tpu.parallel.pipeline import (
     GPipe,
     pipeline_apply,
     pipeline_apply_1f1b,
+    pipeline_apply_interleaved,
     pipeline_value_and_grad,
     pipeline_1f1b_stats,
     interleaved_1f1b_stats,
@@ -37,6 +38,7 @@ __all__ = [
     "GPipe",
     "pipeline_apply",
     "pipeline_apply_1f1b",
+    "pipeline_apply_interleaved",
     "pipeline_value_and_grad",
     "pipeline_1f1b_stats",
     "interleaved_1f1b_stats",

@@ -15,14 +15,19 @@ M microbatches.  The whole schedule differentiates through scan/ppermute,
 so the SAME code is forward and backward pipelining; XLA overlaps the
 ppermute hop with the next tick's compute.
 
-Two training schedules: autodiff through ``pipeline_apply`` yields GPipe
-(all-forward-then-all-backward, activation residency grows with M), and
-``pipeline_value_and_grad`` runs flat 1F1B (interleaved forward/backward
-ticks, residency bounded at 2S microbatches per rank via stage-level
-remat).  The trade is explicit: the lockstep 1F1B schedule idles
-(2S-2)/(M+2S-2) of its slots — about twice GPipe's bubble at equal M —
-but its O(S) memory bound is what lets M grow to amortise the bubble
-where GPipe's O(M) residency cannot (``pipeline_1f1b_stats``).
+Three training schedules: autodiff through ``pipeline_apply`` yields
+GPipe (all-forward-then-all-backward, activation residency grows with
+M); ``pipeline_value_and_grad`` / ``pipeline_apply_1f1b`` run flat 1F1B
+(combined forward/backward ticks, residency bounded at 2S microbatches
+per rank via stage-level remat); and ``n_chunks=v > 1`` /
+``pipeline_apply_interleaved`` run INTERLEAVED 1F1B (v virtual model
+chunks per rank, round-robin placement, wrap-around ppermute).  The
+trades are explicit: flat 1F1B idles (2S-2)/(M+2S-2) of its slots —
+about twice GPipe's bubble at equal M — but its O(S) memory bound lets
+M grow to amortise the bubble where GPipe's O(M) residency cannot
+(``pipeline_1f1b_stats``); interleaving then cuts the flat bubble to
+S+(S-2)/v flat-tick equivalents for v× the residual-ring memory and
+ppermute traffic (``interleaved_1f1b_stats``).
 
 Composes with the batch axes: batch stays sharded over dp/fsdp (each pp
 rank sees its dp-local batch).  Stage-INTERNAL tensor parallelism does
@@ -515,6 +520,152 @@ def pipeline_value_and_grad(stage_fn: StageFn, loss_fn, stacked_params,
     return loss, grads, dx
 
 
+def _chunk_params(stacked_params, v: int, S: int):
+    """[L, ...] logical-order stack -> [v, S, ...] so ``P(None, pp)``
+    realises round-robin placement (leaf[k, r] = logical stage k*S+r —
+    C-order reshape is exactly that map)."""
+    return jax.tree.map(
+        lambda a: a.reshape((v, S) + a.shape[1:]), stacked_params)
+
+
+def _fwd_ticks_interleaved(stage_fn, p_chunks, mb, S, v, m_eff, idx,
+                           pp_axis, vary):
+    """Forward-only interleaved schedule: ``(v*M + S - 1)/v`` flat-tick
+    equivalents versus GPipe's ``M + S - 1`` — the ramp shrinks v× for
+    inference too.  Same (rank, tick) -> (chunk, microbatch) bijection
+    as the combined engine."""
+    L = v * S
+    g_last, q_last = (m_eff - 1) // S, (m_eff - 1) % S
+    ticks = g_last * L + (v - 1) * S + q_last + S
+
+    def chunk(p, k):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), p)
+
+    def tick(carry, t):
+        act_in, out_buf = carry
+        w_f = t - idx
+        q_f = jnp.mod(w_f, S)
+        k_f = jnp.mod((w_f - q_f) // S, v)
+        m_f = (w_f // L) * S + q_f
+        valid_f = (w_f >= 0) & (m_f < m_eff)
+        m_fc = jnp.clip(m_f, 0, m_eff - 1)
+        inject = lax.dynamic_index_in_dim(mb, m_fc, 0, keepdims=False)
+        cur = jnp.where((idx == 0) & (k_f == 0), inject, act_in)
+        y = stage_fn(chunk(p_chunks, k_f), cur)
+        write = (idx == S - 1) & (k_f == v - 1) & valid_f
+        slot = lax.dynamic_index_in_dim(out_buf, m_fc, 0, keepdims=False)
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(write, y, slot), m_fc, 0)
+        act_out = lax.ppermute(y, pp_axis,
+                               [(i, (i + 1) % S) for i in range(S)])
+        return (act_out, out_buf), None
+
+    carry = (vary(jnp.zeros_like(mb[0])), vary(jnp.zeros_like(mb)))
+    (_, out_buf), _ = lax.scan(tick, carry, jnp.arange(ticks))
+    return out_buf
+
+
+def pipeline_apply_interleaved(stage_fn: StageFn, stacked_params,
+                               x: jax.Array, mesh: Mesh,
+                               n_microbatches: int, n_chunks: int, *,
+                               batch_axes: Sequence[str] = ("dp", "fsdp"),
+                               pp_axis: str = "pp",
+                               chunked: bool = False) -> jax.Array:
+    """Interleaved-schedule forward with an O(S)-residency interleaved
+    BACKWARD, composable with ordinary autodiff (the ``GPipe`` module's
+    ``schedule="interleaved"`` path — same contract as
+    ``pipeline_apply_1f1b``, smaller bubble on both passes).
+
+    ``stacked_params``: [L, ...] logical-order stages (L = n_chunks *
+    pp size), or already [v, S, ...]-chunked when ``chunked=True`` (the
+    module stores them chunked so the round-robin placement is the
+    at-rest sharding — no per-step reshard)."""
+    S = int(mesh.shape[pp_axis]) if pp_axis in mesh.axis_names else 1
+    v = int(n_chunks)
+    if S == 1:
+        if chunked:
+            stacked_params = jax.tree.map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), stacked_params)
+        return sequential_apply(stage_fn, stacked_params, x)
+    M = int(n_microbatches)
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    if chunked:
+        bad = {jnp.shape(leaf)[:2] for leaf in
+               jax.tree.leaves(stacked_params)} - {(v, S)}
+        if bad:
+            raise ValueError(
+                f"chunked=True expects [n_chunks={v}, pp={S}, ...] "
+                f"leading dims on every leaf, got {sorted(bad)}; pass "
+                f"the flat [L, ...] logical-order stack with "
+                f"chunked=False to have it chunked here")
+        p_chunked = stacked_params
+    else:
+        _check_stacked(stacked_params, v * S)
+        p_chunked = _chunk_params(stacked_params, v, S)
+    pspec = jax.tree.map(lambda _: P(None, pp_axis), p_chunked)
+
+    @jax.custom_vjp
+    def apply(params, xx):
+        xspec = P(batch, *([None] * (xx.ndim - 1)))
+
+        def ranked(p, xl):
+            idx = lax.axis_index(pp_axis)
+            b = xl.shape[0]
+            m_eff = math.gcd(M, b)
+            mb = xl.reshape((m_eff, b // m_eff) + xl.shape[1:])
+            vary = _make_vary(pp_axis, batch)
+            p_chunks = jax.tree.map(lambda a: vary(a[:, 0]), p)
+            out_buf = _fwd_ticks_interleaved(
+                stage_fn, p_chunks, mb, S, v, m_eff, idx, pp_axis, vary)
+            out = lax.psum(jnp.where(idx == S - 1, out_buf, 0.0), pp_axis)
+            return out.reshape(xl.shape).astype(xl.dtype)
+
+        return jax.shard_map(ranked, mesh=mesh, in_specs=(pspec, xspec),
+                             out_specs=xspec)(params, xx)
+
+    def fwd(params, xx):
+        return apply(params, xx), (params, xx)
+
+    def bwd(res, gy):
+        params, xx = res
+        xspec = P(batch, *([None] * (xx.ndim - 1)))
+
+        def ranked(p, xl, gl):
+            idx = lax.axis_index(pp_axis)
+            b = xl.shape[0]
+            m_eff = math.gcd(M, b)
+            mb = xl.reshape((m_eff, b // m_eff) + xl.shape[1:])
+            gb = gl.reshape((m_eff, b // m_eff) + gl.shape[1:])
+            vary = _make_vary(pp_axis, batch)
+            p_chunks = jax.tree.map(lambda a: vary(a[:, 0]), p)
+
+            def head(y, g_seed):
+                # bwd seeds from the STORED output cotangent (no loss)
+                return jnp.float32(0.0), g_seed
+
+            gacc, dxbuf, _ = _f1b_ticks_interleaved(
+                stage_fn, p_chunks, mb, gb, S, v, m_eff, idx, pp_axis,
+                vary, head)
+            # gy carries the outer scaling; dparams is the raw SUM over
+            # microbatches and dp ranks (params are dp-replicated)
+            if batch:
+                gacc = jax.tree.map(lambda g: lax.psum(g, batch), gacc)
+            grads = jax.tree.map(lambda g: g[:, None], gacc)
+            dx = lax.psum(jnp.where(idx == 0, dxbuf, 0.0),
+                          pp_axis).reshape(xl.shape)
+            return grads, dx.astype(xl.dtype)
+
+        # cotangents match apply's inputs: the CHUNKED tree (autodiff of
+        # the outer _chunk_params reshape maps them back to [L, ...])
+        return jax.shard_map(
+            ranked, mesh=mesh, in_specs=(pspec, xspec, xspec),
+            out_specs=(pspec, xspec))(params, xx, gy)
+
+    apply.defvjp(fwd, bwd)
+    return apply(p_chunked, x)
+
+
 def _value_and_grad_interleaved(stage_fn, loss_fn, stacked_params, x,
                                 labels, mesh, M, S, v, batch, xspec,
                                 lspec, pp_axis):
@@ -523,8 +674,7 @@ def _value_and_grad_interleaved(stage_fn, loss_fn, stacked_params, x,
     round-robin placement (leaf[k, r] = logical stage k*S + r); each
     rank sees its own [v, ...] chunk stack inside shard_map.  Scaling
     contract is identical to the flat path."""
-    p_resh = jax.tree.map(
-        lambda a: a.reshape((v, S) + a.shape[1:]), stacked_params)
+    p_resh = _chunk_params(stacked_params, v, S)
     pspec = jax.tree.map(lambda _: P(None, pp_axis), p_resh)
 
     def ranked(params, xl, ll):
@@ -638,11 +788,18 @@ def pipeline_apply_1f1b(stage_fn: StageFn, stacked_params, x: jax.Array,
     return apply(stacked_params, x)
 
 
-def pp_stage_rules(inner: PartitionRules = ()) -> PartitionRules:
+def pp_stage_rules(inner: PartitionRules = (), *,
+                   n_chunks: int = 1) -> PartitionRules:
     """Partition rules for GPipe's stacked stage params: prepend the stage
     dim ``"pp"`` to each stage-internal rule, then shard everything else's
     stage dim.  ``inner`` patterns should be stage-scoped (they are matched
-    against paths under ``stages/``)."""
+    against paths under ``stages/``).  ``n_chunks > 1`` matches the
+    interleaved layout ([v, S, ...]-chunked leaves): the pp shard moves to
+    dim 1 so each rank holds its round-robin chunks at rest."""
+    if n_chunks > 1:
+        out = [(pat, P(None, "pp", *tuple(spec))) for (pat, spec) in inner]
+        out.append((r"stages/", P(None, "pp")))
+        return tuple(out)
     out = [(pat, P("pp", *tuple(spec))) for (pat, spec) in inner]
     out.append((r"stages/", P("pp")))
     return tuple(out)
@@ -666,32 +823,57 @@ class GPipe(nn.Module):
     # "gpipe": autodiff through the forward scan (activation residency
     # grows with n_microbatches); "1f1b": custom-vjp interleaved
     # backward, residency bounded at 2S microbatches per rank at one
-    # extra recompute-forward per (microbatch, stage)
+    # extra recompute-forward per (microbatch, stage); "interleaved":
+    # 1f1b with n_stages/pp virtual chunks per rank (round-robin
+    # placement, bubble S+(S-2)/v vs 2S-2 — interleaved_1f1b_stats)
     schedule: str = "gpipe"
+
+    def _n_chunks(self) -> int:
+        """Chunks per rank for the interleaved schedule: pipelined when
+        the pp axis divides n_stages (v = n_stages / S), sequential
+        otherwise (same fallback contract as the other schedules)."""
+        S = self.mesh.shape.get("pp", 1) if self.mesh is not None else 1
+        if self.schedule == "interleaved" and S > 1 \
+                and self.n_stages % S == 0 and self.n_stages > S:
+            return self.n_stages // S
+        return 1
 
     @nn.compact
     def __call__(self, x):
-        if self.schedule not in ("gpipe", "1f1b"):
+        if self.schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"schedule must be 'gpipe' or '1f1b', got "
-                f"{self.schedule!r}")
+                f"schedule must be 'gpipe', '1f1b' or 'interleaved', "
+                f"got {self.schedule!r}")
         template = self.stage.clone(parent=None)
+        v = self._n_chunks()
 
         def init_stacked(rng) -> Any:
             keys = jax.random.split(rng, self.n_stages)
             probe = x[:1]
-            return jax.vmap(
+            st = jax.vmap(
                 lambda k: template.init(k, probe)["params"])(keys)
+            if v > 1:       # chunked-at-rest: round-robin placement IS
+                #             the sharding (pp_stage_rules(n_chunks=v))
+                st = jax.tree.map(
+                    lambda a: a.reshape(
+                        (v, self.n_stages // v) + a.shape[1:]), st)
+            return st
 
         params = self.param("stages", init_stacked)
 
         def fn(p, a):
             return template.apply({"params": p}, a)
 
+        if v > 1:
+            return pipeline_apply_interleaved(
+                fn, params, x, self.mesh, self.n_microbatches, v,
+                chunked=True)
         if self.mesh is not None and \
                 self.mesh.shape.get("pp", 1) == self.n_stages and \
                 self.n_stages > 1:
-            run = (pipeline_apply_1f1b if self.schedule == "1f1b"
+            # interleaved with v == 1 chunk per rank IS flat 1f1b
+            run = (pipeline_apply_1f1b
+                   if self.schedule in ("1f1b", "interleaved")
                    else pipeline_apply)
             return run(fn, params, x, self.mesh, self.n_microbatches)
         return sequential_apply(fn, params, x)

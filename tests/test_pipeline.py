@@ -300,6 +300,96 @@ def test_interleaved_1f1b_partial_group():
                                rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("mesh_axes,micro,v", [
+    ({"pp": 4, "dp": 2}, 4, 2),
+    ({"pp": 2, "dp": 4}, 8, 3),
+])
+def test_interleaved_apply_composes_with_autodiff(mesh_axes, micro, v):
+    """pipeline_apply_interleaved under ORDINARY jax.grad equals the
+    sequential oracle — the custom-vjp interleaved backward is invisible
+    to callers (the GPipe-module / Estimator contract)."""
+    from analytics_zoo_tpu.parallel import pipeline_apply_interleaved
+
+    mesh = make_mesh(axes=mesh_axes)
+    width, B = 16, 16
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(B, width)).astype(np.float32))
+    lbl = jnp.asarray(rng.normal(size=(B, width)).astype(np.float32))
+    S = mesh_axes["pp"]
+    params = _stacked_params(v * S, width, x[:1], seed=13)
+    fn = _stage_fn(width)
+
+    def loss_il(p, xx):
+        y = pipeline_apply_interleaved(fn, p, xx, mesh, micro, v)
+        return jnp.mean((y - lbl) ** 2)
+
+    def loss_seq(p, xx):
+        return jnp.mean((sequential_apply(fn, p, xx) - lbl) ** 2)
+
+    l1, (gp1, gx1) = jax.jit(jax.value_and_grad(
+        loss_il, argnums=(0, 1)))(params, x)
+    l2, (gp2, gx2) = jax.value_and_grad(loss_seq, argnums=(0, 1))(
+        params, x)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6), gp1, gp2)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_gpipe_interleaved_schedule_trains_in_estimator():
+    """GPipe(schedule='interleaved') under the full Estimator train step
+    on a pp2 x dp4 mesh with n_stages=4 (v=2 chunks/rank): identical
+    loss trajectory to the same 4 stages run sequentially (schedule=
+    'gpipe' falls back to sequential when pp != n_stages), and the
+    chunked stage params shard P(None, 'pp')."""
+    import optax
+
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.common.config import TrainConfig
+    from analytics_zoo_tpu.learn import Estimator
+    from jax.sharding import PartitionSpec as P
+
+    def run(schedule):
+        init_orca_context("local", mesh_axes={"pp": 2, "dp": 4})
+        try:
+            from analytics_zoo_tpu.common.context import OrcaContext
+
+            mesh = OrcaContext.get_context().mesh
+            n_chunks = 2 if schedule == "interleaved" else 1
+
+            class Net(nn.Module):
+                @nn.compact
+                def __call__(self, x):
+                    x = nn.Dense(16, name="embed")(x)
+                    x = GPipe(stage=Block(16), n_stages=4,
+                              n_microbatches=4, mesh=mesh,
+                              schedule=schedule, name="trunk")(x)
+                    return nn.Dense(2, name="head")(x)
+
+            rng = np.random.default_rng(0)
+            xs = rng.normal(size=(256, 8)).astype(np.float32)
+            ys = (xs.sum(-1) > 0).astype(np.int32)
+            est = Estimator.from_flax(
+                model=Net(), loss="sparse_categorical_crossentropy",
+                optimizer=optax.adam(3e-3),
+                feature_cols=("x",), label_cols=("y",),
+                partition_rules=pp_stage_rules(n_chunks=n_chunks)
+                + ((r".*", P()),),
+                config=TrainConfig(deterministic=True, seed=0))
+            hist = est.fit({"x": xs, "y": ys}, epochs=3, batch_size=64)
+            if schedule == "interleaved":
+                leaf = est.state.params["trunk"]["stages"]["up"]["kernel"]
+                assert leaf.shape[:2] == (2, 2), leaf.shape
+                assert leaf.sharding.spec[1] == "pp", leaf.sharding.spec
+            return [h["loss"] for h in hist]
+        finally:
+            stop_orca_context()
+
+    np.testing.assert_allclose(run("interleaved"), run("gpipe"),
+                               rtol=2e-4)
+
+
 def test_interleaved_stats_beat_flat_at_equal_m():
     """The point of interleaving (VERDICT r4 ask #9): at EQUAL M the
     interleaved schedule spends fewer flat-tick equivalents than flat

@@ -152,6 +152,15 @@ class TestClusterServing:
         finally:
             broker.stop()
 
+    def test_enqueue_rejects_str_fields(self):
+        """Strings would become |U ndarrays and fail deep inside the
+        server; the enqueue-side guard names the fix immediately (same
+        contract as the raw-bytes rejection)."""
+        q = InputQueue.__new__(InputQueue)      # no broker needed: the
+        q.max_backlog = 0                       # guard fires before I/O
+        with pytest.raises(TypeError, match="str"):
+            q.enqueue("u1", x="hello")
+
     def test_abandoned_results_pruned_after_ttl(self):
         """Results nobody queries must not grow broker memory forever."""
         serving = _serving()
