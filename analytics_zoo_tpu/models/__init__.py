@@ -23,8 +23,9 @@ from analytics_zoo_tpu.models.forecast import (
 from analytics_zoo_tpu.models.rnn import RNNStack
 from analytics_zoo_tpu.models.lm import (
     TransformerLM, DecoderLayer, LM_PARTITION_RULES, LM_PP_PARTITION_RULES,
-    LM_MOE_PARTITION_RULES, lm_loss, generate, beam_search,
-    unstack_pp_params)
+    LM_PP_INTERLEAVED_PARTITION_RULES,
+    LM_MOE_PARTITION_RULES, lm_loss, fused_lm_loss, LMWithFusedLoss,
+    generate, beam_search, unstack_pp_params)
 from analytics_zoo_tpu.models.moe import (
     MoEMLP, MoETransformerLayer, MoETransformerClassifier,
     MOE_PARTITION_RULES, MOE_CLASSIFIER_PARTITION_RULES,
@@ -47,9 +48,10 @@ __all__ = [
     "LSTMNet", "TCN", "MTNet", "Seq2SeqTS",
     "RNNStack",
     "TransformerLM", "DecoderLayer", "LM_PARTITION_RULES",
-    "LM_PP_PARTITION_RULES", "LM_MOE_PARTITION_RULES", "lm_loss",
+    "LM_PP_PARTITION_RULES", "LM_PP_INTERLEAVED_PARTITION_RULES",
+    "LM_MOE_PARTITION_RULES", "lm_loss",
     "generate", "beam_search",
-    "unstack_pp_params",
+    "unstack_pp_params", "fused_lm_loss", "LMWithFusedLoss",
     "MoEMLP", "MoETransformerLayer", "MoETransformerClassifier",
     "MOE_PARTITION_RULES", "MOE_CLASSIFIER_PARTITION_RULES",
     "load_balancing_loss",

@@ -53,6 +53,13 @@ LM_PARTITION_RULES = (
 # rest, zero compute parallelism); combine pp with dp/fsdp instead.
 LM_PP_PARTITION_RULES = _ppsr() + LM_PARTITION_RULES
 
+# TransformerLM(pp_stages=v*S, pp_schedule="interleaved") on a pp=S
+# mesh: stage params are stored CHUNKED [v, S, ...] (round-robin
+# placement — parallel/pipeline.py), so the pp shard moves to dim 1.
+# n_chunks here is only a LAYOUT FLAG (any value > 1 selects the
+# chunked specs) — these rules apply to every v, not just v=2.
+LM_PP_INTERLEAVED_PARTITION_RULES = _ppsr(n_chunks=2) + LM_PARTITION_RULES
+
 
 # MoE-LM (moe_experts > 0): expert weights over ep(+tp) + the LM rules.
 # (moe.py imports no LM/transformer modules at top level — no cycle.)
@@ -222,19 +229,38 @@ def beam_search(model: TransformerLM, variables, prompt,
     return toks, scores
 
 
-def unstack_pp_params(params):
+def unstack_pp_params(params, n_chunks: int = 1):
     """pp-trained param tree (``trunk/stages/...`` with a leading stage
     dim) -> the flat ``layer_{i}`` tree a ``pp_stages=0`` TransformerLM
     expects.  The bridge from pipeline training to cached-decode serving:
     train with pp, ``unstack_pp_params``, generate on a non-pp model of
-    the same dimensions."""
+    the same dimensions.
+
+    ``pp_schedule="interleaved"`` models store stages CHUNKED
+    [v, S, ...] (logical stage k*S + r at leaf[k, r] — round-robin
+    placement, parallel/pipeline.py); pass the model's ``n_chunks``
+    (= pp_stages / mesh pp size) so the logical order is reassembled."""
     out = {k: v for k, v in params.items() if k != "trunk"}
     stacked = params["trunk"]["stages"]
     stage_layers = sorted(
         (k for k in stacked if k.startswith("layer_")),
         key=lambda k: int(k.split("_")[1]))
     k_per = len(stage_layers)
-    S = jax.tree.leaves(stacked)[0].shape[0]
+    lead = jax.tree.leaves(stacked)[0].shape
+    if n_chunks > 1:
+        v, S = int(n_chunks), lead[1]
+        if lead[0] != v:
+            raise ValueError(
+                f"n_chunks={n_chunks} does not match the chunked stage "
+                f"leaves' leading dims {lead[:2]}; pass the value the "
+                f"model was built with (pp_stages / mesh pp size)")
+        for k in range(v):
+            for r in range(S):
+                for j, name in enumerate(stage_layers):
+                    out[f"layer_{(k * S + r) * k_per + j}"] = \
+                        jax.tree.map(lambda a: a[k, r], stacked[name])
+        return out
+    S = lead[0]
     for s in range(S):
         for j, name in enumerate(stage_layers):
             out[f"layer_{s * k_per + j}"] = jax.tree.map(
@@ -526,8 +552,11 @@ class TransformerLM(nn.Module):
     remat: bool = False
     pp_stages: int = 0
     pp_microbatches: int = 4
-    # "gpipe" | "1f1b": training schedule for the pipelined trunk (see
-    # parallel/pipeline.py — 1f1b bounds activation residency at O(S))
+    # "gpipe" | "1f1b" | "interleaved": training schedule for the
+    # pipelined trunk (parallel/pipeline.py — 1f1b bounds activation
+    # residency at O(S); interleaved additionally needs pp_stages to be
+    # a multiple v*S of the mesh's pp size and cuts the bubble v-fold,
+    # with LM_PP_INTERLEAVED_PARTITION_RULES for the chunked layout)
     pp_schedule: str = "gpipe"
     sp_strategy: str = "ring"
     # MoE-LM: every moe_every-th layer gets an expert-parallel MoE FFN.
@@ -631,7 +660,10 @@ class TransformerLM(nn.Module):
         emb = self.embed.embedding.astype(jnp.float32)
         return jnp.einsum("bte,ve->btv", x.astype(jnp.float32), emb)
 
-    def __call__(self, tokens, train: bool = False):
+    def hidden_states(self, tokens, train: bool = False):
+        """Final-LayerNorm hidden states [B, T, H] — the forward minus
+        the vocab head.  ``LMWithFusedLoss`` consumes this to compute CE
+        blockwise without ever materialising the [B, T, V] logits."""
         B, T = tokens.shape
         if T > self.max_position:
             raise ValueError(
@@ -647,7 +679,10 @@ class TransformerLM(nn.Module):
         else:
             for layer in self.layers:
                 x = layer(x, train)
-        return self._logits(self.ln_f(x))
+        return self.ln_f(x)
+
+    def __call__(self, tokens, train: bool = False):
+        return self._logits(self.hidden_states(tokens, train))
 
     def decode_step(self, tok, caches_k, caches_v, pos):
         """tok: [B] current tokens; caches_k/v: [n_layers, B, L,
@@ -706,6 +741,70 @@ def lm_loss(logits, tokens):
 
     return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1], tokens[:, 1:]))
+
+
+def fused_lm_loss(per_sample_losses, _tokens):
+    """Estimator loss for ``LMWithFusedLoss`` models: the model output
+    already IS per-sample CE, so the loss is just its mean."""
+    return jnp.mean(per_sample_losses)
+
+
+class LMWithFusedLoss(nn.Module):
+    """Training wrapper that computes the shifted next-token CE
+    BLOCKWISE over the sequence, never materialising the [B, T, V]
+    logits tensor.
+
+    Why: the plain path writes f32 logits (B=8, T=2048, V=32000 →
+    2.1 GB), reads them through softmax-CE, and materialises the same
+    shape again as dlogits in backward — several full HBM passes over
+    multi-GB tensors per step, and an O(T·V) residency that forbids
+    long-context training (T=8192 would need 8.4 GB for logits alone).
+    Here each ``t_block`` slice runs head-matmul + CE inside a
+    ``lax.scan`` whose body is ``jax.checkpoint``-ed: backward
+    recomputes the block's logits from the (tiny) hidden slice, so peak
+    residency is O(B · t_block · V) regardless of T.  Cost: one extra
+    head matmul per block in backward — the standard remat trade, paid
+    where the tensor is bandwidth-monstrous and the matmul is cheap.
+
+    Contract: ``__call__(tokens, train) -> [B]`` per-sample mean CE
+    (use ``loss=fused_lm_loss`` with the Estimator; ``predict`` on this
+    wrapper returns losses, not logits — serve/generate with the inner
+    ``lm`` instead).  ``mean(wrapper(tokens)) == lm_loss(lm(tokens),
+    tokens)`` exactly (tested)."""
+
+    lm: TransformerLM
+    t_block: int = 512
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        import optax
+
+        h = self.lm.hidden_states(tokens, train)
+        emb = self.lm.embed.embedding.astype(jnp.float32)
+        hs = h[:, :-1].astype(jnp.float32)
+        ys = tokens[:, 1:]
+        B, n, H = hs.shape
+        tb = min(int(self.t_block), n)
+        pad = (-n) % tb
+        if pad:
+            hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+            ys = jnp.pad(ys, ((0, 0), (0, pad)))
+        nb = (n + pad) // tb
+        hb = hs.reshape(B, nb, tb, H).transpose(1, 0, 2, 3)
+        yb = ys.reshape(B, nb, tb).transpose(1, 0, 2)
+        mask = (jnp.arange(nb * tb) < n).astype(
+            jnp.float32).reshape(nb, tb)
+
+        def body(acc, blk):
+            hx, yx, mx = blk
+            logits = jnp.einsum("bth,vh->btv", hx, emb)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yx)
+            return acc + jnp.sum(ce * mx[None, :], axis=1), None
+
+        acc0 = jnp.zeros((B,), jnp.float32)
+        total, _ = lax.scan(jax.checkpoint(body), acc0, (hb, yb, mask))
+        return total / n
 
 
 def generate(model: TransformerLM, variables, prompt,

@@ -340,22 +340,13 @@ def _f1b_ticks_interleaved(stage_fn, p_chunks, mb, aux, S, v, m_eff, idx,
     g_last, q_last = (m_eff - 1) // S, (m_eff - 1) % S
     ticks = g_last * L + q_last + 2 * L - 1
 
-    def chunk(p, k):
-        return jax.tree.map(
-            lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), p)
-
     def tick(carry, t):
         act_in, gract_in, resbuf, gacc, dxbuf, lossbuf = carry
-        # ---- forward unit: w = t - r = g*v*S + k*S + q ----
-        w_f = t - idx
-        q_f = jnp.mod(w_f, S)
-        k_f = jnp.mod((w_f - q_f) // S, v)
-        m_f = (w_f // L) * S + q_f
-        valid_f = (w_f >= 0) & (m_f < m_eff)
-        m_fc = jnp.clip(m_f, 0, m_eff - 1)
+        # ---- forward unit (shared bijection: _fwd_wave) ----
+        w_f, k_f, m_fc, valid_f = _fwd_wave(t, idx, S, v, m_eff)
         inject = lax.dynamic_index_in_dim(mb, m_fc, 0, keepdims=False)
         cur = jnp.where((idx == 0) & (k_f == 0), inject, act_in)
-        y = stage_fn(chunk(p_chunks, k_f), cur)
+        y = stage_fn(_chunk_at(p_chunks, k_f), cur)
         slot_f = jnp.mod(w_f, R)
         old = lax.dynamic_index_in_dim(resbuf, slot_f, 0, keepdims=False)
         resbuf = lax.dynamic_update_index_in_dim(
@@ -377,7 +368,7 @@ def _f1b_ticks_interleaved(stage_fn, p_chunks, mb, aux, S, v, m_eff, idx,
             resbuf, jnp.mod(w_fb, R), 0, keepdims=False)
         is_last_b = (idx == S - 1) & (k_b == v - 1)   # fused with fwd tick
         g_use = jnp.where(is_last_b, gy.astype(gract_in.dtype), gract_in)
-        _, vjp = jax.vjp(stage_fn, chunk(p_chunks, k_b), a_saved)
+        _, vjp = jax.vjp(stage_fn, _chunk_at(p_chunks, k_b), a_saved)
         dp, da = vjp(g_use.astype(y.dtype))
         gacc = jax.tree.map(
             lambda g, d: lax.dynamic_update_index_in_dim(
@@ -528,6 +519,26 @@ def _chunk_params(stacked_params, v: int, S: int):
         lambda a: a.reshape((v, S) + a.shape[1:]), stacked_params)
 
 
+def _chunk_at(p, k):
+    """Select chunk ``k`` from a [v, ...]-stacked local param tree."""
+    return jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), p)
+
+
+def _fwd_wave(t, idx, S, v, m_eff):
+    """The interleaved (rank, tick) -> forward-unit bijection, shared by
+    the combined and forward-only engines: ``w = t - r`` decomposes
+    base-(S, v, ·) into (q, k, g); microbatch m = g*S + q.  Returns
+    ``(w_f, k_f, m_fc, valid_f)`` with m clipped for safe indexing."""
+    L = v * S
+    w_f = t - idx
+    q_f = jnp.mod(w_f, S)
+    k_f = jnp.mod((w_f - q_f) // S, v)
+    m_f = (w_f // L) * S + q_f
+    valid_f = (w_f >= 0) & (m_f < m_eff)
+    return w_f, k_f, jnp.clip(m_f, 0, m_eff - 1), valid_f
+
+
 def _fwd_ticks_interleaved(stage_fn, p_chunks, mb, S, v, m_eff, idx,
                            pp_axis, vary):
     """Forward-only interleaved schedule: ``(v*M + S - 1)/v`` flat-tick
@@ -538,21 +549,12 @@ def _fwd_ticks_interleaved(stage_fn, p_chunks, mb, S, v, m_eff, idx,
     g_last, q_last = (m_eff - 1) // S, (m_eff - 1) % S
     ticks = g_last * L + (v - 1) * S + q_last + S
 
-    def chunk(p, k):
-        return jax.tree.map(
-            lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), p)
-
     def tick(carry, t):
         act_in, out_buf = carry
-        w_f = t - idx
-        q_f = jnp.mod(w_f, S)
-        k_f = jnp.mod((w_f - q_f) // S, v)
-        m_f = (w_f // L) * S + q_f
-        valid_f = (w_f >= 0) & (m_f < m_eff)
-        m_fc = jnp.clip(m_f, 0, m_eff - 1)
+        w_f, k_f, m_fc, valid_f = _fwd_wave(t, idx, S, v, m_eff)
         inject = lax.dynamic_index_in_dim(mb, m_fc, 0, keepdims=False)
         cur = jnp.where((idx == 0) & (k_f == 0), inject, act_in)
-        y = stage_fn(chunk(p_chunks, k_f), cur)
+        y = stage_fn(_chunk_at(p_chunks, k_f), cur)
         write = (idx == S - 1) & (k_f == v - 1) & valid_f
         slot = lax.dynamic_index_in_dim(out_buf, m_fc, 0, keepdims=False)
         out_buf = lax.dynamic_update_index_in_dim(
