@@ -108,6 +108,38 @@ def init_context(
         omitted, jax auto-detects from the TPU metadata server.
     """
     import copy
+    import os as _os_cache
+
+    # Persistent XLA compilation cache: every entry-point process (bench
+    # subprocesses, serving workers, elastic restarts) re-lowers the same
+    # programs; caching compiled executables on disk turns the 20-40s
+    # first-compile into a file read on every process after the first.
+    # Opt out with ZOO_COMPILATION_CACHE=0 / point elsewhere with a path.
+    # CPU is excluded: XLA:CPU AOT reuse is machine-feature-pinned (the
+    # loader warns of SIGILL on feature drift) and CPU compiles are fast
+    # enough not to need it.  jax.config.jax_platforms is readable
+    # without initialising a backend — critical when the TPU tunnel is
+    # unreachable and backend init would block.
+    cache_dir = _os_cache.environ.get("ZOO_COMPILATION_CACHE", "")
+    platforms = str(jax.config.jax_platforms
+                    or _os_cache.environ.get("JAX_PLATFORMS", "")).lower()
+    # enable only for an EXPLICIT accelerator platform: when unset, jax
+    # auto-detects — which on an accelerator-less host means XLA:CPU,
+    # and probing the backend here could block on an unreachable tunnel
+    accel = any(p and p != "cpu" for p in platforms.split(","))
+    if (cache_dir != "0" and accel
+            and jax.config.jax_compilation_cache_dir is None):
+        if not cache_dir:
+            cache_dir = _os_cache.path.join(
+                _os_cache.path.expanduser("~"), ".cache",
+                "analytics_zoo_tpu_xla")
+        try:
+            _os_cache.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        except OSError:
+            pass                    # read-only home: cache stays off
 
     cfg = copy.deepcopy(config) if config is not None else ZooConfig()
     if mesh_axes is not None:

@@ -8,7 +8,9 @@ suspect is isolated by measuring jitted step-time DELTAS:
   lm.trunk_only      same but loss = mean(hidden) — no head matmul, no CE
                      (delta = logits materialisation + CE + their bwd)
   lm.dot_attention   use_flash=False (delta = flash kernel vs XLA dot)
-  lm.no_remat_check  remat is already False in bench; asserted
+  lm.fused_loss      LMWithFusedLoss blockwise CE (delta = the cost of
+                     materialising [B, T, V] logits, the suspected sink)
+  lm.no_remat_check  remat=False asserted at model build
   lm.flops           XLA cost-analysis FLOPs vs analytic FLOPs — pallas
                      kernels are invisible to cost_analysis, so reported
                      MFU undercounts when flash is on; the analytic
@@ -21,12 +23,26 @@ block_until_ready only acknowledges enqueue).  Prints one JSON dict.
 """
 
 import json
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import _peak_for  # noqa: E402  (device-keyed peak FLOP/s)
+
+
+def _peak() -> float:
+    return _peak_for(jax.devices()[0]) or 197e12
+
+
+# the ONE profiled LM config — build() and the analytic-FLOPs formula
+# must agree on these or mfu_analytic silently measures a different model
+LM_B, LM_T, LM_V = 8, 2048, 32000
+LM_H, LM_L, LM_F, LM_HEADS = 768, 12, 3072, 12
 
 
 def _time_steps(step, state, batch, n=10):
@@ -48,18 +64,26 @@ def lm_ablations():
         TransformerLM, LM_PARTITION_RULES, lm_loss)
     from analytics_zoo_tpu.data.loader import make_global_batch
 
-    B, T, V = 8, 2048, 32000
+    B, T, V = LM_B, LM_T, LM_V
     rng = np.random.default_rng(0)
     data = {"tokens": rng.integers(0, V, (B * 2, T)).astype(np.int32)}
     out = {}
 
-    def build(loss_fn, use_flash=True):
-        model = TransformerLM(vocab_size=V, hidden_size=768,
-                              num_layers=12, num_heads=12,
-                              intermediate_size=3072, max_position=T,
+    def ckpt():
+        # per-ablation checkpoint: each timing costs minutes of tunnel
+        # round-trips; a wedge between ablations keeps the earlier ones
+        with open("PROFILE_LM_PARTIAL.json", "w") as f:
+            json.dump({"lm": out}, f, indent=1, default=float)
+
+    def build(loss_fn, use_flash=True, wrap=None):
+        model = TransformerLM(vocab_size=V, hidden_size=LM_H,
+                              num_layers=LM_L, num_heads=LM_HEADS,
+                              intermediate_size=LM_F, max_position=T,
                               use_flash=use_flash)
+        assert not model.remat, "bench runs remat=False; profile must too"
         est = Estimator.from_flax(
-            model=model, loss=loss_fn, optimizer=optax.adamw(1e-4),
+            model=wrap(model) if wrap else model, loss=loss_fn,
+            optimizer=optax.adamw(1e-4),
             feature_cols=("tokens",), label_cols=("tokens",),
             partition_rules=LM_PARTITION_RULES)
         est.config.log_every_steps = 1000
@@ -89,32 +113,51 @@ def lm_ablations():
     del lowered
     # analytic: matmul 6*P_mat*tokens (fwd+bwd) + flash fwd 4BT^2H/layer
     # + flash bwd ~2.5x fwd (recompute) ; head fwd+bwd 3x2BTHV
-    p_mat = 12 * (4 * 768 * 768 + 2 * 768 * 3072)   # qkvo + ffn weights
+    p_mat = LM_L * (4 * LM_H * LM_H + 2 * LM_H * LM_F)  # qkvo + ffn weights
     toks = B * T
     mm = 6 * p_mat * toks
-    att = 12 * 4 * B * T * T * 768 * 3.5
-    head = 3 * 2 * B * T * 768 * V
+    att = LM_L * 4 * B * T * T * LM_H * 3.5
+    head = 3 * 2 * B * T * LM_H * V
     out["analytic_flops"] = float(mm + att + head)
-    out["mfu_xla"] = xla_flops / out["full_step_s"] / 197e12
-    out["mfu_analytic"] = out["analytic_flops"] / out["full_step_s"] / 197e12
+    out["mfu_xla"] = xla_flops / out["full_step_s"] / _peak()
+    out["mfu_analytic"] = out["analytic_flops"] / out["full_step_s"] / _peak()
 
+    ckpt()
     del est, g                      # free 111M params + adam state
 
     # CE removed (head matmul stays): delta isolates softmax-CE cost
     est2, g2 = build(trunk_only_loss)
     out["no_ce_step_s"] = _time_steps(
         lambda s, b: est2._jit_train_step(s, b), est2.state, g2)
+    ckpt()
     del est2, g2
 
     # dot attention instead of the pallas flash kernel
     est3, g3 = build(lm_loss, use_flash=False)
     out["dot_attn_step_s"] = _time_steps(
         lambda s, b: est3._jit_train_step(s, b), est3.state, g3)
+    ckpt()
     del est3, g3
+
+    # fused blockwise loss (models/lm.py LMWithFusedLoss): [B,T,V] logits
+    # never materialised — the HBM-bandwidth fix the full/no_ce delta
+    # motivates; delta vs full_step_s is the end-to-end win
+    from analytics_zoo_tpu.models import LMWithFusedLoss, fused_lm_loss
+
+    est4, g4 = build(fused_lm_loss, wrap=lambda m: LMWithFusedLoss(lm=m))
+    out["fused_loss_step_s"] = _time_steps(
+        lambda s, b: est4._jit_train_step(s, b), est4.state, g4)
+    out["mfu_analytic_fused"] = (
+        out["analytic_flops"] / out["fused_loss_step_s"] / _peak())
+    ckpt()
+    del est4, g4
 
     out["ce_cost_s"] = out["full_step_s"] - out["no_ce_step_s"]
     out["flash_saving_s"] = out["dot_attn_step_s"] - out["full_step_s"]
+    out["fused_loss_saving_s"] = (
+        out["full_step_s"] - out["fused_loss_step_s"])
     out["tokens_per_sec"] = toks / out["full_step_s"]
+    out["tokens_per_sec_fused"] = toks / out["fused_loss_step_s"]
     stop_orca_context()
     return out
 
@@ -163,21 +206,28 @@ def resnet_ablations():
         fl = float(cost.get("flops", 0.0)) if cost else 0.0
         out[f"bs{bs}_step_s"] = dt
         out[f"bs{bs}_samples_per_sec"] = bs / dt
-        out[f"bs{bs}_mfu"] = fl / dt / 197e12
+        out[f"bs{bs}_mfu"] = fl / dt / _peak()
     return out
 
 
 def main():
     from analytics_zoo_tpu import init_orca_context, stop_orca_context
 
+    def ckpt(res):
+        # a wedge mid-profile keeps whatever was measured so far
+        with open("PROFILE_LM_PARTIAL.json", "w") as f:
+            json.dump(res, f, indent=1, default=float)
+
     res = {}
     if "--resnet-only" not in sys.argv:
         init_orca_context("local")
         res["lm"] = lm_ablations()      # stops its own context
+        ckpt(res)
     if "--lm-only" not in sys.argv:
         init_orca_context("local")
         res["resnet"] = resnet_ablations()
         stop_orca_context()
+        ckpt(res)
     print(json.dumps(res, indent=1, default=float))
 
 

@@ -3,6 +3,9 @@
 # Probes the tunneled TPU every ~120s with a hard timeout; appends one JSON
 # line per attempt to tpu_probe_log.jsonl. On the first success it touches
 # TPU_ALIVE so an opportunistic bench can be fired immediately.
+# The probe itself is `bench_serving.py --probe` — the ONE shared
+# implementation (also used by bench_serving's inter-scenario gate and
+# bench_on_recovery.sh), so every caller agrees on what "alive" means.
 LOG=/root/repo/tpu_probe_log.jsonl
 FLAG=/root/repo/TPU_ALIVE
 while true; do
@@ -10,13 +13,7 @@ while true; do
     sleep 120; continue   # don't contend for the grant mid-bench
   fi
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-  RAW=$(timeout 120 python -c "
-import jax, jax.numpy as jnp
-d = jax.devices()
-x = jnp.ones((256,256), jnp.bfloat16)
-y = (x@x).sum()
-print('PROBE_OK', d[0].platform, d[0].device_kind, float(y))
-" 2>&1)
+  RAW=$(timeout 120 python /root/repo/bench_serving.py --probe 2>&1)
   RC=$?
   OUT=$(echo "$RAW" | grep PROBE_OK | head -1)
   if [ -n "$OUT" ]; then
