@@ -231,6 +231,20 @@ class TCMFForecaster:
             return optax.apply_updates(X, upd), optX
 
         peak = 0
+        baseline_refs = []
+        if self.collect_memory_stats:
+            # peak must attribute arrays to THIS fit: under a shared
+            # process (e.g. a test suite) unrelated live arrays would
+            # otherwise dominate the max.  Weakrefs keep the id check
+            # precise — a dead baseline array's id can be legitimately
+            # reused by a new (counted) array.
+            import weakref
+
+            for a in jax.live_arrays():
+                try:
+                    baseline_refs.append(weakref.ref(a))
+                except TypeError:       # non-weakref-able array impl
+                    pass
         loss = None
         for ep in range(epochs):
             count = jnp.int32(ep)       # optax counts UPDATES SO FAR
@@ -250,9 +264,12 @@ class TCMFForecaster:
                 if self.collect_memory_stats:
                     # sample while the block's arrays are LIVE — the
                     # honest transient footprint, not the between-epochs
-                    # floor (largest single array, process-global)
+                    # floor (largest single array created by this fit)
+                    alive_baseline = {id(r()) for r in baseline_refs
+                                      if r() is not None}
                     peak = max(peak, max(
-                        (a.size for a in jax.live_arrays()), default=0))
+                        (a.size for a in jax.live_arrays()
+                         if id(a) not in alive_baseline), default=0))
                 F[lo:hi] = np.asarray(Fb)
                 mF[lo:hi] = np.asarray(mb)
                 vF[lo:hi] = np.asarray(vb)

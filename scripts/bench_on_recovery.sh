@@ -37,8 +37,11 @@ while true; do
     echo "bench_serving rc=$? at $(date -u +%H:%M:%SZ)" >> bench_recovery.log
   fi
   if [ ! -f PROFILE_DONE ] && probe; then
-    timeout 3600 python scripts/profile_lm.py > PROFILE_LM.json \
-      2>> bench_recovery.log && touch PROFILE_DONE
+    # tmp + mv: a retry must not truncate a good earlier capture
+    timeout 3600 python scripts/profile_lm.py > PROFILE_LM.json.tmp \
+      2>> bench_recovery.log \
+      && mv PROFILE_LM.json.tmp PROFILE_LM.json \
+      && touch PROFILE_DONE
     echo "profile_lm rc=$? at $(date -u +%H:%M:%SZ)" >> bench_recovery.log
   fi
   if [ ! -f TRAINBENCH_DONE ] && probe; then

@@ -72,8 +72,16 @@ def lm_ablations():
     def ckpt():
         # per-ablation checkpoint: each timing costs minutes of tunnel
         # round-trips; a wedge between ablations keeps the earlier ones
+        # (merge so a co-resident resnet partial is never erased)
+        merged = {}
+        try:
+            with open("PROFILE_LM_PARTIAL.json") as f:
+                merged = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+        merged["lm"] = out
         with open("PROFILE_LM_PARTIAL.json", "w") as f:
-            json.dump({"lm": out}, f, indent=1, default=float)
+            json.dump(merged, f, indent=1, default=float)
 
     def build(loss_fn, use_flash=True, wrap=None):
         model = TransformerLM(vocab_size=V, hidden_size=LM_H,
@@ -214,9 +222,18 @@ def main():
     from analytics_zoo_tpu import init_orca_context, stop_orca_context
 
     def ckpt(res):
-        # a wedge mid-profile keeps whatever was measured so far
+        # a wedge mid-profile keeps whatever was measured so far; merge
+        # with any existing partial so e.g. --resnet-only cannot erase
+        # hard-won lm timings from an earlier wedged run
+        merged = {}
+        try:
+            with open("PROFILE_LM_PARTIAL.json") as f:
+                merged = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+        merged.update(res)
         with open("PROFILE_LM_PARTIAL.json", "w") as f:
-            json.dump(res, f, indent=1, default=float)
+            json.dump(merged, f, indent=1, default=float)
 
     res = {}
     if "--resnet-only" not in sys.argv:

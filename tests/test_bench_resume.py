@@ -1,0 +1,125 @@
+"""Wedge-resume semantics of the serving bench orchestrator
+(bench_serving.main): a prior partial capture must be carried over, not
+re-run and never clobbered — recovery windows on the tunneled device are
+scarce (VERDICT r4 ask #1; tpu_probe_log.jsonl documents multi-hour
+wedges)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench_serving  # noqa: E402
+
+
+class _FakeProc:
+    returncode = 0
+    stderr = ""
+
+    def __init__(self, payload):
+        self.stdout = json.dumps(payload) + "\n"
+
+
+@pytest.fixture()
+def sandbox(tmp_path, monkeypatch):
+    """Run main() in a temp cwd with a tiny plan, recording-only
+    subprocess scenarios, and an always-alive device probe."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(bench_serving, "PLAN", [
+        ("resnet18", 64, 10, 64),
+        ("lm-poisson", 12, 150, 8),
+        ("mlp", 1, 100, 128),
+    ])
+    monkeypatch.setattr(bench_serving, "_device_alive",
+                        lambda timeout_s=90: True)
+    ran = []
+
+    def fake_run(cmd, **kw):
+        assert "--one" in cmd
+        kind, clients = cmd[cmd.index("--one") + 1:cmd.index("--one") + 3]
+        ran.append((kind, int(clients)))
+        if kind.startswith("lm-poisson"):
+            return _FakeProc({"model": kind, "mode": "microbatch",
+                              "rate_per_s": int(clients),
+                              "req_per_sec": 9.0})
+        return _FakeProc({"model": kind, "clients": int(clients),
+                          "req_per_sec": 42.0})
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    return ran
+
+
+def test_fresh_run_writes_complete_file(sandbox):
+    bench_serving.main()
+    out = json.load(open("SERVING_BENCH.json"))
+    assert len(out["scenarios"]) == 3
+    assert "partial" not in out          # complete run clears the flag
+    assert len(sandbox) == 3
+
+
+def test_partial_prior_rows_kept_and_skipped(sandbox):
+    prior = {"scenarios": [
+        {"model": "resnet18", "clients": 64, "req_per_sec": 111.0},
+        {"model": "lm-poisson", "mode": "microbatch", "rate_per_s": 12,
+         "req_per_sec": 7.0},
+    ], "partial": True}
+    json.dump(prior, open("SERVING_BENCH.json", "w"))
+    bench_serving.main()
+    out = json.load(open("SERVING_BENCH.json"))
+    # prior rows carried over verbatim (111.0, not a re-measured 42.0)
+    by_key = {(r["model"], r.get("clients", r.get("rate_per_s"))): r
+              for r in out["scenarios"]}
+    assert by_key[("resnet18", 64)]["req_per_sec"] == 111.0
+    assert by_key[("lm-poisson", 12)]["req_per_sec"] == 7.0
+    assert by_key[("mlp", 1)]["req_per_sec"] == 42.0
+    assert sandbox == [("mlp", 1)]       # only the missing scenario ran
+    assert "partial" not in out
+
+
+def test_complete_prior_file_is_not_resumed(sandbox):
+    """A COMPLETE earlier file (no partial flag) means a fresh capture
+    was requested: everything re-runs, and the complete file survives as
+    .prev until the fresh capture finishes."""
+    json.dump({"scenarios": [
+        {"model": "resnet18", "clients": 64, "req_per_sec": 111.0}]},
+        open("SERVING_BENCH.json", "w"))
+    bench_serving.main()
+    out = json.load(open("SERVING_BENCH.json"))
+    assert len(sandbox) == 3
+    assert all(r["req_per_sec"] != 111.0 for r in out["scenarios"])
+    assert not os.path.exists("SERVING_BENCH.json.prev")  # success: cleaned
+
+
+def test_complete_prior_survives_wedged_fresh_run(sandbox, monkeypatch):
+    """Fresh run over a complete capture wedges after one scenario: the
+    complete capture must still exist (as .prev) alongside the partial."""
+    prior = {"scenarios": [
+        {"model": "resnet18", "clients": 64, "req_per_sec": 111.0},
+        {"model": "mlp", "clients": 1, "req_per_sec": 99.0}]}
+    json.dump(prior, open("SERVING_BENCH.json", "w"))
+    alive = iter([True, False])
+    monkeypatch.setattr(bench_serving, "_device_alive",
+                        lambda timeout_s=90: next(alive))
+    with pytest.raises(SystemExit):
+        bench_serving.main()
+    assert json.load(open("SERVING_BENCH.json"))["partial"] is True
+    assert json.load(open("SERVING_BENCH.json.prev")) == prior
+
+
+def test_wedge_abort_checkpoints_and_flags_partial(sandbox, monkeypatch):
+    """Probe dies after the first scenario: the file must hold that
+    scenario, be flagged partial, and main must exit non-zero."""
+    alive = iter([True, False])
+    monkeypatch.setattr(bench_serving, "_device_alive",
+                        lambda timeout_s=90: next(alive))
+    with pytest.raises(SystemExit) as ex:
+        bench_serving.main()
+    assert ex.value.code == 1
+    out = json.load(open("SERVING_BENCH.json"))
+    assert out["partial"] is True
+    assert len(out["scenarios"]) == 1
+    assert sandbox == [("resnet18", 64)]
