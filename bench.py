@@ -506,6 +506,19 @@ def _device_preflight(timeout: int = 300, attempts: int = 2):
 
 
 def main():
+    # Pause any background probe loop (scripts/tpu_probe_loop.sh) for
+    # the whole run: probe processes contending for the single device
+    # grant mid-bench corrupt timings — and this must hold when the
+    # DRIVER invokes bench.py directly, not just under
+    # scripts/bench_on_recovery.sh.  bench_guard owns the protocol
+    # (atomic acquire, SIGTERM unwind, stale-owner cleanup).
+    from bench_guard import probe_pause
+
+    with probe_pause():
+        _main_inner()
+
+
+def _main_inner():
     if "--bench" in sys.argv:
         name = sys.argv[sys.argv.index("--bench") + 1]
         print(json.dumps(BENCHES[name]()))

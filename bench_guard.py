@@ -1,0 +1,110 @@
+"""The BENCH_RUNNING probe-pause protocol — ONE implementation shared by
+bench.py, bench_serving.py, and (via pid checks) the shell loops.
+
+Why it exists: scripts/tpu_probe_loop.sh probes the tunneled TPU every
+~2 min; a probe process contending for the single device grant mid-bench
+corrupts timings.  The flag pauses the loop.  The protocol must survive
+the ways benches actually die here:
+
+- SIGTERM (``timeout N python bench.py``): a handler raises SystemExit
+  so ``finally`` unwinds and the flag is removed.
+- SIGKILL / hard crash: the flag records the owner pid; any reader
+  (`is_paused`, the shell loops via ``kill -0``) treats a dead-pid flag
+  as stale and removes it, so probing can never be blocked forever.
+- concurrency: ``open(flag, 'x')`` is the atomic acquire; losing the
+  race to a LIVE owner means someone else guards the device (we run
+  un-flagged under their pause — scripts/bench_on_recovery.sh holds the
+  flag across its whole stage queue).
+
+``ZOO_BENCH_FLAG`` overrides the flag path (tests sandbox it there).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+
+
+def flag_path() -> str:
+    return os.environ.get(
+        "ZOO_BENCH_FLAG",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_RUNNING"))
+
+
+def _owner_pid(path: str):
+    try:
+        with open(path) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return None
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def clear_if_stale(path: str | None = None) -> bool:
+    """Remove the flag when its recorded owner is dead (SIGKILL leak).
+    Returns True when the flag is absent afterwards."""
+    path = path or flag_path()
+    if not os.path.exists(path):
+        return True
+    pid = _owner_pid(path)
+    if pid is None or not _pid_alive(pid):
+        with contextlib.suppress(OSError):
+            os.remove(path)
+        return not os.path.exists(path)
+    return False
+
+
+@contextlib.contextmanager
+def probe_pause():
+    """Hold the BENCH_RUNNING flag for the duration of a bench run.
+
+    Nested-aware: when a LIVE owner already holds the flag (e.g.
+    scripts/bench_on_recovery.sh across its stage queue), yields without
+    acquiring — the outer owner removes it."""
+    path = flag_path()
+    clear_if_stale(path)
+    acquired = False
+    try:
+        with open(path, "x") as f:
+            f.write(str(os.getpid()))
+        acquired = True
+    except FileExistsError:
+        pass                        # live owner's pause covers us
+    except OSError:
+        pass                        # unwritable dir: run unguarded
+
+    prev_handler = None
+    if acquired:
+        # `timeout` kills with SIGTERM; default handling would skip the
+        # finally below.  Only the flag owner retargets the signal, and
+        # only when running in the main thread (signal() requirement).
+        def _terminate(signum, frame):
+            raise SystemExit(143)
+
+        try:
+            prev_handler = signal.signal(signal.SIGTERM, _terminate)
+        except ValueError:          # not the main thread
+            prev_handler = None
+    try:
+        yield
+    finally:
+        if acquired:
+            if prev_handler is not None:
+                with contextlib.suppress(ValueError):
+                    signal.signal(signal.SIGTERM, prev_handler)
+            if _owner_pid(path) == os.getpid():
+                with contextlib.suppress(OSError):
+                    os.remove(path)

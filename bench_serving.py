@@ -360,6 +360,13 @@ def main():
     .json is rewritten after EVERY scenario so a mid-run wedge keeps what
     was won, and a failed inter-scenario probe aborts the rest instead of
     queuing 900 s lease-waiters against a dead tunnel."""
+    from bench_guard import probe_pause
+
+    with probe_pause():
+        _main_inner()
+
+
+def _main_inner():
     import os
     import subprocess
     import sys

@@ -11,7 +11,21 @@
 # BENCH_RUNNING pauses the probe loop so probes don't contend for the
 # device grant mid-bench.
 cd /root/repo || exit 1
-trap 'rm -f BENCH_RUNNING' EXIT INT TERM
+# ownership-aware flag protocol (bench_guard.py): the flag records the
+# owner pid; only the owner removes it, and a flag whose owner is dead
+# is stale and reclaimable.
+release_flag() {
+  [ "$(cat BENCH_RUNNING 2>/dev/null)" = "$$" ] && rm -f BENCH_RUNNING
+}
+acquire_flag() {
+  OWNER=$(cat BENCH_RUNNING 2>/dev/null)
+  if [ -n "$OWNER" ] && [ "$OWNER" != "$$" ] \
+      && kill -0 "$OWNER" 2>/dev/null; then
+    return 1    # a live direct bench run holds the pause — defer to it
+  fi
+  echo "$$" > BENCH_RUNNING
+}
+trap 'release_flag' EXIT INT TERM
 
 probe() {   # shared probe (bench_serving.py --probe); rc 0 = alive
   timeout 90 python bench_serving.py --probe 2>/dev/null | grep -q PROBE_OK
@@ -30,7 +44,11 @@ while true; do
   fi
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   echo "recovery round $ROUNDS at $TS" >> bench_recovery.log
-  touch BENCH_RUNNING
+  if ! acquire_flag; then
+    echo "deferring: a live bench holds BENCH_RUNNING" >> bench_recovery.log
+    ROUNDS=$((ROUNDS - 1))   # not a spent attempt
+    sleep 120; continue
+  fi
   if [ ! -f SERVING_DONE ]; then
     timeout 7200 python bench_serving.py >> bench_recovery.log 2>&1 \
       && touch SERVING_DONE
@@ -53,7 +71,7 @@ while true; do
       && touch TRAINBENCH_DONE
     echo "bench rc=$? at $(date -u +%H:%M:%SZ)" >> bench_recovery.log
   fi
-  rm -f BENCH_RUNNING
+  release_flag
   rm -f TPU_ALIVE   # force a fresh probe-loop verdict before next round
   if [ -f SERVING_DONE ] && [ -f PROFILE_DONE ] && [ -f TRAINBENCH_DONE ]; then
     echo "all stages captured at $(date -u +%H:%M:%SZ)" >> bench_recovery.log

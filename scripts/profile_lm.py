@@ -33,6 +33,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import _peak_for  # noqa: E402  (device-keyed peak FLOP/s)
+from bench_guard import probe_pause  # noqa: E402
 
 
 def _peak() -> float:
@@ -43,6 +44,22 @@ def _peak() -> float:
 # must agree on these or mfu_analytic silently measures a different model
 LM_B, LM_T, LM_V = 8, 2048, 32000
 LM_H, LM_L, LM_F, LM_HEADS = 768, 12, 3072, 12
+
+
+def _merge_partial(updates):
+    """Checkpoint into PROFILE_LM_PARTIAL.json by merge, never
+    overwrite: each timing costs minutes of tunnel round-trips and a
+    wedge (or a --lm-only/--resnet-only run) must not erase the other
+    section's hard-won partials."""
+    merged = {}
+    try:
+        with open("PROFILE_LM_PARTIAL.json") as f:
+            merged = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    merged.update(updates)
+    with open("PROFILE_LM_PARTIAL.json", "w") as f:
+        json.dump(merged, f, indent=1, default=float)
 
 
 def _time_steps(step, state, batch, n=10):
@@ -70,18 +87,7 @@ def lm_ablations():
     out = {}
 
     def ckpt():
-        # per-ablation checkpoint: each timing costs minutes of tunnel
-        # round-trips; a wedge between ablations keeps the earlier ones
-        # (merge so a co-resident resnet partial is never erased)
-        merged = {}
-        try:
-            with open("PROFILE_LM_PARTIAL.json") as f:
-                merged = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            pass
-        merged["lm"] = out
-        with open("PROFILE_LM_PARTIAL.json", "w") as f:
-            json.dump(merged, f, indent=1, default=float)
+        _merge_partial({"lm": out})
 
     def build(loss_fn, use_flash=True, wrap=None):
         model = TransformerLM(vocab_size=V, hidden_size=LM_H,
@@ -170,6 +176,41 @@ def lm_ablations():
     return out
 
 
+def flash_block_ablation():
+    """Standalone flash fwd+bwd at the bench's attention shape across
+    block-size configs — the kernel's only tuning knobs.  Cheap (a few
+    steps each); informs whether 512x512 (the default) is right for
+    v5e's VMEM/MXU balance."""
+    from analytics_zoo_tpu.ops import flash_attention
+
+    B, T, H, D = LM_B, LM_T, LM_HEADS, LM_H // LM_HEADS
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+    k = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+    v = jax.random.normal(key, (B, T, H, D), jnp.bfloat16)
+    out = {}
+    for bq, bk in ((256, 256), (512, 512), (1024, 512), (512, 1024)):
+        @jax.jit
+        def step(q, k, v, bq=bq, bk=bk):
+            def f(q, k, v):
+                return flash_attention(q, k, v, causal=True,
+                                       block_q=bq, block_k=bk).sum()
+            l, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+            return l, grads
+
+        try:
+            l, _ = step(q, k, v)
+            float(np.asarray(l))                    # compile + settle
+            t0 = time.perf_counter()
+            for _ in range(10):
+                l, _ = step(q, k, v)
+            float(np.asarray(l))
+            out[f"bq{bq}_bk{bk}_s"] = (time.perf_counter() - t0) / 10
+        except Exception as e:                      # VMEM overflow etc.
+            out[f"bq{bq}_bk{bk}_s"] = f"failed: {type(e).__name__}"
+    return out
+
+
 def resnet_ablations():
     import flax.linen as nn
     import optax
@@ -221,24 +262,14 @@ def resnet_ablations():
 def main():
     from analytics_zoo_tpu import init_orca_context, stop_orca_context
 
-    def ckpt(res):
-        # a wedge mid-profile keeps whatever was measured so far; merge
-        # with any existing partial so e.g. --resnet-only cannot erase
-        # hard-won lm timings from an earlier wedged run
-        merged = {}
-        try:
-            with open("PROFILE_LM_PARTIAL.json") as f:
-                merged = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            pass
-        merged.update(res)
-        with open("PROFILE_LM_PARTIAL.json", "w") as f:
-            json.dump(merged, f, indent=1, default=float)
+    ckpt = _merge_partial
 
     res = {}
     if "--resnet-only" not in sys.argv:
         init_orca_context("local")
         res["lm"] = lm_ablations()      # stops its own context
+        ckpt(res)
+        res["flash_blocks"] = flash_block_ablation()
         ckpt(res)
     if "--lm-only" not in sys.argv:
         init_orca_context("local")
@@ -249,4 +280,5 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    with probe_pause():     # pause the probe loop when run directly
+        main()

@@ -8,9 +8,16 @@
 # bench_on_recovery.sh), so every caller agrees on what "alive" means.
 LOG=/root/repo/tpu_probe_log.jsonl
 FLAG=/root/repo/TPU_ALIVE
+BFLAG=/root/repo/BENCH_RUNNING
 while true; do
-  if [ -f /root/repo/BENCH_RUNNING ]; then
-    sleep 120; continue   # don't contend for the grant mid-bench
+  if [ -f "$BFLAG" ]; then
+    # the flag records its owner pid (bench_guard.py); a dead owner
+    # (SIGKILLed bench) must not pause probing forever
+    OWNER=$(cat "$BFLAG" 2>/dev/null)
+    if [ -n "$OWNER" ] && kill -0 "$OWNER" 2>/dev/null; then
+      sleep 120; continue   # live bench: don't contend for the grant
+    fi
+    rm -f "$BFLAG"          # stale flag from a hard-killed bench
   fi
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   RAW=$(timeout 120 python /root/repo/bench_serving.py --probe 2>&1)

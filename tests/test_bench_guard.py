@@ -1,0 +1,52 @@
+"""bench_guard: the BENCH_RUNNING probe-pause protocol (ownership,
+nesting, stale-owner reclamation) — the contract the probe loop and the
+recovery script rely on to never block probing forever."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench_guard  # noqa: E402
+
+
+def _use_flag(tmp_path, monkeypatch):
+    p = tmp_path / "BENCH_RUNNING"
+    monkeypatch.setenv("ZOO_BENCH_FLAG", str(p))
+    return p
+
+
+def test_acquire_holds_and_releases(tmp_path, monkeypatch):
+    p = _use_flag(tmp_path, monkeypatch)
+    with bench_guard.probe_pause():
+        assert p.exists()
+        assert p.read_text() == str(os.getpid())
+    assert not p.exists()
+
+
+def test_nested_does_not_steal_live_owner(tmp_path, monkeypatch):
+    p = _use_flag(tmp_path, monkeypatch)
+    p.write_text(str(os.getpid()))      # a live "outer" owner (us)
+    with bench_guard.probe_pause():
+        assert p.read_text() == str(os.getpid())
+    # the inner pause must NOT have removed the outer owner's flag
+    assert p.exists()
+
+
+def test_stale_dead_owner_is_reclaimed(tmp_path, monkeypatch):
+    p = _use_flag(tmp_path, monkeypatch)
+    p.write_text("999999999")           # pid that cannot exist
+    assert bench_guard.clear_if_stale()
+    assert not p.exists()
+    # and probe_pause over a stale flag acquires normally
+    p.write_text("999999999")
+    with bench_guard.probe_pause():
+        assert p.read_text() == str(os.getpid())
+    assert not p.exists()
+
+
+def test_garbage_flag_counts_as_stale(tmp_path, monkeypatch):
+    p = _use_flag(tmp_path, monkeypatch)
+    p.write_text("not-a-pid")
+    assert bench_guard.clear_if_stale()
+    assert not p.exists()

@@ -27,8 +27,11 @@ class _FakeProc:
 @pytest.fixture()
 def sandbox(tmp_path, monkeypatch):
     """Run main() in a temp cwd with a tiny plan, recording-only
-    subprocess scenarios, and an always-alive device probe."""
+    subprocess scenarios, and an always-alive device probe.  The
+    BENCH_RUNNING probe-pause flag is sandboxed too (ZOO_BENCH_FLAG) so
+    tests never pause a live probe loop on this machine."""
     monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("ZOO_BENCH_FLAG", str(tmp_path / "BENCH_RUNNING"))
     monkeypatch.setattr(bench_serving, "PLAN", [
         ("resnet18", 64, 10, 64),
         ("lm-poisson", 12, 150, 8),
