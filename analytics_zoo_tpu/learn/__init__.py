@@ -1,8 +1,9 @@
 from analytics_zoo_tpu.learn.estimator import Estimator, FlaxEstimator
 from analytics_zoo_tpu.learn.train_state import ZooTrainState, create_train_state
 from analytics_zoo_tpu.learn.triggers import EarlyStopping
+from analytics_zoo_tpu.learn.lora import LoRAConfig
 from analytics_zoo_tpu.learn import objectives, metrics, triggers
 
 __all__ = ["Estimator", "FlaxEstimator", "ZooTrainState",
            "create_train_state", "objectives", "metrics", "triggers",
-           "EarlyStopping"]
+           "EarlyStopping", "LoRAConfig"]

@@ -85,6 +85,7 @@ class FlaxEstimator:
         config: Optional[TrainConfig] = None,
         model_dir: Optional[str] = None,
         param_loss: Optional[Callable] = None,
+        lora=None,
     ):
         self.model = self._maybe_convert_torch(model)
         # Optional penalty over the param tree (keras-API W_regularizer
@@ -93,10 +94,22 @@ class FlaxEstimator:
         self.loss_fn = get_loss(loss)
         if isinstance(optimizer, (int, float)):
             optimizer = optax.adam(float(optimizer))
+        # LoRA (learn/lora.py): adapters join the params tree under
+        # __lora__, the optimizer is masked to them, and _forward merges
+        # W + scale·A@B before apply — one transform, every model.
+        self.lora = lora
+        if lora is not None:
+            from analytics_zoo_tpu.learn.lora import wrap_optimizer
+
+            optimizer = wrap_optimizer(optimizer, True)
         self.tx = optimizer
         self.metric_fns = resolve_metrics(metrics)
         self.feature_cols = tuple(feature_cols)
         self.label_cols = tuple(label_cols)
+        if lora is not None:
+            from analytics_zoo_tpu.learn.lora import LORA_RULES
+
+            partition_rules = tuple(LORA_RULES) + tuple(partition_rules)
         self.rules = partition_rules
         self.config = config or TrainConfig()
         self.model_dir = model_dir
@@ -160,6 +173,13 @@ class FlaxEstimator:
         to the training loss by _train_step.  Eval applies run without
         mutable collections, so sown losses drop out there (eval loss stays
         comparable across MoE/dense models)."""
+        if self.lora is not None:
+            # gradients flow to the adapters THROUGH this merge; the
+            # base kernels' grads are computed too but the masked
+            # optimizer discards them (learn/lora.py)
+            from analytics_zoo_tpu.learn.lora import merge_lora
+
+            params = merge_lora(params, self.lora)
         variables = {"params": params}
         has_bs = batch_stats is not None
         if has_bs:
@@ -380,6 +400,15 @@ class FlaxEstimator:
             init_rng, train_rng = jax.random.split(root)
             variables = self.model.init(
                 {"params": init_rng, "dropout": init_rng}, *feats, **kw)
+            if self.lora is not None:
+                from analytics_zoo_tpu.learn.lora import (
+                    LORA_KEY, init_lora)
+
+                variables = dict(variables)
+                variables["params"] = dict(variables["params"])
+                variables["params"][LORA_KEY] = init_lora(
+                    variables["params"], self.lora,
+                    jax.random.fold_in(root, 2))
             return create_train_state(train_rng, self.model.apply,
                                       variables, self.tx)
 
@@ -964,6 +993,26 @@ class FlaxEstimator:
     def get_model(self):
         """(model, params) — ref parity: Estimator.get_model."""
         return self.model, None if self.state is None else self.state.params
+
+    def lora_params(self):
+        """The adapter tree alone — megabytes, the thing a fine-tune
+        ships (learn/lora.py)."""
+        from analytics_zoo_tpu.learn.lora import split_lora
+
+        if self.lora is None or self.state is None:
+            raise RuntimeError("no LoRA state: pass lora=LoRAConfig(...) "
+                               "and fit/evaluate first")
+        return split_lora(self.state.params)[1]
+
+    def merged_params(self):
+        """Base params with adapters folded in (W + scale·A@B) — plain
+        tree for serving/InferenceModel, no __lora__ key."""
+        from analytics_zoo_tpu.learn.lora import merge_lora
+
+        if self.lora is None or self.state is None:
+            raise RuntimeError("no LoRA state: pass lora=LoRAConfig(...) "
+                               "and fit/evaluate first")
+        return jax.device_get(merge_lora(self.state.params, self.lora))
 
 
 def _abs(path: str) -> str:

@@ -11,10 +11,12 @@ the ways benches actually die here:
 - SIGKILL / hard crash: the flag records the owner pid; any reader
   (`is_paused`, the shell loops via ``kill -0``) treats a dead-pid flag
   as stale and removes it, so probing can never be blocked forever.
-- concurrency: ``open(flag, 'x')`` is the atomic acquire; losing the
-  race to a LIVE owner means someone else guards the device (we run
-  un-flagged under their pause — scripts/bench_on_recovery.sh holds the
-  flag across its whole stage queue).
+- concurrency: the flag is published atomically (temp + os.replace, so
+  readers never see an empty/torn pid) and ownership is TAKEN OVER by
+  the youngest active bench — if an outer orchestrator dies while its
+  child bench runs on as an orphan, the owner pid is still alive and no
+  reader reclaims the flag mid-bench.  Releases are content-guarded
+  (only the recorded owner removes).
 
 ``ZOO_BENCH_FLAG`` overrides the flag path (tests sandbox it there).
 """
@@ -67,24 +69,35 @@ def clear_if_stale(path: str | None = None) -> bool:
     return False
 
 
+def _write_pid_atomic(path: str) -> bool:
+    """Publish our pid into the flag atomically (temp + rename): the
+    flag must never be readable in an empty/torn state, or readers'
+    stale logic would reclaim a LIVE owner's flag."""
+    tmp = f"{path}.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(str(os.getpid()))
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        return False
+
+
 @contextlib.contextmanager
 def probe_pause():
     """Hold the BENCH_RUNNING flag for the duration of a bench run.
 
-    Nested-aware: when a LIVE owner already holds the flag (e.g.
-    scripts/bench_on_recovery.sh across its stage queue), yields without
-    acquiring — the outer owner removes it."""
+    Nested-aware by OWNERSHIP TAKEOVER: when a live owner already holds
+    the flag (scripts/bench_on_recovery.sh across its stage queue), this
+    process re-publishes the flag with its own pid.  The youngest active
+    bench is always the owner, so if the outer script is killed while
+    the bench runs on as an orphan, the flag's owner is still alive and
+    readers will not reclaim it mid-bench.  The outer script's release
+    is content-guarded (only removes its own pid), so takeover is safe."""
     path = flag_path()
-    clear_if_stale(path)
-    acquired = False
-    try:
-        with open(path, "x") as f:
-            f.write(str(os.getpid()))
-        acquired = True
-    except FileExistsError:
-        pass                        # live owner's pause covers us
-    except OSError:
-        pass                        # unwritable dir: run unguarded
+    acquired = _write_pid_atomic(path)      # overwrite subsumes stale-clear
 
     prev_handler = None
     if acquired:

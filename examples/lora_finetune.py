@@ -1,0 +1,59 @@
+"""LoRA fine-tune of the causal LM, then serve the baked result.
+
+Base weights stay frozen; only rank-r adapters train (Adam state shrinks
+to the adapter tree — for the 111M bench LM that is ~1.5 MB of moments
+instead of ~900 MB).  `merged_params()` folds the adapters back into
+plain params for InferenceModel/serving.
+
+Run: python examples/lora_finetune.py
+"""
+
+import numpy as np
+import optax
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.learn import Estimator, LoRAConfig
+from analytics_zoo_tpu.models import (
+    TransformerLM, LM_PARTITION_RULES, lm_loss)
+
+
+def main():
+    init_orca_context("local")
+    rng = np.random.default_rng(0)
+    V, T, B = 1024, 128, 8
+    # toy corpus with a learnable pattern: token t+1 = (t*3+1) % V
+    start = rng.integers(0, V, (B * 16, 1))
+    seqs = [start]
+    for _ in range(T - 1):
+        seqs.append((seqs[-1] * 3 + 1) % V)
+    data = {"tokens": np.concatenate(seqs, axis=1).astype(np.int32)}
+
+    model = TransformerLM(vocab_size=V, hidden_size=128, num_layers=4,
+                          num_heads=4, intermediate_size=512,
+                          max_position=T)
+    est = Estimator.from_flax(
+        model=model, loss=lm_loss, optimizer=optax.adamw(3e-3),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES,
+        lora=LoRAConfig(rank=8, alpha=16.0))
+    hist = est.fit(data, epochs=5, batch_size=B)
+    print("losses:", [round(h["loss"], 3) for h in hist])
+
+    adapters = est.lora_params()
+    n = sum(int(np.prod(x.shape))
+            for ab in adapters.values() for x in ab.values())
+    print(f"adapter tree: {len(adapters)} kernels, {n:,} params "
+          f"({n * 4 / 2**20:.2f} MB f32)")
+
+    baked = est.merged_params()          # plain params, ready to serve
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    im = InferenceModel()
+    im.load_flax_generator(model, {"params": baked}, max_new_tokens=8)
+    out = im.predict(data["tokens"][:2, :16])    # [2, 16] prompts
+    print("generated:", np.asarray(out))
+    stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

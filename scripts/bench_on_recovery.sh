@@ -23,7 +23,9 @@ acquire_flag() {
       && kill -0 "$OWNER" 2>/dev/null; then
     return 1    # a live direct bench run holds the pause — defer to it
   fi
-  echo "$$" > BENCH_RUNNING
+  # atomic publish (mirror of bench_guard._write_pid_atomic): readers
+  # must never see an empty flag, or stale-reclaim kills a live pause
+  echo "$$" > "BENCH_RUNNING.$$" && mv "BENCH_RUNNING.$$" BENCH_RUNNING
 }
 trap 'release_flag' EXIT INT TERM
 

@@ -17,7 +17,12 @@ while true; do
     if [ -n "$OWNER" ] && kill -0 "$OWNER" 2>/dev/null; then
       sleep 120; continue   # live bench: don't contend for the grant
     fi
-    rm -f "$BFLAG"          # stale flag from a hard-killed bench
+    # reclaim only if the content still matches what we judged stale —
+    # a fresh bench may have republished the flag since we read it
+    if [ "$(cat "$BFLAG" 2>/dev/null)" = "$OWNER" ]; then
+      rm -f "$BFLAG"        # stale flag from a hard-killed bench
+    fi
+    sleep 5; continue       # re-evaluate next round
   fi
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
   RAW=$(timeout 120 python /root/repo/bench_serving.py --probe 2>&1)

@@ -24,13 +24,16 @@ def test_acquire_holds_and_releases(tmp_path, monkeypatch):
     assert not p.exists()
 
 
-def test_nested_does_not_steal_live_owner(tmp_path, monkeypatch):
+def test_nested_takes_over_ownership(tmp_path, monkeypatch):
+    """The youngest active bench owns the flag: an inner pause
+    republishes its own pid (so an orphaned bench stays protected if
+    the outer orchestrator dies) and removes the flag at exit.  The
+    outer holder's release is content-guarded, so this is safe."""
     p = _use_flag(tmp_path, monkeypatch)
-    p.write_text(str(os.getpid()))      # a live "outer" owner (us)
+    p.write_text("1")                   # a live "outer" owner (pid 1)
     with bench_guard.probe_pause():
-        assert p.read_text() == str(os.getpid())
-    # the inner pause must NOT have removed the outer owner's flag
-    assert p.exists()
+        assert p.read_text() == str(os.getpid())    # took ownership
+    assert not p.exists()               # owner removes at exit
 
 
 def test_stale_dead_owner_is_reclaimed(tmp_path, monkeypatch):
@@ -50,3 +53,13 @@ def test_garbage_flag_counts_as_stale(tmp_path, monkeypatch):
     p.write_text("not-a-pid")
     assert bench_guard.clear_if_stale()
     assert not p.exists()
+
+
+def test_atomic_publish_never_empty(tmp_path, monkeypatch):
+    """The flag file must never be observable with empty content —
+    readers treat empty as dead-owner and would reclaim a live pause."""
+    p = _use_flag(tmp_path, monkeypatch)
+    assert bench_guard._write_pid_atomic(str(p))
+    assert p.read_text() == str(os.getpid())
+    # no temp residue
+    assert list(tmp_path.glob("BENCH_RUNNING.*")) == []

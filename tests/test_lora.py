@@ -1,0 +1,177 @@
+"""LoRA fine-tuning (learn/lora.py): frozen base, rank-r adapters merged
+in-step, optimizer state only for adapters.  Beyond-parity extension —
+the reference has no parameter-efficient fine-tuning (SURVEY §2.3 covers
+full-weight estimators only)."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.learn import Estimator, LoRAConfig
+from analytics_zoo_tpu.learn.lora import (
+    LORA_KEY, init_lora, merge_lora, split_lora, target_paths)
+from analytics_zoo_tpu.models import TransformerLM, LM_PARTITION_RULES, lm_loss
+
+
+def _lm(V=64, T=32):
+    return TransformerLM(vocab_size=V, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_position=T, use_flash=False)
+
+
+def _data(n=32, V=64, T=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"tokens": rng.integers(0, V, (n, T)).astype(np.int32)}
+
+
+def _fit_lora(mesh_axes=None, rank=4, epochs=3):
+    from analytics_zoo_tpu.common.context import init_context
+
+    if mesh_axes:
+        init_context("local", mesh_axes=mesh_axes)
+    est = Estimator.from_flax(
+        model=_lm(), loss=lm_loss, optimizer=optax.adamw(1e-2),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES, lora=LoRAConfig(rank=rank))
+    hist = est.fit(_data(), epochs=epochs, batch_size=8)
+    return est, hist
+
+
+def test_base_frozen_adapters_train():
+    est, hist = _fit_lora()
+    assert hist[-1]["loss"] < hist[0]["loss"]       # adapters learn
+    base, lora = split_lora(jax.device_get(est.state.params))
+    # re-init the same model: base kernels must be byte-identical to the
+    # fit result's base (frozen), adapters must have moved off init
+    fresh = Estimator.from_flax(
+        model=_lm(), loss=lm_loss, optimizer=optax.adamw(1e-2),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES, lora=LoRAConfig(rank=4))
+    fresh._ensure_state(_data(4))
+    base0, lora0 = split_lora(jax.device_get(fresh.state.params))
+    for (p1, l1), (p0, l0) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(base)[0],
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(base0)[0],
+                   key=lambda kv: str(kv[0]))):
+        assert str(p1) == str(p0)
+        np.testing.assert_array_equal(l1, l0)
+    moved = any(float(np.abs(l1["b"]).max()) > 0 for l1 in lora.values())
+    assert moved                                    # b starts at 0
+
+
+def test_merged_equals_base_at_init():
+    """b=0 at init → merge is the identity: the LoRA model's first
+    forward must equal the plain model's, exactly."""
+    model = _lm()
+    data = _data(8)
+    feats = jnp.asarray(data["tokens"][:4])
+    variables = model.init(jax.random.key(0), feats)
+    cfg = LoRAConfig(rank=4)
+    lora = init_lora(variables["params"], cfg, jax.random.key(1))
+    aug = dict(variables["params"])
+    aug[LORA_KEY] = lora
+    merged = merge_lora(aug, cfg)
+    out_base = model.apply({"params": variables["params"]}, feats)
+    out_merged = model.apply({"params": merged}, feats)
+    np.testing.assert_array_equal(np.asarray(out_base),
+                                  np.asarray(out_merged))
+
+
+def test_nd_kernel_split_shapes():
+    """DenseGeneral kernels factorize along the layer's true in→out
+    split: q/k/v [hidden, heads, head_dim] → a:[hidden,r] b:[r,heads*hd];
+    attn_out [heads, head_dim, hidden] → a:[heads*hd,r] b:[r,hidden]."""
+    model = _lm()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    lora = init_lora(variables["params"], LoRAConfig(rank=4),
+                     jax.random.key(1))
+    q = lora["layer_0::attention::query::kernel"]
+    assert q["a"].shape == (32, 4) and q["b"].shape == (4, 2 * 16)
+    o = lora["layer_0::attention::attn_out::kernel"]
+    assert o["a"].shape == (2 * 16, 4) and o["b"].shape == (4, 32)
+    assert len(lora) == 12                      # 2 layers x 6 kernels
+
+
+def test_merged_params_serve_identically():
+    est, _ = _fit_lora()
+    preds_lora = np.asarray(est.predict(_data(8), batch_size=8))
+    baked = est.merged_params()
+    assert LORA_KEY not in baked
+    plain = Estimator.from_flax(
+        model=_lm(), loss=lm_loss, optimizer=optax.adamw(1e-2),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES)
+    plain._ensure_state(_data(4))
+    plain.state = plain.state.replace(params=baked)
+    preds_baked = np.asarray(plain.predict(_data(8), batch_size=8))
+    np.testing.assert_allclose(preds_lora, preds_baked,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_optimizer_state_only_for_adapters():
+    """The memory claim: Adam moments exist ONLY for adapter leaves."""
+    est, _ = _fit_lora()
+    sizes = [int(np.prod(x.shape)) for x in
+             jax.tree.leaves(est.state.opt_state)
+             if hasattr(x, "shape") and np.prod(x.shape) > 1]
+    lora = est.lora_params()
+    lora_elems = sum(int(np.prod(x.shape))
+                     for ab in lora.values() for x in ab.values())
+    # mu + nu per adapter leaf, nothing base-sized
+    assert sum(sizes) == 2 * lora_elems, (sum(sizes), lora_elems)
+
+
+def test_checkpoint_roundtrip_with_lora(tmp_path):
+    est, _ = _fit_lora()
+    preds = np.asarray(est.predict(_data(8), batch_size=8))
+    est.save_checkpoint(str(tmp_path))
+    est2 = Estimator.from_flax(
+        model=_lm(), loss=lm_loss, optimizer=optax.adamw(1e-2),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES, lora=LoRAConfig(rank=4))
+    est2._ensure_state(_data(4))
+    est2.load_checkpoint(str(tmp_path))
+    preds2 = np.asarray(est2.predict(_data(8), batch_size=8))
+    np.testing.assert_allclose(preds, preds2, rtol=1e-6, atol=1e-6)
+
+
+def test_lora_on_tp_mesh(devices):
+    """Adapters replicate; base shards per LM rules — fit runs and
+    learns on a dp×tp mesh."""
+    est, hist = _fit_lora(mesh_axes={"dp": -1, "tp": 2})
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    assert dict(est.mesh.shape) == {"dp": 4, "tp": 2}
+
+
+def test_no_match_fails_loud():
+    with pytest.raises(ValueError, match="matched no"):
+        est = Estimator.from_flax(
+            model=_lm(), loss=lm_loss, optimizer=optax.adamw(1e-2),
+            feature_cols=("tokens",), label_cols=("tokens",),
+            lora=LoRAConfig(rank=4, target_regex="does_not_exist"))
+        est.fit(_data(8), epochs=1, batch_size=4)
+
+
+def test_unknown_nd_split_fails_loud():
+    model = _lm()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    cfg = LoRAConfig(rank=2, target_regex=r"query/kernel$", splits=())
+    with pytest.raises(ValueError, match="input-dims split"):
+        init_lora(variables["params"], cfg, jax.random.key(1))
+
+
+def test_target_paths_selects_expected():
+    model = _lm()
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    paths = {"/".join(p) for p in
+             target_paths(variables["params"], LoRAConfig())}
+    assert "layer_0/ffn_up/kernel" in paths
+    assert "layer_1/attention/value/kernel" in paths
+    assert not any("embed" in p for p in paths)     # embeddings frozen
