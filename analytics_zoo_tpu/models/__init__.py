@@ -26,6 +26,7 @@ from analytics_zoo_tpu.models.lm import (
     LM_PP_INTERLEAVED_PARTITION_RULES,
     LM_MOE_PARTITION_RULES, lm_loss, fused_lm_loss, LMWithFusedLoss,
     generate, beam_search, unstack_pp_params)
+from analytics_zoo_tpu.models.speculative import speculative_generate
 from analytics_zoo_tpu.models.moe import (
     MoEMLP, MoETransformerLayer, MoETransformerClassifier,
     MOE_PARTITION_RULES, MOE_CLASSIFIER_PARTITION_RULES,
@@ -50,7 +51,7 @@ __all__ = [
     "TransformerLM", "DecoderLayer", "LM_PARTITION_RULES",
     "LM_PP_PARTITION_RULES", "LM_PP_INTERLEAVED_PARTITION_RULES",
     "LM_MOE_PARTITION_RULES", "lm_loss",
-    "generate", "beam_search",
+    "generate", "beam_search", "speculative_generate",
     "unstack_pp_params", "fused_lm_loss", "LMWithFusedLoss",
     "MoEMLP", "MoETransformerLayer", "MoETransformerClassifier",
     "MOE_PARTITION_RULES", "MOE_CLASSIFIER_PARTITION_RULES",

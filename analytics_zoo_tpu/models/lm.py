@@ -409,6 +409,58 @@ class DecoderAttention(nn.Module):
         o = o.reshape(B, 1, self._h, self._d)
         return self.attn_out(o.astype(self.dtype)), cache_k, cache_v
 
+    def decode_k(self, xs, cache_k, cache_v, pos):
+        """Cached decode of S tokens AT ONCE — the verify pass of
+        speculative decoding (models/speculative.py): the S draft tokens
+        run one MXU-friendly forward instead of S sequential steps.
+
+        xs: [B, S, E] hiddens of the S new tokens; pos: [B] int32, row
+        b's tokens occupy cache positions pos[b]..pos[b]+S-1.  Token j
+        attends cache entries < its own position plus itself (block-
+        causal against the cache, exactly the mask sequential decode
+        would have produced).  Returns (ys [B, S, E], cache_k, cache_v)
+        with all S K/V written; the CALLER decides how much of the write
+        becomes durable by how far it advances pos (rejected tokens'
+        entries are never attended once pos stops short of them, and the
+        next round overwrites them).
+        """
+        B, S = xs.shape[0], xs.shape[1]
+        L = cache_k.shape[1]
+        KH = self._kh
+        G = self._h // KH
+        q = self.query(xs)                              # [B, S, H, D]
+        ks = self.key(xs)                               # [B, S, KH, D]
+        vs = self.value(xs)
+        p = pos[:, None] + jnp.arange(S)[None, :]       # [B, S]
+        if self.pos_encoding == "rope":
+            q = _apply_rope(q, p, self.rope_base)
+            ks = _apply_rope(ks, p, self.rope_base)
+        # scatter the S new K/V rows to their per-row positions: one-hot
+        # matmul [B,S,L] — O(B·S·L·KH·D), the bandwidth the attention
+        # read below pays anyway (S is the small speculation depth)
+        hit = (jnp.arange(L)[None, None, :] == p[:, :, None])  # [B,S,L]
+        scat = hit.astype(cache_k.dtype)
+        wrote = hit.any(axis=1)[:, :, None, None]              # [B,L,1,1]
+        new_k = jnp.einsum("bsl,bshd->blhd", scat,
+                           ks.astype(cache_k.dtype))
+        new_v = jnp.einsum("bsl,bshd->blhd", scat,
+                           vs.astype(cache_v.dtype))
+        cache_k = jnp.where(wrote, new_k, cache_k)
+        cache_v = jnp.where(wrote, new_v, cache_v)
+        # token j sees cache position l iff l <= pos[b]+j
+        mask = (jnp.arange(L)[None, None, :]
+                <= p[:, :, None])[:, None, None, :, :]  # [B,1,1,S,L]
+        scale = 1.0 / jnp.sqrt(self._d).astype(jnp.float32)
+        qg = q.reshape(B, S, KH, G, self._d)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, cache_k,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask, logits, -jnp.inf)
+        w = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", w.astype(cache_v.dtype),
+                       cache_v, preferred_element_type=jnp.float32)
+        o = o.reshape(B, S, self._h, self._d)
+        return self.attn_out(o.astype(self.dtype)), cache_k, cache_v
+
 
 class DecoderLayer(nn.Module):
     """Pre-LN causal decoder block (pre-LN trains stably at depth without
@@ -481,6 +533,13 @@ class DecoderLayer(nn.Module):
         x1 = x1 + a
         x1 = x1 + self._mlp(self.ln_ffn(x1).astype(self.dtype), False)
         return x1, ck, cv
+
+    def decode_k(self, xs, cache_k, cache_v, pos):
+        a, ck, cv = self.attention.decode_k(
+            self.ln_attn(xs).astype(self.dtype), cache_k, cache_v, pos)
+        xs = xs + a
+        xs = xs + self._mlp(self.ln_ffn(xs).astype(self.dtype), False)
+        return xs, ck, cv
 
     def forward_kv(self, x, train: bool = False):
         """``__call__`` that also returns this layer's K/V ``[B, T, H,
@@ -708,6 +767,34 @@ class TransformerLM(nn.Module):
             vs.append(cv)
         logits = self._logits(self.ln_f(x))[:, 0]
         return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def verify_step(self, toks, caches_k, caches_v, pos):
+        """Cached decode of S tokens per row in ONE forward — the
+        speculative-decoding verify pass (models/speculative.py).
+
+        toks: [B, S]; caches as in decode_step; pos: [B] int32, row b's
+        tokens land at cache positions pos[b]..pos[b]+S-1.  Returns
+        (logits [B, S, V], caches_k, caches_v).  All S K/V entries are
+        written; advancing pos by fewer than S on the next call makes
+        the surplus entries dead (never attended, later overwritten) —
+        that is the rejection mechanism."""
+        if self.pp_stages > 0:
+            raise NotImplementedError(
+                "verify_step is not pipelined (same restriction as "
+                "decode_step); convert with models.lm.unstack_pp_params")
+        B, S = toks.shape
+        x = self.embed(toks)
+        if self.pos_embed is not None:
+            p = pos[:, None] + jnp.arange(S)[None, :]
+            x = x + self.pos_embed(p)
+        x = x.astype(self.dtype)
+        ks, vs = [], []
+        for i, layer in enumerate(self.layers):
+            x, ck, cv = layer.decode_k(x, caches_k[i], caches_v[i], pos)
+            ks.append(ck)
+            vs.append(cv)
+        return (self._logits(self.ln_f(x)), jnp.stack(ks),
+                jnp.stack(vs))
 
     def prefill(self, tokens):
         """Causal forward that ALSO returns every layer's K/V: ``(logits

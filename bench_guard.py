@@ -89,14 +89,18 @@ def _write_pid_atomic(path: str) -> bool:
 def probe_pause():
     """Hold the BENCH_RUNNING flag for the duration of a bench run.
 
-    Nested-aware by OWNERSHIP TAKEOVER: when a live owner already holds
+    Nested-aware by TAKEOVER-AND-RESTORE: when an owner already holds
     the flag (scripts/bench_on_recovery.sh across its stage queue), this
-    process re-publishes the flag with its own pid.  The youngest active
-    bench is always the owner, so if the outer script is killed while
-    the bench runs on as an orphan, the flag's owner is still alive and
-    readers will not reclaim it mid-bench.  The outer script's release
-    is content-guarded (only removes its own pid), so takeover is safe."""
+    process re-publishes the flag with its own pid — so if the outer
+    orchestrator dies while the bench runs on as an orphan, the owner
+    pid is still alive and no reader reclaims the flag mid-bench.  On
+    release, a prior owner that is STILL ALIVE gets the flag back (its
+    pause outlives this nested run); a dead or absent prior owner means
+    we were the last guard and the flag is removed."""
     path = flag_path()
+    prior = _owner_pid(path) if os.path.exists(path) else None
+    if prior == os.getpid():
+        prior = None                        # re-entrant: we already own it
     acquired = _write_pid_atomic(path)      # overwrite subsumes stale-clear
 
     prev_handler = None
@@ -119,5 +123,16 @@ def probe_pause():
                 with contextlib.suppress(ValueError):
                     signal.signal(signal.SIGTERM, prev_handler)
             if _owner_pid(path) == os.getpid():
-                with contextlib.suppress(OSError):
-                    os.remove(path)
+                if prior is not None and _pid_alive(prior):
+                    # the outer holder's pause outlives this nested run
+                    tmp = f"{path}.{os.getpid()}"
+                    try:
+                        with open(tmp, "w") as f:
+                            f.write(str(prior))
+                        os.replace(tmp, path)
+                    except OSError:
+                        with contextlib.suppress(OSError):
+                            os.remove(tmp)
+                else:
+                    with contextlib.suppress(OSError):
+                        os.remove(path)

@@ -24,16 +24,24 @@ def test_acquire_holds_and_releases(tmp_path, monkeypatch):
     assert not p.exists()
 
 
-def test_nested_takes_over_ownership(tmp_path, monkeypatch):
-    """The youngest active bench owns the flag: an inner pause
-    republishes its own pid (so an orphaned bench stays protected if
-    the outer orchestrator dies) and removes the flag at exit.  The
-    outer holder's release is content-guarded, so this is safe."""
+def test_nested_takeover_restores_live_outer_owner(tmp_path, monkeypatch):
+    """The youngest active bench owns the flag while it runs (orphan
+    protection if the outer orchestrator dies), but a LIVE outer
+    holder's pause must outlive the nested run: release restores the
+    prior owner's pid instead of removing the flag."""
     p = _use_flag(tmp_path, monkeypatch)
-    p.write_text("1")                   # a live "outer" owner (pid 1)
+    p.write_text("1")                   # a live "outer" owner (init)
     with bench_guard.probe_pause():
         assert p.read_text() == str(os.getpid())    # took ownership
-    assert not p.exists()               # owner removes at exit
+    assert p.read_text() == "1"         # outer pause restored
+
+
+def test_nested_takeover_removes_dead_outer_owner(tmp_path, monkeypatch):
+    p = _use_flag(tmp_path, monkeypatch)
+    p.write_text("999999999")           # outer owner already dead
+    with bench_guard.probe_pause():
+        assert p.read_text() == str(os.getpid())
+    assert not p.exists()               # last guard out removes
 
 
 def test_stale_dead_owner_is_reclaimed(tmp_path, monkeypatch):

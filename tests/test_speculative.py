@@ -1,0 +1,129 @@
+"""Speculative decoding (models/speculative.py): draft proposes k,
+target verifies in one cached forward.  The greedy contract — output
+EXACTLY equals target-only greedy generate() — is the whole test
+surface; no statistical tolerance."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models import TransformerLM
+from analytics_zoo_tpu.models.lm import generate
+from analytics_zoo_tpu.models.speculative import speculative_generate
+
+V, T = 64, 256
+
+
+def _models():
+    target = TransformerLM(vocab_size=V, hidden_size=32, num_layers=2,
+                           num_heads=2, intermediate_size=64,
+                           max_position=T)
+    draft = TransformerLM(vocab_size=V, hidden_size=16, num_layers=1,
+                          num_heads=2, intermediate_size=32,
+                          max_position=T)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(1, V, (3, 10)).astype(np.int32))
+    tv = target.init(jax.random.key(0), prompt)
+    dv = draft.init(jax.random.key(1), prompt)
+    return target, tv, draft, dv, prompt
+
+
+def test_verify_step_equals_sequential_decode():
+    """The decode_k path is the round's engine: S cached tokens in one
+    forward must reproduce S sequential decode_steps bitwise."""
+    for pe, kvh in (("learned", 2), ("rope", 1)):
+        model = TransformerLM(vocab_size=V, hidden_size=32, num_layers=2,
+                              num_heads=2, intermediate_size=64,
+                              max_position=T, pos_encoding=pe,
+                              num_kv_heads=kvh)
+        rng = np.random.default_rng(1)
+        toks = jnp.asarray(rng.integers(0, V, (2, 9)).astype(np.int32))
+        variables = model.init(jax.random.key(0), toks)
+        H = model.kv_heads
+        D = model.hidden_size // model.num_heads
+        ck = jnp.zeros((2, 2, 32, H, D), model.dtype)
+        cv = jnp.zeros_like(ck)
+        ck1, cv1, outs = ck, cv, []
+        for t in range(9):
+            lg, ck1, cv1 = model.apply(
+                variables, toks[:, t], ck1, cv1,
+                jnp.full((2,), t, jnp.int32),
+                method=TransformerLM.decode_step)
+            outs.append(lg)
+        lg2, ck2, cv2 = model.apply(
+            variables, toks, ck, cv, jnp.zeros((2,), jnp.int32),
+            method=TransformerLM.verify_step)
+        np.testing.assert_array_equal(np.asarray(jnp.stack(outs, 1)),
+                                      np.asarray(lg2))
+        np.testing.assert_array_equal(np.asarray(ck1), np.asarray(ck2))
+
+
+def test_greedy_equality_random_draft():
+    target, tv, draft, dv, prompt = _models()
+    ref = np.asarray(generate(target, tv, prompt, 24))
+    out, stats = speculative_generate(target, tv, draft, dv, prompt,
+                                      24, k=4)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert stats["rounds"] <= 24
+
+
+def test_self_draft_full_acceptance():
+    """draft == target → every proposal accepted: k+1 tokens per round,
+    including across the bonus-token boundary (the draft-cache edge that
+    needs the k+1-th feed)."""
+    target, tv, _, _, prompt = _models()
+    ref = np.asarray(generate(target, tv, prompt, 24))
+    out, stats = speculative_generate(target, tv, target, tv, prompt,
+                                      24, k=4)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert stats["rounds"] == -(-24 // 5)           # ceil(24/(k+1))
+    assert stats["mean_accepted_per_round"] > 4.5
+
+
+@pytest.mark.parametrize("k", [1, 3, 7])
+def test_greedy_equality_across_k(k):
+    target, tv, draft, dv, prompt = _models()
+    ref = np.asarray(generate(target, tv, prompt, 15))
+    out, _ = speculative_generate(target, tv, draft, dv, prompt, 15, k=k)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_ragged_prompts():
+    target, tv, draft, dv, prompt = _models()
+    plen = jnp.asarray([10, 6, 8], jnp.int32)
+    ref = np.asarray(generate(target, tv, prompt, 12, prompt_len=plen))
+    out, _ = speculative_generate(target, tv, draft, dv, prompt, 12,
+                                  k=3, prompt_len=plen)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_eos_freeze_parity():
+    """Pick the eos id the reference generation actually emits so the
+    freeze path runs; rows must freeze at eos exactly like generate."""
+    target, tv, draft, dv, prompt = _models()
+    ref = np.asarray(generate(target, tv, prompt, 16))
+    eos = int(ref[0, 3])                    # forces an early stop row 0
+    ref_eos = np.asarray(generate(target, tv, prompt, 16, eos_id=eos))
+    out, _ = speculative_generate(target, tv, draft, dv, prompt, 16,
+                                  k=4, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(out), ref_eos)
+
+
+def test_vocab_mismatch_fails_loud():
+    target, tv, _, _, prompt = _models()
+    other = TransformerLM(vocab_size=V * 2, hidden_size=16, num_layers=1,
+                          num_heads=2, intermediate_size=32,
+                          max_position=T)
+    ov = other.init(jax.random.key(2),
+                    jnp.zeros((1, 4), jnp.int32))
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(target, tv, other, ov, prompt, 8)
+
+
+def test_max_position_overflow_fails_loud():
+    target, tv, draft, dv, prompt = _models()
+    with pytest.raises(ValueError, match="max_position"):
+        speculative_generate(target, tv, draft, dv, prompt,
+                             T, k=4)
