@@ -69,6 +69,8 @@ class InferenceModel:
         self._variables = None
         self._buckets = tuple(sorted(batch_buckets))
         self._jit: Optional[Callable] = None
+        self._jit_outer = True  # False = host-loop apply_fn (spec decode)
+        self.spec_stats = None  # cumulative speculative-decoding stats
         self._compile_lock = threading.Lock()
         self._sem = threading.Semaphore(max(1, concurrent_num))
         self._takes_train: Optional[str] = None
@@ -166,13 +168,16 @@ class InferenceModel:
         self.prompt_pad_id = None
         self._gen_max_new_tokens = None
         self._jit = None        # new model -> stale compiled wrapper
+        self._jit_outer = True  # ditto a stale host-loop (draft) flag
         return self
 
     def load_flax_generator(self, model, variables, max_new_tokens: int,
                             prompt_buckets: Sequence[int] = (16, 32, 64,
                                                              128),
                             pad_id: int = 0,
-                            quantize: Optional[str] = None
+                            quantize: Optional[str] = None,
+                            draft_model=None, draft_variables=None,
+                            speculation_k: int = 4
                             ) -> "InferenceModel":
         """Serve autoregressive GENERATION from a TransformerLM: predict
         takes right-padded prompts [B, P] (+ optional per-row lengths [B])
@@ -187,19 +192,38 @@ class InferenceModel:
         int8-LLM-serving role.  No reference counterpart (SURVEY.md §2.5:
         no generative LM upstream) — the serving face of
         models/lm.generate.
+
+        ``draft_model``/``draft_variables`` switch decoding to
+        SPECULATIVE (models/speculative.py): the draft proposes
+        ``speculation_k`` tokens per round and the target verifies them
+        in one cached forward — identical greedy output, fewer
+        host round-trips per token by the acceptance rate.  Per-request
+        stats land in ``self.spec_stats``.  ``quantize`` applies to
+        the TARGET only (the draft is small; quantizing it buys little).
         """
         from analytics_zoo_tpu.models.lm import generate
 
+        if (draft_model is None) != (draft_variables is None):
+            raise ValueError("pass draft_model and draft_variables "
+                             "together (or neither)")
         self.model = model
         self._variables = self._install_quantized(variables, quantize)
         self._takes_train = None
         # a bucket only counts if the padded prompt + generation still
         # fits the model's position table — otherwise a prompt that
         # genuinely fits would fail generate()'s length check after
-        # bucket padding
+        # bucket padding.  Speculative decoding needs k+1 extra cache
+        # slack (verify overshoot) and must fit BOTH models' position
+        # tables, so its limit is tighter — validated HERE so a request
+        # the serving bounds-check admits can never fail at predict time.
+        eff_max_pos = model.max_position
+        eff_new = max_new_tokens
+        if draft_model is not None:
+            eff_max_pos = min(model.max_position,
+                              draft_model.max_position)
+            eff_new = max_new_tokens + int(speculation_k) + 1
         pbuckets = filter_prompt_buckets(prompt_buckets,
-                                         model.max_position,
-                                         max_new_tokens)
+                                         eff_max_pos, eff_new)
         # serving batcher reads these to bounds-check ragged prompts
         # per-request and to cross-check its own pad id against the
         # generator's (a mismatch would silently miscount prompt lengths)
@@ -209,11 +233,45 @@ class InferenceModel:
         self._gen_max_new_tokens = int(max_new_tokens)
         self._gen_prompt_buckets = pbuckets
 
-        def apply_fn(variables, prompts, lengths):
-            if self._dequant is not None:
-                variables = self._dequant(variables)
-            return generate(model, variables, prompts, max_new_tokens,
-                            prompt_len=lengths)
+        if draft_model is not None:
+            from analytics_zoo_tpu.models.speculative import (
+                speculative_generate)
+
+            def apply_fn(variables, prompts, lengths):
+                # host-loop orchestration (each round is jitted inside);
+                # _compiled() must NOT wrap this in an outer jit
+                if self._dequant is not None:
+                    variables = self._dequant(variables)
+                toks, stats = speculative_generate(
+                    model, variables, draft_model, draft_variables,
+                    prompts, max_new_tokens, k=speculation_k,
+                    prompt_len=lengths)
+                # CUMULATIVE since load (lock: predicts may run from
+                # several serving threads; chunked predicts call this
+                # once per chunk) — a per-request hook would be racy
+                with self._spec_stats_lock:
+                    agg = self.spec_stats or {
+                        "rounds": 0, "emitted_tokens": 0,
+                        "row_rounds": 0}
+                    agg["rounds"] += stats["rounds"]
+                    agg["emitted_tokens"] += stats["emitted_tokens"]
+                    agg["row_rounds"] += stats["rounds"] * stats["batch"]
+                    agg["mean_accepted_per_round"] = (
+                        agg["emitted_tokens"] / max(1, agg["row_rounds"]))
+                    self.spec_stats = agg
+                return toks
+
+            self._jit_outer = False
+            self._spec_stats_lock = threading.Lock()
+            self.spec_stats = None
+        else:
+            def apply_fn(variables, prompts, lengths):
+                if self._dequant is not None:
+                    variables = self._dequant(variables)
+                return generate(model, variables, prompts,
+                                max_new_tokens, prompt_len=lengths)
+
+            self._jit_outer = True
 
         def pre_pad(inputs):
             prompts = np.asarray(inputs[0])
@@ -325,7 +383,11 @@ class InferenceModel:
 
     def _compiled(self) -> Callable:
         # one jit wrapper; jax's own per-shape trace cache (driven by the
-        # bucket padding in predict) bounds compilations
+        # bucket padding in predict) bounds compilations.  Host-loop
+        # apply_fns (speculative decoding) jit their own inner rounds
+        # and must not be wrapped again.
+        if not getattr(self, "_jit_outer", True):
+            return self._apply_fn
         with self._compile_lock:
             if self._jit is None:
                 self._jit = jax.jit(self._apply_fn)

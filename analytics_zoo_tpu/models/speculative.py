@@ -192,6 +192,8 @@ def speculative_generate(model: TransformerLM, variables,
         o = np.where((m - (o == eos_id)) > 0, eos_id, o)
         out = jnp.asarray(o)
     stats = {"rounds": rounds,
+             "emitted_tokens": emitted,
+             "batch": B,
              "mean_accepted_per_round":
                  emitted / max(1, rounds * B)}
     return out, stats

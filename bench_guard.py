@@ -98,9 +98,10 @@ def probe_pause():
     pause outlives this nested run); a dead or absent prior owner means
     we were the last guard and the flag is removed."""
     path = flag_path()
+    # prior may be our own pid (re-entrant nesting): restoring it on
+    # release keeps the OUTER same-process pause intact — only the
+    # outermost release actually removes the flag
     prior = _owner_pid(path) if os.path.exists(path) else None
-    if prior == os.getpid():
-        prior = None                        # re-entrant: we already own it
     acquired = _write_pid_atomic(path)      # overwrite subsumes stale-clear
 
     prev_handler = None

@@ -127,3 +127,61 @@ def test_max_position_overflow_fails_loud():
     with pytest.raises(ValueError, match="max_position"):
         speculative_generate(target, tv, draft, dv, prompt,
                              T, k=4)
+
+
+def test_serving_path_speculative_equals_plain():
+    """InferenceModel.load_flax_generator(draft_model=...) — the full
+    serving pipeline (bucket padding, length inference, async fetch)
+    with speculative decoding must serve the same tokens as plain."""
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    target, tv, draft, dv, prompt = _models()
+    prompts = np.asarray(prompt)
+    ref = np.asarray(InferenceModel().load_flax_generator(
+        target, tv, max_new_tokens=12).predict(prompts))
+    im = InferenceModel().load_flax_generator(
+        target, tv, max_new_tokens=12,
+        draft_model=draft, draft_variables=dv, speculation_k=3)
+    out = np.asarray(im.predict(prompts))
+    np.testing.assert_array_equal(out, ref)
+    assert im.spec_stats["rounds"] >= 1
+    before = im.spec_stats["rounds"]
+    im.predict(prompts)
+    assert im.spec_stats["rounds"] > before      # cumulative
+
+
+def test_serving_speculative_bucket_limit_checked_at_load():
+    """Speculative needs prompt + max_new + k + 1 <= BOTH models'
+    max_position; a bucket valid for plain decoding must be rejected at
+    LOAD time, not crash inside predict."""
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    target, tv, draft, dv, _ = _models()
+    # bucket 16 + max_new = T: fine for plain, impossible for spec
+    im = InferenceModel().load_flax_generator(
+        target, tv, max_new_tokens=T - 16, prompt_buckets=(16,))
+    assert im.max_prompt_width == 16
+    with pytest.raises(ValueError, match="no prompt bucket fits"):
+        InferenceModel().load_flax_generator(
+            target, tv, max_new_tokens=T - 16, prompt_buckets=(16,),
+            draft_model=draft, draft_variables=dv, speculation_k=4)
+    # and a small draft position table tightens the limit the same way
+    short_draft = TransformerLM(vocab_size=V, hidden_size=16,
+                                num_layers=1, num_heads=2,
+                                intermediate_size=32, max_position=24)
+    sv = short_draft.init(jax.random.key(3),
+                          jnp.zeros((1, 4), jnp.int32))
+    with pytest.raises(ValueError, match="no prompt bucket fits"):
+        InferenceModel().load_flax_generator(
+            target, tv, max_new_tokens=12, prompt_buckets=(16,),
+            draft_model=short_draft, draft_variables=sv,
+            speculation_k=4)
+
+
+def test_serving_draft_args_must_pair():
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    target, tv, draft, _, _ = _models()
+    with pytest.raises(ValueError, match="together"):
+        InferenceModel().load_flax_generator(
+            target, tv, max_new_tokens=4, draft_model=draft)
