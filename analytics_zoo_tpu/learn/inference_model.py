@@ -169,6 +169,8 @@ class InferenceModel:
         self._gen_max_new_tokens = None
         self._jit = None        # new model -> stale compiled wrapper
         self._jit_outer = True  # ditto a stale host-loop (draft) flag
+        self.spec_stats = None  # ditto stale speculative stats
+        self._spec_draft = False
         return self
 
     def load_flax_generator(self, model, variables, max_new_tokens: int,
@@ -237,25 +239,37 @@ class InferenceModel:
             from analytics_zoo_tpu.models.speculative import (
                 speculative_generate)
 
+            # host-loop apply_fn: a fused dequant would re-run EAGERLY
+            # per request (no outer jit to fold it into) — dequantize
+            # once at load instead, like make_continuous_engine
+            if self._dequant is not None:
+                self._variables = jax.device_put(
+                    self._dequant(self._variables))
+                self._dequant = None
+
             def apply_fn(variables, prompts, lengths):
                 # host-loop orchestration (each round is jitted inside);
                 # _compiled() must NOT wrap this in an outer jit
-                if self._dequant is not None:
-                    variables = self._dequant(variables)
                 toks, stats = speculative_generate(
                     model, variables, draft_model, draft_variables,
                     prompts, max_new_tokens, k=speculation_k,
                     prompt_len=lengths)
                 # CUMULATIVE since load (lock: predicts may run from
                 # several serving threads; chunked predicts call this
-                # once per chunk) — a per-request hook would be racy
+                # once per chunk) — a per-request hook would be racy.
+                # Batch-bucket padding adds phantom all-pad rows whose
+                # lengths are 0 (pre_pad rejects real empty prompts):
+                # count only REAL rows or the acceptance diagnostic
+                # reflects padding, not traffic.
+                real = np.asarray(lengths) > 0
                 with self._spec_stats_lock:
                     agg = self.spec_stats or {
                         "rounds": 0, "emitted_tokens": 0,
                         "row_rounds": 0}
                     agg["rounds"] += stats["rounds"]
-                    agg["emitted_tokens"] += stats["emitted_tokens"]
-                    agg["row_rounds"] += stats["rounds"] * stats["batch"]
+                    agg["emitted_tokens"] += int(
+                        stats["per_row_emitted"][real].sum())
+                    agg["row_rounds"] += stats["rounds"] * int(real.sum())
                     agg["mean_accepted_per_round"] = (
                         agg["emitted_tokens"] / max(1, agg["row_rounds"]))
                     self.spec_stats = agg
@@ -264,6 +278,7 @@ class InferenceModel:
             self._jit_outer = False
             self._spec_stats_lock = threading.Lock()
             self.spec_stats = None
+            self._spec_draft = True
         else:
             def apply_fn(variables, prompts, lengths):
                 if self._dequant is not None:
@@ -272,6 +287,8 @@ class InferenceModel:
                                 max_new_tokens, prompt_len=lengths)
 
             self._jit_outer = True
+            self.spec_stats = None      # stale draft-run stats would lie
+            self._spec_draft = False
 
         def pre_pad(inputs):
             prompts = np.asarray(inputs[0])
@@ -322,6 +339,14 @@ class InferenceModel:
         if getattr(self, "_gen_max_new_tokens", None) is None:
             raise ValueError("continuous batching needs a model loaded "
                              "via load_flax_generator")
+        if getattr(self, "_spec_draft", False):
+            # silently dropping the draft would also inherit the
+            # spec-tightened prompt buckets (k+1 slack, draft position
+            # table) — constraints that don't apply to the engine
+            raise ValueError(
+                "speculative decoding is batch-generative only; reload "
+                "via load_flax_generator WITHOUT draft_model to build a "
+                "continuous engine")
         variables = self._variables
         if self._dequant is not None:
             variables = jax.device_put(self._dequant(variables))

@@ -27,6 +27,8 @@ from analytics_zoo_tpu.models.lm import (
     LM_MOE_PARTITION_RULES, lm_loss, fused_lm_loss, LMWithFusedLoss,
     generate, beam_search, unstack_pp_params)
 from analytics_zoo_tpu.models.speculative import speculative_generate
+from analytics_zoo_tpu.models.distill import (
+    DistillLM, distill_draft, distill_loss)
 from analytics_zoo_tpu.models.moe import (
     MoEMLP, MoETransformerLayer, MoETransformerClassifier,
     MOE_PARTITION_RULES, MOE_CLASSIFIER_PARTITION_RULES,
@@ -52,6 +54,7 @@ __all__ = [
     "LM_PP_PARTITION_RULES", "LM_PP_INTERLEAVED_PARTITION_RULES",
     "LM_MOE_PARTITION_RULES", "lm_loss",
     "generate", "beam_search", "speculative_generate",
+    "DistillLM", "distill_draft", "distill_loss",
     "unstack_pp_params", "fused_lm_loss", "LMWithFusedLoss",
     "MoEMLP", "MoETransformerLayer", "MoETransformerClassifier",
     "MOE_PARTITION_RULES", "MOE_CLASSIFIER_PARTITION_RULES",

@@ -1,0 +1,155 @@
+"""Draft-model distillation — the companion of speculative decoding.
+
+Speculation only pays when the draft guesses like the target
+(models/speculative.py: acceptance rate IS the speedup).  An
+independently-trained small LM guesses like itself; a DISTILLED one is
+trained to match the target's token distribution, which is exactly the
+acceptance criterion.  This module trains a small TransformerLM against
+a frozen target's logits in one Estimator fit:
+
+    draft_vars = distill_draft(target, target_vars, draft, data, ...)
+
+Design: ``DistillLM`` wraps both models in one module — the jitted
+train step runs the frozen target forward (``stop_gradient``) and the
+draft forward on the same tokens and returns the per-sample distillation
+loss (forward KL, temperature-scaled, optionally mixed with next-token
+CE).  The target's params ride in the same tree under ``target/`` but
+``freeze_target_optimizer`` masks them out of the optimizer
+(``optax.multi_transform`` — no Adam moments for the big model, same
+memory shape as learn/lora.py).  TPU fit: both forwards share one XLA
+program, the target runs inference-only (no activation stashing), and
+everything jits/shards like any other Estimator model.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from analytics_zoo_tpu.models.lm import TransformerLM
+
+
+class DistillLM(nn.Module):
+    """Train-time pair: frozen ``target`` teaches ``draft``.
+
+    ``__call__(tokens, train)`` returns per-sample loss [B]:
+    ``kl_weight * KL(target_T || draft_T) + ce_weight * CE(draft,
+    next-token)`` where ``_T`` is temperature-softened.  Use with
+    ``loss=distill_loss`` (the mean) and
+    ``freeze_target_optimizer(tx)``."""
+
+    draft: TransformerLM
+    target: TransformerLM
+    temperature: float = 1.0
+    kl_weight: float = 1.0
+    ce_weight: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        if self.draft.vocab_size != self.target.vocab_size:
+            raise ValueError(
+                f"draft vocab {self.draft.vocab_size} != target vocab "
+                f"{self.target.vocab_size}")
+        t_logits = lax.stop_gradient(
+            self.target(tokens, False).astype(jnp.float32))
+        d_logits = self.draft(tokens, train).astype(jnp.float32)
+        # next-token alignment: position t teaches token t+1
+        t_logits = t_logits[:, :-1]
+        d_logits = d_logits[:, :-1]
+        inv_t = 1.0 / float(self.temperature)
+        t_logp = jax.nn.log_softmax(t_logits * inv_t, axis=-1)
+        d_logp = jax.nn.log_softmax(d_logits * inv_t, axis=-1)
+        # forward KL, mean over positions -> [B]; the standard T^2
+        # factor keeps gradient scale comparable across temperatures
+        kl = jnp.sum(jnp.exp(t_logp) * (t_logp - d_logp), axis=-1)
+        loss = self.kl_weight * float(self.temperature) ** 2 \
+            * jnp.mean(kl, axis=-1)
+        if self.ce_weight:
+            import optax
+
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                d_logits, tokens[:, 1:])
+            loss = loss + self.ce_weight * jnp.mean(ce, axis=-1)
+        return loss
+
+
+def distill_loss(per_sample, _tokens):
+    """Estimator loss for DistillLM: the model output IS the loss."""
+    return jnp.mean(per_sample)
+
+
+def freeze_target_optimizer(tx):
+    """Mask the optimizer to the draft's params: the frozen target gets
+    ``set_to_zero`` labels, so no Adam moments are allocated for it."""
+    import optax
+
+    def labels(params):
+        return {k: jax.tree.map(lambda _: "frozen" if k == "target"
+                                else "train", v)
+                for k, v in params.items()}
+
+    return optax.multi_transform(
+        {"train": tx, "frozen": optax.set_to_zero()}, labels)
+
+
+def distill_draft(target: TransformerLM, target_variables,
+                  draft: TransformerLM, data, *,
+                  epochs: int = 3, batch_size: int = 8,
+                  optimizer=None, temperature: float = 2.0,
+                  ce_weight: float = 0.1,
+                  partition_rules=None,
+                  estimator_kwargs: Optional[dict] = None):
+    """One-call distillation: fit ``draft`` to match ``target`` on
+    ``data`` (dict with a ``tokens`` [N, T] int32 column).  Returns
+    ``(draft_variables, history)`` — feed them straight into
+    ``speculative_generate`` / ``load_flax_generator(draft_model=...)``.
+    """
+    import optax
+
+    from analytics_zoo_tpu.learn import Estimator
+    from analytics_zoo_tpu.models.lm import LM_PARTITION_RULES
+
+    pair = DistillLM(draft=draft, target=target,
+                     temperature=temperature, ce_weight=ce_weight)
+    tx = optimizer if optimizer is not None else optax.adamw(3e-3)
+    est = Estimator.from_flax(
+        model=pair, loss=distill_loss,
+        optimizer=freeze_target_optimizer(tx),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=(partition_rules if partition_rules is not None
+                         else LM_PARTITION_RULES),
+        **(estimator_kwargs or {}))
+
+    # seed the pair's param tree with the REAL target weights before the
+    # first step: _ensure_state initialises both submodules, then the
+    # target subtree is replaced wholesale (it never trains, so this is
+    # the only write it ever sees)
+    sample = {k: v[:batch_size] for k, v in data.items()}
+    est._ensure_state(sample)
+    params = dict(est.state.params)
+    tgt = target_variables["params"] if "params" in target_variables \
+        else target_variables
+    import numpy as np
+
+    def _shape_tree(t):
+        return jax.tree.map(lambda x: tuple(x.shape), t)
+
+    if _shape_tree(params["target"]) != _shape_tree(tgt):
+        raise ValueError(
+            "target_variables do not match the target model's shapes — "
+            "wrong checkpoint?")
+    params["target"] = jax.tree.map(
+        # keep each leaf's dtype AND sharding (tp-sharded fits shard the
+        # frozen teacher too)
+        lambda dst, src: jax.device_put(
+            np.asarray(src).astype(dst.dtype), dst.sharding),
+        params["target"], tgt)
+    est.state = est.state.replace(params=params)
+
+    hist = est.fit(data, epochs=epochs, batch_size=batch_size)
+    draft_params = jax.device_get(est.state.params)["draft"]
+    return {"params": draft_params}, hist

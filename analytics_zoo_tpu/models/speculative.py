@@ -194,6 +194,9 @@ def speculative_generate(model: TransformerLM, variables,
     stats = {"rounds": rounds,
              "emitted_tokens": emitted,
              "batch": B,
+             # per-row totals let callers exclude phantom rows (serving
+             # pads batches to buckets; those rows aren't traffic)
+             "per_row_emitted": np.asarray(carry[8]),
              "mean_accepted_per_round":
                  emitted / max(1, rounds * B)}
     return out, stats

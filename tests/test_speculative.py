@@ -185,3 +185,32 @@ def test_serving_draft_args_must_pair():
     with pytest.raises(ValueError, match="together"):
         InferenceModel().load_flax_generator(
             target, tv, max_new_tokens=4, draft_model=draft)
+
+
+def test_serving_int8_draft_dequantizes_once():
+    """quantize + draft: the host-loop path has no outer jit to fuse a
+    dequant into, so it must dequantize at LOAD (serving still equals
+    the plain int8 serving output)."""
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    target, tv, draft, dv, prompt = _models()
+    prompts = np.asarray(prompt)
+    ref = np.asarray(InferenceModel().load_flax_generator(
+        target, tv, max_new_tokens=8, quantize="int8").predict(prompts))
+    im = InferenceModel().load_flax_generator(
+        target, tv, max_new_tokens=8, quantize="int8",
+        draft_model=draft, draft_variables=dv, speculation_k=3)
+    assert im._dequant is None          # folded at load, not per request
+    out = np.asarray(im.predict(prompts))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_continuous_engine_refuses_draft_load():
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    target, tv, draft, dv, _ = _models()
+    im = InferenceModel().load_flax_generator(
+        target, tv, max_new_tokens=8,
+        draft_model=draft, draft_variables=dv)
+    with pytest.raises(ValueError, match="batch-generative only"):
+        im.make_continuous_engine()
