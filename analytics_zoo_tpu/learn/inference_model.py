@@ -279,6 +279,9 @@ class InferenceModel:
             self._spec_stats_lock = threading.Lock()
             self.spec_stats = None
             self._spec_draft = True
+            self._spec_draft_model = draft_model
+            self._spec_draft_variables = draft_variables
+            self._spec_k = int(speculation_k)
         else:
             def apply_fn(variables, prompts, lengths):
                 if self._dequant is not None:
@@ -339,17 +342,17 @@ class InferenceModel:
         if getattr(self, "_gen_max_new_tokens", None) is None:
             raise ValueError("continuous batching needs a model loaded "
                              "via load_flax_generator")
-        if getattr(self, "_spec_draft", False):
-            # silently dropping the draft would also inherit the
-            # spec-tightened prompt buckets (k+1 slack, draft position
-            # table) — constraints that don't apply to the engine
-            raise ValueError(
-                "speculative decoding is batch-generative only; reload "
-                "via load_flax_generator WITHOUT draft_model to build a "
-                "continuous engine")
         variables = self._variables
         if self._dequant is not None:
             variables = jax.device_put(self._dequant(variables))
+        spec = {}
+        if getattr(self, "_spec_draft", False):
+            # a draft-loaded handle builds a SPECULATIVE engine: the
+            # spec-tightened prompt buckets stored at load (k+1 slack,
+            # both position tables) are exactly the engine's own limit
+            spec = dict(draft_model=self._spec_draft_model,
+                        draft_variables=self._spec_draft_variables,
+                        speculation_k=self._spec_k)
         return ContinuousEngine(
             self.model, variables,
             max_new_tokens=self._gen_max_new_tokens,
@@ -357,7 +360,7 @@ class InferenceModel:
             prompt_buckets=self._gen_prompt_buckets,
             eos_id=eos_id, pad_id=self.prompt_pad_id,
             ticks_per_step=ticks_per_step, cache_dtype=cache_dtype,
-            mesh=mesh, partition_rules=partition_rules)
+            mesh=mesh, partition_rules=partition_rules, **spec)
 
     def load_openvino(self, xml_path: str, bin_path: str = None,
                       quantize: Optional[str] = None) -> "InferenceModel":

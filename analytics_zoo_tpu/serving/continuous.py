@@ -79,7 +79,9 @@ class ContinuousEngine:
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  ticks_per_step: int = 1,
                  cache_dtype=None,
-                 mesh=None, partition_rules=None):
+                 mesh=None, partition_rules=None,
+                 draft_model: Optional[TransformerLM] = None,
+                 draft_variables=None, speculation_k: int = 4):
         """``mesh`` (with a ``tp`` axis) serves a model LARGER than one
         chip's HBM: weights shard per ``partition_rules`` (default
         ``LM_PARTITION_RULES`` — Megatron layout), the KV arena shards
@@ -95,11 +97,42 @@ class ContinuousEngine:
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
+        # ---- speculative mode (draft arena) ----------------------------
+        # the slot arena is ALREADY per-row-positioned, which is exactly
+        # what per-slot acceptance rates need: each verify round advances
+        # every slot by its own accepted count.  Greedy-only (a sampled
+        # slot's speculative contract needs rejection sampling — not
+        # implemented; submit() rejects temperature > 0 in this mode).
+        self.draft_model = draft_model
+        self._draft_variables = draft_variables
+        self._spec_k = int(speculation_k) if draft_model is not None else 0
+        if draft_model is not None:
+            if draft_variables is None:
+                raise ValueError("draft_model needs draft_variables")
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab_size} != target "
+                    f"vocab {model.vocab_size}")
+            if draft_model.pp_stages > 0:
+                raise ValueError("draft must be pp_stages=0")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "speculative continuous batching is single-chip for "
+                    "now; drop either mesh or draft_model")
+            if self._spec_k < 1:
+                raise ValueError("speculation_k must be >= 1")
+        # speculative verify writes k+1 entries past the pointer and
+        # looks up positions there, so the bucket limit tightens by k+1
+        # and must fit BOTH models' position tables
+        eff_max_pos = model.max_position if draft_model is None else \
+            min(model.max_position, draft_model.max_position)
         self.prompt_buckets = filter_prompt_buckets(
-            prompt_buckets, model.max_position, max_new_tokens)
+            prompt_buckets, eff_max_pos,
+            max_new_tokens + (self._spec_k + 1 if draft_model else 0))
         self.max_prompt_width = self.prompt_buckets[-1]
         S = int(max_slots)
-        L = self.max_prompt_width + self.max_new_tokens
+        L = self.max_prompt_width + self.max_new_tokens \
+            + (self._spec_k + 1 if draft_model is not None else 0)
         self._S, self._L = S, L
         # GQA models store only kv_heads in the cache: the arena shrinks
         # num_heads/kv_heads-fold, which is more co-resident requests
@@ -234,6 +267,84 @@ class ContinuousEngine:
 
         self._insert = jax.jit(insert_fn, donate_argnums=(0, 1))
 
+        if draft_model is not None:
+            self._init_speculative(cdtype)
+
+    def _init_speculative(self, cdtype):
+        """Draft arena + the jitted spec-round program.  One round per
+        device call: draft proposes k per slot (k+1 cached feeds), the
+        target verifies all slots' proposals in ONE decode_k forward,
+        each slot advances by its own accepted count (per-row pointers —
+        the arena layout the engine already has)."""
+        draft, dvars = self.draft_model, self._draft_variables
+        model, variables = self.model, self._variables
+        S, L, k = self._S, self._L, self._spec_k
+        eos_id = self.eos_id
+        DH = getattr(draft, "kv_heads", draft.num_heads)
+        DD = draft.hidden_size // draft.num_heads
+        self._dck = jnp.zeros((draft.num_layers, S, L, DH, DD), cdtype)
+        self._dcv = jnp.zeros_like(self._dck)
+        self._dpos = np.zeros(S, np.int32)
+
+        def spec_step(ck, cv, dck, dcv, tok, pos, dpos, done):
+            # draft: k proposals via k+1 greedy cached feeds (the extra
+            # feed writes d_{k-1}'s KV so a full-acceptance round leaves
+            # the draft cache complete — models/speculative.py)
+            def dstep(c, _):
+                t, dck, dcv, p = c
+                lg, dck, dcv = draft.apply(
+                    dvars, t, dck, dcv, p,
+                    method=TransformerLM.decode_step)
+                nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                return (nxt, dck, dcv, p + 1), nxt
+
+            (_, dck, dcv, _), d = jax.lax.scan(
+                dstep, (tok, dck, dcv, dpos), None, length=k + 1)
+            d = d.T[:, :k]                              # [S, k]
+
+            inputs = jnp.concatenate([tok[:, None], d], axis=1)
+            logits, ck, cv = model.apply(
+                variables, inputs, ck, cv, pos,
+                method=TransformerLM.verify_step)
+            t = jnp.argmax(logits, -1).astype(jnp.int32)  # [S, k+1]
+
+            match = (t[:, :k] == d)
+            a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                        axis=1)
+            n_emit = a + 1
+            if eos_id is not None:
+                js = jnp.arange(k + 1)[None, :]
+                is_eos = (t == eos_id) & (js < n_emit[:, None])
+                first_eos = jnp.where(is_eos.any(axis=1),
+                                      jnp.argmax(is_eos, axis=1), k + 1)
+                n_emit = jnp.minimum(n_emit, first_eos + 1)
+                # frozen tail on-device, like the plain step: everything
+                # after a slot's first eos reads as eos
+                t = jnp.where(js > first_eos[:, None],
+                              jnp.int32(eos_id), t)
+            n_emit = jnp.where(done, 0, n_emit)
+            new_tok = jnp.where(
+                n_emit > 0,
+                jnp.take_along_axis(
+                    t, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0],
+                tok)
+            if eos_id is not None:
+                done = done | ((n_emit > 0) & (new_tok == eos_id))
+            pos = jnp.minimum(pos + n_emit, L - 1)
+            dpos = jnp.minimum(dpos + n_emit, L - 1)
+            # [k+1, S] to match the plain step's emission-order layout
+            return (t.T, n_emit, new_tok, pos, dpos, done,
+                    ck, cv, dck, dcv)
+
+        self._spec_step = jax.jit(spec_step, donate_argnums=(0, 1, 2, 3))
+
+        def draft_prefill_fn(prompts):
+            _, ks, vs = draft.apply(dvars, prompts,
+                                    method=TransformerLM.prefill)
+            return ks, vs
+
+        self._draft_prefill = jax.jit(draft_prefill_fn)
+
     @staticmethod
     def _kv_kernels_tp_sharded(shardings) -> bool:
         """Do the chosen rules put 'tp' on the k/v projection outputs?
@@ -322,6 +433,11 @@ class ContinuousEngine:
                 f"prompt length {n} outside [1, {self.max_prompt_width}]")
         if temperature > 0.0 and rng_seed is None:
             raise ValueError("temperature > 0 needs rng_seed")
+        if temperature > 0.0 and self.draft_model is not None:
+            raise ValueError(
+                "speculative continuous batching is greedy-only (the "
+                "sampled contract needs rejection sampling); submit "
+                "with temperature=0 or build the engine without a draft")
         if rng_seed is not None:
             # mask into uint32 range: an out-of-range client seed must
             # not crash the pump thread at the np.uint32 staging array
@@ -368,6 +484,9 @@ class ContinuousEngine:
                         plens[i] = len(req[1])
                     pre = self._prefill(jnp.asarray(padded),
                                         jnp.asarray(plens))
+                    if self.draft_model is not None:
+                        pre = pre + self._draft_prefill(
+                            jnp.asarray(padded))
                 except Exception as e:
                     logger.exception(
                         "prefill failed for %d request(s), bucket %d",
@@ -396,13 +515,18 @@ class ContinuousEngine:
     def _splice_one(self, pre, i: int, req) -> None:
         """Insert one prefetched joiner into a free slot; the slot goes
         back to the free list if the splice fails."""
-        last_logits, ks, vs = pre
+        last_logits, ks, vs = pre[0], pre[1], pre[2]
         uri, prompt, on_done, on_error, temp, seed, mn = req
         slot = self._free.popleft()
         try:
             self._ck, self._cv = self._insert(
                 self._ck, self._cv, ks[:, i:i + 1], vs[:, i:i + 1],
                 jnp.int32(slot))
+            if self.draft_model is not None:
+                dks, dvs = pre[3], pre[4]
+                self._dck, self._dcv = self._insert(
+                    self._dck, self._dcv, dks[:, i:i + 1],
+                    dvs[:, i:i + 1], jnp.int32(slot))
             plen = len(prompt)
             first = self._pick_first(last_logits[i], plen, temp, seed)
         except Exception:
@@ -413,6 +537,8 @@ class ContinuousEngine:
             on_error=on_error, temperature=temp, rng_seed=seed)
         self._tok[slot] = first
         self._pos[slot] = plen
+        if self.draft_model is not None:
+            self._dpos[slot] = plen
         self._done[slot] = False
         self._record_token(slot, int(first))
 
@@ -465,6 +591,8 @@ class ContinuousEngine:
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
             return 0
+        if self.draft_model is not None:
+            return self._spec_tick(active)
         sampled = any(self._slots[i].temperature > 0.0 for i in active)
         temps = np.zeros(self._S, np.float32)
         seeds = np.zeros(self._S, np.uint32)
@@ -490,6 +618,34 @@ class ContinuousEngine:
             for j in range(n_eff):
                 if self._slots[i] is None:
                     break       # finished mid-chunk; the rest is frozen
+                self._record_token(i, int(toks[j, i]))
+        self._admit()       # freed slots recycle on the SAME iteration
+        return self.n_active
+
+    def _spec_tick(self, active) -> int:
+        """One speculative round for the whole arena: every resident
+        advances by its own accepted count (1..k+1 tokens) in one device
+        call.  Emission recording mirrors the plain path: per slot, in
+        order, stopping when the slot finishes (budget surplus dropped
+        host-side)."""
+        (toks, n_emit, tok, pos, dpos, done,
+         self._ck, self._cv, self._dck, self._dcv) = self._spec_step(
+            self._ck, self._cv, self._dck, self._dcv,
+            jnp.asarray(self._tok), jnp.asarray(self._pos),
+            jnp.asarray(self._dpos), jnp.asarray(self._done))
+        toks = np.asarray(toks)                 # [k+1, S]
+        n_emit = np.asarray(n_emit)
+        self._tok = np.array(tok)
+        self._pos = np.array(pos)
+        self._dpos = np.array(dpos)
+        self._done = np.array(done)
+        self._spec_rounds = getattr(self, "_spec_rounds", 0) + 1
+        self._spec_emitted = getattr(self, "_spec_emitted", 0) + int(
+            n_emit[active].sum())
+        for i in active:
+            for j in range(int(n_emit[i])):
+                if self._slots[i] is None:
+                    break       # finished mid-round; the rest is frozen
                 self._record_token(i, int(toks[j, i]))
         self._admit()       # freed slots recycle on the SAME iteration
         return self.n_active

@@ -60,9 +60,13 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
         feat = np.zeros((1, 224, 224, 3), np.uint8)
         cfg = ServingConfig(batch_size=batch_size, batch_timeout_ms=4.0,
                             image_shape=[224, 224], workers=workers)
-    elif model_kind == "lm":
+    elif model_kind.startswith("lm"):
         # generative serving: ragged token prompts in, 32 greedy tokens
-        # out through the KV-cache scan (models/lm.generate)
+        # out through the KV-cache scan (models/lm.generate).  "lm-spec"
+        # adds SELF-draft speculative decoding: acceptance is ~k+1 by
+        # construction, so the row measures the UPPER BOUND of the
+        # round-trip amortisation (real drafts sit between this and the
+        # plain "lm" row; models/distill.py closes the gap).
         from analytics_zoo_tpu.models import TransformerLM
 
         model = TransformerLM(vocab_size=8192, hidden_size=256,
@@ -76,7 +80,13 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
 
     variables = model.init(jax.random.key(0), feat)
     im = InferenceModel(batch_buckets=(1, 8, 32, batch_size))
-    if model_kind == "lm":
+    if model_kind == "lm-spec":
+        im.load_flax_generator(model, variables, max_new_tokens=32,
+                               prompt_buckets=(32,),
+                               draft_model=model,
+                               draft_variables=variables,
+                               speculation_k=4)
+    elif model_kind == "lm":
         im.load_flax_generator(model, variables, max_new_tokens=32,
                                prompt_buckets=(32,))
     else:
@@ -94,7 +104,7 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
     # warm the jit buckets so compile time is not measured
     for b in (1, 8, 32, batch_size):
         x = np.zeros((b,) + feat.shape[1:], feat.dtype)
-        im.predict(x + 1 if model_kind == "lm" else x)
+        im.predict(x + 1 if model_kind.startswith("lm") else x)
 
     jpegs = []
     if model_kind.startswith("resnet18"):
@@ -125,7 +135,7 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
                 if jpegs:
                     uri = inq.enqueue_image(
                         f"c{idx}-{i}", image=jpegs[(idx + i) % len(jpegs)])
-                elif model_kind == "lm":
+                elif model_kind.startswith("lm"):
                     toks = rng.integers(
                         1, 8192, int(rng.integers(8, 33))).astype(np.int32)
                     uri = inq.enqueue(f"c{idx}-{i}", tokens=toks)
@@ -160,6 +170,11 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
         raise RuntimeError(f"bench clients failed: {errors[:3]}")
     a = np.asarray(lat)
     extra = {}
+    if getattr(im, "spec_stats", None):
+        extra["spec_mean_accepted_per_round"] = round(
+            im.spec_stats["mean_accepted_per_round"], 2)
+        extra["spec_note"] = ("self-draft upper bound: acceptance ~k+1 "
+                              "by construction")
     if im.quant_stats:
         extra["weight_compression"] = im.quant_stats["compression"]
         extra["int8_role"] = (
@@ -307,7 +322,8 @@ PLAN = [("resnet18", 64, 10, 64),
         # open-loop Poisson mixed workload: clients = rate (req/s),
         # rpc = total requests; convoy vs continuous head-to-head
         ("lm-poisson", 12, 150, 8), ("lm-poisson-cb", 12, 150, 8),
-        ("lm", 16, 10, 32), ("lm", 64, 5, 32), ("lm", 1, 20, 32),
+        ("lm", 16, 10, 32), ("lm-spec", 16, 10, 32),
+        ("lm", 64, 5, 32), ("lm", 1, 20, 32),
         ("mlp", 256, 50, 128), ("mlp", 64, 50, 128),
         ("mlp", 1, 100, 128),
         ("resnet18", 16, 20, 64), ("resnet18", 1, 50, 64)]

@@ -548,3 +548,139 @@ def test_engine_tp_arena_follows_custom_rules(lm):
         eng._ck.sharding.spec
     rep = eng.capacity_report()
     assert rep["arena_bytes_per_chip"] == rep["arena_bytes"]
+
+
+# ---- speculative continuous batching -----------------------------------
+
+def _draft_lm():
+    model = _tiny_lm(hidden_size=16, num_layers=1, intermediate_size=32)
+    variables = model.init(jax.random.key(9),
+                           np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+@pytest.mark.parametrize("self_draft", [False, True])
+def test_spec_engine_matches_solo_generation(lm, self_draft):
+    """The solo-equality contract holds in speculative mode — with
+    recycling pressure (more requests than slots) and for both a
+    low-acceptance random draft and the full-acceptance self draft."""
+    model, variables = lm
+    dm, dvv = (model, variables) if self_draft else _draft_lm()
+    eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                           max_slots=3, prompt_buckets=(8, 16),
+                           draft_model=dm, draft_variables=dvv,
+                           speculation_k=3)
+    rng = np.random.default_rng(0)
+    prompts = {f"r{i}": rng.integers(1, 32, rng.integers(2, 9)).astype(
+        np.int32) for i in range(7)}
+    results = {}
+    for uri, p in prompts.items():
+        eng.submit(uri, p, on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    assert set(results) == set(prompts)
+    for uri, p in prompts.items():
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(p[None]), 5))[0]
+        np.testing.assert_array_equal(results[uri], solo, err_msg=uri)
+    if self_draft:
+        # the speedup claim: full acceptance packs k+1 tokens per round
+        assert eng._spec_emitted / eng._spec_rounds > 3.0
+
+
+def test_spec_engine_eos_matches_generate(lm):
+    """EOS mid-round: frozen eos tail, early slot free, recycling — all
+    identical to generate(eos_id=...) per request."""
+    model, variables = lm
+    dm, dvv = _draft_lm()
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 32, 4).astype(np.int32) for _ in range(4)]
+    first_tok = int(np.asarray(generate(
+        model, variables, jnp.asarray(prompts[0][None]), 1))[0, 0])
+    eng = ContinuousEngine(model, variables, max_new_tokens=6,
+                           max_slots=2, prompt_buckets=(8,),
+                           eos_id=first_tok, draft_model=dm,
+                           draft_variables=dvv, speculation_k=3)
+    results = {}
+    for i, p in enumerate(prompts):
+        eng.submit(f"r{i}", p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    for i, p in enumerate(prompts):
+        solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                                   6, eos_id=first_tok))[0]
+        np.testing.assert_array_equal(results[f"r{i}"], solo,
+                                      err_msg=f"r{i}")
+
+
+def test_spec_engine_per_request_budget(lm):
+    """max_new overrides clip emission: a 2-token request finishes after
+    2 tokens even when a round accepts more."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=6,
+                           max_slots=2, prompt_buckets=(8,),
+                           draft_model=model, draft_variables=variables,
+                           speculation_k=4)
+    p = np.arange(1, 5, dtype=np.int32)
+    results = {}
+    eng.submit("short", p, max_new=2,
+               on_done=lambda u, t: results.__setitem__(u, t))
+    eng.submit("long", p,
+               on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                               6))[0]
+    np.testing.assert_array_equal(results["short"], solo[:2])
+    np.testing.assert_array_equal(results["long"], solo)
+
+
+def test_spec_engine_rejects_sampling(lm):
+    model, variables = lm
+    dm, dvv = _draft_lm()
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8,),
+                           draft_model=dm, draft_variables=dvv)
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.submit("s", np.arange(1, 4, dtype=np.int32),
+                   temperature=0.8, rng_seed=1)
+
+
+def test_spec_engine_validation(lm):
+    model, variables = lm
+    dm, dvv = _draft_lm()
+    with pytest.raises(ValueError, match="draft_variables"):
+        ContinuousEngine(model, variables, max_new_tokens=4,
+                         draft_model=dm)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = _tiny_lm(vocab_size=64, hidden_size=16, num_layers=1,
+                       intermediate_size=32)
+        bv = bad.init(jax.random.key(2), np.zeros((1, 8), np.int32))
+        ContinuousEngine(model, variables, max_new_tokens=4,
+                         draft_model=bad, draft_variables=bv)
+    with pytest.raises(NotImplementedError, match="single-chip"):
+        from analytics_zoo_tpu.parallel.mesh import make_mesh
+
+        ContinuousEngine(model, variables, max_new_tokens=4,
+                         mesh=make_mesh(axes={"dp": -1, "tp": 2}),
+                         draft_model=dm, draft_variables=dvv)
+
+
+def test_inference_model_builds_spec_engine(lm):
+    """A draft-loaded InferenceModel's make_continuous_engine builds a
+    SPECULATIVE engine whose outputs equal the plain engine's."""
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+
+    model, variables = lm
+    dm, dvv = _draft_lm()
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=5, prompt_buckets=(8,),
+        draft_model=dm, draft_variables=dvv, speculation_k=3)
+    eng = im.make_continuous_engine(max_slots=2)
+    assert eng.draft_model is dm
+    rng = np.random.default_rng(3)
+    p = rng.integers(1, 32, 6).astype(np.int32)
+    results = {}
+    eng.submit("x", p, on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                               5))[0]
+    np.testing.assert_array_equal(results["x"], solo)

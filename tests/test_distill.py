@@ -17,9 +17,15 @@ from analytics_zoo_tpu.models.speculative import speculative_generate
 V, T = 64, 160
 
 
+@pytest.fixture(scope="module")
+def target_setup():
+    return _target_and_corpus()
+
+
 def _target_and_corpus():
     """A briefly-trained target on a deterministic token pattern — it
-    must HAVE structure for distillation to transfer."""
+    must HAVE structure for distillation to transfer.  Module-scoped
+    fixture: every test reads the target weights, none writes them."""
     target = TransformerLM(vocab_size=V, hidden_size=32, num_layers=2,
                            num_heads=2, intermediate_size=64,
                            max_position=T)
@@ -43,10 +49,10 @@ def _draft():
                          max_position=T)
 
 
-def test_distillation_raises_speculative_acceptance():
+def test_distillation_raises_speculative_acceptance(target_setup):
     """The whole point: a distilled draft accepts markedly better than
     an untrained one on the target's own domain."""
-    target, tv, corpus = _target_and_corpus()
+    target, tv, corpus = target_setup
     draft = _draft()
     prompt = jnp.asarray(corpus["tokens"][:4, :8])
     dv0 = draft.init(jax.random.key(1), prompt)
@@ -59,8 +65,8 @@ def test_distillation_raises_speculative_acceptance():
             >= s0["mean_accepted_per_round"] + 1.0), (s0, s1)
 
 
-def test_target_stays_frozen():
-    target, tv, corpus = _target_and_corpus()
+def test_target_stays_frozen(target_setup):
+    target, tv, corpus = target_setup
     before = jax.tree.map(np.asarray, tv["params"])
     dv, _ = distill_draft(target, tv, _draft(), corpus,
                           epochs=2, batch_size=8)
@@ -72,8 +78,8 @@ def test_target_stays_frozen():
     assert "params" in dv and "target" not in dv["params"]
 
 
-def test_optimizer_state_only_for_draft():
-    target, tv, corpus = _target_and_corpus()
+def test_optimizer_state_only_for_draft(target_setup):
+    target, tv, corpus = target_setup
     draft = _draft()
     pair = DistillLM(draft=draft, target=target)
     est = Estimator.from_flax(
@@ -91,8 +97,8 @@ def test_optimizer_state_only_for_draft():
     assert sum(opt_elems) == 2 * draft_elems    # adam mu+nu, draft only
 
 
-def test_vocab_mismatch_fails_loud():
-    target, tv, corpus = _target_and_corpus()
+def test_vocab_mismatch_fails_loud(target_setup):
+    target, tv, corpus = target_setup
     bad = TransformerLM(vocab_size=V * 2, hidden_size=16, num_layers=1,
                         num_heads=2, intermediate_size=32,
                         max_position=T)
@@ -100,8 +106,8 @@ def test_vocab_mismatch_fails_loud():
         distill_draft(target, tv, bad, corpus, epochs=1, batch_size=8)
 
 
-def test_wrong_target_checkpoint_fails_loud():
-    target, tv, corpus = _target_and_corpus()
+def test_wrong_target_checkpoint_fails_loud(target_setup):
+    target, tv, corpus = target_setup
     wrong = {"params": jax.tree.map(
         lambda x: np.zeros((3, 3), np.float32), tv["params"])}
     with pytest.raises(ValueError, match="do not match"):

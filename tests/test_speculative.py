@@ -205,12 +205,15 @@ def test_serving_int8_draft_dequantizes_once():
     np.testing.assert_array_equal(out, ref)
 
 
-def test_continuous_engine_refuses_draft_load():
+def test_continuous_engine_from_draft_load_is_speculative():
+    """Superseded refusal: a draft-loaded handle now builds a
+    SPECULATIVE continuous engine (tests/test_continuous.py has the
+    solo-equality coverage; here just the handoff)."""
     from analytics_zoo_tpu.learn.inference_model import InferenceModel
 
     target, tv, draft, dv, _ = _models()
     im = InferenceModel().load_flax_generator(
         target, tv, max_new_tokens=8,
         draft_model=draft, draft_variables=dv)
-    with pytest.raises(ValueError, match="batch-generative only"):
-        im.make_continuous_engine()
+    eng = im.make_continuous_engine(max_slots=2)
+    assert eng.draft_model is draft and eng._spec_k == 4
