@@ -270,6 +270,57 @@ class ContinuousEngine:
         if draft_model is not None:
             self._init_speculative(cdtype)
 
+        # ---- prefix caching (shared system prompts) --------------------
+        # register_prefix() prefills a prompt PREFIX once; requests that
+        # name it splice the stored K/V and prefill only their suffix —
+        # against the spliced cache, via the same block-causal decode_k
+        # the speculative verify uses (bitwise = running the full
+        # concatenated prompt).
+        self._prefixes: Dict[int, tuple] = {}
+        self._next_prefix_id = 0
+
+        def _prefix_admit_for(m, v, want_logits):
+            def fn(ck, cv, pks, pvs, suffixes, suffix_lens, slots):
+                """Splice a stored prefix [layers, 1, P, H, D] into n
+                slots and run their suffixes through decode_k against it
+                in ONE forward — a burst naming the same system prompt
+                (the feature's primary workload) costs one device call,
+                like the plain path's bucketed prefill.  slots must be
+                distinct (the admit loop pops them from the free list).
+                Returns (last-real-position logits [n, V] | None, ck,
+                cv)."""
+                P = pks.shape[2]
+                n = suffixes.shape[0]
+                rows_k = jnp.take(ck, slots, axis=1)  # [layers,n,L,H,D]
+                rows_v = jnp.take(cv, slots, axis=1)
+                pref_k = jnp.broadcast_to(
+                    pks, (pks.shape[0], n) + pks.shape[2:])
+                pref_v = jnp.broadcast_to(
+                    pvs, (pvs.shape[0], n) + pvs.shape[2:])
+                rows_k = jax.lax.dynamic_update_slice(
+                    rows_k, pref_k.astype(rows_k.dtype), (0, 0, 0, 0, 0))
+                rows_v = jax.lax.dynamic_update_slice(
+                    rows_v, pref_v.astype(rows_v.dtype), (0, 0, 0, 0, 0))
+                logits, rows_k, rows_v = m.apply(
+                    v, suffixes, rows_k, rows_v,
+                    jnp.full((n,), P, jnp.int32),
+                    method=TransformerLM.verify_step)
+                ck = ck.at[:, slots].set(rows_k.astype(ck.dtype))
+                cv = cv.at[:, slots].set(rows_v.astype(cv.dtype))
+                if not want_logits:
+                    return None, ck, cv
+                last = jnp.take_along_axis(
+                    logits, (suffix_lens - 1)[:, None, None],
+                    axis=1)[:, 0]
+                return last, ck, cv
+
+            return jax.jit(fn, donate_argnums=(0, 1))
+
+        self._prefix_admit = _prefix_admit_for(model, variables, True)
+        if self.draft_model is not None:
+            self._draft_prefix_admit = _prefix_admit_for(
+                self.draft_model, self._draft_variables, False)
+
     def _init_speculative(self, cdtype):
         """Draft arena + the jitted spec-round program.  One round per
         device call: draft proposes k per slot (k+1 cached feeds), the
@@ -408,12 +459,53 @@ class ContinuousEngine:
         with self._lock:
             return len(self._waiting)
 
+    def register_prefix(self, tokens: np.ndarray) -> int:
+        """Prefill a shared prompt PREFIX (system prompt) once; returns
+        an id for ``submit(..., prefix=id)``.  Requests then ship only
+        their suffix: admission splices the stored K/V and runs the
+        suffix against it (block-causal decode_k — bitwise what the
+        full concatenated prompt would have produced)."""
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim != 1 or len(tokens) < 1:
+            raise ValueError("prefix must be a non-empty 1-D int32 array")
+        P = len(tokens)
+        if P >= self.max_prompt_width:
+            raise ValueError(
+                f"prefix length {P} leaves no room for a suffix inside "
+                f"max prompt width {self.max_prompt_width}")
+        _, ks, vs = self.model.apply(self._variables,
+                                     jnp.asarray(tokens[None]),
+                                     method=TransformerLM.prefill)
+        entry = [jax.device_put(ks), jax.device_put(vs), P, None, None]
+        if self.draft_model is not None:
+            _, dks, dvs = self.draft_model.apply(
+                self._draft_variables, jnp.asarray(tokens[None]),
+                method=TransformerLM.prefill)
+            entry[3], entry[4] = jax.device_put(dks), jax.device_put(dvs)
+        with self._lock:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._prefixes[pid] = tuple(entry)
+        return pid
+
+    def unregister_prefix(self, pid: int) -> None:
+        """Release a prefix's pinned device K/V (both models').  A
+        long-running server registering per-tenant prefixes must be able
+        to evict them or HBM ratchets up forever.  In-flight requests
+        already admitted keep their spliced copy; queued requests naming
+        the id will fail admission loudly."""
+        with self._lock:
+            if pid not in self._prefixes:
+                raise ValueError(f"unknown prefix id {pid}")
+            del self._prefixes[pid]
+
     def submit(self, uri: str, prompt: np.ndarray,
                on_done: Optional[Callable] = None, *,
                on_error: Optional[Callable] = None,
                temperature: float = 0.0,
                rng_seed: Optional[int] = None,
-               max_new: Optional[int] = None) -> None:
+               max_new: Optional[int] = None,
+               prefix: Optional[int] = None) -> None:
         """Queue one request.  ``prompt``: 1-D int32 token array.
         ``on_done(uri, tokens)`` fires from the pump thread when the
         request finishes (tokens: ``[max_new]`` int32, eos-padded frozen
@@ -428,7 +520,19 @@ class ContinuousEngine:
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be 1-D, got {prompt.shape}")
         n = len(prompt)
-        if n < 1 or n > self.max_prompt_width:
+        if prefix is not None:
+            with self._lock:
+                if prefix not in self._prefixes:
+                    raise ValueError(f"unknown prefix id {prefix}")
+                plen_pref = self._prefixes[prefix][2]
+            # the TRUE prompt (prefix + suffix) must fit the prompt
+            # budget; the padded suffix only needs to fit the cache
+            # (_suffix_width handles that), so no bucket term here
+            if n < 1 or plen_pref + n > self.max_prompt_width:
+                raise ValueError(
+                    f"prefix({plen_pref}) + suffix({n}) exceeds max "
+                    f"prompt width {self.max_prompt_width}")
+        elif n < 1 or n > self.max_prompt_width:
             raise ValueError(
                 f"prompt length {n} outside [1, {self.max_prompt_width}]")
         if temperature > 0.0 and rng_seed is None:
@@ -449,7 +553,7 @@ class ContinuousEngine:
         with self._lock:
             self._waiting.append(
                 (uri, prompt, on_done, on_error, float(temperature),
-                 rng_seed, mn))
+                 rng_seed, mn, prefix))
 
     # ---- pump ---------------------------------------------------------
 
@@ -467,9 +571,26 @@ class ContinuousEngine:
             if not batch:
                 break
             by_bucket: Dict[int, list] = {}
+            by_prefix: Dict[Tuple[int, int], list] = {}
             for req in batch:
+                if req[7] is not None:      # prefix-cached request
+                    with self._lock:
+                        P = self._prefixes.get(req[7], (None, None, 0)
+                                               )[2]
+                    sb = self._suffix_width(len(req[1]), P)
+                    by_prefix.setdefault((req[7], sb), []).append(req)
+                    continue
                 pb = _next_bucket(len(req[1]), self.prompt_buckets)
                 by_bucket.setdefault(pb, []).append(req)
+            for (pid, sb), reqs in by_prefix.items():
+                try:
+                    admitted += self._admit_prefix_group(pid, sb, reqs)
+                except Exception as e:
+                    logger.exception(
+                        "prefix admission failed for %d request(s), "
+                        "prefix %s", len(reqs), pid)
+                    for req in reqs:
+                        self._req_error(req[0], req[3], e)
             for pb, reqs in by_bucket.items():
                 # a failed prefill/splice must not swallow requests that
                 # already left the waiting queue: surface each one to
@@ -512,11 +633,89 @@ class ContinuousEngine:
         except Exception:
             logger.exception("on_error callback failed for %r", uri)
 
+    def _suffix_width(self, n: int, P: int) -> int:
+        """Padded width for a prefix request's suffix: a shared prompt
+        bucket when one fits after the prefix (bounded compile count),
+        else the exact remaining cache room (one compile per prefix
+        length — still bounded by registered prefixes).  Suffix padding
+        writes dead K/V past the true prompt; they are never attended
+        and later rounds overwrite them, so only the CACHE bound (L)
+        applies, not the prompt budget."""
+        for b in self.prompt_buckets:
+            if n <= b and P + b <= self._L - 1:
+                return b
+        return self._L - 1 - P
+
+    def _admit_prefix_group(self, pid: int, sb: int, reqs) -> int:
+        """Admission for prefix-cached requests sharing (prefix, suffix
+        width): splice the stored K/V into each group member's slot and
+        run ALL their suffixes against it in one decode_k forward — the
+        semantics of prefilling each concatenated prompt, at one device
+        call per burst.  Returns the number admitted."""
+        with self._lock:
+            if pid not in self._prefixes:
+                raise ValueError(f"prefix id {pid} was unregistered "
+                                 f"while queued")
+            pks, pvs, P, dks, dvs = self._prefixes[pid]
+        n = min(len(reqs), len(self._free))
+        if n < len(reqs):
+            # free slots ran out mid-batch: requeue the rest in order
+            with self._lock:
+                for req in reversed(reqs[n:]):
+                    self._waiting.appendleft(req)
+            reqs = reqs[:n]
+        if not reqs:
+            return 0
+        padded = np.full((n, sb), self.pad_id, np.int32)
+        lens = np.zeros(n, np.int32)
+        for i, req in enumerate(reqs):
+            padded[i, :len(req[1])] = req[1]
+            lens[i] = len(req[1])
+        slots = [self._free.popleft() for _ in range(n)]
+        try:
+            last, self._ck, self._cv = self._prefix_admit(
+                self._ck, self._cv, pks, pvs, jnp.asarray(padded),
+                jnp.asarray(lens), jnp.asarray(slots, jnp.int32))
+            if self.draft_model is not None:
+                _, self._dck, self._dcv = self._draft_prefix_admit(
+                    self._dck, self._dcv, dks, dvs, jnp.asarray(padded),
+                    jnp.asarray(lens), jnp.asarray(slots, jnp.int32))
+        except Exception:
+            self._free.extend(slots)
+            raise
+        admitted = 0
+        for i, req in enumerate(reqs):
+            uri, suffix, on_done, on_error, temp, seed, mn, _ = req
+            try:
+                plen = P + int(lens[i])
+                first = self._pick_first(last[i], plen, temp, seed)
+                self._install_slot(slots[i], uri, plen, mn, on_done,
+                                   on_error, temp, seed, first)
+                admitted += 1
+            except Exception as e:
+                self._free.append(slots[i])
+                self._req_error(uri, on_error, e)
+        return admitted
+
+    def _install_slot(self, slot, uri, plen, mn, on_done, on_error,
+                      temp, seed, first):
+        """Shared slot-state installation for every admission path —
+        plain bucket splice and prefix admission must never drift."""
+        self._slots[slot] = _Slot(
+            uri=uri, plen=plen, max_new=mn, on_done=on_done,
+            on_error=on_error, temperature=temp, rng_seed=seed)
+        self._tok[slot] = first
+        self._pos[slot] = plen
+        if self.draft_model is not None:
+            self._dpos[slot] = plen
+        self._done[slot] = False
+        self._record_token(slot, int(first))
+
     def _splice_one(self, pre, i: int, req) -> None:
         """Insert one prefetched joiner into a free slot; the slot goes
         back to the free list if the splice fails."""
         last_logits, ks, vs = pre[0], pre[1], pre[2]
-        uri, prompt, on_done, on_error, temp, seed, mn = req
+        uri, prompt, on_done, on_error, temp, seed, mn = req[:7]
         slot = self._free.popleft()
         try:
             self._ck, self._cv = self._insert(

@@ -684,3 +684,125 @@ def test_inference_model_builds_spec_engine(lm):
     solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
                                5))[0]
     np.testing.assert_array_equal(results["x"], solo)
+
+
+# ---- prefix caching ----------------------------------------------------
+
+@pytest.mark.parametrize("spec", [False, True])
+def test_prefix_requests_match_concatenated_solo(lm, spec):
+    """register_prefix + suffix-only submit must produce EXACTLY the
+    tokens of solo generate() on the concatenated prompt — plain and
+    speculative engines, mixed with non-prefix traffic and recycling."""
+    model, variables = lm
+    kw = {}
+    if spec:
+        dm, dvv = _draft_lm()
+        kw = dict(draft_model=dm, draft_variables=dvv, speculation_k=3)
+    eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                           max_slots=2, prompt_buckets=(4, 8, 16), **kw)
+    rng = np.random.default_rng(5)
+    prefix = rng.integers(1, 32, 6).astype(np.int32)
+    pid = eng.register_prefix(prefix)
+    results = {}
+    cases = {}
+    for i in range(4):                          # prefix-cached requests
+        sfx = rng.integers(1, 32, int(rng.integers(1, 5))).astype(
+            np.int32)
+        cases[f"p{i}"] = np.concatenate([prefix, sfx])
+        eng.submit(f"p{i}", sfx, prefix=pid,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    for i in range(2):                          # plain traffic mixed in
+        p = rng.integers(1, 32, 5).astype(np.int32)
+        cases[f"n{i}"] = p
+        eng.submit(f"n{i}", p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    for uri, full in cases.items():
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(full[None]), 5))[0]
+        np.testing.assert_array_equal(results[uri], solo, err_msg=uri)
+
+
+def test_prefix_sampled_matches_generate(lm):
+    """Temperature sampling composes with prefix caching: the rng
+    position-fold uses the TRUE prompt length (prefix + suffix), so
+    sampled tokens equal solo generate with the same seed."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(4, 8, 16))
+    rng = np.random.default_rng(6)
+    prefix = rng.integers(1, 32, 5).astype(np.int32)
+    pid = eng.register_prefix(prefix)
+    sfx = rng.integers(1, 32, 3).astype(np.int32)
+    results = {}
+    eng.submit("s", sfx, prefix=pid, temperature=0.7, rng_seed=123,
+               on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    full = np.concatenate([prefix, sfx])
+    solo = np.asarray(generate(
+        model, variables, jnp.asarray(full[None]), 4,
+        temperature=0.7, rng=jax.random.key(123)))[0]
+    np.testing.assert_array_equal(results["s"], solo)
+
+
+def test_prefix_validation(lm):
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8, 16))
+    with pytest.raises(ValueError, match="unknown prefix"):
+        eng.submit("x", np.arange(1, 4, dtype=np.int32), prefix=99)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.register_prefix(np.zeros((0,), np.int32))
+    with pytest.raises(ValueError, match="no room"):
+        eng.register_prefix(np.arange(1, 17, dtype=np.int32))
+    pid = eng.register_prefix(np.arange(1, 13, dtype=np.int32))  # P=12
+    with pytest.raises(ValueError, match="exceeds max prompt"):
+        eng.submit("x", np.arange(1, 6, dtype=np.int32), prefix=pid)
+
+
+def test_prefix_burst_exceeding_slots_requeues(lm):
+    """A same-prefix burst larger than the free-slot count admits a
+    group now and requeues the rest in order — everyone still matches
+    solo generate on their concatenated prompt."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(4, 8, 16))
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(1, 32, 5).astype(np.int32)
+    pid = eng.register_prefix(prefix)
+    results = {}
+    order = []
+    suffixes = [rng.integers(1, 32, 3).astype(np.int32)
+                for _ in range(5)]
+    for i, sfx in enumerate(suffixes):
+        eng.submit(f"b{i}", sfx, prefix=pid,
+                   on_done=lambda u, t: (results.__setitem__(u, t),
+                                         order.append(u)))
+    eng.drain()
+    assert len(results) == 5
+    for i, sfx in enumerate(suffixes):
+        full = np.concatenate([prefix, sfx])
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(full[None]), 4))[0]
+        np.testing.assert_array_equal(results[f"b{i}"], solo,
+                                      err_msg=f"b{i}")
+
+
+def test_unregister_prefix(lm):
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8, 16))
+    pid = eng.register_prefix(np.arange(1, 5, dtype=np.int32))
+    eng.unregister_prefix(pid)
+    with pytest.raises(ValueError, match="unknown prefix"):
+        eng.unregister_prefix(pid)
+    with pytest.raises(ValueError, match="unknown prefix"):
+        eng.submit("x", np.arange(1, 4, dtype=np.int32), prefix=pid)
+    # queued-then-unregistered: the request fails via its error callback
+    pid2 = eng.register_prefix(np.arange(1, 5, dtype=np.int32))
+    errs = {}
+    eng.submit("y", np.arange(1, 4, dtype=np.int32), prefix=pid2,
+               on_error=lambda u, e: errs.__setitem__(u, e))
+    eng.unregister_prefix(pid2)
+    eng.step()
+    assert "y" in errs and "unregistered" in str(errs["y"])
