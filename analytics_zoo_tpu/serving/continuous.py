@@ -281,32 +281,38 @@ class ContinuousEngine:
 
         def _prefix_admit_for(m, v, want_logits):
             def fn(ck, cv, pks, pvs, suffixes, suffix_lens, slots):
-                """Splice a stored prefix [layers, 1, P, H, D] into n
+                """Splice a stored prefix [layers, 1, P, H, D] into kb
                 slots and run their suffixes through decode_k against it
                 in ONE forward — a burst naming the same system prompt
                 (the feature's primary workload) costs one device call,
-                like the plain path's bucketed prefill.  slots must be
-                distinct (the admit loop pops them from the free list).
-                Returns (last-real-position logits [n, V] | None, ck,
-                cv)."""
+                like the plain path's bucketed prefill.  The row count
+                is padded to a power of two by the caller (bounded
+                compile count, like _admit's kb); padding rows carry the
+                OUT-OF-RANGE slot index S — their reads clamp and their
+                scatter-back is dropped (mode='drop'), so they touch no
+                real slot.  Real slots must be distinct (popped from the
+                free list)."""
                 P = pks.shape[2]
-                n = suffixes.shape[0]
-                rows_k = jnp.take(ck, slots, axis=1)  # [layers,n,L,H,D]
-                rows_v = jnp.take(cv, slots, axis=1)
+                kb = suffixes.shape[0]
+                read_idx = jnp.minimum(slots, ck.shape[1] - 1)
+                rows_k = jnp.take(ck, read_idx, axis=1)
+                rows_v = jnp.take(cv, read_idx, axis=1)
                 pref_k = jnp.broadcast_to(
-                    pks, (pks.shape[0], n) + pks.shape[2:])
+                    pks, (pks.shape[0], kb) + pks.shape[2:])
                 pref_v = jnp.broadcast_to(
-                    pvs, (pvs.shape[0], n) + pvs.shape[2:])
+                    pvs, (pvs.shape[0], kb) + pvs.shape[2:])
                 rows_k = jax.lax.dynamic_update_slice(
                     rows_k, pref_k.astype(rows_k.dtype), (0, 0, 0, 0, 0))
                 rows_v = jax.lax.dynamic_update_slice(
                     rows_v, pref_v.astype(rows_v.dtype), (0, 0, 0, 0, 0))
                 logits, rows_k, rows_v = m.apply(
                     v, suffixes, rows_k, rows_v,
-                    jnp.full((n,), P, jnp.int32),
+                    jnp.full((kb,), P, jnp.int32),
                     method=TransformerLM.verify_step)
-                ck = ck.at[:, slots].set(rows_k.astype(ck.dtype))
-                cv = cv.at[:, slots].set(rows_v.astype(cv.dtype))
+                ck = ck.at[:, slots].set(rows_k.astype(ck.dtype),
+                                         mode="drop")
+                cv = cv.at[:, slots].set(rows_v.astype(cv.dtype),
+                                         mode="drop")
                 if not want_logits:
                     return None, ck, cv
                 last = jnp.take_along_axis(
@@ -448,7 +454,23 @@ class ContinuousEngine:
             "arena_bytes_per_chip": per_slot * self._S // arena_tp,
             "capacity_multiplier_vs_mha_model_dtype":
                 round(full / per_slot, 2),
+            # HBM the speculative/prefix features pin beyond the arena
+            "draft_arena_bytes": (
+                2 * int(np.prod(self._dck.shape))
+                * self._dck.dtype.itemsize
+                if self.draft_model is not None else 0),
+            "prefix_bytes": sum(
+                int(np.prod(e.shape)) * e.dtype.itemsize
+                for entry in self._prefix_snapshot()
+                for e in (entry[0], entry[1], entry[3], entry[4])
+                if e is not None),
         }
+
+    def _prefix_snapshot(self):
+        # register/unregister mutate the dict from client threads;
+        # iterate a locked copy
+        with self._lock:
+            return list(self._prefixes.values())
 
     @property
     def n_active(self) -> int:
@@ -666,12 +688,17 @@ class ContinuousEngine:
             reqs = reqs[:n]
         if not reqs:
             return 0
-        padded = np.full((n, sb), self.pad_id, np.int32)
-        lens = np.zeros(n, np.int32)
+        # pad rows to a power of two (bounded compile count, like the
+        # bucketed prefill); padding rows target the out-of-range slot
+        # index S — reads clamp, writes drop
+        kb = 1 << (n - 1).bit_length()
+        padded = np.full((kb, sb), self.pad_id, np.int32)
+        lens = np.ones(kb, np.int32)
         for i, req in enumerate(reqs):
             padded[i, :len(req[1])] = req[1]
             lens[i] = len(req[1])
-        slots = [self._free.popleft() for _ in range(n)]
+        real = [self._free.popleft() for _ in range(n)]
+        slots = real + [self._S] * (kb - n)
         try:
             last, self._ck, self._cv = self._prefix_admit(
                 self._ck, self._cv, pks, pvs, jnp.asarray(padded),
@@ -681,7 +708,7 @@ class ContinuousEngine:
                     self._dck, self._dcv, dks, dvs, jnp.asarray(padded),
                     jnp.asarray(lens), jnp.asarray(slots, jnp.int32))
         except Exception:
-            self._free.extend(slots)
+            self._free.extend(real)
             raise
         admitted = 0
         for i, req in enumerate(reqs):
@@ -689,11 +716,11 @@ class ContinuousEngine:
             try:
                 plen = P + int(lens[i])
                 first = self._pick_first(last[i], plen, temp, seed)
-                self._install_slot(slots[i], uri, plen, mn, on_done,
+                self._install_slot(real[i], uri, plen, mn, on_done,
                                    on_error, temp, seed, first)
                 admitted += 1
             except Exception as e:
-                self._free.append(slots[i])
+                self._free.append(real[i])
                 self._req_error(uri, on_error, e)
         return admitted
 
@@ -731,15 +758,8 @@ class ContinuousEngine:
         except Exception:
             self._free.append(slot)
             raise
-        self._slots[slot] = _Slot(
-            uri=uri, plen=plen, max_new=mn, on_done=on_done,
-            on_error=on_error, temperature=temp, rng_seed=seed)
-        self._tok[slot] = first
-        self._pos[slot] = plen
-        if self.draft_model is not None:
-            self._dpos[slot] = plen
-        self._done[slot] = False
-        self._record_token(slot, int(first))
+        self._install_slot(slot, uri, plen, mn, on_done, on_error,
+                           temp, seed, first)
 
     def _pick_first(self, last_logits, plen: int, temp: float,
                     seed) -> int:

@@ -1,0 +1,79 @@
+"""The modern LLM-serving stack, end to end on one chip:
+
+  1. distill a draft from the target        (models/distill.py)
+  2. build a SPECULATIVE continuous engine  (serving/continuous.py)
+  3. register a shared system-prompt prefix (prefix caching)
+  4. serve a mixed burst — suffix-only requests at different lengths,
+     co-resident in the slot arena, each advancing by its own
+     acceptance rate
+
+Every emitted stream is exactly what solo greedy generate() would
+produce for the concatenated prompt (the engine's tested contract).
+
+Run: python examples/serve_llm_stack.py
+"""
+
+import numpy as np
+
+import jax
+
+from analytics_zoo_tpu.models import TransformerLM
+from analytics_zoo_tpu.models.distill import distill_draft
+from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+
+
+def main():
+    V, T = 512, 256
+    target = TransformerLM(vocab_size=V, hidden_size=128, num_layers=4,
+                           num_heads=4, intermediate_size=512,
+                           max_position=T)
+    draft = TransformerLM(vocab_size=V, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=256,
+                          max_position=T)
+    rng = np.random.default_rng(0)
+    tv = {"params": target.init(
+        jax.random.key(0), np.zeros((1, 8), np.int32))["params"]}
+
+    # 1. distill: the draft learns to guess like the target
+    start = rng.integers(0, V, (64, 1))
+    seqs = [start]
+    for _ in range(47):
+        seqs.append((seqs[-1] * 5 + 3) % V)
+    corpus = {"tokens": np.concatenate(seqs, 1).astype(np.int32)}
+    dv, hist = distill_draft(target, tv, draft, corpus,
+                             epochs=4, batch_size=8)
+    print(f"distilled draft: loss {hist[0]['loss']:.3f} -> "
+          f"{hist[-1]['loss']:.3f}")
+
+    # 2.+3. speculative engine + shared system prompt
+    eng = ContinuousEngine(target, tv, max_new_tokens=16, max_slots=4,
+                           prompt_buckets=(16, 32),
+                           draft_model=draft, draft_variables=dv,
+                           speculation_k=4)
+    system = rng.integers(1, V, 12).astype(np.int32)
+    pid = eng.register_prefix(system)
+    rep = eng.capacity_report()
+    print(f"arena {rep['arena_bytes']/1e3:.0f} kB + draft arena "
+          f"{rep['draft_arena_bytes']/1e3:.0f} kB + prefix "
+          f"{rep['prefix_bytes']/1e3:.0f} kB")
+
+    # 4. mixed burst
+    results = {}
+    for i in range(6):
+        sfx = rng.integers(1, V, int(rng.integers(2, 8))).astype(
+            np.int32)
+        eng.submit(f"req{i}", sfx, prefix=pid,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        if i % 2:                               # plus plain traffic
+            p = rng.integers(1, V, 10).astype(np.int32)
+            eng.submit(f"plain{i}", p,
+                       on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    acc = eng._spec_emitted / max(1, eng._spec_rounds)
+    print(f"served {len(results)} requests in {eng._spec_rounds} "
+          f"speculative rounds ({acc:.1f} tokens/round/arena)")
+    print("sample output:", results["req0"][:8], "...")
+
+
+if __name__ == "__main__":
+    main()

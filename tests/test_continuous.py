@@ -806,3 +806,28 @@ def test_unregister_prefix(lm):
     eng.unregister_prefix(pid2)
     eng.step()
     assert "y" in errs and "unregistered" in str(errs["y"])
+
+
+def test_prefix_burst_pow2_padding_rows_touch_no_slot(lm):
+    """A 3-request same-prefix burst pads to kb=4 rows; the padding row
+    targets the out-of-range slot sentinel (reads clamp, scatter drops)
+    and must corrupt no real slot — all requests still match solo."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=4, prompt_buckets=(4, 8, 16))
+    rng = np.random.default_rng(8)
+    prefix = rng.integers(1, 32, 5).astype(np.int32)
+    pid = eng.register_prefix(prefix)
+    results = {}
+    suffixes = [rng.integers(1, 32, 3).astype(np.int32)
+                for _ in range(3)]
+    for i, sfx in enumerate(suffixes):
+        eng.submit(f"k{i}", sfx, prefix=pid,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    for i, sfx in enumerate(suffixes):
+        full = np.concatenate([prefix, sfx])
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(full[None]), 4))[0]
+        np.testing.assert_array_equal(results[f"k{i}"], solo,
+                                      err_msg=f"k{i}")
