@@ -480,9 +480,14 @@ class DecoderLayer(nn.Module):
     num_kv_heads: Optional[int] = None
     pos_encoding: str = "learned"
     rope_base: float = 10000.0
+    # LayerNorm epsilon: flax's 1e-6 by default; importers of foreign
+    # checkpoints (net/hf_net.py — GPT-2 uses 1e-5) must match it or
+    # logits drift
+    ln_eps: float = 1e-6
 
     def setup(self):
-        self.ln_attn = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")
+        self.ln_attn = nn.LayerNorm(dtype=jnp.float32, name="ln_attn",
+                                    epsilon=self.ln_eps)
         self.attention = DecoderAttention(
             self.hidden_size, self.num_heads,
             num_kv_heads=self.num_kv_heads, dtype=self.dtype,
@@ -490,7 +495,8 @@ class DecoderLayer(nn.Module):
             sp_strategy=self.sp_strategy,
             pos_encoding=self.pos_encoding, rope_base=self.rope_base,
             name="attention")
-        self.ln_ffn = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")
+        self.ln_ffn = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn",
+                                   epsilon=self.ln_eps)
         if self.num_experts > 0:
             from analytics_zoo_tpu.models.moe import MoEMLP
 
@@ -567,6 +573,7 @@ class _LMStage(nn.Module):
     num_kv_heads: Optional[int] = None
     pos_encoding: str = "learned"
     rope_base: float = 10000.0
+    ln_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x):
@@ -580,6 +587,7 @@ class _LMStage(nn.Module):
                              num_kv_heads=self.num_kv_heads,
                              pos_encoding=self.pos_encoding,
                              rope_base=self.rope_base,
+                             ln_eps=self.ln_eps,
                              name=f"layer_{i}")(x, False)
         return x
 
@@ -637,6 +645,9 @@ class TransformerLM(nn.Module):
     # position table; max_position still bounds sequence/cache length)
     pos_encoding: str = "learned"
     rope_base: float = 10000.0
+    # LayerNorm epsilon — foreign-checkpoint importers must match the
+    # source model's (GPT-2: 1e-5; net/hf_net.py sets this)
+    ln_eps: float = 1e-6
 
     @property
     def kv_heads(self) -> int:
@@ -656,7 +667,8 @@ class TransformerLM(nn.Module):
             nn.Embed(self.max_position, self.hidden_size,
                      name="pos_embed")
             if self.pos_encoding == "learned" else None)
-        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f",
+                                 epsilon=self.ln_eps)
         if self.pp_stages > 0:
             from analytics_zoo_tpu.parallel.pipeline import GPipe
 
@@ -684,7 +696,8 @@ class TransformerLM(nn.Module):
                                use_flash=self.use_flash,
                                num_kv_heads=self.num_kv_heads,
                                pos_encoding=self.pos_encoding,
-                               rope_base=self.rope_base),
+                               rope_base=self.rope_base,
+                               ln_eps=self.ln_eps),
                 n_stages=self.pp_stages,
                 n_microbatches=self.pp_microbatches,
                 schedule=self.pp_schedule,
@@ -711,6 +724,7 @@ class TransformerLM(nn.Module):
                       num_kv_heads=self.num_kv_heads,
                       pos_encoding=self.pos_encoding,
                       rope_base=self.rope_base,
+                      ln_eps=self.ln_eps,
                       name=f"layer_{i}")
             for i in range(self.num_layers)]
 

@@ -90,6 +90,17 @@ class Net:
         return OpenVINONet.from_ir(p, bin_path)
 
     @staticmethod
+    def load_hf_gpt2(model_or_path, dtype=None):
+        """A HuggingFace GPT-2 (``GPT2LMHeadModel`` instance or a local
+        ``from_pretrained`` path) -> ``(TransformerLM, variables)`` with
+        exact logit parity (net/hf_net.py) — the checkpoint then gets
+        pjit training, LoRA, generation, speculative decoding, and
+        continuous-batching serving."""
+        from analytics_zoo_tpu.net.hf_net import from_hf_gpt2
+
+        return from_hf_gpt2(model_or_path, dtype=dtype)
+
+    @staticmethod
     def load_bigdl(*a, **kw):
         raise NotImplementedError(
             "BigDL JVM models are not loadable without a JVM; rebuild the "

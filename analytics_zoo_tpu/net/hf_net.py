@@ -1,0 +1,123 @@
+"""HuggingFace checkpoint import — GPT-2 family → TransformerLM.
+
+Reference parity role: the reference's ``Net.load_tf``/``load_torch``
+imported foreign checkpoints into its runtime (SURVEY.md §2.4); this is
+the same capability pointed at the de-facto LLM checkpoint ecosystem.
+A ``transformers`` GPT-2 (``GPT2LMHeadModel`` instance, or anything
+``from_pretrained`` can load from local disk — this environment has no
+egress, but user machines do) converts into the zoo's ``TransformerLM``
+and from there gets everything the framework has: pjit fine-tuning,
+LoRA, generation, speculative decoding, continuous-batching serving.
+
+Architectural fit is exact, not approximate: GPT-2 is pre-LN with
+tanh-GELU, learned positions, and tied embeddings — precisely
+``TransformerLM``'s default configuration (the LN epsilon difference,
+1e-5 vs flax's 1e-6, is carried through ``ln_eps``).  The parity test
+asserts logits agreement against the torch forward.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.lm import TransformerLM
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def from_hf_gpt2(model_or_path, dtype=None
+                 ) -> Tuple[TransformerLM, dict]:
+    """Convert a HF ``GPT2LMHeadModel`` (instance or local path) to
+    ``(TransformerLM, variables)``.
+
+    ``dtype`` sets the compute dtype of the returned model (default
+    f32; params are stored f32 as HF ships them; pass ``jnp.bfloat16``
+    for TPU serving).
+    """
+    import torch  # noqa: F401  (transformers needs it importable)
+    from transformers import GPT2LMHeadModel
+
+    hf = model_or_path
+    if not isinstance(hf, GPT2LMHeadModel):
+        hf = GPT2LMHeadModel.from_pretrained(model_or_path)
+    cfg = hf.config
+    # every config knob that would silently change the FUNCTION (not
+    # just the weights) is checked: a wrong-but-running conversion is
+    # the worst outcome an importer can produce
+    if getattr(cfg, "activation_function", "gelu_new") not in (
+            "gelu_new", "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            f"GPT-2 activation {cfg.activation_function!r}: TransformerLM "
+            f"uses tanh-GELU (gelu_new); other activations would silently "
+            f"change the function")
+    if not getattr(cfg, "tie_word_embeddings", True):
+        raise NotImplementedError(
+            "untied lm_head (tie_word_embeddings=False): TransformerLM "
+            "ties logits to the embedding table")
+    if getattr(cfg, "scale_attn_by_inverse_layer_idx", False):
+        raise NotImplementedError(
+            "scale_attn_by_inverse_layer_idx=True: TransformerLM scales "
+            "attention by 1/sqrt(D) only")
+    if getattr(cfg, "reorder_and_upcast_attn", False):
+        raise NotImplementedError("reorder_and_upcast_attn=True is not "
+                                  "replicated")
+    H = cfg.n_embd
+    heads = cfg.n_head
+    D = H // heads
+    if dtype is None:
+        dtype = jnp.float32
+
+    model = TransformerLM(
+        vocab_size=cfg.vocab_size, hidden_size=H, num_layers=cfg.n_layer,
+        num_heads=heads,
+        intermediate_size=int(getattr(cfg, "n_inner", None) or 4 * H),
+        max_position=cfg.n_positions, dropout=0.0, dtype=dtype,
+        pos_encoding="learned", ln_eps=float(cfg.layer_norm_epsilon))
+
+    sd = hf.state_dict()
+    params = {
+        "embed": {"embedding": _np(sd["transformer.wte.weight"])},
+        "pos_embed": {"embedding": _np(sd["transformer.wpe.weight"])},
+        "ln_f": {"scale": _np(sd["transformer.ln_f.weight"]),
+                 "bias": _np(sd["transformer.ln_f.bias"])},
+    }
+    for i in range(cfg.n_layer):
+        pre = f"transformer.h.{i}."
+        # HF Conv1D stores [in, out] — already the flax kernel layout
+        w_qkv = _np(sd[pre + "attn.c_attn.weight"])      # [H, 3H]
+        b_qkv = _np(sd[pre + "attn.c_attn.bias"])        # [3H]
+        wq, wk, wv = np.split(w_qkv, 3, axis=1)
+        bq, bk, bv = np.split(b_qkv, 3)
+        w_o = _np(sd[pre + "attn.c_proj.weight"])        # [H, H]
+        b_o = _np(sd[pre + "attn.c_proj.bias"])
+        params[f"layer_{i}"] = {
+            "ln_attn": {"scale": _np(sd[pre + "ln_1.weight"]),
+                        "bias": _np(sd[pre + "ln_1.bias"])},
+            "ln_ffn": {"scale": _np(sd[pre + "ln_2.weight"]),
+                       "bias": _np(sd[pre + "ln_2.bias"])},
+            "attention": {
+                # DenseGeneral((heads, D)): kernel [H, heads, D]
+                "query": {"kernel": wq.reshape(H, heads, D),
+                          "bias": bq.reshape(heads, D)},
+                "key": {"kernel": wk.reshape(H, heads, D),
+                        "bias": bk.reshape(heads, D)},
+                "value": {"kernel": wv.reshape(H, heads, D),
+                          "bias": bv.reshape(heads, D)},
+                # DenseGeneral(H, axis=(-2, -1)): kernel [heads, D, H]
+                "attn_out": {"kernel": w_o.reshape(heads, D, H),
+                             "bias": b_o},
+            },
+            "ffn_up": {"kernel": _np(sd[pre + "mlp.c_fc.weight"]),
+                       "bias": _np(sd[pre + "mlp.c_fc.bias"])},
+            "ffn_down": {"kernel": _np(sd[pre + "mlp.c_proj.weight"]),
+                         "bias": _np(sd[pre + "mlp.c_proj.bias"])},
+        }
+    # lm_head is tied to wte in GPT-2, exactly TransformerLM's tied
+    # head — nothing to copy
+    return model, {"params": params}
