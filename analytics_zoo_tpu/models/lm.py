@@ -41,7 +41,9 @@ LM_PARTITION_RULES = (
     (r"(query|key|value)/kernel", P(None, "tp")),
     (r"attn_out/kernel", P("tp", None)),
     (r"ffn_up/kernel", P(None, "tp")),
+    (r"ffn_gate/kernel", P(None, "tp")),   # SwiGLU gate: column-parallel
     (r"ffn_down/kernel", P("tp", None)),
+    (r"lm_head/kernel", P(None, "tp")),    # untied head: vocab-sharded
     (r".*", P()),
 )
 
@@ -268,6 +270,14 @@ def unstack_pp_params(params, n_chunks: int = 1):
     return out
 
 
+def _make_norm(kind: str, eps: float, name: str):
+    """One norm selector for block norms and the final norm — the two
+    must never drift (a mismatch would silently skew logits)."""
+    if kind == "rmsnorm":
+        return nn.RMSNorm(dtype=jnp.float32, name=name, epsilon=eps)
+    return nn.LayerNorm(dtype=jnp.float32, name=name, epsilon=eps)
+
+
 def _apply_rope(x, pos, base: float):
     """Rotary position embedding (rotate-half convention).
 
@@ -317,6 +327,7 @@ class DecoderAttention(nn.Module):
     # so flash/ring/GQA paths run unchanged)
     pos_encoding: str = "learned"
     rope_base: float = 10000.0
+    use_bias: bool = True       # llama-family imports project bias-free
 
     def setup(self):
         H = self.num_heads
@@ -327,12 +338,17 @@ class DecoderAttention(nn.Module):
         D = self.hidden_size // H
         self._h, self._kh, self._d = H, KH, D
         self.query = nn.DenseGeneral((H, D), dtype=self.dtype,
+                                     use_bias=self.use_bias,
                                      name="query")
-        self.key = nn.DenseGeneral((KH, D), dtype=self.dtype, name="key")
+        self.key = nn.DenseGeneral((KH, D), dtype=self.dtype,
+                                   use_bias=self.use_bias, name="key")
         self.value = nn.DenseGeneral((KH, D), dtype=self.dtype,
+                                     use_bias=self.use_bias,
                                      name="value")
         self.attn_out = nn.DenseGeneral(self.hidden_size, axis=(-2, -1),
-                                        dtype=self.dtype, name="attn_out")
+                                        dtype=self.dtype,
+                                        use_bias=self.use_bias,
+                                        name="attn_out")
 
     def _expand_kv(self, t):
         """[B, T, KH, D] -> [B, T, H, D] by repeating each KV head over
@@ -484,19 +500,24 @@ class DecoderLayer(nn.Module):
     # checkpoints (net/hf_net.py — GPT-2 uses 1e-5) must match it or
     # logits drift
     ln_eps: float = 1e-6
+    # "layernorm" | "rmsnorm"; "gelu" | "swiglu" — the llama family is
+    # rmsnorm + swiglu + bias-free projections (net/hf_net.py)
+    norm: str = "layernorm"
+    mlp: str = "gelu"
+    use_bias: bool = True
 
     def setup(self):
-        self.ln_attn = nn.LayerNorm(dtype=jnp.float32, name="ln_attn",
-                                    epsilon=self.ln_eps)
+        self.ln_attn = _make_norm(self.norm, self.ln_eps, "ln_attn")
         self.attention = DecoderAttention(
             self.hidden_size, self.num_heads,
             num_kv_heads=self.num_kv_heads, dtype=self.dtype,
             mesh=self.mesh, use_flash=self.use_flash,
             sp_strategy=self.sp_strategy,
             pos_encoding=self.pos_encoding, rope_base=self.rope_base,
+            use_bias=self.use_bias,
             name="attention")
-        self.ln_ffn = nn.LayerNorm(dtype=jnp.float32, name="ln_ffn",
-                                   epsilon=self.ln_eps)
+        self.ln_ffn = _make_norm(self.norm, self.ln_eps,
+                                 "ln_ffn")
         if self.num_experts > 0:
             from analytics_zoo_tpu.models.moe import MoEMLP
 
@@ -507,9 +528,16 @@ class DecoderLayer(nn.Module):
                               name="moe")
         else:
             self.ffn_up = nn.Dense(self.intermediate_size,
-                                   dtype=self.dtype, name="ffn_up")
+                                   dtype=self.dtype,
+                                   use_bias=self.use_bias, name="ffn_up")
             self.ffn_down = nn.Dense(self.hidden_size, dtype=self.dtype,
+                                     use_bias=self.use_bias,
                                      name="ffn_down")
+            if self.mlp == "swiglu":
+                self.ffn_gate = nn.Dense(self.intermediate_size,
+                                         dtype=self.dtype,
+                                         use_bias=self.use_bias,
+                                         name="ffn_gate")
         self.drop = nn.Dropout(self.dropout)
 
     def _mlp(self, x, train):
@@ -522,6 +550,9 @@ class DecoderLayer(nn.Module):
             # batch-coupling property documented on MoEMLP; raise
             # moe_capacity_factor where that matters.
             h = self.moe(x, train)
+        elif self.mlp == "swiglu":
+            h = self.ffn_down(nn.silu(self.ffn_gate(x))
+                              * self.ffn_up(x))
         else:
             h = self.ffn_down(nn.gelu(self.ffn_up(x)))
         return self.drop(h, deterministic=not train)
@@ -574,6 +605,9 @@ class _LMStage(nn.Module):
     pos_encoding: str = "learned"
     rope_base: float = 10000.0
     ln_eps: float = 1e-6
+    norm: str = "layernorm"
+    mlp: str = "gelu"
+    use_bias: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -588,6 +622,8 @@ class _LMStage(nn.Module):
                              pos_encoding=self.pos_encoding,
                              rope_base=self.rope_base,
                              ln_eps=self.ln_eps,
+                             norm=self.norm, mlp=self.mlp,
+                             use_bias=self.use_bias,
                              name=f"layer_{i}")(x, False)
         return x
 
@@ -648,6 +684,14 @@ class TransformerLM(nn.Module):
     # LayerNorm epsilon — foreign-checkpoint importers must match the
     # source model's (GPT-2: 1e-5; net/hf_net.py sets this)
     ln_eps: float = 1e-6
+    # llama-family knobs (net/hf_net.py from_hf_llama): rmsnorm blocks,
+    # SwiGLU MLP, bias-free projections, untied lm_head.  Defaults are
+    # the GPT-2-shaped configuration every existing user of this class
+    # already has.
+    norm: str = "layernorm"         # "layernorm" | "rmsnorm"
+    mlp: str = "gelu"               # "gelu" | "swiglu"
+    use_bias: bool = True
+    tied_head: bool = True
 
     @property
     def kv_heads(self) -> int:
@@ -667,8 +711,10 @@ class TransformerLM(nn.Module):
             nn.Embed(self.max_position, self.hidden_size,
                      name="pos_embed")
             if self.pos_encoding == "learned" else None)
-        self.ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f",
-                                 epsilon=self.ln_eps)
+        self.ln_f = _make_norm(self.norm, self.ln_eps, "ln_f")
+        if not self.tied_head:
+            self.lm_head = nn.Dense(self.vocab_size, use_bias=False,
+                                    dtype=jnp.float32, name="lm_head")
         if self.pp_stages > 0:
             from analytics_zoo_tpu.parallel.pipeline import GPipe
 
@@ -697,7 +743,9 @@ class TransformerLM(nn.Module):
                                num_kv_heads=self.num_kv_heads,
                                pos_encoding=self.pos_encoding,
                                rope_base=self.rope_base,
-                               ln_eps=self.ln_eps),
+                               ln_eps=self.ln_eps,
+                               norm=self.norm, mlp=self.mlp,
+                               use_bias=self.use_bias),
                 n_stages=self.pp_stages,
                 n_microbatches=self.pp_microbatches,
                 schedule=self.pp_schedule,
@@ -725,10 +773,14 @@ class TransformerLM(nn.Module):
                       pos_encoding=self.pos_encoding,
                       rope_base=self.rope_base,
                       ln_eps=self.ln_eps,
+                      norm=self.norm, mlp=self.mlp,
+                      use_bias=self.use_bias,
                       name=f"layer_{i}")
             for i in range(self.num_layers)]
 
     def _logits(self, x):
+        if not self.tied_head:
+            return self.lm_head(x.astype(jnp.float32))
         # tied head: f32 logits for a stable softmax/CE
         emb = self.embed.embedding.astype(jnp.float32)
         return jnp.einsum("bte,ve->btv", x.astype(jnp.float32), emb)
@@ -880,6 +932,12 @@ class LMWithFusedLoss(nn.Module):
     def __call__(self, tokens, train: bool = False):
         import optax
 
+        if not self.lm.tied_head:
+            raise ValueError(
+                "LMWithFusedLoss computes blockwise logits from the TIED "
+                "embedding table; an untied-head model (tied_head=False, "
+                "e.g. a llama import) would silently train the wrong "
+                "projection — use loss=lm_loss on the plain model")
         h = self.lm.hidden_states(tokens, train)
         emb = self.lm.embed.embedding.astype(jnp.float32)
         hs = h[:, :-1].astype(jnp.float32)

@@ -101,6 +101,15 @@ class Net:
         return from_hf_gpt2(model_or_path, dtype=dtype)
 
     @staticmethod
+    def load_hf_llama(model_or_path, dtype=None):
+        """A HuggingFace Llama (``LlamaForCausalLM`` instance or local
+        path) -> ``(TransformerLM, variables)``: rmsnorm + SwiGLU +
+        rope + GQA + untied head, exact logit parity (net/hf_net.py)."""
+        from analytics_zoo_tpu.net.hf_net import from_hf_llama
+
+        return from_hf_llama(model_or_path, dtype=dtype)
+
+    @staticmethod
     def load_bigdl(*a, **kw):
         raise NotImplementedError(
             "BigDL JVM models are not loadable without a JVM; rebuild the "

@@ -28,7 +28,10 @@ from analytics_zoo_tpu.models.lm import TransformerLM
 
 
 def _np(t) -> np.ndarray:
-    return t.detach().cpu().numpy()
+    # .float(): torch bf16/fp16 tensors (torch_dtype=bfloat16 loads, the
+    # normal way to hold a big checkpoint) cannot convert to numpy
+    # directly
+    return t.detach().cpu().float().numpy()
 
 
 def from_hf_gpt2(model_or_path, dtype=None
@@ -67,6 +70,10 @@ def from_hf_gpt2(model_or_path, dtype=None
     if getattr(cfg, "reorder_and_upcast_attn", False):
         raise NotImplementedError("reorder_and_upcast_attn=True is not "
                                   "replicated")
+    if not getattr(cfg, "scale_attn_weights", True):
+        raise NotImplementedError(
+            "scale_attn_weights=False: TransformerLM always scales "
+            "attention by 1/sqrt(D)")
     H = cfg.n_embd
     heads = cfg.n_head
     D = H // heads
@@ -120,4 +127,93 @@ def from_hf_gpt2(model_or_path, dtype=None
         }
     # lm_head is tied to wte in GPT-2, exactly TransformerLM's tied
     # head — nothing to copy
+    return model, {"params": params}
+
+
+def from_hf_llama(model_or_path, dtype=None
+                  ) -> Tuple[TransformerLM, dict]:
+    """Convert a HF ``LlamaForCausalLM`` (instance or local path) to
+    ``(TransformerLM, variables)`` — rmsnorm + SwiGLU + rope + GQA +
+    bias-free projections, via the model's llama-family knobs.
+
+    torch ``Linear`` stores ``[out, in]``; every kernel transposes into
+    the flax ``[in, out]`` layout (unlike GPT-2's Conv1D, which already
+    matches)."""
+    import torch  # noqa: F401
+    from transformers import LlamaForCausalLM
+
+    hf = model_or_path
+    if not isinstance(hf, LlamaForCausalLM):
+        hf = LlamaForCausalLM.from_pretrained(model_or_path)
+    cfg = hf.config
+    H = cfg.hidden_size
+    heads = cfg.num_attention_heads
+    D = H // heads
+    kvh = getattr(cfg, "num_key_value_heads", heads)
+    # function-changing knobs fail loud (same policy as GPT-2 above)
+    if getattr(cfg, "rope_scaling", None):
+        raise NotImplementedError(
+            f"rope_scaling={cfg.rope_scaling!r}: TransformerLM applies "
+            f"plain rotary embeddings")
+    if getattr(cfg, "head_dim", None) not in (None, D):
+        raise NotImplementedError(
+            f"head_dim={cfg.head_dim} != hidden/heads={D}: "
+            f"TransformerLM derives head dim from hidden_size")
+    if getattr(cfg, "attention_bias", False) or getattr(
+            cfg, "mlp_bias", False):
+        raise NotImplementedError(
+            "biased llama projections: this converter maps the "
+            "bias-free layout (use_bias=False)")
+    if getattr(cfg, "hidden_act", "silu") != "silu":
+        raise NotImplementedError(
+            f"hidden_act {cfg.hidden_act!r}: TransformerLM's SwiGLU "
+            f"uses silu")
+    tied = bool(getattr(cfg, "tie_word_embeddings", False))
+    if dtype is None:
+        dtype = jnp.float32
+
+    model = TransformerLM(
+        vocab_size=cfg.vocab_size, hidden_size=H,
+        num_layers=cfg.num_hidden_layers, num_heads=heads,
+        intermediate_size=cfg.intermediate_size,
+        max_position=cfg.max_position_embeddings, dropout=0.0,
+        dtype=dtype, pos_encoding="rope",
+        rope_base=float(getattr(cfg, "rope_theta", 10000.0)),
+        num_kv_heads=kvh, norm="rmsnorm", mlp="swiglu",
+        use_bias=False, tied_head=tied,
+        ln_eps=float(cfg.rms_norm_eps))
+
+    sd = hf.state_dict()
+
+    def lin(name):                          # torch [out, in] -> [in, out]
+        return _np(sd[name]).T
+
+    params = {
+        "embed": {"embedding": _np(sd["model.embed_tokens.weight"])},
+        "ln_f": {"scale": _np(sd["model.norm.weight"])},
+    }
+    if not tied:
+        params["lm_head"] = {"kernel": lin("lm_head.weight")}
+    for i in range(cfg.num_hidden_layers):
+        pre = f"model.layers.{i}."
+        params[f"layer_{i}"] = {
+            "ln_attn": {"scale": _np(sd[pre + "input_layernorm.weight"])},
+            "ln_ffn": {"scale": _np(
+                sd[pre + "post_attention_layernorm.weight"])},
+            "attention": {
+                "query": {"kernel":
+                          lin(pre + "self_attn.q_proj.weight")
+                          .reshape(H, heads, D)},
+                "key": {"kernel": lin(pre + "self_attn.k_proj.weight")
+                        .reshape(H, kvh, D)},
+                "value": {"kernel": lin(pre + "self_attn.v_proj.weight")
+                          .reshape(H, kvh, D)},
+                "attn_out": {"kernel":
+                             lin(pre + "self_attn.o_proj.weight")
+                             .reshape(heads, D, H)},
+            },
+            "ffn_gate": {"kernel": lin(pre + "mlp.gate_proj.weight")},
+            "ffn_up": {"kernel": lin(pre + "mlp.up_proj.weight")},
+            "ffn_down": {"kernel": lin(pre + "mlp.down_proj.weight")},
+        }
     return model, {"params": params}

@@ -100,3 +100,78 @@ def test_unsupported_activation_fails_loud():
                      n_layer=1, n_head=2, activation_function="relu")
     with pytest.raises(NotImplementedError, match="activation"):
         from_hf_gpt2(GPT2LMHeadModel(cfg))
+
+
+# ---- llama family ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def llama_pair():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=96, hidden_size=32,
+                      intermediate_size=88, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, rms_norm_eps=1e-5,
+                      rope_theta=10000.0, attention_dropout=0.0,
+                      tie_word_embeddings=False)
+    hf = LlamaForCausalLM(cfg).eval()
+    from analytics_zoo_tpu.net.hf_net import from_hf_llama
+
+    model, variables = from_hf_llama(hf)
+    return hf, model, variables
+
+
+def test_llama_logit_parity(llama_pair):
+    hf, model, variables = llama_pair
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 96, (3, 13)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits.numpy()
+    ours = np.asarray(model.apply(variables,
+                                  jnp.asarray(toks.astype(np.int32))))
+    assert np.abs(ref - ours).max() < 1e-4   # measured ~1e-7
+    np.testing.assert_array_equal(ref.argmax(-1), ours.argmax(-1))
+
+
+def test_llama_config_carried(llama_pair):
+    _, model, variables = llama_pair
+    assert model.norm == "rmsnorm" and model.mlp == "swiglu"
+    assert not model.use_bias and not model.tied_head
+    assert model.pos_encoding == "rope" and model.num_kv_heads == 2
+    assert "lm_head" in variables["params"]
+    # rmsnorm has no bias params anywhere
+    flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+    assert not any("bias" in str(p) for p, _ in flat)
+
+
+def test_llama_generation_matches_hf(llama_pair):
+    """The cached rope+GQA decode path with an untied head: greedy
+    generation must agree token-for-token with transformers."""
+    from analytics_zoo_tpu.models.lm import generate
+
+    hf, model, variables = llama_pair
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(1, 96, (2, 7)).astype(np.int32)
+    out = np.asarray(generate(model, variables, jnp.asarray(prompt), 6))
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor(prompt.astype(np.int64)),
+                          max_new_tokens=6, do_sample=False,
+                          pad_token_id=0)[:, 7:].numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_llama_guards_fail_loud():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from analytics_zoo_tpu.net.hf_net import from_hf_llama
+
+    base = dict(vocab_size=32, hidden_size=16, intermediate_size=32,
+                num_hidden_layers=1, num_attention_heads=2,
+                max_position_embeddings=32)
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        from_hf_llama(LlamaForCausalLM(LlamaConfig(
+            **base, rope_scaling={"rope_type": "linear", "factor": 2.0})))
+    with pytest.raises(NotImplementedError, match="hidden_act"):
+        from_hf_llama(LlamaForCausalLM(LlamaConfig(
+            **base, hidden_act="gelu")))
