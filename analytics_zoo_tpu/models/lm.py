@@ -844,6 +844,16 @@ class TransformerLM(nn.Module):
         written; advancing pos by fewer than S on the next call makes
         the surplus entries dead (never attended, later overwritten) —
         that is the rejection mechanism."""
+        h, ck, cv = self.verify_hidden(toks, caches_k, caches_v, pos)
+        return self._logits(h), ck, cv
+
+    def verify_hidden(self, toks, caches_k, caches_v, pos):
+        """``verify_step`` minus the vocab head: (hidden [B, S, H],
+        caches).  Callers that consume ONE position per row (the greedy
+        forward prefill) gather the hidden state first and apply the
+        head to [B, 1, H] — materialising [B, S, V] logits for a long
+        prompt is exactly the multi-GB residency LMWithFusedLoss exists
+        to avoid."""
         if self.pp_stages > 0:
             raise NotImplementedError(
                 "verify_step is not pipelined (same restriction as "
@@ -859,8 +869,7 @@ class TransformerLM(nn.Module):
             x, ck, cv = layer.decode_k(x, caches_k[i], caches_v[i], pos)
             ks.append(ck)
             vs.append(cv)
-        return (self._logits(self.ln_f(x)), jnp.stack(ks),
-                jnp.stack(vs))
+        return self.ln_f(x), jnp.stack(ks), jnp.stack(vs)
 
     def prefill(self, tokens):
         """Causal forward that ALSO returns every layer's K/V: ``(logits
@@ -886,6 +895,58 @@ class TransformerLM(nn.Module):
             ks.append(k)
             vs.append(v)
         return self._logits(self.ln_f(x)), jnp.stack(ks), jnp.stack(vs)
+
+
+def _generate_forward_prefill(model, variables, prompt, max_new_tokens,
+                              prompt_len, eos_id):
+    """Greedy generation, forward-prefill variant (see generate()):
+    one verify_step over the padded prompt + a max_new-step scan at
+    per-row positions — the continuous engine's admission pattern
+    applied to the batch path."""
+    B, Pn = prompt.shape
+    L = Pn + max_new_tokens
+    plen = (jnp.full((B,), Pn, jnp.int32) if prompt_len is None
+            else jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, Pn))
+    H = model.kv_heads
+    D = model.hidden_size // model.num_heads
+    ck = jnp.zeros((model.num_layers, B, L, H, D), jnp.dtype(model.dtype))
+    cv = jnp.zeros_like(ck)
+    # one block-causal forward writes K/V for every prompt position;
+    # entries past a row's true length are dead (mask never reaches
+    # them) and generation overwrites them in order.  Hidden-only: the
+    # head applies to ONE gathered position per row, so [B, P, V]
+    # logits are never materialised (that tensor is ~8 GB for a
+    # llama-vocab model at P=2048).
+    hidden, ck, cv = model.apply(
+        variables, prompt, ck, cv, jnp.zeros((B,), jnp.int32),
+        method=TransformerLM.verify_hidden)
+    last_h = jnp.take_along_axis(
+        hidden, (plen - 1)[:, None, None], axis=1)        # [B, 1, H]
+    first_logits = model.apply(variables, last_h,
+                               method=TransformerLM._logits)[:, 0]
+    tok0 = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+    done0 = jnp.zeros((B,), bool)
+    if eos_id is not None:
+        done0 = tok0 == eos_id
+
+    def step(carry, _):
+        tok, pos, done, ck, cv = carry
+        logits, ck, cv = model.apply(
+            variables, tok, ck, cv, pos,
+            method=TransformerLM.decode_step)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | (nxt == eos_id)
+        pos = jnp.minimum(pos + 1, L - 1)
+        return (nxt, pos, done, ck, cv), nxt
+
+    if max_new_tokens == 1:
+        return tok0[:, None]
+    (_, _, _, _, _), toks = lax.scan(
+        step, (tok0, plen, done0, ck, cv), None,
+        length=max_new_tokens - 1)
+    return jnp.concatenate([tok0[:, None], toks.transpose(1, 0)], axis=1)
 
 
 def lm_loss(logits, tokens):
@@ -969,16 +1030,25 @@ class LMWithFusedLoss(nn.Module):
 def generate(model: TransformerLM, variables, prompt,
              max_new_tokens: int, prompt_len=None, *,
              temperature: float = 0.0, top_k: int = 0,
-             rng=None, eos_id=None) -> jax.Array:
-    """Generation as ONE lax.scan with a threaded KV cache.
+             rng=None, eos_id=None, prefill: str = "auto") -> jax.Array:
+    """Generation with a threaded KV cache.
 
     prompt: [B, P] int32; ``prompt_len`` (optional [B] int32) gives each
     row's true prompt length for right-padded ragged batches (the serving
     path) — defaults to the full width P.  Returns [B, max_new_tokens]:
-    row i's tokens generated after its own prompt end.  The same scan
-    does prompt prefill (positions < prompt_len teacher-force the prompt)
-    and generation feedback — no separate prefill program, no dynamic
-    shapes.
+    row i's tokens generated after its own prompt end.
+
+    ``prefill``: GREEDY decoding defaults to the FORWARD prefill — one
+    block-causal ``verify_step`` over the whole (padded) prompt fills
+    the cache in a single MXU-friendly forward, then a ``max_new``-step
+    scan decodes at per-row positions: P + max_new sequential steps
+    become max_new.  Token output is identical to the scan path
+    (``decode_k`` is bitwise-equal to sequential decode; tested), and
+    pad positions' K/V are dead entries the per-row mask never reaches.
+    ``prefill="scan"`` forces the original single-scan path (prompt
+    positions teacher-force; also what SAMPLED decoding always uses —
+    its batch rng draws are tied to the lockstep scan and are kept
+    exactly reproducible).
 
     Sampling: ``temperature=0`` (default) is greedy argmax;
     ``temperature>0`` samples from logits/temperature (pass ``rng``, a
@@ -994,6 +1064,15 @@ def generate(model: TransformerLM, variables, prompt,
     if L > model.max_position:
         raise ValueError(f"prompt+new = {L} exceeds max_position "
                          f"{model.max_position}")
+    if prefill not in ("auto", "forward", "scan"):
+        raise ValueError(f"prefill must be auto|forward|scan, got "
+                         f"{prefill!r}")
+    use_forward = (prefill != "scan" and temperature <= 0.0
+                   and max_new_tokens > 0 and model.pp_stages == 0)
+    if use_forward:
+        return _generate_forward_prefill(model, variables, prompt,
+                                         max_new_tokens, prompt_len,
+                                         eos_id)
     # prompt_len outside [1, P] has no defined meaning (the scan must
     # start from SOME real token, and can't teacher-force past the row):
     # clamp both ends so bad rows degrade to defined behavior (length-1 /

@@ -745,3 +745,51 @@ def test_rope_lm_trains_and_generates():
         np.testing.assert_array_equal(results["r"], out[0])
     finally:
         stop_orca_context()
+
+
+def test_forward_prefill_equals_scan_generate():
+    """generate()'s greedy fast path (one verify_step prefill + a
+    max_new scan at per-row positions) must emit EXACTLY the scan
+    path's tokens — uniform, ragged, eos-frozen, and max_new=1."""
+    import numpy as np
+
+    model = TransformerLM(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=256, use_flash=False)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(1, 64, (3, 20)).astype(np.int32))
+    tv = model.init(jax.random.key(0), prompt)
+    plen = jnp.asarray([20, 9, 14], jnp.int32)
+    for kw in (dict(), dict(prompt_len=plen)):
+        old = np.asarray(generate(model, tv, prompt, 12,
+                                  prefill="scan", **kw))
+        new = np.asarray(generate(model, tv, prompt, 12, **kw))
+        np.testing.assert_array_equal(old, new)
+    ref = np.asarray(generate(model, tv, prompt, 12, prefill="scan"))
+    eos = int(ref[1, 2])
+    np.testing.assert_array_equal(
+        np.asarray(generate(model, tv, prompt, 12, prefill="scan",
+                            eos_id=eos)),
+        np.asarray(generate(model, tv, prompt, 12, eos_id=eos)))
+    np.testing.assert_array_equal(
+        np.asarray(generate(model, tv, prompt, 1, prefill="scan")),
+        np.asarray(generate(model, tv, prompt, 1)))
+
+
+def test_sampled_generate_keeps_scan_path():
+    """Sampled decoding must keep the lockstep scan (its batch rng
+    draws are reproducible only there): outputs with the same key are
+    unchanged by the prefill knob."""
+    import numpy as np
+
+    model = TransformerLM(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=128, use_flash=False)
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(1, 64, (2, 8)).astype(np.int32))
+    tv = model.init(jax.random.key(0), prompt)
+    a = np.asarray(generate(model, tv, prompt, 6, temperature=0.8,
+                            rng=jax.random.key(7)))
+    b = np.asarray(generate(model, tv, prompt, 6, temperature=0.8,
+                            rng=jax.random.key(7), prefill="forward"))
+    np.testing.assert_array_equal(a, b)     # forward falls back for sampled
