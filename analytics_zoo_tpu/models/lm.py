@@ -897,6 +897,22 @@ class TransformerLM(nn.Module):
         return self._logits(self.ln_f(x)), jnp.stack(ks), jnp.stack(vs)
 
 
+def _gen_state(model, prompt, max_new_tokens, prompt_len):
+    """The prompt-length clamp + KV-cache allocation BOTH generate paths
+    share — one definition, so cache sizing and the length-degradation
+    rule can never drift between them (their token-identical guarantee
+    depends on it)."""
+    B, Pn = prompt.shape
+    L = Pn + max_new_tokens
+    plen = (jnp.full((B,), Pn, jnp.int32) if prompt_len is None
+            else jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, Pn))
+    H = model.kv_heads                  # GQA: cache stores KV heads only
+    D = model.hidden_size // model.num_heads
+    ck = jnp.zeros((model.num_layers, B, L, H, D),
+                   jnp.dtype(model.dtype))
+    return L, plen, ck, jnp.zeros_like(ck)
+
+
 def _generate_forward_prefill(model, variables, prompt, max_new_tokens,
                               prompt_len, eos_id):
     """Greedy generation, forward-prefill variant (see generate()):
@@ -904,13 +920,8 @@ def _generate_forward_prefill(model, variables, prompt, max_new_tokens,
     per-row positions — the continuous engine's admission pattern
     applied to the batch path."""
     B, Pn = prompt.shape
-    L = Pn + max_new_tokens
-    plen = (jnp.full((B,), Pn, jnp.int32) if prompt_len is None
-            else jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, Pn))
-    H = model.kv_heads
-    D = model.hidden_size // model.num_heads
-    ck = jnp.zeros((model.num_layers, B, L, H, D), jnp.dtype(model.dtype))
-    cv = jnp.zeros_like(ck)
+    L, plen, ck, cv = _gen_state(model, prompt, max_new_tokens,
+                                 prompt_len)
     # one block-causal forward writes K/V for every prompt position;
     # entries past a row's true length are dead (mask never reaches
     # them) and generation overwrites them in order.  Hidden-only: the
@@ -938,8 +949,8 @@ def _generate_forward_prefill(model, variables, prompt, max_new_tokens,
         if eos_id is not None:
             nxt = jnp.where(done, jnp.int32(eos_id), nxt)
             done = done | (nxt == eos_id)
-        pos = jnp.minimum(pos + 1, L - 1)
-        return (nxt, pos, done, ck, cv), nxt
+        # last write lands at plen+max_new-2 <= L-2: no clamp needed
+        return (nxt, pos + 1, done, ck, cv), nxt
 
     if max_new_tokens == 1:
         return tok0[:, None]
@@ -1067,25 +1078,28 @@ def generate(model: TransformerLM, variables, prompt,
     if prefill not in ("auto", "forward", "scan"):
         raise ValueError(f"prefill must be auto|forward|scan, got "
                          f"{prefill!r}")
-    use_forward = (prefill != "scan" and temperature <= 0.0
-                   and max_new_tokens > 0 and model.pp_stages == 0)
-    if use_forward:
+    can_forward = (temperature <= 0.0 and max_new_tokens > 0
+                   and model.pp_stages == 0)
+    if prefill == "forward" and not can_forward:
+        # an explicit request that silently measured the scan path
+        # would invalidate whatever comparison the caller is making
+        raise ValueError(
+            "prefill='forward' needs greedy decoding (temperature=0), "
+            "max_new_tokens > 0, and pp_stages=0; use 'auto' to fall "
+            "back silently")
+    if prefill != "scan" and can_forward:
         return _generate_forward_prefill(model, variables, prompt,
                                          max_new_tokens, prompt_len,
                                          eos_id)
     # prompt_len outside [1, P] has no defined meaning (the scan must
     # start from SOME real token, and can't teacher-force past the row):
-    # clamp both ends so bad rows degrade to defined behavior (length-1 /
-    # full-width prompt) instead of off-by-one garbage — values are
-    # traced, so raising is not an option here.  Callers that can reject
-    # bad lengths per-request (serving) do so before this.
-    plen = (jnp.full((B,), Pn, jnp.int32) if prompt_len is None
-            else jnp.clip(jnp.asarray(prompt_len, jnp.int32), 1, Pn))
-    H = model.kv_heads                  # GQA: cache stores KV heads only
-    D = model.hidden_size // model.num_heads
-    cdtype = jnp.dtype(model.dtype)
-    ck0 = jnp.zeros((model.num_layers, B, L, H, D), cdtype)
-    cv0 = jnp.zeros_like(ck0)
+    # _gen_state clamps both ends so bad rows degrade to defined
+    # behavior (length-1 / full-width prompt) instead of off-by-one
+    # garbage — values are traced, so raising is not an option here.
+    # Callers that can reject bad lengths per-request (serving) do so
+    # before this.
+    _, plen, ck0, cv0 = _gen_state(model, prompt, max_new_tokens,
+                                   prompt_len)
 
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 needs a jax.random key via rng=")

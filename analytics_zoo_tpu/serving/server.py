@@ -271,6 +271,22 @@ class ClusterServing:
                     else "")
         return self
 
+    def register_prefix(self, tokens) -> int:
+        """Register a shared prompt prefix (system prompt) with the
+        continuous engine; clients then send ``prefix=np.int32(id)``
+        alongside a suffix-only prompt.  Python API, after ``start()``
+        (the engine owns device state)."""
+        if self.engine is None:
+            raise RuntimeError(
+                "register_prefix needs a RUNNING continuous engine: "
+                "enable continuous_batching and call start() first")
+        return self.engine.register_prefix(tokens)
+
+    def unregister_prefix(self, pid: int) -> None:
+        if self.engine is None:
+            raise RuntimeError("no continuous engine running")
+        self.engine.unregister_prefix(pid)
+
     def stop(self):
         self._stop.set()
         for t in getattr(self, "_threads", []):
@@ -481,6 +497,11 @@ class ClusterServing:
                         if "seed" in r:
                             kw["rng_seed"] = int(np.asarray(
                                 self._decode_value(r["seed"])))
+                        if "prefix" in r:
+                            # prefix-cached request: the id from
+                            # ClusterServing.register_prefix
+                            kw["prefix"] = int(np.asarray(
+                                self._decode_value(r["prefix"])))
                         # capture only the uri, not the whole request
                         # dict (it holds the encoded prompt payload —
                         # a needless second copy for the generation's
@@ -581,13 +602,23 @@ class ClusterServing:
         batch) gets an ERROR result published and its entry finished; the
         rest of the batch still runs — one bad payload must never
         black-hole its batchmates."""
+        # control fields are NEVER model inputs: discovered columns
+        # treating e.g. a stray `prefix` id as a second input would make
+        # pre_pad read it as per-row prompt lengths — silently wrong
+        # generations.  (The continuous pump handles these fields; here
+        # the unsupported ones error-publish per request below.)
+        control = {"uri", "prefix", "max_new", "temperature", "seed"}
         cols = self.config.input_cols or \
-            [k for k in requests[0] if k != "uri"]
+            [k for k in requests[0] if k not in control]
         per_req: List[Optional[List[np.ndarray]]] = [None] * len(requests)
 
         def decode_req(i_req):
             i, r = i_req
             try:
+                if "prefix" in r:
+                    raise ValueError(
+                        "prefix-cached requests need continuous_batching:"
+                        " true (the batch path has no prefix arena)")
                 per_req[i] = [self._decode_value(r[c]) for c in cols]
             except Exception as e:
                 self._publish_error(r, f"decode failed: {e!r}")

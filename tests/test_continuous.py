@@ -831,3 +831,53 @@ def test_prefix_burst_pow2_padding_rows_touch_no_slot(lm):
                                    jnp.asarray(full[None]), 4))[0]
         np.testing.assert_array_equal(results[f"k{i}"], solo,
                                       err_msg=f"k{i}")
+
+
+def test_cluster_serving_prefix_round_trip(lm):
+    """e2e: a registered system-prompt prefix, clients sending
+    suffix-only prompts with a per-request prefix id over the wire —
+    each result equals solo generation on the concatenated prompt;
+    non-prefix traffic interleaves; an unknown prefix id error-publishes
+    without killing the pump."""
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                           OutputQueue, ServingConfig)
+
+    model, variables = lm
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=5, prompt_buckets=(4, 8, 16))
+    cfg = ServingConfig(prompt_col="prompt", continuous_batching=True,
+                        engine_slots=3)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        rng = np.random.default_rng(9)
+        system = rng.integers(1, 32, 6).astype(np.int32)
+        pid = srv.register_prefix(system)
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        cases = {}
+        for i in range(4):
+            sfx = rng.integers(1, 32, int(rng.integers(1, 5))).astype(
+                np.int32)
+            cases[f"p{i}"] = np.concatenate([system, sfx])
+            iq.enqueue(f"p{i}", prompt=sfx, prefix=np.int32(pid))
+        plain = rng.integers(1, 32, 5).astype(np.int32)
+        cases["n0"] = plain
+        iq.enqueue("n0", prompt=plain)
+        for uri, full in cases.items():
+            got = oq.query(uri, timeout=60)
+            solo = np.asarray(generate(model, variables,
+                                       jnp.asarray(full[None]), 5))[0]
+            np.testing.assert_array_equal(np.asarray(got), solo,
+                                          err_msg=uri)
+        # unknown prefix id: per-request error, pump survives
+        iq.enqueue("bad", prompt=plain, prefix=np.int32(999))
+        with pytest.raises(RuntimeError, match="serving error"):
+            oq.query("bad", timeout=30)
+        iq.enqueue("after", prompt=plain)
+        got = oq.query("after", timeout=30)
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(plain[None]), 5))[0]
+        np.testing.assert_array_equal(np.asarray(got), solo)
+    finally:
+        srv.stop()

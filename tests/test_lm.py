@@ -777,10 +777,12 @@ def test_forward_prefill_equals_scan_generate():
 
 
 def test_sampled_generate_keeps_scan_path():
-    """Sampled decoding must keep the lockstep scan (its batch rng
-    draws are reproducible only there): outputs with the same key are
-    unchanged by the prefill knob."""
+    """Sampled decoding always uses the lockstep scan (its batch rng
+    draws are reproducible only there): 'auto' and 'scan' agree with
+    the same key, and an EXPLICIT 'forward' request that cannot be
+    honored raises instead of silently measuring the scan path."""
     import numpy as np
+    import pytest
 
     model = TransformerLM(vocab_size=64, hidden_size=32, num_layers=2,
                           num_heads=2, intermediate_size=64,
@@ -791,5 +793,8 @@ def test_sampled_generate_keeps_scan_path():
     a = np.asarray(generate(model, tv, prompt, 6, temperature=0.8,
                             rng=jax.random.key(7)))
     b = np.asarray(generate(model, tv, prompt, 6, temperature=0.8,
-                            rng=jax.random.key(7), prefill="forward"))
-    np.testing.assert_array_equal(a, b)     # forward falls back for sampled
+                            rng=jax.random.key(7), prefill="scan"))
+    np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="prefill='forward'"):
+        generate(model, tv, prompt, 6, temperature=0.8,
+                 rng=jax.random.key(7), prefill="forward")

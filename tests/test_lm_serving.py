@@ -265,3 +265,33 @@ def test_http_frontend_continuous_with_controls():
         if fe is not None:
             fe.stop()
         serving.stop()
+
+
+def test_batch_path_rejects_prefix_field():
+    """A `prefix` control field on the NON-continuous path must error-
+    publish per request (the batch path has no prefix arena) — never
+    become a phantom second model input that pre_pad misreads as
+    per-row prompt lengths."""
+    import numpy as np
+    import pytest
+
+    model, variables = _lm_and_vars()
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=4, prompt_buckets=(8, 16),
+        pad_id=0)
+    cfg = ServingConfig(batch_size=8, batch_timeout_ms=30.0,
+                        prompt_col="tokens", prompt_pad_id=0)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        toks = np.arange(1, 6, dtype=np.int32)
+        iq.enqueue("with-prefix", tokens=toks, prefix=np.int32(0))
+        with pytest.raises(RuntimeError, match="serving error"):
+            oq.query("with-prefix", timeout=30)
+        # the pump survives and plain requests still serve
+        iq.enqueue("plain", tokens=toks)
+        out = oq.query("plain", timeout=30)
+        assert np.asarray(out).shape == (4,)
+    finally:
+        srv.stop()
