@@ -897,6 +897,28 @@ class TransformerLM(nn.Module):
         return self._logits(self.ln_f(x)), jnp.stack(ks), jnp.stack(vs)
 
 
+def top_p_filter(scaled, top_p):
+    """Nucleus filter over the last axis: keep the smallest set of
+    tokens whose (temperature-scaled) probability mass reaches
+    ``top_p``; everything else goes to -inf.  The highest-probability
+    token always survives (cumulative > p can exclude everything at
+    tiny p otherwise).  top_p may be a scalar or broadcastable
+    per-row [..., 1] array; values >= 1 or <= 0 disable the filter
+    row-wise."""
+    probs = jax.nn.softmax(scaled, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]
+    csum = jnp.cumsum(sorted_probs, axis=-1)
+    # rank of the last kept token: first index where csum >= top_p
+    keep_n = jnp.sum((csum < top_p).astype(jnp.int32), axis=-1,
+                     keepdims=True) + 1
+    kth = jnp.take_along_axis(sorted_probs,
+                              jnp.minimum(keep_n - 1,
+                                          scaled.shape[-1] - 1),
+                              axis=-1)
+    active = (top_p > 0.0) & (top_p < 1.0)
+    return jnp.where(active & (probs < kth), -jnp.inf, scaled)
+
+
 def _gen_state(model, prompt, max_new_tokens, prompt_len):
     """The prompt-length clamp + KV-cache allocation BOTH generate paths
     share — one definition, so cache sizing and the length-degradation
@@ -1041,6 +1063,7 @@ class LMWithFusedLoss(nn.Module):
 def generate(model: TransformerLM, variables, prompt,
              max_new_tokens: int, prompt_len=None, *,
              temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0,
              rng=None, eos_id=None, prefill: str = "auto") -> jax.Array:
     """Generation with a threaded KV cache.
 
@@ -1064,7 +1087,9 @@ def generate(model: TransformerLM, variables, prompt,
     Sampling: ``temperature=0`` (default) is greedy argmax;
     ``temperature>0`` samples from logits/temperature (pass ``rng``, a
     ``jax.random`` key — required then), optionally truncated to the
-    ``top_k`` highest-probability tokens.
+    ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus
+    (the smallest set of tokens whose probability mass reaches top_p;
+    0 or >=1 disables).  Both filters compose (top_k first).
 
     ``eos_id``: once a row emits it (past its prompt), the rest of the
     row freezes at eos — the fixed-shape analog of stop-on-EOS (same
@@ -1111,6 +1136,8 @@ def generate(model: TransformerLM, variables, prompt,
         if top_k > 0:
             kth = lax.top_k(scaled, top_k)[0][:, -1][:, None]
             scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        if top_p > 0.0:
+            scaled = top_p_filter(scaled, jnp.float32(top_p))
         key = jax.random.fold_in(rng, t)
         return jax.random.categorical(key, scaled, axis=-1).astype(
             jnp.int32)

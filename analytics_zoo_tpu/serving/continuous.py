@@ -41,7 +41,8 @@ import numpy as np
 
 from analytics_zoo_tpu.learn.inference_model import (
     _next_bucket, filter_prompt_buckets)
-from analytics_zoo_tpu.models.lm import TransformerLM
+from analytics_zoo_tpu.models.lm import (TransformerLM,
+                                         top_p_filter)
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -56,6 +57,7 @@ class _Slot:
     on_error: Optional[Callable] = None
     temperature: float = 0.0
     rng_seed: Optional[int] = None
+    top_p: float = 0.0
 
 
 class ContinuousEngine:
@@ -195,8 +197,8 @@ class ContinuousEngine:
 
         Lmax = L
 
-        def step_fn(ck, cv, tok, pos, done, temps, seeds, n_ticks,
-                    use_sample):
+        def step_fn(ck, cv, tok, pos, done, temps, seeds, topps,
+                    n_ticks, use_sample, use_topp):
             """Advance every slot ``n_ticks`` tokens in ONE device call
             (a lax.scan) — each extra tick saves a host round-trip,
             which dominates per-token cost on tunneled devices.  A slot
@@ -212,15 +214,17 @@ class ContinuousEngine:
                 nxt = jnp.argmax(logits, -1).astype(jnp.int32)
                 if use_sample:          # static: greedy-only compile
 
-                    def sample_row(seed, t, lg, p):
+                    def sample_row(seed, t, tp, lg, p):
                         key = jax.random.fold_in(jax.random.key(seed), p)
                         scaled = lg.astype(jnp.float32) / jnp.maximum(
                             t, 1e-6)
+                        if use_topp:    # static: no sort when unused
+                            scaled = top_p_filter(scaled, tp)
                         return jax.random.categorical(key, scaled).astype(
                             jnp.int32)
 
-                    sampled = jax.vmap(sample_row)(seeds, temps, logits,
-                                                   pos)
+                    sampled = jax.vmap(sample_row)(seeds, temps, topps,
+                                                   logits, pos)
                     nxt = jnp.where(temps > 0.0, sampled, nxt)
                 if eos_id is not None:
                     nxt = jnp.where(done, jnp.int32(eos_id), nxt)
@@ -236,11 +240,13 @@ class ContinuousEngine:
         # bounded by ticks_per_step, so the cache stays small
         self._step_cache: Dict[Tuple[int, bool], Callable] = {}
 
-        def get_step(n: int, sampled: bool) -> Callable:
-            key = (n, sampled)
+        def get_step(n: int, sampled: bool,
+                     use_topp: bool = False) -> Callable:
+            key = (n, sampled, use_topp)
             if key not in self._step_cache:
                 self._step_cache[key] = jax.jit(
-                    partial(step_fn, n_ticks=n, use_sample=sampled),
+                    partial(step_fn, n_ticks=n, use_sample=sampled,
+                            use_topp=use_topp),
                     donate_argnums=(0, 1))
             return self._step_cache[key]
 
@@ -527,7 +533,8 @@ class ContinuousEngine:
                temperature: float = 0.0,
                rng_seed: Optional[int] = None,
                max_new: Optional[int] = None,
-               prefix: Optional[int] = None) -> None:
+               prefix: Optional[int] = None,
+               top_p: float = 0.0) -> None:
         """Queue one request.  ``prompt``: 1-D int32 token array.
         ``on_done(uri, tokens)`` fires from the pump thread when the
         request finishes (tokens: ``[max_new]`` int32, eos-padded frozen
@@ -575,7 +582,7 @@ class ContinuousEngine:
         with self._lock:
             self._waiting.append(
                 (uri, prompt, on_done, on_error, float(temperature),
-                 rng_seed, mn, prefix))
+                 rng_seed, mn, prefix, float(top_p)))
 
     # ---- pump ---------------------------------------------------------
 
@@ -712,12 +719,13 @@ class ContinuousEngine:
             raise
         admitted = 0
         for i, req in enumerate(reqs):
-            uri, suffix, on_done, on_error, temp, seed, mn, _ = req
+            uri, suffix, on_done, on_error, temp, seed, mn = req[:7]
+            tp = req[8]
             try:
                 plen = P + int(lens[i])
-                first = self._pick_first(last[i], plen, temp, seed)
+                first = self._pick_first(last[i], plen, temp, seed, tp)
                 self._install_slot(real[i], uri, plen, mn, on_done,
-                                   on_error, temp, seed, first)
+                                   on_error, temp, seed, first, tp)
                 admitted += 1
             except Exception as e:
                 self._free.append(real[i])
@@ -725,12 +733,13 @@ class ContinuousEngine:
         return admitted
 
     def _install_slot(self, slot, uri, plen, mn, on_done, on_error,
-                      temp, seed, first):
+                      temp, seed, first, top_p=0.0):
         """Shared slot-state installation for every admission path —
         plain bucket splice and prefix admission must never drift."""
         self._slots[slot] = _Slot(
             uri=uri, plen=plen, max_new=mn, on_done=on_done,
-            on_error=on_error, temperature=temp, rng_seed=seed)
+            on_error=on_error, temperature=temp, rng_seed=seed,
+            top_p=top_p)
         self._tok[slot] = first
         self._pos[slot] = plen
         if self.draft_model is not None:
@@ -743,6 +752,7 @@ class ContinuousEngine:
         back to the free list if the splice fails."""
         last_logits, ks, vs = pre[0], pre[1], pre[2]
         uri, prompt, on_done, on_error, temp, seed, mn = req[:7]
+        tp = req[8]
         slot = self._free.popleft()
         try:
             self._ck, self._cv = self._insert(
@@ -754,23 +764,26 @@ class ContinuousEngine:
                     self._dck, self._dcv, dks[:, i:i + 1],
                     dvs[:, i:i + 1], jnp.int32(slot))
             plen = len(prompt)
-            first = self._pick_first(last_logits[i], plen, temp, seed)
+            first = self._pick_first(last_logits[i], plen, temp, seed,
+                                     tp)
         except Exception:
             self._free.append(slot)
             raise
         self._install_slot(slot, uri, plen, mn, on_done, on_error,
-                           temp, seed, first)
+                           temp, seed, first, tp)
 
     def _pick_first(self, last_logits, plen: int, temp: float,
-                    seed) -> int:
+                    seed, top_p: float = 0.0) -> int:
         """The prefill's last-position logits produce the request's first
         token — same pick semantics (and rng position-fold) as
         ``generate``'s step at t = plen-1."""
         if temp <= 0.0:
             return int(jnp.argmax(last_logits))
         key = jax.random.fold_in(jax.random.key(int(seed)), plen - 1)
-        return int(jax.random.categorical(
-            key, last_logits.astype(jnp.float32) / temp))
+        scaled = last_logits.astype(jnp.float32) / temp
+        if top_p > 0.0:
+            scaled = top_p_filter(scaled, jnp.float32(top_p))
+        return int(jax.random.categorical(key, scaled))
 
     def _record_token(self, slot: int, token: int):
         """Append one generated token; finish + free the slot when done."""
@@ -813,20 +826,24 @@ class ContinuousEngine:
         if self.draft_model is not None:
             return self._spec_tick(active)
         sampled = any(self._slots[i].temperature > 0.0 for i in active)
+        use_topp = any(self._slots[i].top_p > 0.0 for i in active)
         temps = np.zeros(self._S, np.float32)
         seeds = np.zeros(self._S, np.uint32)
+        topps = np.zeros(self._S, np.float32)
         for i in active:
             temps[i] = self._slots[i].temperature
             seeds[i] = self._slots[i].rng_seed or 0
+            topps[i] = self._slots[i].top_p
         n_eff = max(1, min(
             self.ticks_per_step,
             max(self._slots[i].max_new - len(self._slots[i].tokens)
                 for i in active)))
-        step = self._get_step(n_eff, sampled)
+        step = self._get_step(n_eff, sampled, use_topp)
         toks, tok, pos, done, self._ck, self._cv = step(
             self._ck, self._cv, jnp.asarray(self._tok),
             jnp.asarray(self._pos), jnp.asarray(self._done),
-            jnp.asarray(temps), jnp.asarray(seeds))
+            jnp.asarray(temps), jnp.asarray(seeds),
+            jnp.asarray(topps))
         toks = np.asarray(toks)                     # [n_eff, S]
         # np.asarray of a jax array is a read-only view; _admit writes
         # per-slot entries, so take mutable copies

@@ -497,6 +497,9 @@ class ClusterServing:
                         if "seed" in r:
                             kw["rng_seed"] = int(np.asarray(
                                 self._decode_value(r["seed"])))
+                        if "top_p" in r:
+                            kw["top_p"] = float(np.asarray(
+                                self._decode_value(r["top_p"])))
                         if "prefix" in r:
                             # prefix-cached request: the id from
                             # ClusterServing.register_prefix
@@ -607,7 +610,8 @@ class ClusterServing:
         # pre_pad read it as per-row prompt lengths — silently wrong
         # generations.  (The continuous pump handles these fields; here
         # the unsupported ones error-publish per request below.)
-        control = {"uri", "prefix", "max_new", "temperature", "seed"}
+        control = {"uri", "prefix", "max_new", "temperature",
+                   "seed", "top_p"}
         cols = self.config.input_cols or \
             [k for k in requests[0] if k not in control]
         per_req: List[Optional[List[np.ndarray]]] = [None] * len(requests)

@@ -881,3 +881,29 @@ def test_cluster_serving_prefix_round_trip(lm):
         np.testing.assert_array_equal(np.asarray(got), solo)
     finally:
         srv.stop()
+
+
+def test_engine_per_request_top_p_matches_generate(lm):
+    """Per-request nucleus sampling: an engine request with
+    (temperature, seed, top_p) equals solo generate with the same
+    controls — the first-pick and per-tick paths both apply the
+    filter."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=6,
+                           max_slots=2, prompt_buckets=(8,))
+    rng = np.random.default_rng(11)
+    p = rng.integers(1, 32, 6).astype(np.int32)
+    results = {}
+    eng.submit("np", p, temperature=0.9, rng_seed=21,
+               on_done=lambda u, t: results.__setitem__(u, t))
+    eng.submit("tp", p, temperature=0.9, rng_seed=21, top_p=0.7,
+               on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    solo_plain = np.asarray(generate(
+        model, variables, jnp.asarray(p[None]), 6, temperature=0.9,
+        rng=jax.random.key(21)))[0]
+    solo_tp = np.asarray(generate(
+        model, variables, jnp.asarray(p[None]), 6, temperature=0.9,
+        rng=jax.random.key(21), top_p=0.7))[0]
+    np.testing.assert_array_equal(results["np"], solo_plain)
+    np.testing.assert_array_equal(results["tp"], solo_tp)

@@ -798,3 +798,35 @@ def test_sampled_generate_keeps_scan_path():
     with pytest.raises(ValueError, match="prefill='forward'"):
         generate(model, tv, prompt, 6, temperature=0.8,
                  rng=jax.random.key(7), prefill="forward")
+
+
+def test_top_p_sampling():
+    """Nucleus sampling: top_p >= 1 (or 0) is plain sampling; a tiny
+    top_p collapses to greedy; intermediate values only ever emit
+    tokens inside the nucleus."""
+    import numpy as np
+
+    model = TransformerLM(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=64, use_flash=False)
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(1, 64, (2, 6)).astype(np.int32))
+    tv = model.init(jax.random.key(0), prompt)
+    key = jax.random.key(11)
+    plain = np.asarray(generate(model, tv, prompt, 8, temperature=0.9,
+                                rng=key))
+    disabled = np.asarray(generate(model, tv, prompt, 8, temperature=0.9,
+                                   rng=key, top_p=1.0))
+    np.testing.assert_array_equal(plain, disabled)
+    greedy = np.asarray(generate(model, tv, prompt, 8))
+    collapsed = np.asarray(generate(model, tv, prompt, 8,
+                                    temperature=0.9, rng=key,
+                                    top_p=1e-6))
+    np.testing.assert_array_equal(greedy, collapsed)
+    # distinct keys under a mid top_p: outputs vary but stay valid ids
+    a = np.asarray(generate(model, tv, prompt, 8, temperature=1.2,
+                            rng=jax.random.key(1), top_p=0.8))
+    b = np.asarray(generate(model, tv, prompt, 8, temperature=1.2,
+                            rng=jax.random.key(2), top_p=0.8))
+    assert a.min() >= 0 and a.max() < 64
+    assert not np.array_equal(a, b)
