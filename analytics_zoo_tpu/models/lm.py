@@ -328,6 +328,9 @@ class DecoderAttention(nn.Module):
     pos_encoding: str = "learned"
     rope_base: float = 10000.0
     use_bias: bool = True       # llama-family imports project bias-free
+    # qwen2-style split: biased q/k/v with a bias-free o_proj/mlp
+    # (None follows use_bias)
+    qkv_bias: Optional[bool] = None
 
     def setup(self):
         H = self.num_heads
@@ -337,13 +340,14 @@ class DecoderAttention(nn.Module):
                 f"num_heads {H} must be a multiple of num_kv_heads {KH}")
         D = self.hidden_size // H
         self._h, self._kh, self._d = H, KH, D
+        qkvb = self.use_bias if self.qkv_bias is None else self.qkv_bias
         self.query = nn.DenseGeneral((H, D), dtype=self.dtype,
-                                     use_bias=self.use_bias,
+                                     use_bias=qkvb,
                                      name="query")
         self.key = nn.DenseGeneral((KH, D), dtype=self.dtype,
-                                   use_bias=self.use_bias, name="key")
+                                   use_bias=qkvb, name="key")
         self.value = nn.DenseGeneral((KH, D), dtype=self.dtype,
-                                     use_bias=self.use_bias,
+                                     use_bias=qkvb,
                                      name="value")
         self.attn_out = nn.DenseGeneral(self.hidden_size, axis=(-2, -1),
                                         dtype=self.dtype,
@@ -505,6 +509,7 @@ class DecoderLayer(nn.Module):
     norm: str = "layernorm"
     mlp: str = "gelu"
     use_bias: bool = True
+    qkv_bias: Optional[bool] = None
 
     def setup(self):
         self.ln_attn = _make_norm(self.norm, self.ln_eps, "ln_attn")
@@ -514,7 +519,7 @@ class DecoderLayer(nn.Module):
             mesh=self.mesh, use_flash=self.use_flash,
             sp_strategy=self.sp_strategy,
             pos_encoding=self.pos_encoding, rope_base=self.rope_base,
-            use_bias=self.use_bias,
+            use_bias=self.use_bias, qkv_bias=self.qkv_bias,
             name="attention")
         self.ln_ffn = _make_norm(self.norm, self.ln_eps,
                                  "ln_ffn")
@@ -608,6 +613,7 @@ class _LMStage(nn.Module):
     norm: str = "layernorm"
     mlp: str = "gelu"
     use_bias: bool = True
+    qkv_bias: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x):
@@ -624,6 +630,7 @@ class _LMStage(nn.Module):
                              ln_eps=self.ln_eps,
                              norm=self.norm, mlp=self.mlp,
                              use_bias=self.use_bias,
+                             qkv_bias=self.qkv_bias,
                              name=f"layer_{i}")(x, False)
         return x
 
@@ -691,6 +698,8 @@ class TransformerLM(nn.Module):
     norm: str = "layernorm"         # "layernorm" | "rmsnorm"
     mlp: str = "gelu"               # "gelu" | "swiglu"
     use_bias: bool = True
+    # qwen2-style: biased q/k/v despite bias-free o_proj/mlp
+    qkv_bias: Optional[bool] = None
     tied_head: bool = True
 
     @property
@@ -745,7 +754,8 @@ class TransformerLM(nn.Module):
                                rope_base=self.rope_base,
                                ln_eps=self.ln_eps,
                                norm=self.norm, mlp=self.mlp,
-                               use_bias=self.use_bias),
+                               use_bias=self.use_bias,
+                               qkv_bias=self.qkv_bias),
                 n_stages=self.pp_stages,
                 n_microbatches=self.pp_microbatches,
                 schedule=self.pp_schedule,
@@ -774,7 +784,7 @@ class TransformerLM(nn.Module):
                       rope_base=self.rope_base,
                       ln_eps=self.ln_eps,
                       norm=self.norm, mlp=self.mlp,
-                      use_bias=self.use_bias,
+                      use_bias=self.use_bias, qkv_bias=self.qkv_bias,
                       name=f"layer_{i}")
             for i in range(self.num_layers)]
 

@@ -110,6 +110,15 @@ class Net:
         return from_hf_llama(model_or_path, dtype=dtype)
 
     @staticmethod
+    def load_hf_qwen2(model_or_path, dtype=None):
+        """A HuggingFace Qwen2 (``Qwen2ForCausalLM`` instance or local
+        path) -> ``(TransformerLM, variables)``: the llama family plus
+        biased q/k/v projections (net/hf_net.py)."""
+        from analytics_zoo_tpu.net.hf_net import from_hf_qwen2
+
+        return from_hf_qwen2(model_or_path, dtype=dtype)
+
+    @staticmethod
     def load_bigdl(*a, **kw):
         raise NotImplementedError(
             "BigDL JVM models are not loadable without a JVM; rebuild the "

@@ -146,11 +146,24 @@ def from_hf_llama(model_or_path, dtype=None
     if not isinstance(hf, LlamaForCausalLM):
         hf = LlamaForCausalLM.from_pretrained(model_or_path)
     cfg = hf.config
+    if getattr(cfg, "attention_bias", False) or getattr(
+            cfg, "mlp_bias", False):
+        raise NotImplementedError(
+            "biased llama projections: this converter maps the "
+            "bias-free layout (use_bias=False); qwen2-style qkv bias "
+            "routes through from_hf_qwen2")
+    return _from_llama_family(hf, cfg, dtype, qkv_bias=False)
+
+
+def _from_llama_family(hf, cfg, dtype, qkv_bias: bool
+                       ) -> Tuple[TransformerLM, dict]:
+    """Shared llama-family conversion body (llama: bias-free; qwen2:
+    biased q/k/v)."""
     H = cfg.hidden_size
     heads = cfg.num_attention_heads
     D = H // heads
     kvh = getattr(cfg, "num_key_value_heads", heads)
-    # function-changing knobs fail loud (same policy as GPT-2 above)
+    # function-changing knobs fail loud (same policy as GPT-2)
     if getattr(cfg, "rope_scaling", None):
         raise NotImplementedError(
             f"rope_scaling={cfg.rope_scaling!r}: TransformerLM applies "
@@ -159,11 +172,6 @@ def from_hf_llama(model_or_path, dtype=None
         raise NotImplementedError(
             f"head_dim={cfg.head_dim} != hidden/heads={D}: "
             f"TransformerLM derives head dim from hidden_size")
-    if getattr(cfg, "attention_bias", False) or getattr(
-            cfg, "mlp_bias", False):
-        raise NotImplementedError(
-            "biased llama projections: this converter maps the "
-            "bias-free layout (use_bias=False)")
     if getattr(cfg, "hidden_act", "silu") != "silu":
         raise NotImplementedError(
             f"hidden_act {cfg.hidden_act!r}: TransformerLM's SwiGLU "
@@ -180,7 +188,7 @@ def from_hf_llama(model_or_path, dtype=None
         dtype=dtype, pos_encoding="rope",
         rope_base=float(getattr(cfg, "rope_theta", 10000.0)),
         num_kv_heads=kvh, norm="rmsnorm", mlp="swiglu",
-        use_bias=False, tied_head=tied,
+        use_bias=False, qkv_bias=qkv_bias, tied_head=tied,
         ln_eps=float(cfg.rms_norm_eps))
 
     sd = hf.state_dict()
@@ -196,24 +204,49 @@ def from_hf_llama(model_or_path, dtype=None
         params["lm_head"] = {"kernel": lin("lm_head.weight")}
     for i in range(cfg.num_hidden_layers):
         pre = f"model.layers.{i}."
+        attn = {
+            "query": {"kernel": lin(pre + "self_attn.q_proj.weight")
+                      .reshape(H, heads, D)},
+            "key": {"kernel": lin(pre + "self_attn.k_proj.weight")
+                    .reshape(H, kvh, D)},
+            "value": {"kernel": lin(pre + "self_attn.v_proj.weight")
+                      .reshape(H, kvh, D)},
+            "attn_out": {"kernel": lin(pre + "self_attn.o_proj.weight")
+                         .reshape(heads, D, H)},
+        }
+        if qkv_bias:                # qwen2-style biased projections
+            attn["query"]["bias"] = _np(
+                sd[pre + "self_attn.q_proj.bias"]).reshape(heads, D)
+            attn["key"]["bias"] = _np(
+                sd[pre + "self_attn.k_proj.bias"]).reshape(kvh, D)
+            attn["value"]["bias"] = _np(
+                sd[pre + "self_attn.v_proj.bias"]).reshape(kvh, D)
         params[f"layer_{i}"] = {
             "ln_attn": {"scale": _np(sd[pre + "input_layernorm.weight"])},
             "ln_ffn": {"scale": _np(
                 sd[pre + "post_attention_layernorm.weight"])},
-            "attention": {
-                "query": {"kernel":
-                          lin(pre + "self_attn.q_proj.weight")
-                          .reshape(H, heads, D)},
-                "key": {"kernel": lin(pre + "self_attn.k_proj.weight")
-                        .reshape(H, kvh, D)},
-                "value": {"kernel": lin(pre + "self_attn.v_proj.weight")
-                          .reshape(H, kvh, D)},
-                "attn_out": {"kernel":
-                             lin(pre + "self_attn.o_proj.weight")
-                             .reshape(heads, D, H)},
-            },
+            "attention": attn,
             "ffn_gate": {"kernel": lin(pre + "mlp.gate_proj.weight")},
             "ffn_up": {"kernel": lin(pre + "mlp.up_proj.weight")},
             "ffn_down": {"kernel": lin(pre + "mlp.down_proj.weight")},
         }
     return model, {"params": params}
+
+
+def from_hf_qwen2(model_or_path, dtype=None
+                  ) -> Tuple[TransformerLM, dict]:
+    """Convert a HF ``Qwen2ForCausalLM`` — llama-shaped (rmsnorm,
+    SwiGLU, rope, GQA, untied or tied head) plus BIASED q/k/v
+    projections (``qkv_bias``)."""
+    import torch  # noqa: F401
+    from transformers import Qwen2ForCausalLM
+
+    hf = model_or_path
+    if not isinstance(hf, Qwen2ForCausalLM):
+        hf = Qwen2ForCausalLM.from_pretrained(model_or_path)
+    cfg = hf.config
+    if getattr(cfg, "use_sliding_window", False):
+        raise NotImplementedError(
+            "use_sliding_window=True: TransformerLM attends the full "
+            "causal window")
+    return _from_llama_family(hf, cfg, dtype, qkv_bias=True)

@@ -33,7 +33,8 @@ import logging
 import threading
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +46,22 @@ from analytics_zoo_tpu.models.lm import (TransformerLM,
                                          top_p_filter)
 
 logger = logging.getLogger("analytics_zoo_tpu")
+
+
+class _Req(NamedTuple):
+    """One waiting-queue entry — named fields, because positional
+    indexing across three consumers silently breaks when a field is
+    added."""
+
+    uri: str
+    prompt: np.ndarray
+    on_done: Optional[Callable]
+    on_error: Optional[Callable]
+    temperature: float
+    rng_seed: Optional[int]
+    max_new: int
+    prefix: Optional[int]
+    top_p: float
 
 
 @dataclass
@@ -238,7 +255,8 @@ class ContinuousEngine:
 
         # one compiled program per (n_ticks, sampled) pair — n_ticks is
         # bounded by ticks_per_step, so the cache stays small
-        self._step_cache: Dict[Tuple[int, bool], Callable] = {}
+        self._step_cache: Dict[Tuple[int, bool, bool],
+                               Callable] = {}
 
         def get_step(n: int, sampled: bool,
                      use_topp: bool = False) -> Callable:
@@ -580,9 +598,9 @@ class ContinuousEngine:
             raise ValueError(
                 f"max_new {mn} outside [1, {self.max_new_tokens}]")
         with self._lock:
-            self._waiting.append(
-                (uri, prompt, on_done, on_error, float(temperature),
-                 rng_seed, mn, prefix, float(top_p)))
+            self._waiting.append(_Req(
+                uri, prompt, on_done, on_error, float(temperature),
+                rng_seed, mn, prefix, float(top_p)))
 
     # ---- pump ---------------------------------------------------------
 
@@ -602,14 +620,15 @@ class ContinuousEngine:
             by_bucket: Dict[int, list] = {}
             by_prefix: Dict[Tuple[int, int], list] = {}
             for req in batch:
-                if req[7] is not None:      # prefix-cached request
+                if req.prefix is not None:  # prefix-cached request
                     with self._lock:
-                        P = self._prefixes.get(req[7], (None, None, 0)
-                                               )[2]
-                    sb = self._suffix_width(len(req[1]), P)
-                    by_prefix.setdefault((req[7], sb), []).append(req)
+                        P = self._prefixes.get(req.prefix,
+                                               (None, None, 0))[2]
+                    sb = self._suffix_width(len(req.prompt), P)
+                    by_prefix.setdefault((req.prefix, sb),
+                                         []).append(req)
                     continue
-                pb = _next_bucket(len(req[1]), self.prompt_buckets)
+                pb = _next_bucket(len(req.prompt), self.prompt_buckets)
                 by_bucket.setdefault(pb, []).append(req)
             for (pid, sb), reqs in by_prefix.items():
                 try:
@@ -619,7 +638,7 @@ class ContinuousEngine:
                         "prefix admission failed for %d request(s), "
                         "prefix %s", len(reqs), pid)
                     for req in reqs:
-                        self._req_error(req[0], req[3], e)
+                        self._req_error(req.uri, req.on_error, e)
             for pb, reqs in by_bucket.items():
                 # a failed prefill/splice must not swallow requests that
                 # already left the waiting queue: surface each one to
@@ -630,8 +649,8 @@ class ContinuousEngine:
                     padded = np.full((kb, pb), self.pad_id, np.int32)
                     plens = np.ones(kb, np.int32)   # dummy rows: len 1
                     for i, req in enumerate(reqs):
-                        padded[i, :len(req[1])] = req[1]
-                        plens[i] = len(req[1])
+                        padded[i, :len(req.prompt)] = req.prompt
+                        plens[i] = len(req.prompt)
                     pre = self._prefill(jnp.asarray(padded),
                                         jnp.asarray(plens))
                     if self.draft_model is not None:
@@ -642,15 +661,15 @@ class ContinuousEngine:
                         "prefill failed for %d request(s), bucket %d",
                         len(reqs), pb)
                     for req in reqs:
-                        self._req_error(req[0], req[3], e)
+                        self._req_error(req.uri, req.on_error, e)
                     continue
                 for i, req in enumerate(reqs):
                     try:
                         self._splice_one(pre, i, req)
                         admitted += 1
                     except Exception as e:
-                        logger.exception("splice failed for %r", req[0])
-                        self._req_error(req[0], req[3], e)
+                        logger.exception("splice failed for %r", req.uri)
+                        self._req_error(req.uri, req.on_error, e)
         return admitted
 
     @staticmethod
@@ -702,8 +721,8 @@ class ContinuousEngine:
         padded = np.full((kb, sb), self.pad_id, np.int32)
         lens = np.ones(kb, np.int32)
         for i, req in enumerate(reqs):
-            padded[i, :len(req[1])] = req[1]
-            lens[i] = len(req[1])
+            padded[i, :len(req.prompt)] = req.prompt
+            lens[i] = len(req.prompt)
         real = [self._free.popleft() for _ in range(n)]
         slots = real + [self._S] * (kb - n)
         try:
@@ -719,17 +738,19 @@ class ContinuousEngine:
             raise
         admitted = 0
         for i, req in enumerate(reqs):
-            uri, suffix, on_done, on_error, temp, seed, mn = req[:7]
-            tp = req[8]
             try:
                 plen = P + int(lens[i])
-                first = self._pick_first(last[i], plen, temp, seed, tp)
-                self._install_slot(real[i], uri, plen, mn, on_done,
-                                   on_error, temp, seed, first, tp)
+                first = self._pick_first(last[i], plen,
+                                         req.temperature, req.rng_seed,
+                                         req.top_p)
+                self._install_slot(real[i], req.uri, plen, req.max_new,
+                                   req.on_done, req.on_error,
+                                   req.temperature, req.rng_seed,
+                                   first, req.top_p)
                 admitted += 1
             except Exception as e:
                 self._free.append(real[i])
-                self._req_error(uri, on_error, e)
+                self._req_error(req.uri, req.on_error, e)
         return admitted
 
     def _install_slot(self, slot, uri, plen, mn, on_done, on_error,
@@ -751,8 +772,9 @@ class ContinuousEngine:
         """Insert one prefetched joiner into a free slot; the slot goes
         back to the free list if the splice fails."""
         last_logits, ks, vs = pre[0], pre[1], pre[2]
-        uri, prompt, on_done, on_error, temp, seed, mn = req[:7]
-        tp = req[8]
+        uri, prompt = req.uri, req.prompt
+        temp, seed, tp = req.temperature, req.rng_seed, req.top_p
+        mn, on_done, on_error = req.max_new, req.on_done, req.on_error
         slot = self._free.popleft()
         try:
             self._ck, self._cv = self._insert(

@@ -608,21 +608,26 @@ class ClusterServing:
         # control fields are NEVER model inputs: discovered columns
         # treating e.g. a stray `prefix` id as a second input would make
         # pre_pad read it as per-row prompt lengths — silently wrong
-        # generations.  (The continuous pump handles these fields; here
-        # the unsupported ones error-publish per request below.)
-        control = {"uri", "prefix", "max_new", "temperature",
-                   "seed", "top_p"}
+        # generations.  The continuous pump honors these fields; the
+        # batch path cannot (its one scan runs every row identically),
+        # so a request carrying any of them error-publishes rather than
+        # silently serving different semantics than asked for.
+        control = {"prefix", "max_new", "temperature", "seed", "top_p"}
         cols = self.config.input_cols or \
-            [k for k in requests[0] if k not in control]
+            [k for k in requests[0] if k != "uri" and k not in control]
         per_req: List[Optional[List[np.ndarray]]] = [None] * len(requests)
 
         def decode_req(i_req):
             i, r = i_req
             try:
-                if "prefix" in r:
+                present = sorted(control & set(
+                    k.decode() if isinstance(k, bytes) else k
+                    for k in r))
+                if present:
                     raise ValueError(
-                        "prefix-cached requests need continuous_batching:"
-                        " true (the batch path has no prefix arena)")
+                        f"per-request controls {present} need "
+                        f"continuous_batching: true (the batch path "
+                        f"runs every row identically)")
                 per_req[i] = [self._decode_value(r[c]) for c in cols]
             except Exception as e:
                 self._publish_error(r, f"decode failed: {e!r}")

@@ -175,3 +175,54 @@ def test_llama_guards_fail_loud():
     with pytest.raises(NotImplementedError, match="hidden_act"):
         from_hf_llama(LlamaForCausalLM(LlamaConfig(
             **base, hidden_act="gelu")))
+
+
+# ---- qwen2 (llama family + biased q/k/v) -------------------------------
+
+def test_qwen2_logit_parity_and_generation():
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from analytics_zoo_tpu.net.hf_net import from_hf_qwen2
+
+    torch.manual_seed(0)
+    cfg = Qwen2Config(vocab_size=96, hidden_size=32,
+                      intermediate_size=88, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, rms_norm_eps=1e-5,
+                      attention_dropout=0.0, tie_word_embeddings=False)
+    hf = Qwen2ForCausalLM(cfg).eval()
+    model, variables = from_hf_qwen2(hf)
+    assert model.qkv_bias is True and not model.use_bias
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 96, (3, 11)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits.numpy()
+    ours = np.asarray(model.apply(variables,
+                                  jnp.asarray(toks.astype(np.int32))))
+    assert np.abs(ref - ours).max() < 1e-4
+    np.testing.assert_array_equal(ref.argmax(-1), ours.argmax(-1))
+    # cached decode with biased projections: generation agreement
+    from analytics_zoo_tpu.models.lm import generate
+
+    prompt = rng.integers(1, 96, (2, 6)).astype(np.int32)
+    out = np.asarray(generate(model, variables, jnp.asarray(prompt), 5))
+    with torch.no_grad():
+        gref = hf.generate(torch.tensor(prompt.astype(np.int64)),
+                           max_new_tokens=5, do_sample=False,
+                           pad_token_id=0)[:, 6:].numpy()
+    np.testing.assert_array_equal(out, gref)
+
+
+def test_qwen2_sliding_window_fails_loud():
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from analytics_zoo_tpu.net.hf_net import from_hf_qwen2
+
+    cfg = Qwen2Config(vocab_size=32, hidden_size=16,
+                      intermediate_size=32, num_hidden_layers=1,
+                      num_attention_heads=2,
+                      max_position_embeddings=64,
+                      use_sliding_window=True, sliding_window=8,
+                      max_window_layers=0)
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        from_hf_qwen2(Qwen2ForCausalLM(cfg))
