@@ -110,6 +110,14 @@ class Net:
         return from_hf_llama(model_or_path, dtype=dtype)
 
     @staticmethod
+    def load_hf_mistral(model_or_path, dtype=None):
+        """A HuggingFace Mistral (non-windowed) -> ``(TransformerLM,
+        variables)`` via the llama family (net/hf_net.py)."""
+        from analytics_zoo_tpu.net.hf_net import from_hf_mistral
+
+        return from_hf_mistral(model_or_path, dtype=dtype)
+
+    @staticmethod
     def load_hf_qwen2(model_or_path, dtype=None):
         """A HuggingFace Qwen2 (``Qwen2ForCausalLM`` instance or local
         path) -> ``(TransformerLM, variables)``: the llama family plus

@@ -146,13 +146,15 @@ def from_hf_llama(model_or_path, dtype=None
     if not isinstance(hf, LlamaForCausalLM):
         hf = LlamaForCausalLM.from_pretrained(model_or_path)
     cfg = hf.config
-    if getattr(cfg, "attention_bias", False) or getattr(
-            cfg, "mlp_bias", False):
+    if getattr(cfg, "mlp_bias", False):
         raise NotImplementedError(
-            "biased llama projections: this converter maps the "
-            "bias-free layout (use_bias=False); qwen2-style qkv bias "
-            "routes through from_hf_qwen2")
-    return _from_llama_family(hf, cfg, dtype, qkv_bias=False)
+            "mlp_bias=True llama projections are not mapped "
+            "(TransformerLM's SwiGLU is bias-free)")
+    # attention_bias=True (community llamas) is the qwen2 layout —
+    # the shared body handles it directly
+    return _from_llama_family(
+        hf, cfg, dtype,
+        qkv_bias=bool(getattr(cfg, "attention_bias", False)))
 
 
 def _from_llama_family(hf, cfg, dtype, qkv_bias: bool
@@ -233,6 +235,28 @@ def _from_llama_family(hf, cfg, dtype, qkv_bias: bool
     return model, {"params": params}
 
 
+def from_hf_mistral(model_or_path, dtype=None
+                    ) -> Tuple[TransformerLM, dict]:
+    """Convert a HF ``MistralForCausalLM`` — llama-shaped when its
+    sliding window is off (None or >= the position budget); windowed
+    attention is not replicated and fails loud."""
+    import torch  # noqa: F401
+    from transformers import MistralForCausalLM
+
+    hf = model_or_path
+    if not isinstance(hf, MistralForCausalLM):
+        hf = MistralForCausalLM.from_pretrained(model_or_path)
+    cfg = hf.config
+    sw = getattr(cfg, "sliding_window", None)
+    if sw is not None and sw < cfg.max_position_embeddings:
+        raise NotImplementedError(
+            f"sliding_window={sw} < max_position "
+            f"{cfg.max_position_embeddings}: TransformerLM attends the "
+            f"full causal window (a windowed checkpoint would silently "
+            f"attend differently)")
+    return _from_llama_family(hf, cfg, dtype, qkv_bias=False)
+
+
 def from_hf_qwen2(model_or_path, dtype=None
                   ) -> Tuple[TransformerLM, dict]:
     """Convert a HF ``Qwen2ForCausalLM`` — llama-shaped (rmsnorm,
@@ -245,8 +269,14 @@ def from_hf_qwen2(model_or_path, dtype=None
     if not isinstance(hf, Qwen2ForCausalLM):
         hf = Qwen2ForCausalLM.from_pretrained(model_or_path)
     cfg = hf.config
-    if getattr(cfg, "use_sliding_window", False):
+    # HF qwen2 windows only layers with idx >= max_window_layers: the
+    # guard fires only when some layer would ACTUALLY window
+    if (getattr(cfg, "use_sliding_window", False)
+            and getattr(cfg, "max_window_layers", 0)
+            < cfg.num_hidden_layers
+            and (getattr(cfg, "sliding_window", None) or 0)
+            < cfg.max_position_embeddings):
         raise NotImplementedError(
-            "use_sliding_window=True: TransformerLM attends the full "
-            "causal window")
+            "use_sliding_window=True with windowed layers: "
+            "TransformerLM attends the full causal window")
     return _from_llama_family(hf, cfg, dtype, qkv_bias=True)

@@ -615,14 +615,16 @@ class ClusterServing:
         control = {"prefix", "max_new", "temperature", "seed", "top_p"}
         cols = self.config.input_cols or \
             [k for k in requests[0] if k != "uri" and k not in control]
+        # a model may LEGITIMATELY have an input named e.g.
+        # "temperature" (explicit input_cols); only fields that are not
+        # inputs count as controls here
+        reject = control - set(cols)
         per_req: List[Optional[List[np.ndarray]]] = [None] * len(requests)
 
         def decode_req(i_req):
             i, r = i_req
             try:
-                present = sorted(control & set(
-                    k.decode() if isinstance(k, bytes) else k
-                    for k in r))
+                present = sorted(reject & set(r))
                 if present:
                     raise ValueError(
                         f"per-request controls {present} need "

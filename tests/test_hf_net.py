@@ -226,3 +226,33 @@ def test_qwen2_sliding_window_fails_loud():
                       max_window_layers=0)
     with pytest.raises(NotImplementedError, match="sliding_window"):
         from_hf_qwen2(Qwen2ForCausalLM(cfg))
+
+
+def test_mistral_parity_and_window_guard():
+    from transformers import MistralConfig, MistralForCausalLM
+
+    from analytics_zoo_tpu.net.hf_net import from_hf_mistral
+
+    torch.manual_seed(0)
+    cfg = MistralConfig(vocab_size=96, hidden_size=32,
+                        intermediate_size=88, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        max_position_embeddings=64, sliding_window=None,
+                        attention_dropout=0.0,
+                        tie_word_embeddings=False)
+    hf = MistralForCausalLM(cfg).eval()
+    model, variables = from_hf_mistral(hf)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 96, (2, 9)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks)).logits.numpy()
+    ours = np.asarray(model.apply(variables,
+                                  jnp.asarray(toks.astype(np.int32))))
+    assert np.abs(ref - ours).max() < 1e-4
+    np.testing.assert_array_equal(ref.argmax(-1), ours.argmax(-1))
+    wcfg = MistralConfig(vocab_size=32, hidden_size=16,
+                         intermediate_size=32, num_hidden_layers=1,
+                         num_attention_heads=2,
+                         max_position_embeddings=64, sliding_window=8)
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        from_hf_mistral(MistralForCausalLM(wcfg))
