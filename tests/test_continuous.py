@@ -907,3 +907,51 @@ def test_engine_per_request_top_p_matches_generate(lm):
         rng=jax.random.key(21), top_p=0.7))[0]
     np.testing.assert_array_equal(results["np"], solo_plain)
     np.testing.assert_array_equal(results["tp"], solo_tp)
+
+
+def test_http_frontend_generation_controls_continuous(lm):
+    """HTTP → continuous engine with per-request controls: arbitrary
+    instance fields ride InputQueue.enqueue into engine.submit, so
+    max_new / temperature / seed / top_p work over plain JSON."""
+    import http.client
+    import json as _json
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving import (ClusterServing, HttpFrontend,
+                                           ServingConfig)
+
+    model, variables = lm
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=6, prompt_buckets=(8,))
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=2)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = None
+    try:
+        fe = HttpFrontend(redis_port=srv.port, timeout=40,
+                          serving=srv).start()
+        rng = np.random.default_rng(13)
+        p = rng.integers(1, 32, 5).astype(np.int32)
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=60)
+        conn.request("POST", "/predict", _json.dumps({"instances": [
+            {"tokens": p.tolist(), "max_new": 2},
+            {"tokens": p.tolist(), "temperature": 0.9, "seed": 33,
+             "top_p": 0.8},
+        ]}), {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        preds = _json.loads(resp.read())["predictions"]
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(p[None]), 6))[0]
+        np.testing.assert_array_equal(
+            np.asarray(preds[0], np.int32), solo[:2])
+        solo_s = np.asarray(generate(
+            model, variables, jnp.asarray(p[None]), 6, temperature=0.9,
+            rng=jax.random.key(33), top_p=0.8))[0]
+        np.testing.assert_array_equal(
+            np.asarray(preds[1], np.int32), solo_s)
+    finally:
+        if fe is not None:
+            fe.stop()
+        srv.stop()
