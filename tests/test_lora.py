@@ -175,3 +175,18 @@ def test_target_paths_selects_expected():
     assert "layer_0/ffn_up/kernel" in paths
     assert "layer_1/attention/value/kernel" in paths
     assert not any("embed" in p for p in paths)     # embeddings frozen
+
+
+def test_lora_with_gradient_accumulation():
+    """LoRA composes with accum_steps: the merge happens inside
+    _forward, so the microbatched loss path trains adapters and keeps
+    the base frozen exactly like the plain step."""
+    est = Estimator.from_flax(
+        model=_lm(), loss=lm_loss, optimizer=optax.adamw(1e-2),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES, lora=LoRAConfig(rank=4),
+        config={"accum_steps": 2})
+    hist = est.fit(_data(), epochs=3, batch_size=8)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    base, lora = split_lora(jax.device_get(est.state.params))
+    assert any(float(np.abs(ab["b"]).max()) > 0 for ab in lora.values())
