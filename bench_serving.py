@@ -196,14 +196,21 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
 
 
 def run_poisson_scenario(continuous: bool, rate_per_s: float,
-                         n_requests: int, slots: int = 8) -> dict:
+                         n_requests: int, slots: int = 8,
+                         prefix_mode: str = "none") -> dict:
     """Open-loop mixed generative workload: requests arrive at Poisson
     times (not closed-loop clients), 80% short prompts / 20% long, all
     wanting 32 tokens.  The metric that separates the two serving modes
     is SHORT-request p50: under micro-batching a short prompt convoys
     behind the whole co-batched generation (plus the previous batch),
     while continuous batching admits it into the running decode arena
-    and publishes it the moment it finishes."""
+    and publishes it the moment it finishes.
+
+    ``prefix_mode`` (continuous only) benchmarks prefix caching on a
+    system-prompt workload (every request = a shared 24-token prefix +
+    its own short suffix): "full" ships the concatenated prompt every
+    time, "cached" registers the prefix once and ships only suffixes —
+    the delta is the per-request prefill the cache amortises away."""
     import queue as _q
 
     import jax
@@ -219,7 +226,8 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     variables = model.init(jax.random.key(0), np.zeros((1, 32), np.int32))
     im = InferenceModel(batch_buckets=(1, 8, slots))
     im.load_flax_generator(model, variables, max_new_tokens=32,
-                           prompt_buckets=(8, 32))
+                           prompt_buckets=(8, 32)
+                           if prefix_mode == "none" else (8, 32, 80))
     cfg = ServingConfig(prompt_col="tokens", batch_size=slots,
                         batch_timeout_ms=4.0,
                         continuous_batching=continuous,
@@ -230,15 +238,36 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     serving = ClusterServing(im, cfg, embedded_broker=True).start()
     inq = InputQueue(port=serving.port)
     rng = np.random.default_rng(11)
-    short = [rng.integers(1, 8192, int(rng.integers(4, 9))).astype(
-        np.int32) for _ in range(16)]
-    long_ = [rng.integers(1, 8192, int(rng.integers(24, 33))).astype(
-        np.int32) for _ in range(16)]
+    pid = None
+    PFX = 64                    # the win scales with prefix length
+    if prefix_mode != "none":
+        assert continuous, "prefix_mode needs the continuous engine"
+        system = rng.integers(1, 8192, PFX).astype(np.int32)
+        if prefix_mode == "cached":
+            pid = serving.register_prefix(system)
+        # system-prompt workload: all requests share the prefix; the
+        # suffixes are short
+        short = [np.concatenate([system, rng.integers(
+            1, 8192, int(rng.integers(4, 9))).astype(np.int32)])
+            for _ in range(16)]
+        long_ = short
+    else:
+        short = [rng.integers(1, 8192, int(rng.integers(4, 9))).astype(
+            np.int32) for _ in range(16)]
+        long_ = [rng.integers(1, 8192, int(rng.integers(24, 33))).astype(
+            np.int32) for _ in range(16)]
+
+    def enqueue_req(uri, p):
+        if pid is not None:
+            # ship ONLY the suffix; the engine splices the cached prefix
+            inq.enqueue(uri, tokens=p[PFX:], prefix=np.int32(pid))
+        else:
+            inq.enqueue(uri, tokens=p)
 
     # warm both compile paths through the real serving loop
     wq = OutputQueue(port=serving.port)
-    inq.enqueue("warm-s", tokens=short[0])
-    inq.enqueue("warm-l", tokens=long_[0])
+    enqueue_req("warm-s", short[0])
+    enqueue_req("warm-l", long_[0])
     wq.query("warm-s", timeout=600)
     wq.query("warm-l", timeout=600)
 
@@ -281,7 +310,7 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
         uri = f"r{i}"
         kinds[uri] = "short" if is_short else "long"
         enq_t[uri] = time.perf_counter()
-        inq.enqueue(uri, tokens=p)
+        enqueue_req(uri, p)
         uris.put(uri)
         time.sleep(float(rng.exponential(1.0 / rate_per_s)))
     for _ in waiters:
@@ -300,8 +329,11 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
         return round(float(np.percentile(a, q)) * 1e3, 2) if a.size \
             else None
 
+    name = "lm-poisson-cb" if continuous else "lm-poisson"
+    if prefix_mode != "none":
+        name = f"lm-prefix-{prefix_mode}"
     return {
-        "model": "lm-poisson-cb" if continuous else "lm-poisson",
+        "model": name,
         "mode": "continuous" if continuous else "microbatch",
         "rate_per_s": rate_per_s,
         "requests": len(lat),
@@ -322,6 +354,12 @@ PLAN = [("resnet18", 64, 10, 64),
         # open-loop Poisson mixed workload: clients = rate (req/s),
         # rpc = total requests; convoy vs continuous head-to-head
         ("lm-poisson", 12, 150, 8), ("lm-poisson-cb", 12, 150, 8),
+        # system-prompt workload: concatenated-every-time vs prefix
+        # cache (the delta = per-request prefill amortised away).  NOTE:
+        # at toy scale on a CPU host the cached row can read SLOWER
+        # (per-admission dispatch overhead dominates the tiny prefill it
+        # saves); the claim is for real prefill costs — judge on TPU.
+        ("lm-prefix-full", 12, 120, 8), ("lm-prefix-cached", 12, 120, 8),
         ("lm", 16, 10, 32), ("lm-spec", 16, 10, 32),
         ("lm", 64, 5, 32), ("lm", 1, 20, 32),
         ("mlp", 256, 50, 128), ("mlp", 64, 50, 128),
@@ -475,7 +513,11 @@ def _one():
 
     kind, clients, rpc, bs = (sys.argv[2], int(sys.argv[3]),
                               int(sys.argv[4]), int(sys.argv[5]))
-    if kind.startswith("lm-poisson"):
+    if kind.startswith("lm-prefix"):
+        r = run_poisson_scenario(True, rate_per_s=clients,
+                                 n_requests=rpc, slots=bs,
+                                 prefix_mode=kind.split("-")[-1])
+    elif kind.startswith("lm-poisson"):
         r = run_poisson_scenario(kind.endswith("-cb"), rate_per_s=clients,
                                  n_requests=rpc, slots=bs)
     else:
