@@ -207,10 +207,12 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     and publishes it the moment it finishes.
 
     ``prefix_mode`` (continuous only) benchmarks prefix caching on a
-    system-prompt workload (every request = a shared 24-token prefix +
-    its own short suffix): "full" ships the concatenated prompt every
-    time, "cached" registers the prefix once and ships only suffixes —
-    the delta is the per-request prefill the cache amortises away."""
+    system-prompt workload (every request = one shared PFX-token prefix
+    + its own short suffix — one request class, so only the short_*
+    percentiles are reported): "full" ships the concatenated prompt
+    every time, "cached" registers the prefix once and ships only
+    suffixes — the delta is the per-request prefill the cache amortises
+    away."""
     import queue as _q
 
     import jax
@@ -332,7 +334,7 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     name = "lm-poisson-cb" if continuous else "lm-poisson"
     if prefix_mode != "none":
         name = f"lm-prefix-{prefix_mode}"
-    return {
+    out = {
         "model": name,
         "mode": "continuous" if continuous else "microbatch",
         "rate_per_s": rate_per_s,
@@ -340,9 +342,16 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
         "req_per_sec": round(len(lat) / wall, 1),
         "short_p50_ms": pct("short", 50),
         "short_p90_ms": pct("short", 90),
-        "long_p50_ms": pct("long", 50),
-        "long_p90_ms": pct("long", 90),
     }
+    if prefix_mode == "none":
+        # prefix rows have ONE request class; a long_* percentile there
+        # would read as long-prompt latency when it is just a random
+        # subsample of the identical workload
+        out["long_p50_ms"] = pct("long", 50)
+        out["long_p90_ms"] = pct("long", 90)
+    else:
+        out["prefix_tokens"] = PFX
+    return out
 
 
 # scenario plan, most-informative-first (the claims a judge needs —
