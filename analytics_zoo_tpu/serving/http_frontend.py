@@ -83,10 +83,28 @@ class HttpFrontend:
                  timeout: float = 30.0,
                  certfile: Optional[str] = None,
                  keyfile: Optional[str] = None,
-                 serving=None):
+                 serving=None, tokenizer=None,
+                 prompt_col: Optional[str] = None):
         self.redis_host, self.redis_port = redis_host, redis_port
         self.timeout = timeout
         self.serving = serving          # optional ClusterServing for stats
+        # text-in / text-out generative serving: a ``tokenizers``
+        # Tokenizer instance or a tokenizer.json path.  Instances with a
+        # "text" field encode into the prompt column; their results
+        # decode back to strings (trimmed at the serving eos, if set).
+        if isinstance(tokenizer, str):
+            from tokenizers import Tokenizer
+
+            tokenizer = Tokenizer.from_file(tokenizer)
+        self.tokenizer = tokenizer
+        # fallback mirrors server.py's continuous pump ("prompt") so an
+        # unset ServingConfig.prompt_col yields ONE shared default
+        self.prompt_col = prompt_col or (
+            serving.config.prompt_col if serving is not None
+            and getattr(serving.config, "prompt_col", None)
+            else "prompt")
+        self._eos_id = (serving.config.eos_id
+                        if serving is not None else None)
         self.latency = _Percentiles()
         # ThreadingHTTPServer spawns a fresh thread per connection, so
         # thread-local caching would never hit: pool the RESP client pairs
@@ -133,9 +151,34 @@ class HttpFrontend:
                         instances = req.get("instances")
                         if instances is None:
                             instances = [req]   # single-instance body
-                        decoded = [
-                            {k: _decode_value(v) for k, v in inst.items()}
-                            for inst in instances]
+                        text_rows = []
+                        decoded = []
+                        for inst in instances:
+                            if "text" in inst:
+                                if frontend.tokenizer is None:
+                                    raise ValueError(
+                                        "'text' instances need the "
+                                        "frontend started with "
+                                        "tokenizer=...")
+                                if frontend.prompt_col in inst:
+                                    raise ValueError(
+                                        f"instance carries BOTH 'text' "
+                                        f"and {frontend.prompt_col!r}: "
+                                        f"ambiguous prompt — send one")
+                                inst = dict(inst)
+                                ids = np.asarray(
+                                    frontend.tokenizer.encode(
+                                        str(inst.pop("text"))).ids,
+                                    np.int32)
+                                if ids.size == 0:
+                                    raise ValueError(
+                                        "text tokenized to zero tokens")
+                                inst[frontend.prompt_col] = ids
+                                text_rows.append(True)
+                            else:
+                                text_rows.append(False)
+                            decoded.append({k: _decode_value(v)
+                                            for k, v in inst.items()})
                         for inst in decoded:
                             if "uri" in inst:
                                 raise ValueError(
@@ -146,7 +189,7 @@ class HttpFrontend:
                         self._send(400,
                                    {"error": f"{type(e).__name__}: {e}"})
                         return
-                    preds = frontend._predict(decoded)
+                    preds = frontend._predict(decoded, text_rows)
                 except TimeoutError as e:
                     self._send(504, {"error": str(e)})
                     return
@@ -187,7 +230,7 @@ class HttpFrontend:
         pair[0].close()
         pair[1].close()
 
-    def _predict(self, decoded):
+    def _predict(self, decoded, text_rows=None):
         # instances are decoded by the handler BEFORE enqueueing anything
         # (payload errors -> 400 without leaving orphaned work behind);
         # failures in here are backend-side by construction
@@ -207,7 +250,19 @@ class HttpFrontend:
                     raise TimeoutError(
                         f"result for {uri} not ready within "
                         f"{self.timeout}s")
-                preds.append(np.asarray(r).tolist())
+                preds.append(np.asarray(r))
+            out = []
+            for i, p in enumerate(preds):
+                if text_rows and i < len(text_rows) and text_rows[i]:
+                    ids = p.astype(np.int64).ravel()
+                    if self._eos_id is not None:
+                        hits = np.nonzero(ids == self._eos_id)[0]
+                        if hits.size:
+                            ids = ids[:hits[0]]
+                    out.append(self.tokenizer.decode(ids.tolist()))
+                else:
+                    out.append(p.tolist())
+            preds = out
         except BaseException:
             # a failure may leave the RESP protocol state mid-message —
             # drop the pair rather than poisoning the pool

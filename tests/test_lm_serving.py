@@ -295,3 +295,85 @@ def test_batch_path_rejects_prefix_field():
         assert np.asarray(out).shape == (4,)
     finally:
         srv.stop()
+
+
+def _tiny_tokenizer(vocab_target=48):
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE(unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_target, special_tokens=["[UNK]", "[EOS]"])
+    tok.train_from_iterator(
+        ["the cat sat on the mat", "a dog ran fast", "cats and dogs"],
+        trainer)
+    return tok
+
+
+def test_http_text_in_text_out():
+    """Text serving: 'text' instances tokenize into the prompt column,
+    results decode back to strings (equal to decoding the solo
+    generation of the same ids); tensor instances in the same batch
+    stay arrays; text without a tokenizer is a 400."""
+    import http.client
+    import json
+
+    from analytics_zoo_tpu.serving import HttpFrontend
+
+    tok = _tiny_tokenizer()
+    V = tok.get_vocab_size()
+    model = TransformerLM(vocab_size=V + 8, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=64, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 8), np.int32))
+    im = InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=5, prompt_buckets=(8, 16))
+    cfg = ServingConfig(batch_size=8, batch_timeout_ms=30.0,
+                        prompt_col="tokens", prompt_pad_id=0)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = None
+    try:
+        fe = HttpFrontend(redis_port=srv.port, timeout=40, serving=srv,
+                          tokenizer=tok).start()
+        text = "the cat ran"
+        ids = np.asarray(tok.encode(text).ids, np.int32)
+        arr_prompt = np.asarray([3, 4, 5], np.int32)
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=60)
+        conn.request("POST", "/predict", json.dumps({"instances": [
+            {"text": text},
+            {"tokens": arr_prompt.tolist()},
+        ]}), {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        preds = json.loads(resp.read())["predictions"]
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(ids[None]), 5))[0]
+        assert preds[0] == tok.decode(solo.astype(np.int64).tolist())
+        solo2 = np.asarray(generate(model, variables,
+                                    jnp.asarray(arr_prompt[None]), 5))[0]
+        np.testing.assert_array_equal(np.asarray(preds[1], np.int32),
+                                      solo2)
+        # both text and tokens in one instance -> ambiguous, 400
+        conn3 = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                           timeout=30)
+        conn3.request("POST", "/predict", json.dumps(
+            {"text": "hi", "tokens": [1, 2]}),
+            {"Content-Type": "application/json"})
+        assert conn3.getresponse().status == 400
+        # no tokenizer configured -> 400, not a backend error
+        fe2 = HttpFrontend(redis_port=srv.port, timeout=10,
+                           serving=srv).start()
+        try:
+            conn2 = http.client.HTTPConnection("127.0.0.1", fe2.port,
+                                               timeout=30)
+            conn2.request("POST", "/predict", json.dumps(
+                {"text": "hi"}), {"Content-Type": "application/json"})
+            assert conn2.getresponse().status == 400
+        finally:
+            fe2.stop()
+    finally:
+        if fe is not None:
+            fe.stop()
+        srv.stop()
