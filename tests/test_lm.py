@@ -830,3 +830,32 @@ def test_top_p_sampling():
                             rng=jax.random.key(2), top_p=0.8))
     assert a.min() >= 0 and a.max() < 64
     assert not np.array_equal(a, b)
+
+
+def test_top_p_filter_edges():
+    """Unit edges of the nucleus filter: argmax always survives, the
+    kept set is the smallest reaching p, disabled values pass through
+    untouched, and per-row thresholds broadcast."""
+    import numpy as np
+
+    from analytics_zoo_tpu.models.lm import top_p_filter
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # p=0.6: {0.5} reaches only 0.5 < 0.6 so token 1 joins; tokens 2,3 cut
+    out = np.asarray(top_p_filter(logits, jnp.float32(0.6)))[0]
+    assert np.isfinite(out[0]) and np.isfinite(out[1])
+    assert np.isneginf(out[2]) and np.isneginf(out[3])
+    # tiny p: only the argmax survives
+    out = np.asarray(top_p_filter(logits, jnp.float32(1e-9)))[0]
+    assert np.isfinite(out[0]) and np.isneginf(out[1:]).all()
+    # disabled (>=1 and <=0): bit-identical pass-through
+    for p in (1.0, 0.0, 1.5):
+        np.testing.assert_array_equal(
+            np.asarray(top_p_filter(logits, jnp.float32(p))),
+            np.asarray(logits))
+    # per-row thresholds: row 0 disabled, row 1 collapses to argmax
+    two = jnp.concatenate([logits, logits])
+    ps = jnp.asarray([[1.0], [1e-9]], jnp.float32)
+    out = np.asarray(top_p_filter(two, ps))
+    np.testing.assert_array_equal(out[0], np.asarray(logits)[0])
+    assert np.isneginf(out[1, 1:]).all() and np.isfinite(out[1, 0])
