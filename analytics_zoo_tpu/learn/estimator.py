@@ -86,6 +86,7 @@ class FlaxEstimator:
         model_dir: Optional[str] = None,
         param_loss: Optional[Callable] = None,
         lora=None,
+        initial_variables=None,
     ):
         self.model = self._maybe_convert_torch(model)
         # Optional penalty over the param tree (keras-API W_regularizer
@@ -97,6 +98,9 @@ class FlaxEstimator:
         # LoRA (learn/lora.py): adapters join the params tree under
         # __lora__, the optimizer is masked to them, and _forward merges
         # W + scale·A@B before apply — one transform, every model.
+        # pretrained weights to seed instead of random init (HF imports,
+        # Estimator.save exports): a {'params': ...} tree or bare params
+        self._initial_variables = initial_variables
         self.lora = lora
         if lora is not None:
             from analytics_zoo_tpu.learn.lora import wrap_optimizer
@@ -416,11 +420,47 @@ class FlaxEstimator:
         self._state_sharding = state_sharding(self.mesh, shapes, self.rules)
         self.state = jax.jit(
             init_fn, out_shardings=self._state_sharding)()
+        if self._initial_variables is not None:
+            self._seed_initial_params()
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree.leaves(self.state.params))
         logger.info("initialised %s params=%s mesh=%s",
                     type(self.model).__name__, f"{n_params:,}",
                     dict(self.mesh.shape))
+
+    def _seed_initial_params(self):
+        """Replace the random init with caller-provided weights
+        (initial_variables) — each leaf keeps its dtype AND sharding,
+        and shape mismatches fail loud naming the problem.  With LoRA,
+        the seeded tree is the FROZEN BASE (adapters keep their fresh
+        init)."""
+        src = self._initial_variables
+        if isinstance(src, dict) and "params" in src:
+            src = src["params"]
+        params = self.state.params
+        if self.lora is not None:
+            from analytics_zoo_tpu.learn.lora import LORA_KEY
+
+            params = dict(params)
+            base = {k: v for k, v in params.items() if k != LORA_KEY}
+            shapes_dst = jax.tree.map(lambda x: tuple(x.shape), base)
+        else:
+            base = params
+            shapes_dst = jax.tree.map(lambda x: tuple(x.shape), base)
+        shapes_src = jax.tree.map(lambda x: tuple(np.asarray(x).shape),
+                                  src)
+        if shapes_dst != shapes_src:
+            raise ValueError(
+                "initial_variables do not match the model's param "
+                "shapes — wrong checkpoint for this architecture?")
+        seeded = jax.tree.map(
+            lambda dst, s: jax.device_put(
+                np.asarray(s).astype(dst.dtype), dst.sharding),
+            base, src)
+        if self.lora is not None:
+            seeded = dict(seeded)
+            seeded[LORA_KEY] = self.state.params[LORA_KEY]
+        self.state = self.state.replace(params=seeded)
 
     # ------------------------------------------------------------------
     # observability (SURVEY §5; ref: KerasNet.set_tensorboard ->

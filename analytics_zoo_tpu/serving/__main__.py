@@ -14,13 +14,17 @@ import sys
 import threading
 
 
-def main(argv=None):
+def main(argv=None, block=True):
     ap = argparse.ArgumentParser(
         prog="python -m analytics_zoo_tpu.serving",
         description="Start a Cluster Serving job from a config.yaml")
     ap.add_argument("config", help="path to config.yaml")
     ap.add_argument("--embedded-broker", action="store_true",
                     help="run the bundled RESP broker in-process")
+    ap.add_argument("--http-port", type=int, default=None,
+                    help="also start the HTTP frontend (ref: "
+                         "FrontEndApp) on this port (0 = an ephemeral "
+                         "port, printed in the banner)")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform (e.g. cpu) — env vars "
                          "are too late once sitecustomize imports jax")
@@ -40,13 +44,38 @@ def main(argv=None):
     # clean shutdown rather than the SIGTERM default
     serving = ClusterServing.from_config(
         args.config, embedded_broker=args.embedded_broker).start()
+    frontend = None
+    if args.http_port is not None:
+        from analytics_zoo_tpu.serving import HttpFrontend
+
+        try:
+            frontend = HttpFrontend(
+                redis_host=serving.config.redis_host,
+                redis_port=serving.port, http_port=args.http_port,
+                serving=serving).start()
+        except BaseException:
+            # a bind failure (port in use) must not abandon the already-
+            # started serving loop / broker / decode pool
+            serving.stop()
+            raise
     stop = threading.Event()
+    banner = (f"serving up on {serving.config.redis_host}:"
+              f"{serving.port}"
+              + (f", http on :{frontend.port}" if frontend else "")
+              + " (Ctrl-C to stop)")
+
+    def shutdown():
+        if frontend is not None:
+            frontend.stop()
+        serving.stop()
+
+    if not block:       # tests drive the assembled stack directly
+        return serving, frontend, shutdown
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    print(f"serving up on {serving.config.redis_host}:"
-          f"{serving.port} (Ctrl-C to stop)", flush=True)
+    print(banner, flush=True)
     stop.wait()
-    serving.stop()
+    shutdown()
     return 0
 
 

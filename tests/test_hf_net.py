@@ -256,3 +256,55 @@ def test_mistral_parity_and_window_guard():
                          max_position_embeddings=64, sliding_window=8)
     with pytest.raises(NotImplementedError, match="sliding_window"):
         from_hf_mistral(MistralForCausalLM(wcfg))
+
+
+def test_estimator_initial_variables_seeding(hf_pair):
+    """from_flax(initial_variables=...) replaces the random init with
+    the imported weights — plain AND as the frozen LoRA base — and
+    shape mismatches fail loud."""
+    import optax
+
+    from analytics_zoo_tpu.learn import Estimator, LoRAConfig
+    from analytics_zoo_tpu.learn.lora import LORA_KEY
+    from analytics_zoo_tpu.models import LM_PARTITION_RULES, lm_loss
+
+    hf, model, variables = hf_pair
+    rng = np.random.default_rng(3)
+    data = {"tokens": rng.integers(0, 96, (16, 12)).astype(np.int32)}
+    # plain: the estimator's params ARE the imported weights
+    est = Estimator.from_flax(
+        model=model, loss=lm_loss, optimizer=optax.adamw(1e-3),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES,
+        initial_variables=variables)
+    est._ensure_state({k: v[:8] for k, v in data.items()})
+    for (p0, l0), (p1, l1) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                variables["params"])[0],
+            jax.tree_util.tree_flatten_with_path(est.state.params)[0]):
+        np.testing.assert_allclose(np.asarray(l0), np.asarray(l1),
+                                   rtol=0, atol=0)
+    # LoRA: imported weights become the frozen base; adapters fresh
+    est2 = Estimator.from_flax(
+        model=model, loss=lm_loss, optimizer=optax.adamw(1e-2),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES,
+        initial_variables=variables, lora=LoRAConfig(rank=4))
+    hist = est2.fit(data, epochs=2, batch_size=8)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    base = {k: v for k, v in
+            jax.device_get(est2.state.params).items() if k != LORA_KEY}
+    for (p0, l0), (p1, l1) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                variables["params"])[0],
+            jax.tree_util.tree_flatten_with_path(base)[0]):
+        np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    # wrong checkpoint: loud failure
+    bad = jax.tree.map(lambda x: np.zeros((2, 2), np.float32),
+                       variables["params"])
+    est3 = Estimator.from_flax(
+        model=model, loss=lm_loss, optimizer=optax.adamw(1e-3),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        initial_variables={"params": bad})
+    with pytest.raises(ValueError, match="do not match"):
+        est3._ensure_state({k: v[:8] for k, v in data.items()})

@@ -665,3 +665,45 @@ class TestFromConfig:
             "params:\n  continuous_batching: true\n")
         with pytest.raises(ValueError, match="load_flax_generator"):
             ClusterServing.from_config(str(cfgp))
+
+
+def test_cli_http_port_serves_over_http(tmp_path):
+    """cluster-serving-start --http-port: one command line assembles
+    broker + serving loop + HTTP frontend from a config.yaml."""
+    import http.client
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_openvino import _mlp_ir
+
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.serving.__main__ import main
+
+    rng = np.random.default_rng(6)
+    xml, (w1, b1, w2) = _mlp_ir(tmp_path, rng)
+    cfgp = tmp_path / "config.yaml"
+    cfgp.write_text(f"model:\n  path: {xml}\n"
+                    "params:\n  batch_size: 8\n")
+    serving, frontend, shutdown = main(
+        [str(cfgp), "--embedded-broker", "--http-port", "0"],
+        block=False)
+    try:
+        assert frontend is not None and frontend.port > 0
+        x = rng.normal(size=(4,)).astype(np.float32)
+        conn = http.client.HTTPConnection("127.0.0.1", frontend.port,
+                                          timeout=30)
+        conn.request("POST", "/predict",
+                     json.dumps({"instances": [{"x": x.tolist()}]}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        got = np.asarray(json.loads(resp.read())["predictions"][0])
+        h = np.maximum(x[None] @ w1 + b1, 0.0)
+        ref = np.asarray(jax.nn.softmax(jnp.asarray(h @ w2), axis=1))[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    finally:
+        shutdown()
