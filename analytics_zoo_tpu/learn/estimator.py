@@ -418,49 +418,94 @@ class FlaxEstimator:
 
         shapes = jax.eval_shape(init_fn)
         self._state_sharding = state_sharding(self.mesh, shapes, self.rules)
-        self.state = jax.jit(
-            init_fn, out_shardings=self._state_sharding)()
         if self._initial_variables is not None:
-            self._seed_initial_params()
+            self.state = self._build_seeded_state(shapes, seed)
+        else:
+            self.state = jax.jit(
+                init_fn, out_shardings=self._state_sharding)()
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree.leaves(self.state.params))
         logger.info("initialised %s params=%s mesh=%s",
                     type(self.model).__name__, f"{n_params:,}",
                     dict(self.mesh.shape))
 
-    def _seed_initial_params(self):
-        """Replace the random init with caller-provided weights
-        (initial_variables) — each leaf keeps its dtype AND sharding,
-        and shape mismatches fail loud naming the problem.  With LoRA,
-        the seeded tree is the FROZEN BASE (adapters keep their fresh
-        init)."""
-        src = self._initial_variables
-        if isinstance(src, dict) and "params" in src:
-            src = src["params"]
-        params = self.state.params
-        if self.lora is not None:
-            from analytics_zoo_tpu.learn.lora import LORA_KEY
+    def _build_seeded_state(self, shapes, seed):
+        """Build the train state DIRECTLY from caller-provided weights
+        (initial_variables) — the random base init is never materialised
+        (a full throwaway tree would double peak HBM at exactly the
+        large-checkpoint imports this serves).  Each leaf lands with the
+        state's dtype and sharding; shape mismatches fail loud naming
+        the problem.  With LoRA the seeded tree is the FROZEN BASE and
+        adapters get their usual fresh init (same seed-derived values as
+        the unseeded path).  A source tree saved from a LoRA run may
+        carry a ``__lora__`` subtree — it is DROPPED (seed
+        ``merged_params()`` instead to bake adapters in)."""
+        from analytics_zoo_tpu.learn.lora import LORA_KEY, init_lora
 
-            params = dict(params)
-            base = {k: v for k, v in params.items() if k != LORA_KEY}
-            shapes_dst = jax.tree.map(lambda x: tuple(x.shape), base)
-        else:
-            base = params
-            shapes_dst = jax.tree.map(lambda x: tuple(x.shape), base)
+        src = self._initial_variables
+        src_extra = {}
+        if isinstance(src, dict) and "params" in src:
+            src_extra = {k: v for k, v in src.items() if k != "params"}
+            src = src["params"]
+        if isinstance(src, dict) and LORA_KEY in src:
+            src = {k: v for k, v in src.items() if k != LORA_KEY}
+
+        dst_params = shapes.params
+        if self.lora is not None:
+            dst_params = {k: v for k, v in dst_params.items()
+                          if k != LORA_KEY}
+        shapes_dst = jax.tree.map(lambda x: tuple(x.shape), dst_params)
         shapes_src = jax.tree.map(lambda x: tuple(np.asarray(x).shape),
                                   src)
         if shapes_dst != shapes_src:
             raise ValueError(
                 "initial_variables do not match the model's param "
                 "shapes — wrong checkpoint for this architecture?")
-        seeded = jax.tree.map(
-            lambda dst, s: jax.device_put(
-                np.asarray(s).astype(dst.dtype), dst.sharding),
-            base, src)
-        if self.lora is not None:
-            seeded = dict(seeded)
-            seeded[LORA_KEY] = self.state.params[LORA_KEY]
-        self.state = self.state.replace(params=seeded)
+        # batch-stats models (BatchNorm): fresh running statistics under
+        # pretrained weights silently corrupt inference — require them
+        if shapes.batch_stats is not None and "batch_stats" not in \
+                src_extra:
+            raise ValueError(
+                "this model carries batch_stats (BatchNorm running "
+                "statistics); initial_variables must include them "
+                "(pass the full saved variables, not just params) — "
+                "fresh statistics under pretrained weights would "
+                "silently corrupt inference")
+
+        pspec = self._state_sharding.params
+        base_spec = ({k: v for k, v in pspec.items() if k != LORA_KEY}
+                     if self.lora is not None else pspec)
+        params_dev = jax.tree.map(
+            lambda dst, sh, s: jax.device_put(
+                np.asarray(s).astype(dst.dtype), sh),
+            dst_params, base_spec, src)
+        bs_dev = None
+        if shapes.batch_stats is not None:
+            bs_dev = jax.tree.map(
+                lambda dst, sh, s: jax.device_put(
+                    np.asarray(s).astype(dst.dtype), sh),
+                shapes.batch_stats, self._state_sharding.batch_stats,
+                src_extra["batch_stats"])
+
+        lora_cfg = self.lora
+
+        def assemble(params, batch_stats):
+            root = jax.random.key(seed)
+            _, train_rng = jax.random.split(root)
+            if lora_cfg is not None:
+                params = {**params,
+                          LORA_KEY: init_lora(params, lora_cfg,
+                                              jax.random.fold_in(root,
+                                                                 2))}
+            variables = {"params": params}
+            if batch_stats is not None:
+                variables["batch_stats"] = batch_stats
+            return create_train_state(train_rng, self.model.apply,
+                                      variables, self.tx)
+
+        return jax.jit(assemble,
+                       out_shardings=self._state_sharding,
+                       static_argnames=())(params_dev, bs_dev)
 
     # ------------------------------------------------------------------
     # observability (SURVEY §5; ref: KerasNet.set_tensorboard ->

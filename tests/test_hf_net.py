@@ -308,3 +308,68 @@ def test_estimator_initial_variables_seeding(hf_pair):
         initial_variables={"params": bad})
     with pytest.raises(ValueError, match="do not match"):
         est3._ensure_state({k: v[:8] for k, v in data.items()})
+
+
+def test_initial_variables_lora_export_and_batch_stats(hf_pair):
+    """A source tree saved from a LoRA run (carrying __lora__) seeds by
+    dropping the adapters; a BatchNorm model refuses params-only
+    seeding (fresh running stats under pretrained weights would corrupt
+    inference) and accepts full variables."""
+    import flax.linen as nn
+    import optax
+
+    from analytics_zoo_tpu.learn import Estimator, LoRAConfig
+    from analytics_zoo_tpu.models import LM_PARTITION_RULES, lm_loss
+
+    _, model, variables = hf_pair
+    rng = np.random.default_rng(4)
+    data = {"tokens": rng.integers(0, 96, (16, 10)).astype(np.int32)}
+    lora_est = Estimator.from_flax(
+        model=model, loss=lm_loss, optimizer=optax.adamw(1e-3),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES,
+        initial_variables=variables, lora=LoRAConfig(rank=4))
+    lora_est.fit(data, epochs=1, batch_size=8)
+    exported = {"params": jax.device_get(lora_est.state.params)}
+    assert "__lora__" in exported["params"]
+    # seeding a fresh (non-LoRA) estimator from the LoRA export works —
+    # adapters dropped, base preserved exactly
+    est = Estimator.from_flax(
+        model=model, loss=lm_loss, optimizer=optax.adamw(1e-3),
+        feature_cols=("tokens",), label_cols=("tokens",),
+        partition_rules=LM_PARTITION_RULES, initial_variables=exported)
+    est._ensure_state({k: v[:8] for k, v in data.items()})
+    for (p0, l0), (p1, l1) in zip(
+            jax.tree_util.tree_flatten_with_path(
+                variables["params"])[0],
+            jax.tree_util.tree_flatten_with_path(
+                jax.device_get(est.state.params))[0]):
+        np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+
+    # BatchNorm model: params-only seeding is refused loudly
+    class BN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Dense(8)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(2)(x)
+
+    bn = BN()
+    v = bn.init(jax.random.key(0), np.zeros((4, 4), np.float32))
+    xd = {"x": rng.normal(size=(16, 4)).astype(np.float32),
+          "y": rng.integers(0, 2, 16).astype(np.int32)}
+    bad = Estimator.from_flax(
+        model=BN(), loss="sparse_categorical_crossentropy",
+        optimizer=optax.adam(1e-3), feature_cols=("x",),
+        label_cols=("y",), initial_variables={"params": v["params"]})
+    with pytest.raises(ValueError, match="batch_stats"):
+        bad._ensure_state({k: val[:8] for k, val in xd.items()})
+    good = Estimator.from_flax(
+        model=BN(), loss="sparse_categorical_crossentropy",
+        optimizer=optax.adam(1e-3), feature_cols=("x",),
+        label_cols=("y",), initial_variables=v)
+    good.fit(xd, epochs=1, batch_size=8)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(v["batch_stats"])[0]).shape,
+        np.asarray(jax.tree.leaves(
+            jax.device_get(good.state.batch_stats))[0]).shape)
