@@ -49,6 +49,20 @@ from analytics_zoo_tpu.parallel.partition import (
 from jax.sharding import PartitionSpec as P
 
 
+def _cpu_sync_every(mesh) -> int:
+    """Dispatch-drift barrier interval for MULTI-device XLA:CPU meshes
+    (0 = no barrier).  XLA:CPU's in-process collectives kill the process
+    when one participant misses a 40 s rendezvous window; with many
+    virtual devices on few host cores, a long unsynchronised dispatch
+    queue lets per-device execution drift that far (observed ~30 async
+    steps on an 8-device mesh on a 1-core host).  Single-device runs
+    have no rendezvous, and TPU runs must not pay a mid-epoch D2H
+    round-trip — both stay barrier-free."""
+    if jax.default_backend() != "cpu":
+        return 0
+    return 8 if mesh.devices.size > 1 else 0
+
+
 def _model_accepts(model, kwarg: str) -> bool:
     try:
         sig = inspect.signature(type(model).__call__)
@@ -716,6 +730,7 @@ class FlaxEstimator:
     def _fit_epochs(self, epochs, it, batch_size, validation_data, trigger,
                     mlog, prof, history, log_every, callbacks):
         prof_active = False
+        sync_every = _cpu_sync_every(self.mesh)
         for _ in range(epochs):
             t0 = time.perf_counter()
             n_steps = 0
@@ -735,6 +750,8 @@ class FlaxEstimator:
                 step_mets.append(mets)
                 n_steps += 1
                 self._global_step += 1
+                if sync_every and n_steps % sync_every == 0:
+                    jax.block_until_ready(mets["loss"])
                 if prof_active and self._global_step >= prof[1] + prof[2]:
                     jax.block_until_ready(mets["loss"])
                     jax.profiler.stop_trace()
@@ -925,6 +942,7 @@ class FlaxEstimator:
         acc = EpochAccumulator()
         stream = self._local_eval_stream(data, per_host, arrays)
         mets_list, counts = [], []
+        sync_every = _cpu_sync_every(self.mesh)
         for j, chunk in enumerate(
                 _padded_chunks(stream, plan and plan[0], sample)):
             real = len(next(iter(chunk.values())))
@@ -935,6 +953,11 @@ class FlaxEstimator:
             # keep metrics on-device: blocking here would serialise eval
             # steps and pay a device round-trip per chunk
             mets_list.append(self._jit_eval_step(self.state, gbatch, gw))
+            # ...except on the multi-device CPU mesh, where an
+            # unbounded dispatch queue can breach XLA:CPU's 40 s
+            # collective-rendezvous wall (_cpu_sync_every)
+            if sync_every and len(mets_list) % sync_every == 0:
+                jax.block_until_ready(mets_list[-1])
             # exact global row count per chunk: the zero-weight padding
             # rows never enter the metric averages
             counts.append(real if plan is None else plan[1][j])
