@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test verify examples bench native clean
+.PHONY: test verify examples bench native serve-smoke clean
 
 # full suite on the 8-virtual-device CPU mesh (tests/conftest.py forces it)
 test:
@@ -35,6 +35,13 @@ native:
 # one-chip benchmark suite (writes the driver-facing JSON line)
 bench:
 	$(PY) bench.py
+
+# paged serving smoke: the paged KV-cache test file + a 20-request e2e
+# wire-protocol bench leg, both forced onto host CPU (fast; fits the
+# tier-1 timeout)
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
 
 clean:
 	rm -rf build dist *.egg-info analytics_zoo_tpu/native/*.so

@@ -328,7 +328,12 @@ class InferenceModel:
                                eos_id: Optional[int] = None,
                                ticks_per_step: int = 1,
                                cache_dtype=None,
-                               mesh=None, partition_rules=None):
+                               mesh=None, partition_rules=None,
+                               paged: bool = False,
+                               block_size: int = 16,
+                               n_blocks: Optional[int] = None,
+                               hbm_fraction: Optional[float] = None,
+                               enable_prefix_cache: bool = True):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
         loaded via ``load_flax_generator`` (quantized weights dequantize
         once at build — the engine trades the at-rest memory win for
@@ -336,7 +341,13 @@ class InferenceModel:
 
         ``mesh`` (with a ``tp`` axis) serves models beyond one chip's
         HBM: weights + KV arena shard over tp (docs/serving.md
-        'tp-sharded generation')."""
+        'tp-sharded generation').
+
+        ``paged=True`` swaps the per-slot KV arena for the block-pool
+        cache (serving/paged_cache.py: pay-as-you-grow block
+        allocation, automatic prefix sharing, preemption-to-queue —
+        docs/serving_memory.md); ``block_size``/``n_blocks``/
+        ``hbm_fraction``/``enable_prefix_cache`` size and tune it."""
         from analytics_zoo_tpu.serving.continuous import ContinuousEngine
 
         if getattr(self, "_gen_max_new_tokens", None) is None:
@@ -360,7 +371,10 @@ class InferenceModel:
             prompt_buckets=self._gen_prompt_buckets,
             eos_id=eos_id, pad_id=self.prompt_pad_id,
             ticks_per_step=ticks_per_step, cache_dtype=cache_dtype,
-            mesh=mesh, partition_rules=partition_rules, **spec)
+            mesh=mesh, partition_rules=partition_rules,
+            paged=paged, block_size=block_size, n_blocks=n_blocks,
+            hbm_fraction=hbm_fraction,
+            enable_prefix_cache=enable_prefix_cache, **spec)
 
     def load_openvino(self, xml_path: str, bin_path: str = None,
                       quantize: Optional[str] = None) -> "InferenceModel":

@@ -481,6 +481,36 @@ class DecoderAttention(nn.Module):
         o = o.reshape(B, S, self._h, self._d)
         return self.attn_out(o.astype(self.dtype)), cache_k, cache_v
 
+    def decode_paged(self, xs, pool_k, pool_v, tables, pos):
+        """Cached decode of S tokens per row against a PAGED KV cache.
+
+        Same contract as :meth:`decode_k` except the cache is one flat
+        block pool shared by every resident: pool_k/pool_v ``[N, bs,
+        KH, D]``, tables ``[B, M]`` int32 mapping row b's logical block
+        j to a physical pool block (the serving BlockPool keeps
+        unallocated table entries pointed at the sink block 0).  xs:
+        [B, S, E]; pos: [B] int32, row b's tokens occupy logical
+        positions pos[b]..pos[b]+S-1.  S=1 is the plain decode step;
+        S>1 is the block-causal prefill/verify forward.  Returns (ys
+        [B, S, E], pool_k, pool_v) with the S new K/V rows scattered
+        through the tables (write precedes the attention read, so each
+        token attends itself).
+        """
+        from analytics_zoo_tpu.ops.flash_attention import (
+            paged_attention, paged_kv_update)
+
+        q = self.query(xs)                              # [B, S, H, D]
+        ks = self.key(xs)                               # [B, S, KH, D]
+        vs = self.value(xs)
+        if self.pos_encoding == "rope":
+            p = pos[:, None] + jnp.arange(xs.shape[1])[None, :]
+            q = _apply_rope(q, p, self.rope_base)
+            ks = _apply_rope(ks, p, self.rope_base)
+        pool_k, pool_v = paged_kv_update(pool_k, pool_v, tables, pos,
+                                         ks, vs)
+        o = paged_attention(q, pool_k, pool_v, tables, pos)
+        return self.attn_out(o.astype(self.dtype)), pool_k, pool_v
+
 
 class DecoderLayer(nn.Module):
     """Pre-LN causal decoder block (pre-LN trains stably at depth without
@@ -582,6 +612,14 @@ class DecoderLayer(nn.Module):
         xs = xs + a
         xs = xs + self._mlp(self.ln_ffn(xs).astype(self.dtype), False)
         return xs, ck, cv
+
+    def decode_paged(self, xs, pool_k, pool_v, tables, pos):
+        a, pk, pv = self.attention.decode_paged(
+            self.ln_attn(xs).astype(self.dtype), pool_k, pool_v,
+            tables, pos)
+        xs = xs + a
+        xs = xs + self._mlp(self.ln_ffn(xs).astype(self.dtype), False)
+        return xs, pk, pv
 
     def forward_kv(self, x, train: bool = False):
         """``__call__`` that also returns this layer's K/V ``[B, T, H,
@@ -879,6 +917,68 @@ class TransformerLM(nn.Module):
             x, ck, cv = layer.decode_k(x, caches_k[i], caches_v[i], pos)
             ks.append(ck)
             vs.append(cv)
+        return self.ln_f(x), jnp.stack(ks), jnp.stack(vs)
+
+    def decode_step_paged(self, tok, pools_k, pools_v, tables, pos):
+        """One cached decode step against a PAGED KV cache.
+
+        tok: [B] current tokens; pools_k/v: [n_layers, N, bs, kv_heads,
+        D] — ONE flat block pool per layer shared by all residents;
+        tables: [B, M] int32 per-row block tables (logical block j ->
+        physical pool block); pos: [B] int32 per-row positions.
+        Returns (logits [B, V], pools_k, pools_v) with each row's new
+        K/V written through its table at position pos[b] — attention
+        reads only logical positions <= pos[b], so garbage in
+        unwritten/sink blocks is never attended.
+        """
+        if self.pp_stages > 0:
+            raise NotImplementedError(
+                "cached decode is not pipelined; convert the params "
+                "with models.lm.unstack_pp_params and generate on a "
+                "pp_stages=0 TransformerLM of the same dimensions")
+        x = self.embed(tok)[:, None]
+        if self.pos_embed is not None:
+            x = x + self.pos_embed(pos)[:, None]
+        x = x.astype(self.dtype)
+        ks, vs = [], []
+        for i, layer in enumerate(self.layers):
+            x, pk, pv = layer.decode_paged(x, pools_k[i], pools_v[i],
+                                           tables, pos)
+            ks.append(pk)
+            vs.append(pv)
+        logits = self._logits(self.ln_f(x))[:, 0]
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def verify_step_paged(self, toks, pools_k, pools_v, tables, pos):
+        """``verify_step`` against a paged cache: S tokens per row in one
+        block-causal forward, K/V scattered through the block tables.
+        Returns (logits [B, S, V], pools_k, pools_v)."""
+        h, pk, pv = self.verify_hidden_paged(toks, pools_k, pools_v,
+                                             tables, pos)
+        return self._logits(h), pk, pv
+
+    def verify_hidden_paged(self, toks, pools_k, pools_v, tables, pos):
+        """``verify_step_paged`` minus the vocab head: (hidden [B, S,
+        H], pools).  The paged-admission prefill consumes ONE position
+        per row, gathers that hidden state, and applies the head to
+        [B, 1, H] — same logits-residency rationale as
+        :meth:`verify_hidden`."""
+        if self.pp_stages > 0:
+            raise NotImplementedError(
+                "verify_step is not pipelined (same restriction as "
+                "decode_step); convert with models.lm.unstack_pp_params")
+        B, S = toks.shape
+        x = self.embed(toks)
+        if self.pos_embed is not None:
+            p = pos[:, None] + jnp.arange(S)[None, :]
+            x = x + self.pos_embed(p)
+        x = x.astype(self.dtype)
+        ks, vs = [], []
+        for i, layer in enumerate(self.layers):
+            x, pk, pv = layer.decode_paged(x, pools_k[i], pools_v[i],
+                                           tables, pos)
+            ks.append(pk)
+            vs.append(pv)
         return self.ln_f(x), jnp.stack(ks), jnp.stack(vs)
 
     def prefill(self, tokens):

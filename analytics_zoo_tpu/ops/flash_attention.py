@@ -396,3 +396,92 @@ def sharded_flash_attention(q, k, v, mesh, kv_mask=None, *,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec, check_vma=False,
     )(q, k, v, kv_mask)
+
+
+# ---------------------------------------------------------------------------
+# paged attention (block-pool KV cache, serving/paged_cache.py)
+# ---------------------------------------------------------------------------
+
+def paged_kv_update(pool_k, pool_v, tables, pos, new_k, new_v):
+    """Scatter S new K/V rows per batch row into a block-pool cache.
+
+    pool_k/pool_v: ``[N, bs, KH, D]`` — the flat block arena (N physical
+    blocks of bs token positions each).  tables: ``[B, M]`` int32 — row
+    b's logical block j lives in physical block ``tables[b, j]``.
+    pos: ``[B]`` int32 — row b's tokens land at logical positions
+    ``pos[b] .. pos[b]+S-1``.  new_k/new_v: ``[B, S, KH, D]``.
+
+    Logical position p maps to (physical block ``tables[b, p // bs]``,
+    offset ``p % bs``); the scatter goes through ONE flattened
+    ``[N*bs, KH, D]`` index per tensor — positions whose logical block
+    index exceeds the table width clamp to the last table entry, which
+    the allocator keeps pointed at the sink block for anything
+    unallocated, so overshoot writes land in garbage space instead of a
+    live block.  Distinctness contract (the allocator's invariant, not
+    checked here): every (row, position) a caller actually cares about
+    maps to a PRIVATE tail block of that row, so real writes never
+    collide; sink-block collisions are garbage-on-garbage.
+    """
+    N, bs, KH, D = pool_k.shape
+    B, S = new_k.shape[:2]
+    M = tables.shape[1]
+    p = pos[:, None] + jnp.arange(S)[None, :]               # [B, S]
+    blk = jnp.minimum(p // bs, M - 1)
+    phys = jnp.take_along_axis(tables, blk, axis=1)         # [B, S]
+    flat_idx = phys * bs + (p % bs)                         # [B, S]
+    pk = pool_k.reshape(N * bs, KH, D).at[flat_idx].set(
+        new_k.astype(pool_k.dtype), mode="drop")
+    pv = pool_v.reshape(N * bs, KH, D).at[flat_idx].set(
+        new_v.astype(pool_v.dtype), mode="drop")
+    return pk.reshape(N, bs, KH, D), pv.reshape(N, bs, KH, D)
+
+
+def paged_attention(q, pool_k, pool_v, tables, pos):
+    """Block-causal attention of S query tokens per row against a PAGED
+    KV cache: keys/values are gathered through per-row block tables from
+    one flat ``[N, bs, KH, D]`` pool, so co-resident sequences share
+    physical blocks (prefix caching) and only occupy the blocks they
+    have actually filled.
+
+    q: ``[B, S, H, D]`` (already rope'd/scaled upstream conventions —
+    this op applies the 1/sqrt(D) scale itself, matching the dense
+    decode paths); pos: ``[B]`` int32, row b's queries sit at logical
+    positions ``pos[b] .. pos[b]+S-1`` and query j attends logical cache
+    positions ``<= pos[b]+j`` (its own K/V must already be in the pool —
+    call :func:`paged_kv_update` first; write-then-read inside one jit
+    is a plain data dependency).  ``KH <= H`` is grouped-query
+    attention: q regroups ``[B, S, KH, G, D]`` so each KV head serves
+    its G query heads without materialising expanded K/V.
+
+    Implementation is the ``jnp.take``-based fallback — one gather to
+    ``[B, M*bs, KH, D]`` rows then the same masked einsum-softmax the
+    dense decode path runs, f32 accumulation.  The gather costs the
+    bandwidth the attention read pays anyway; a fused Pallas kernel that
+    streams blocks HBM->VMEM without the materialised gather (the
+    flash-kernel structure above with a block-table indirection on the
+    k-grid) is the follow-on once measured to win on real HBM.
+    """
+    B, S, H, D = q.shape
+    N, bs, KH, _ = pool_k.shape
+    if H % KH:
+        raise ValueError(f"query heads {H} not a multiple of KV heads "
+                         f"{KH}")
+    G = H // KH
+    M = tables.shape[1]
+    L = M * bs
+    # [B, M] tables -> [B, M*bs(=L), KH, D] gathered rows: logical
+    # position l of row b is pool[tables[b, l // bs], l % bs]
+    cache_k = jnp.take(pool_k, tables, axis=0).reshape(B, L, KH, D)
+    cache_v = jnp.take(pool_v, tables, axis=0).reshape(B, L, KH, D)
+    p = pos[:, None] + jnp.arange(S)[None, :]               # [B, S]
+    mask = (jnp.arange(L)[None, None, :]
+            <= p[:, :, None])[:, None, None, :, :]          # [B,1,1,S,L]
+    scale = 1.0 / jnp.sqrt(jnp.float32(D))
+    qg = q.reshape(B, S, KH, G, D)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, cache_k,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask, logits, -jnp.inf)
+    w = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", w.astype(cache_v.dtype),
+                   cache_v, preferred_element_type=jnp.float32)
+    return o.reshape(B, S, H, D)

@@ -1,9 +1,10 @@
 from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+from analytics_zoo_tpu.serving.paged_cache import BlockPool
 from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.resp import RespClient, RespServer
 from analytics_zoo_tpu.serving.server import ClusterServing, ServingConfig
 from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
 
-__all__ = ["ContinuousEngine", "InputQueue", "OutputQueue", "RespClient",
-           "RespServer", "ClusterServing", "ServingConfig",
+__all__ = ["ContinuousEngine", "BlockPool", "InputQueue", "OutputQueue",
+           "RespClient", "RespServer", "ClusterServing", "ServingConfig",
            "HttpFrontend"]

@@ -44,6 +44,8 @@ from analytics_zoo_tpu.learn.inference_model import (
     _next_bucket, filter_prompt_buckets)
 from analytics_zoo_tpu.models.lm import (TransformerLM,
                                          top_p_filter)
+from analytics_zoo_tpu.serving.paged_cache import (BlockPool,
+                                                   SINK_BLOCK)
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -75,6 +77,12 @@ class _Slot:
     temperature: float = 0.0
     rng_seed: Optional[int] = None
     top_p: float = 0.0
+    # paged mode: the original request (requeued verbatim on
+    # preemption) and an admission sequence number (the preemption
+    # victim is always the LATEST admission — earliest admissions keep
+    # making forward progress, so preemption can never livelock)
+    req: Optional[_Req] = None
+    admit_seq: int = 0
 
 
 class ContinuousEngine:
@@ -88,6 +96,30 @@ class ContinuousEngine:
     arena buffers are donated through step/insert so XLA updates them in
     place instead of copying ``S*L`` of KV per token.
 
+    **KV memory.** The cache stores only ``model.kv_heads`` heads per
+    position: a grouped-query model (``num_kv_heads < num_heads``)
+    shrinks every resident's K/V ``num_heads/num_kv_heads``-fold, which
+    is proportionally more co-resident requests for the same HBM
+    (``capacity_report()`` quantifies it); ``cache_dtype`` narrows it
+    further (e.g. a bfloat16 cache under an f32 model halves it again —
+    attention upcasts via the einsums' f32 accumulation).
+
+    **``paged=True``** replaces the per-slot arena with a block-pool
+    cache (serving/paged_cache.py): K/V live in one flat pool of
+    ``block_size``-token blocks, each resident holds only the blocks it
+    has actually filled (via a per-slot block table), full prompt
+    blocks are hash-indexed so requests sharing a prompt prefix attach
+    to the same physical blocks copy-free (subsuming the manual
+    ``register_prefix`` splice), and when the pool runs dry the engine
+    PREEMPTS the latest admission back to the queue front instead of
+    OOMing — its partial tokens are discarded and regenerate
+    deterministically on readmission (greedy argmax, and sampled rows
+    fold the rng by absolute position).  ``cache_metrics()`` reports
+    occupancy/hit-rate/preemptions.  Paged limitations (ROADMAP open
+    items): no draft-model speculation, no mesh; paged
+    ``register_prefix`` must run before the pump starts (it updates
+    the donated pool buffers — racing a live ``step()`` is undefined).
+
     Not thread-safe by itself: ``submit`` may be called from any thread,
     but ``step``/``drain`` must run on ONE pump thread (the serving loop).
     """
@@ -100,7 +132,11 @@ class ContinuousEngine:
                  cache_dtype=None,
                  mesh=None, partition_rules=None,
                  draft_model: Optional[TransformerLM] = None,
-                 draft_variables=None, speculation_k: int = 4):
+                 draft_variables=None, speculation_k: int = 4,
+                 paged: bool = False, block_size: int = 16,
+                 n_blocks: Optional[int] = None,
+                 hbm_fraction: Optional[float] = None,
+                 enable_prefix_cache: bool = True):
         """``mesh`` (with a ``tp`` axis) serves a model LARGER than one
         chip's HBM: weights shard per ``partition_rules`` (default
         ``LM_PARTITION_RULES`` — Megatron layout), the KV arena shards
@@ -160,11 +196,96 @@ class ContinuousEngine:
         # reads upcast via the einsums' f32 accumulation).
         H = getattr(model, "kv_heads", model.num_heads)
         D = model.hidden_size // model.num_heads
-        cdtype = jnp.dtype(cache_dtype) if cache_dtype is not None \
-            else jnp.dtype(model.dtype)
+        # validate cache_dtype EAGERLY with a serving-level message — a
+        # bad value must not surface as a bare jnp.dtype TypeError deep
+        # inside arena allocation
+        if cache_dtype is None:
+            cdtype = jnp.dtype(model.dtype)
+        else:
+            try:
+                cdtype = jnp.dtype(cache_dtype)
+            except TypeError:
+                raise ValueError(
+                    f"cache_dtype {cache_dtype!r} is not a dtype the KV "
+                    f"cache can be allocated with; pass a floating "
+                    f"dtype like 'bfloat16' or 'float32' (or None to "
+                    f"follow model.dtype "
+                    f"{jnp.dtype(model.dtype).name})") from None
+            if not jnp.issubdtype(cdtype, jnp.floating):
+                raise ValueError(
+                    f"cache_dtype {cache_dtype!r} resolves to "
+                    f"{cdtype.name}, which is not a floating dtype — "
+                    f"K/V projections cannot be stored in it without "
+                    f"corrupting attention")
         self.mesh = mesh
+        # ---- paged mode (block-pool cache, serving/paged_cache.py) -----
+        self.paged = bool(paged)
+        self._preemptions = 0
+        self._peak_resident = 0
+        self._admit_seq = 0
+        self._pool: Optional[BlockPool] = None
+        self._pk = self._pv = None
+        self._paged_prefixes: Dict[int, tuple] = {}
+        if self.paged:
+            if draft_model is not None:
+                raise NotImplementedError(
+                    "paged + speculative decoding is a ROADMAP open "
+                    "item; build the paged engine without a draft")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "paged mode is single-chip for now (multi-replica "
+                    "routing is a ROADMAP open item); drop mesh")
+            bs = int(block_size)
+            if bs < 1:
+                raise ValueError(f"block_size must be >= 1, got {bs}")
+            M = -(-L // bs)         # logical blocks per row, ceil(L/bs)
+            if n_blocks is None:
+                per_block = 2 * model.num_layers * bs * H * D \
+                    * cdtype.itemsize
+                lim = 0
+                if hbm_fraction is not None:
+                    try:
+                        stats = jax.devices()[0].memory_stats() or {}
+                        lim = int(stats.get("bytes_limit", 0))
+                    except Exception:
+                        lim = 0
+                if lim:
+                    n_blocks = max(M + 1,
+                                   int(lim * float(hbm_fraction))
+                                   // per_block)
+                else:
+                    if hbm_fraction is not None:
+                        logger.warning(
+                            "hbm_fraction=%s ignored: device exposes no "
+                            "memory_stats (CPU backend?); sizing the "
+                            "pool arena-equivalent (S*M+1 blocks)",
+                            hbm_fraction)
+                    # arena-equivalent capacity: every slot can run to
+                    # full length — paged still wins whenever real
+                    # traffic doesn't (shorter prompts, prefix sharing)
+                    n_blocks = S * M + 1
+            n_blocks = int(n_blocks)
+            if n_blocks < M + 1:
+                raise ValueError(
+                    f"n_blocks={n_blocks} cannot hold one full-length "
+                    f"sequence: need >= {M + 1} ({M} logical blocks of "
+                    f"{bs} positions + the sink block 0)")
+            self._bs, self._M = bs, M
+            self._pool = BlockPool(n_blocks, bs, enable_prefix_cache)
+            # pool-mutation guard: admission/growth run on the pump
+            # thread, but unregister_prefix releases from client threads
+            self._pool_lock = threading.Lock()
+            self._pk = jnp.zeros((model.num_layers, n_blocks, bs, H, D),
+                                 cdtype)
+            self._pv = jnp.zeros_like(self._pk)
+            # per-slot block tables; SINK everywhere a row holds no
+            # block, so stray writes land in storage nothing attends
+            self._tables = np.full((S, M), SINK_BLOCK, np.int32)
+            self._row_blocks: List[List[int]] = [[] for _ in range(S)]
         tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
-        if tp > 1:
+        if self.paged:
+            self._ck = self._cv = None  # pool replaces the slot arena
+        elif tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from analytics_zoo_tpu.models.lm import LM_PARTITION_RULES
@@ -214,6 +335,33 @@ class ContinuousEngine:
 
         Lmax = L
 
+        def pick_next(logits, pos, done, temps, seeds, topps,
+                      use_sample, use_topp):
+            """One token per row from per-row logits — ONE definition so
+            the arena and paged step programs can never drift (their
+            greedy-parity guarantee depends on it).  Sampling folds the
+            rng by absolute position, so a preempted-and-readmitted row
+            regenerates identical tokens."""
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            if use_sample:              # static: greedy-only compile
+
+                def sample_row(seed, t, tp, lg, p):
+                    key = jax.random.fold_in(jax.random.key(seed), p)
+                    scaled = lg.astype(jnp.float32) / jnp.maximum(
+                        t, 1e-6)
+                    if use_topp:        # static: no sort when unused
+                        scaled = top_p_filter(scaled, tp)
+                    return jax.random.categorical(key, scaled).astype(
+                        jnp.int32)
+
+                sampled = jax.vmap(sample_row)(seeds, temps, topps,
+                                               logits, pos)
+                nxt = jnp.where(temps > 0.0, sampled, nxt)
+            if eos_id is not None:
+                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                done = done | (nxt == eos_id)
+            return nxt, done
+
         def step_fn(ck, cv, tok, pos, done, temps, seeds, topps,
                     n_ticks, use_sample, use_topp):
             """Advance every slot ``n_ticks`` tokens in ONE device call
@@ -228,30 +376,36 @@ class ContinuousEngine:
                 logits, ck, cv = model.apply(
                     variables, tok, ck, cv, pos,
                     method=TransformerLM.decode_step)
-                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
-                if use_sample:          # static: greedy-only compile
-
-                    def sample_row(seed, t, tp, lg, p):
-                        key = jax.random.fold_in(jax.random.key(seed), p)
-                        scaled = lg.astype(jnp.float32) / jnp.maximum(
-                            t, 1e-6)
-                        if use_topp:    # static: no sort when unused
-                            scaled = top_p_filter(scaled, tp)
-                        return jax.random.categorical(key, scaled).astype(
-                            jnp.int32)
-
-                    sampled = jax.vmap(sample_row)(seeds, temps, topps,
-                                                   logits, pos)
-                    nxt = jnp.where(temps > 0.0, sampled, nxt)
-                if eos_id is not None:
-                    nxt = jnp.where(done, jnp.int32(eos_id), nxt)
-                    done = done | (nxt == eos_id)
+                nxt, done = pick_next(logits, pos, done, temps, seeds,
+                                      topps, use_sample, use_topp)
                 pos = jnp.minimum(pos + 1, Lmax - 1)
                 return (nxt, pos, done, ck, cv), nxt
 
             (tok, pos, done, ck, cv), toks = jax.lax.scan(
                 one, (tok, pos, done, ck, cv), None, length=n_ticks)
             return toks, tok, pos, done, ck, cv
+
+        def step_fn_paged(pk, pv, tok, pos, done, tables, temps, seeds,
+                          topps, n_ticks, use_sample, use_topp):
+            """The paged twin of ``step_fn``: decode through per-slot
+            block tables against the shared pool.  Rows holding no
+            blocks (free/done slots — their table rows are all SINK)
+            write and read only the sink block's garbage, which their
+            frozen/ignored outputs never surface."""
+
+            def one(carry, _):
+                tok, pos, done, pk, pv = carry
+                logits, pk, pv = model.apply(
+                    variables, tok, pk, pv, tables, pos,
+                    method=TransformerLM.decode_step_paged)
+                nxt, done = pick_next(logits, pos, done, temps, seeds,
+                                      topps, use_sample, use_topp)
+                pos = jnp.minimum(pos + 1, Lmax - 1)
+                return (nxt, pos, done, pk, pv), nxt
+
+            (tok, pos, done, pk, pv), toks = jax.lax.scan(
+                one, (tok, pos, done, pk, pv), None, length=n_ticks)
+            return toks, tok, pos, done, pk, pv
 
         # one compiled program per (n_ticks, sampled) pair — n_ticks is
         # bounded by ticks_per_step, so the cache stays small
@@ -262,13 +416,36 @@ class ContinuousEngine:
                      use_topp: bool = False) -> Callable:
             key = (n, sampled, use_topp)
             if key not in self._step_cache:
+                fn = step_fn_paged if self.paged else step_fn
                 self._step_cache[key] = jax.jit(
-                    partial(step_fn, n_ticks=n, use_sample=sampled,
+                    partial(fn, n_ticks=n, use_sample=sampled,
                             use_topp=use_topp),
                     donate_argnums=(0, 1))
             return self._step_cache[key]
 
         self._get_step = get_step
+
+        def paged_admit_fn(pk, pv, suffixes, slens, tables, pos):
+            """Paged admission prefill: each row's (unshared) prompt
+            suffix runs block-causally against pool K/V its table
+            already maps — prefix-matched blocks behind ``pos`` read as
+            if this row had prefilled them itself.  Suffix padding
+            beyond ``slens`` writes dead K/V (sink or masked private
+            tail — decode overwrites each position before attending
+            it); padding ROWS carry all-sink tables.  Returns each
+            row's last-real-position logits (the head applied to [kb,
+            1, H] — never the [kb, sb, V] cube)."""
+            h, pk, pv = model.apply(
+                variables, suffixes, pk, pv, tables, pos,
+                method=TransformerLM.verify_hidden_paged)
+            last_h = jnp.take_along_axis(
+                h, (slens - 1)[:, None, None], axis=1)
+            logits = model.apply(variables, last_h,
+                                 method=TransformerLM._logits)[:, 0]
+            return logits, pk, pv
+
+        self._paged_admit = jax.jit(paged_admit_fn,
+                                    donate_argnums=(0, 1))
 
         def prefill_fn(prompts, plens):
             """Batched joiner prefill: [k, Pb] prompts in ONE forward
@@ -451,6 +628,33 @@ class ContinuousEngine:
         bytes per slot, total arena bytes, and the multiplier vs a
         full-head model-dtype arena of the same geometry."""
         m = self.model
+        if self.paged:
+            H = self._pk.shape[3]
+            D = self._pk.shape[4]
+            per_block = 2 * m.num_layers * self._bs * H * D \
+                * self._pk.dtype.itemsize
+            per_slot_max = per_block * self._M
+            arena_equiv = 2 * m.num_layers * self._L * H * D \
+                * self._pk.dtype.itemsize * self._S
+            return {
+                "mode": "paged",
+                "slots": self._S,
+                "cache_len": self._L,
+                "kv_heads": H,
+                "cache_dtype": str(self._pk.dtype),
+                "block_size": self._bs,
+                "n_blocks": self._pool.n_blocks,
+                "blocks_per_row_max": self._M,
+                "bytes_per_block": per_block,
+                "bytes_per_slot": per_slot_max,   # worst case; actual
+                # residency is pay-as-you-grow + shared prefixes
+                "arena_bytes": per_block * self._pool.n_blocks,
+                "arena_equivalent_bytes": arena_equiv,
+                "tp": 1,
+                "arena_bytes_per_chip": per_block * self._pool.n_blocks,
+                "draft_arena_bytes": 0,
+                "prefix_bytes": 0,  # pinned prefixes live IN the pool
+            }
         H_full = m.num_heads
         H = self._ck.shape[3]
         D = self._ck.shape[4]
@@ -519,6 +723,8 @@ class ContinuousEngine:
             raise ValueError(
                 f"prefix length {P} leaves no room for a suffix inside "
                 f"max prompt width {self.max_prompt_width}")
+        if self.paged:
+            return self._register_prefix_paged(tokens)
         _, ks, vs = self.model.apply(self._variables,
                                      jnp.asarray(tokens[None]),
                                      method=TransformerLM.prefill)
@@ -539,7 +745,20 @@ class ContinuousEngine:
         long-running server registering per-tenant prefixes must be able
         to evict them or HBM ratchets up forever.  In-flight requests
         already admitted keep their spliced copy; queued requests naming
-        the id will fail admission loudly."""
+        the id will fail admission loudly.
+
+        Paged mode: releases the pin on the prefix's blocks — they park
+        in the pool's LRU (still shareable by chain-hash lookups) until
+        allocation pressure actually evicts them."""
+        if self.paged:
+            with self._lock:
+                if pid not in self._paged_prefixes:
+                    raise ValueError(f"unknown prefix id {pid}")
+                _, blocks = self._paged_prefixes.pop(pid)
+            with self._pool_lock:
+                for b in blocks:
+                    self._pool.release(b)
+            return
         with self._lock:
             if pid not in self._prefixes:
                 raise ValueError(f"unknown prefix id {pid}")
@@ -569,9 +788,14 @@ class ContinuousEngine:
         n = len(prompt)
         if prefix is not None:
             with self._lock:
-                if prefix not in self._prefixes:
-                    raise ValueError(f"unknown prefix id {prefix}")
-                plen_pref = self._prefixes[prefix][2]
+                if self.paged:
+                    if prefix not in self._paged_prefixes:
+                        raise ValueError(f"unknown prefix id {prefix}")
+                    plen_pref = len(self._paged_prefixes[prefix][0])
+                else:
+                    if prefix not in self._prefixes:
+                        raise ValueError(f"unknown prefix id {prefix}")
+                    plen_pref = self._prefixes[prefix][2]
             # the TRUE prompt (prefix + suffix) must fit the prompt
             # budget; the padded suffix only needs to fit the cache
             # (_suffix_width handles that), so no bucket term here
@@ -610,6 +834,8 @@ class ContinuousEngine:
         to a power of two so a burst costs a handful of compiles, not
         one per burst size); their K/V splice into slots one
         dynamic_update_slice each.  Returns the number admitted."""
+        if self.paged:
+            return self._admit_paged()
         admitted = 0
         while self._free:
             with self._lock:
@@ -753,14 +979,295 @@ class ContinuousEngine:
                 self._req_error(req.uri, req.on_error, e)
         return admitted
 
+    # ---- paged mode (block-pool cache) --------------------------------
+
+    def _full_prompt(self, req: _Req) -> np.ndarray:
+        """The TRUE token sequence a paged request decodes: a
+        ``prefix=`` id expands to its registered tokens + the suffix —
+        the chain-hash index then shares the pinned blocks
+        automatically, subsuming the arena's device-side splice."""
+        if req.prefix is None:
+            return req.prompt
+        with self._lock:
+            if req.prefix not in self._paged_prefixes:
+                raise ValueError(f"prefix id {req.prefix} was "
+                                 f"unregistered while queued")
+            ptoks = self._paged_prefixes[req.prefix][0]
+        return np.concatenate([ptoks, req.prompt])
+
+    def _register_prefix_paged(self, tokens: np.ndarray) -> int:
+        """Pin a shared prefix's FULL blocks in the pool (ref held until
+        ``unregister_prefix``): prefill them once through the paged
+        path, publish their chain hashes, and store the tokens so
+        ``submit(prefix=id)`` requests concatenate host-side and match
+        the pinned blocks at admission.  The partial tail beyond the
+        last full block recomputes per request inside its suffix (a
+        partial block can never be shared — it would keep growing)."""
+        P = len(tokens)
+        bs = self._bs
+        nfull = P // bs
+        hashes = self._pool.block_hashes(tokens[:nfull * bs])
+        with self._pool_lock:
+            matched = self._pool.lookup(hashes)
+            for b in matched:
+                self._pool.acquire(b)
+            blocks = list(matched)
+            for _ in range(nfull - len(matched)):
+                b = self._pool.allocate()
+                if b is None:
+                    for bb in blocks:
+                        self._pool.release(bb)
+                    raise RuntimeError(
+                        f"block pool has no room to pin a {nfull}-block "
+                        f"prefix ({self._pool.num_referenced()} of "
+                        f"{self._pool.n_blocks} blocks referenced)")
+                blocks.append(b)
+        if len(matched) < nfull:
+            span = tokens[len(matched) * bs:nfull * bs]
+            sb = _next_bucket(len(span), self.prompt_buckets)
+            padded = np.full((1, sb), self.pad_id, np.int32)
+            padded[0, :len(span)] = span
+            tabs = np.full((1, self._M), SINK_BLOCK, np.int32)
+            tabs[0, :len(blocks)] = blocks
+            _, self._pk, self._pv = self._paged_admit(
+                self._pk, self._pv, jnp.asarray(padded),
+                jnp.asarray(np.array([len(span)], np.int32)),
+                jnp.asarray(tabs),
+                jnp.asarray(np.array([len(matched) * bs], np.int32)))
+            with self._pool_lock:
+                for j in range(len(matched), nfull):
+                    self._pool.insert(hashes[j], blocks[j])
+        with self._lock:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._paged_prefixes[pid] = (tokens, blocks)
+        return pid
+
+    def _admit_paged(self) -> int:
+        """Paged admission: per request, match leading FULL prompt
+        blocks in the chain-hash index (copy-free sharing), allocate
+        private blocks for the rest, and prefill only the unshared
+        suffix — grouped by suffix bucket so a burst costs one device
+        call per bucket.  A request the pool can't hold yet requeues at
+        the FRONT (order preserved) and admission stops — residents
+        finishing or preemption will free blocks.  The match length is
+        capped at ``(plen-1)//bs`` blocks so the LAST prompt token
+        always recomputes: its forward yields the first-token logits
+        (a 100% cache hit would leave nothing to run)."""
+        admitted = 0
+        while self._free:
+            with self._lock:
+                grab = min(len(self._free), len(self._waiting))
+                batch = [self._waiting.popleft() for _ in range(grab)]
+            if not batch:
+                break
+            plans, blocked = [], []
+            for req in batch:
+                if blocked:         # keep queue order behind the block
+                    blocked.append(req)
+                    continue
+                try:
+                    full = self._full_prompt(req)
+                except Exception as e:
+                    self._req_error(req.uri, req.on_error, e)
+                    continue
+                plen = len(full)
+                hashes = self._pool.block_hashes(full)
+                total = -(-plen // self._bs)
+                with self._pool_lock:
+                    matched = self._pool.lookup(
+                        hashes[:(plen - 1) // self._bs])
+                    need = total - len(matched)
+                    # +1 headroom: the first decode tokens must not
+                    # instantly preempt what admission just built
+                    if need + 1 > self._pool.n_blocks - 1:
+                        self._req_error(req.uri, req.on_error, ValueError(
+                            f"prompt needs {need} private blocks + "
+                            f"headroom but the pool holds "
+                            f"{self._pool.n_blocks - 1}"))
+                        continue
+                    if self._pool.allocatable() < need + 1:
+                        if (self.n_active == 0 and not plans
+                                and admitted == 0):
+                            # nothing in flight will ever free blocks:
+                            # only prefix pins hold the pool
+                            self._req_error(
+                                req.uri, req.on_error, RuntimeError(
+                                    f"pool dry with no residents: "
+                                    f"{self._pool.num_referenced()} of "
+                                    f"{self._pool.n_blocks} blocks are "
+                                    f"pinned (unregister a prefix or "
+                                    f"raise n_blocks)"))
+                            continue
+                        blocked.append(req)
+                        continue
+                    for b in matched:
+                        self._pool.acquire(b)
+                    blocks = list(matched)
+                    for _ in range(need):
+                        blocks.append(self._pool.allocate())
+                plans.append((req, full, hashes, len(matched), blocks))
+            if blocked:
+                with self._lock:
+                    for req in reversed(blocked):
+                        self._waiting.appendleft(req)
+            groups: Dict[int, list] = {}
+            for plan in plans:
+                slen = len(plan[1]) - plan[3] * self._bs
+                sb = _next_bucket(slen, self.prompt_buckets)
+                groups.setdefault(sb, []).append(plan)
+            for sb, plist in groups.items():
+                try:
+                    admitted += self._admit_paged_group(sb, plist)
+                except Exception as e:
+                    logger.exception("paged admission failed for %d "
+                                     "request(s)", len(plist))
+                    with self._pool_lock:
+                        for req, _, _, _, blocks in plist:
+                            for b in blocks:
+                                self._pool.release(b)
+                    for req, _, _, _, _ in plist:
+                        self._req_error(req.uri, req.on_error, e)
+            if blocked:
+                break
+        return admitted
+
+    def _admit_paged_group(self, sb: int, plans) -> int:
+        """One paged-prefill device call for every planned request
+        sharing a suffix bucket (rows padded to a power of two;
+        padding rows carry all-sink tables and touch nothing real).
+        After the call each row's full private prompt blocks are
+        published in the hash index, so the NEXT identical prompt
+        shares them."""
+        n = len(plans)
+        kb = 1 << (n - 1).bit_length()
+        padded = np.full((kb, sb), self.pad_id, np.int32)
+        lens = np.ones(kb, np.int32)
+        pos = np.zeros(kb, np.int32)
+        tabs = np.full((kb, self._M), SINK_BLOCK, np.int32)
+        for i, (req, full, hashes, n_match, blocks) in enumerate(plans):
+            sfx = full[n_match * self._bs:]
+            padded[i, :len(sfx)] = sfx
+            lens[i] = len(sfx)
+            pos[i] = n_match * self._bs
+            tabs[i, :len(blocks)] = blocks
+        last, self._pk, self._pv = self._paged_admit(
+            self._pk, self._pv, jnp.asarray(padded), jnp.asarray(lens),
+            jnp.asarray(tabs), jnp.asarray(pos))
+        admitted = 0
+        for i, (req, full, hashes, n_match, blocks) in enumerate(plans):
+            plen = len(full)
+            slot = self._free.popleft()
+            self._row_blocks[slot] = blocks
+            self._tables[slot, :] = SINK_BLOCK
+            self._tables[slot, :len(blocks)] = blocks
+            # publish BEFORE install: the prefill succeeded, so the
+            # blocks' content is valid for sharing even if this
+            # particular install fails below
+            with self._pool_lock:
+                for j in range(n_match, plen // self._bs):
+                    self._pool.insert(hashes[j], blocks[j])
+            try:
+                first = self._pick_first(last[i], plen,
+                                         req.temperature, req.rng_seed,
+                                         req.top_p)
+                self._install_slot(slot, req.uri, plen, req.max_new,
+                                   req.on_done, req.on_error,
+                                   req.temperature, req.rng_seed,
+                                   first, req.top_p, req=req)
+                admitted += 1
+            except Exception as e:
+                self._free.append(slot)
+                self._release_slot_blocks(slot)
+                self._req_error(req.uri, req.on_error, e)
+        return admitted
+
+    def _ensure_blocks(self, active) -> list:
+        """Grow each resident's block table to cover the positions the
+        coming chunk will write.  When the pool is dry, PREEMPT the
+        latest admission (never the oldest — earliest requests keep
+        strict forward progress, so this terminates): its blocks free
+        up, its request requeues at the queue front, and its tokens
+        regenerate deterministically on readmission.  Returns the
+        still-active subset."""
+        for i in list(active):
+            st = self._slots[i]
+            if st is None:
+                continue
+            ticks = max(1, min(self.ticks_per_step,
+                               st.max_new - len(st.tokens)))
+            last_write = min(int(self._pos[i]) + ticks - 1, self._L - 1)
+            need = last_write // self._bs + 1
+            while (self._slots[i] is not None
+                   and len(self._row_blocks[i]) < need):
+                with self._pool_lock:
+                    b = self._pool.allocate()
+                if b is None:
+                    self._preempt(self._pick_victim())
+                    continue
+                j = len(self._row_blocks[i])
+                self._row_blocks[i].append(b)
+                self._tables[i, j] = b
+        return [i for i in active if self._slots[i] is not None]
+
+    def _pick_victim(self) -> int:
+        return max((i for i in range(self._S)
+                    if self._slots[i] is not None),
+                   key=lambda i: self._slots[i].admit_seq)
+
+    def _preempt(self, slot: int) -> None:
+        """Evict a resident back to the WAITING queue (front, original
+        request intact, partial tokens discarded) and free its blocks.
+        Readmission recomputes the prompt — recompute-not-swap, the
+        vLLM default — and regenerates the same tokens (greedy argmax;
+        sampled rows fold the rng by absolute position)."""
+        st = self._slots[slot]
+        self._slots[slot] = None
+        self._done[slot] = True
+        self._free.append(slot)
+        self._release_slot_blocks(slot)
+        self._preemptions += 1
+        logger.warning("block pool dry: preempted %r (recompute on "
+                       "readmission)", st.uri)
+        with self._lock:
+            self._waiting.appendleft(st.req)
+
+    def _release_slot_blocks(self, slot: int) -> None:
+        """Drop a finished/preempted row's block references and point
+        its whole table row at the sink, so the frozen row's future
+        writes can NEVER touch a block the pool hands to someone else
+        — the paged form of the arena's recycled-slot isolation."""
+        blocks = self._row_blocks[slot]
+        self._row_blocks[slot] = []
+        self._tables[slot, :] = SINK_BLOCK
+        with self._pool_lock:
+            for b in blocks:
+                self._pool.release(b)
+
+    def cache_metrics(self) -> dict:
+        """Serving-visible cache counters (bench_serving.py columns):
+        pool occupancy / prefix hit rate / evictions in paged mode,
+        plus preemption count and the peak co-resident request count
+        either mode observed."""
+        out = {
+            "mode": "paged" if self.paged else "arena",
+            "preemptions": self._preemptions,
+            "peak_resident": self._peak_resident,
+        }
+        if self.paged:
+            with self._pool_lock:
+                out.update(self._pool.metrics())
+        return out
+
     def _install_slot(self, slot, uri, plen, mn, on_done, on_error,
-                      temp, seed, first, top_p=0.0):
+                      temp, seed, first, top_p=0.0, req=None):
         """Shared slot-state installation for every admission path —
         plain bucket splice and prefix admission must never drift."""
         self._slots[slot] = _Slot(
             uri=uri, plen=plen, max_new=mn, on_done=on_done,
             on_error=on_error, temperature=temp, rng_seed=seed,
-            top_p=top_p)
+            top_p=top_p, req=req, admit_seq=self._admit_seq)
+        self._admit_seq += 1
         self._tok[slot] = first
         self._pos[slot] = plen
         if self.draft_model is not None:
@@ -822,6 +1329,10 @@ class ContinuousEngine:
         self._slots[slot] = None
         self._done[slot] = True     # terminal state until readmission
         self._free.append(slot)
+        if self.paged:
+            # refcounts drop + table row -> sink BEFORE the next device
+            # step, so a recycled block can never see this row's writes
+            self._release_slot_blocks(slot)
         if st.on_done is not None:
             try:
                 st.on_done(st.uri, out)
@@ -847,6 +1358,13 @@ class ContinuousEngine:
             return 0
         if self.draft_model is not None:
             return self._spec_tick(active)
+        if self.paged:
+            # grow block tables for the coming chunk; may preempt
+            active = self._ensure_blocks(active)
+            if not active:
+                self._admit()   # preemptions freed blocks: retry now
+                return self.n_active
+        self._peak_resident = max(self._peak_resident, len(active))
         sampled = any(self._slots[i].temperature > 0.0 for i in active)
         use_topp = any(self._slots[i].top_p > 0.0 for i in active)
         temps = np.zeros(self._S, np.float32)
@@ -861,11 +1379,18 @@ class ContinuousEngine:
             max(self._slots[i].max_new - len(self._slots[i].tokens)
                 for i in active)))
         step = self._get_step(n_eff, sampled, use_topp)
-        toks, tok, pos, done, self._ck, self._cv = step(
-            self._ck, self._cv, jnp.asarray(self._tok),
-            jnp.asarray(self._pos), jnp.asarray(self._done),
-            jnp.asarray(temps), jnp.asarray(seeds),
-            jnp.asarray(topps))
+        if self.paged:
+            toks, tok, pos, done, self._pk, self._pv = step(
+                self._pk, self._pv, jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._done),
+                jnp.asarray(self._tables), jnp.asarray(temps),
+                jnp.asarray(seeds), jnp.asarray(topps))
+        else:
+            toks, tok, pos, done, self._ck, self._cv = step(
+                self._ck, self._cv, jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._done),
+                jnp.asarray(temps), jnp.asarray(seeds),
+                jnp.asarray(topps))
         toks = np.asarray(toks)                     # [n_eff, S]
         # np.asarray of a jax array is a read-only view; _admit writes
         # per-slot entries, so take mutable copies

@@ -1,0 +1,255 @@
+"""Block-pool KV-cache memory manager for the serving engine.
+
+The continuous-batching arena (serving/continuous.py) reserves a full
+max-length KV strip per slot: HBM pays worst-case sequence length for
+every resident, which caps co-residency far below what the traffic
+actually needs.  This module is the vLLM-PagedAttention /
+SGLang-RadixAttention answer: ONE flat pool of fixed-size blocks
+``[n_layers, n_blocks, block_size, kv_heads, head_dim]`` on device,
+and a host-side :class:`BlockPool` that hands blocks to requests as
+they actually grow, refcounts them, and indexes FULL prompt blocks by
+a position-aligned chain hash so later requests sharing a prompt
+prefix attach to the same physical blocks copy-free.
+
+Division of labour: everything here is host-side bookkeeping (plain
+Python ints — no jax in this module); the device arena and the block
+tables that feed ``TransformerLM.decode_step_paged`` live in the
+engine.  The engine calls, in order:
+
+- :meth:`BlockPool.block_hashes` + :meth:`BlockPool.lookup` at
+  admission to find how many leading prompt blocks are already
+  resident, then :meth:`BlockPool.acquire` each match (ref++),
+- :meth:`BlockPool.allocate` for every block it must fill itself
+  (free list first, then LRU eviction of unreferenced cached blocks),
+- :meth:`BlockPool.insert` after a successful prefill to publish the
+  request's own full prompt blocks for future sharing,
+- :meth:`BlockPool.release` for every held block when the request
+  finishes or is preempted — blocks that are still hash-indexed park
+  in the LRU (reusable by future lookups OR evictable), unindexed
+  ones return straight to the free list.
+
+Hash-chain safety: a block's key hashes ALL tokens from position 0
+through the block's end, so equal hash ⇒ equal token history ⇒ equal
+K/V content at those positions for BOTH rope and learned position
+encodings (K is stored post-rotation at absolute positions — see
+``_apply_rope`` in models/lm.py).  Only full, position-aligned prompt
+blocks are ever indexed; a partially-filled tail block is always
+private to its request.
+
+Block 0 is the SINK: never allocated, never indexed, permanently
+garbage.  The engine points every unallocated block-table entry at it
+so out-of-range or padding-row writes land in storage nothing ever
+attends.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SINK_BLOCK = 0
+
+
+def chain_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
+    """Position-aligned chain hash of each FULL ``block_size`` chunk of
+    ``tokens``: chunk j's key covers tokens[0 : (j+1)*block_size], so
+    two sequences share a key only when their entire history through
+    that block is identical.  A trailing partial chunk gets no hash
+    (it must stay private — its K/V will keep growing)."""
+    out: List[int] = []
+    h = 0x9E3779B97F4A7C15  # non-zero seed so an empty prefix != hash 0
+    for j in range(len(tokens) // block_size):
+        chunk = tuple(int(t) for t in
+                      tokens[j * block_size:(j + 1) * block_size])
+        # int-tuple hashing is deterministic (PYTHONHASHSEED only
+        # perturbs str/bytes), so the index is stable across runs
+        h = hash((h, chunk))
+        out.append(h)
+    return out
+
+
+class BlockPool:
+    """Host-side allocator/refcounter/prefix-index over ``n_blocks``
+    physical KV blocks of ``block_size`` token positions each.
+
+    Lifecycle of a physical block:
+
+    - FREE (on ``_free``): content is garbage; ``allocate`` hands it
+      out with ref=1.
+    - REFERENCED (ref >= 1): owned by one or more live requests.  A
+      block published via ``insert`` may be acquired by later lookups
+      (ref counts sharers).
+    - CACHED (ref == 0 but hash-indexed, on ``_lru``): no live owner,
+      but its K/V is intact and future lookups may resurrect it
+      (``acquire`` → ref=1).  ``allocate`` evicts from here, oldest
+      first, when the free list is dry — eviction unpublishes the
+      hash so no later lookup can match stale storage.
+
+    Block 0 (``SINK_BLOCK``) is outside all three states forever.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int,
+                 enable_prefix_cache: bool = True):
+        if n_blocks < 2:
+            raise ValueError(
+                f"n_blocks must be >= 2 (block 0 is the sink), got "
+                f"{n_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        self._free: deque = deque(range(1, self.n_blocks))
+        self._ref: Dict[int, int] = {}
+        self._hash_of: Dict[int, int] = {}     # block -> published hash
+        self._index: Dict[int, int] = {}       # hash  -> block
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # metrics (monotonic counters except the gauges derived below)
+        self.prefix_queries = 0    # blocks asked of lookup()
+        self.prefix_hits = 0       # blocks answered from the index
+        self.evictions = 0
+        self.alloc_failures = 0    # allocate() returned None
+
+    # -- hashing / lookup --------------------------------------------
+
+    def block_hashes(self, tokens: Sequence[int]) -> List[int]:
+        """Chain hashes of every full block of ``tokens`` (see
+        :func:`chain_hashes`)."""
+        return chain_hashes(tokens, self.block_size)
+
+    def lookup(self, hashes: Sequence[int]) -> List[int]:
+        """Longest indexed run from the start of ``hashes`` → physical
+        block ids.  Counts every offered hash as a query and every
+        match as a hit (the hit RATE is hits/queries).  Does NOT take
+        references — call :meth:`acquire` on each returned block while
+        still holding the engine lock, or another admission could
+        evict them out from under you."""
+        self.prefix_queries += len(hashes)
+        if not self.enable_prefix_cache:
+            return []
+        out: List[int] = []
+        for h in hashes:
+            blk = self._index.get(h)
+            if blk is None:
+                break
+            out.append(blk)
+        self.prefix_hits += len(out)
+        return out
+
+    # -- reference management ----------------------------------------
+
+    def acquire(self, block: int) -> None:
+        """ref++ on an indexed block a lookup returned (resurrects it
+        from the LRU if it was unreferenced)."""
+        if block == SINK_BLOCK:
+            raise ValueError("cannot acquire the sink block")
+        self._ref[block] = self._ref.get(block, 0) + 1
+        self._lru.pop(block, None)
+
+    def allocate(self) -> Optional[int]:
+        """A fresh block with ref=1 and garbage content: free list
+        first, else evict the least-recently-parked CACHED block
+        (unpublishing its hash).  ``None`` when every block is
+        referenced — the engine's cue to stop admitting / preempt."""
+        if self._free:
+            blk = self._free.popleft()
+        elif self._lru:
+            blk, _ = self._lru.popitem(last=False)
+            h = self._hash_of.pop(blk)
+            del self._index[h]
+            self.evictions += 1
+        else:
+            self.alloc_failures += 1
+            return None
+        self._ref[blk] = 1
+        return blk
+
+    def release(self, block: int) -> None:
+        """ref--; at zero the block parks in the LRU if it is still
+        hash-indexed (K/V reusable), else returns to the free list."""
+        if block == SINK_BLOCK:
+            raise ValueError("cannot release the sink block")
+        r = self._ref.get(block, 0) - 1
+        if r < 0:
+            raise ValueError(f"release of unreferenced block {block}")
+        if r:
+            self._ref[block] = r
+            return
+        del self._ref[block]
+        if block in self._hash_of:
+            self._lru[block] = None
+        else:
+            self._free.append(block)
+
+    def insert(self, hash_: int, block: int) -> None:
+        """Publish a REFERENCED block under its chain hash so future
+        lookups can share it.  First writer wins: if the hash is
+        already indexed (two identical prompts prefetched in the same
+        admission wave) the existing mapping stands and this block
+        simply stays private — correct, merely not deduplicated."""
+        if not self.enable_prefix_cache:
+            return
+        if block == SINK_BLOCK or self._ref.get(block, 0) < 1:
+            raise ValueError(
+                f"insert requires a referenced non-sink block, got "
+                f"{block} (ref={self._ref.get(block, 0)})")
+        if hash_ in self._index or block in self._hash_of:
+            return
+        self._index[hash_] = block
+        self._hash_of[block] = hash_
+
+    # -- introspection -----------------------------------------------
+
+    def allocatable(self) -> int:
+        """Blocks ``allocate`` could return right now (free + cached)."""
+        return len(self._free) + len(self._lru)
+
+    def num_referenced(self) -> int:
+        return len(self._ref)
+
+    def num_cached(self) -> int:
+        return len(self._lru)
+
+    def occupancy(self) -> float:
+        """Fraction of non-sink blocks currently referenced by live
+        requests (cached-but-unreferenced blocks do not count — they
+        are reclaimable on demand)."""
+        return len(self._ref) / max(1, self.n_blocks - 1)
+
+    def hit_rate(self) -> float:
+        return self.prefix_hits / max(1, self.prefix_queries)
+
+    def metrics(self) -> Dict[str, float]:
+        return {
+            "n_blocks": self.n_blocks,
+            "block_size": self.block_size,
+            "referenced_blocks": len(self._ref),
+            "cached_blocks": len(self._lru),
+            "free_blocks": len(self._free),
+            "occupancy": self.occupancy(),
+            "prefix_queries": self.prefix_queries,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": self.hit_rate(),
+            "evictions": self.evictions,
+            "alloc_failures": self.alloc_failures,
+        }
+
+    def check(self) -> None:
+        """Invariant audit (tests): every non-sink block is in exactly
+        one of free/referenced/cached, and the hash index is a
+        bijection onto indexed blocks."""
+        free = set(self._free)
+        ref = set(self._ref)
+        cached = set(self._lru)
+        assert not (free & ref) and not (free & cached) \
+            and not (ref & cached), "block state overlap"
+        assert free | ref | cached == set(range(1, self.n_blocks)), \
+            "block leak/duplication"
+        assert cached <= set(self._hash_of), "cached block lost its hash"
+        assert set(self._hash_of) <= ref | cached, \
+            "indexed block neither referenced nor cached"
+        assert (sorted(self._index.values())
+                == sorted(self._hash_of.keys())), "index not a bijection"
+        assert all(self._index[h] == b
+                   for b, h in self._hash_of.items()), \
+            "index/hash_of disagree"

@@ -77,6 +77,18 @@ class ServingConfig:
     engine_ticks: int = 1
     # narrow the KV arena ("bfloat16" under an f32 model = 2x slots)
     engine_cache_dtype: Optional[str] = None
+    # Paged KV cache (serving/paged_cache.py): block-pool memory
+    # instead of a per-slot arena — residents hold only the blocks
+    # they've filled, shared prompt prefixes attach to the same blocks
+    # copy-free, and a dry pool preempts-to-queue instead of OOMing.
+    engine_paged: bool = False
+    engine_block_size: int = 16
+    # pool size: engine_blocks wins when set; else engine_hbm_fraction
+    # of device HBM (where the backend reports it); else arena-
+    # equivalent (every slot can run full-length)
+    engine_blocks: Optional[int] = None
+    engine_hbm_fraction: Optional[float] = None
+    engine_prefix_cache: bool = True
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -117,6 +129,16 @@ class ServingConfig:
             cfg.engine_ticks = int(params["engine_ticks"])
         if "engine_cache_dtype" in params:
             cfg.engine_cache_dtype = str(params["engine_cache_dtype"])
+        if "engine_paged" in params:
+            cfg.engine_paged = bool(params["engine_paged"])
+        if "engine_block_size" in params:
+            cfg.engine_block_size = int(params["engine_block_size"])
+        if "engine_blocks" in params:
+            cfg.engine_blocks = int(params["engine_blocks"])
+        if "engine_hbm_fraction" in params:
+            cfg.engine_hbm_fraction = float(params["engine_hbm_fraction"])
+        if "engine_prefix_cache" in params:
+            cfg.engine_prefix_cache = bool(params["engine_prefix_cache"])
         return cfg
 
 
@@ -250,7 +272,12 @@ class ClusterServing:
                 ticks_per_step=self.config.engine_ticks,
                 cache_dtype=self.config.engine_cache_dtype,
                 mesh=self.engine_mesh,
-                partition_rules=self.engine_partition_rules)
+                partition_rules=self.engine_partition_rules,
+                paged=self.config.engine_paged,
+                block_size=self.config.engine_block_size,
+                n_blocks=self.config.engine_blocks,
+                hbm_fraction=self.config.engine_hbm_fraction,
+                enable_prefix_cache=self.config.engine_prefix_cache)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
                                  name="zoo-serving-cb")
@@ -458,6 +485,7 @@ class ClusterServing:
                                      uri)
             self._finish_entries(client, [eid])
             dt = (time.perf_counter() - t0) * 1000
+            cache = engine.cache_metrics()
             with self._stats_lock:
                 self.stats["requests"] += 1
                 self.stats["batches"] += 1
@@ -466,6 +494,10 @@ class ClusterServing:
                 self.stats["predict_ms"] = dt
                 self.stats["batch_fill"] = engine.n_active / max(
                     1, self.config.engine_slots)
+                # KV-memory counters (paged mode adds pool occupancy /
+                # prefix hit rate / evictions; both modes report
+                # preemptions + peak co-residency)
+                self.stats["cache"] = cache
                 self._written.append((uri, time.monotonic()))
 
         try:

@@ -197,7 +197,8 @@ def run_scenario(model_kind: str, n_clients: int, requests_per_client: int,
 
 def run_poisson_scenario(continuous: bool, rate_per_s: float,
                          n_requests: int, slots: int = 8,
-                         prefix_mode: str = "none") -> dict:
+                         prefix_mode: str = "none",
+                         paged: bool = False) -> dict:
     """Open-loop mixed generative workload: requests arrive at Poisson
     times (not closed-loop clients), 80% short prompts / 20% long, all
     wanting 32 tokens.  The metric that separates the two serving modes
@@ -212,7 +213,16 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     percentiles are reported): "full" ships the concatenated prompt
     every time, "cached" registers the prefix once and ships only
     suffixes — the delta is the per-request prefill the cache amortises
-    away."""
+    away.
+
+    ``paged=True`` serves from the block-pool KV cache instead of the
+    slot arena and adds cache columns to the row: peak pool occupancy
+    (sampled during the run), prefix-cache hit rate, max co-resident
+    requests, preemptions, evictions.  With ``prefix_mode="full"`` the
+    concatenated system prompt is shipped every time and the BLOCK-level
+    prefix index dedups it automatically — no register_prefix call —
+    which is the shared-system-prompt scenario the hit-rate column
+    belongs to."""
     import queue as _q
 
     import jax
@@ -236,8 +246,24 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
                         engine_slots=slots,
                         # 4 tokens per device call: admission granularity
                         # vs host round-trips (tunneled-device win)
-                        engine_ticks=4)
+                        engine_ticks=4,
+                        engine_paged=paged, engine_block_size=16)
     serving = ClusterServing(im, cfg, embedded_broker=True).start()
+
+    # paged cache columns: occupancy is instantaneous (drained pool ==
+    # 0), so a sampler thread records the PEAK while requests are live
+    occ_peak = [0.0]
+    occ_stop = threading.Event()
+
+    def occ_sampler():
+        while not occ_stop.wait(0.05):
+            m = serving.engine.cache_metrics()
+            occ_peak[0] = max(occ_peak[0], m.get("occupancy", 0.0))
+
+    occ_thread = None
+    if paged:
+        occ_thread = threading.Thread(target=occ_sampler, daemon=True)
+        occ_thread.start()
     inq = InputQueue(port=serving.port)
     rng = np.random.default_rng(11)
     pid = None
@@ -320,6 +346,10 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     for w in waiters:
         w.join()
     wall = time.perf_counter() - t_start
+    cache = serving.engine.cache_metrics() if paged else None
+    if occ_thread is not None:
+        occ_stop.set()
+        occ_thread.join()
     serving.stop()
     inq.close()
     wq.close()
@@ -334,6 +364,9 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     name = "lm-poisson-cb" if continuous else "lm-poisson"
     if prefix_mode != "none":
         name = f"lm-prefix-{prefix_mode}"
+    if paged:
+        name = "lm-sysprompt-pg" if prefix_mode != "none" \
+            else "lm-poisson-pg"
     out = {
         "model": name,
         "mode": "continuous" if continuous else "microbatch",
@@ -351,7 +384,75 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
         out["long_p90_ms"] = pct("long", 90)
     else:
         out["prefix_tokens"] = PFX
+    if cache is not None:
+        out["cache_occupancy_peak"] = round(float(occ_peak[0]), 3)
+        out["prefix_hit_rate"] = round(cache["prefix_hit_rate"], 3)
+        out["max_coresident"] = cache["peak_resident"]
+        out["preemptions"] = cache["preemptions"]
+        out["evictions"] = cache["evictions"]
     return out
+
+
+def run_capacity_scenario(slots: int = 4) -> dict:
+    """Equal-HBM co-residency head-to-head (no wire protocol — the claim
+    is about KV memory, not RESP throughput).  The arena pays worst-case
+    length L for every slot; the paged pool pays actual length in
+    block_size-token quanta.  Give the paged engine a pool NO BIGGER
+    than the arena's cache bytes and drive short-prompt traffic: it
+    sustains >= 2x the arena's co-resident requests (ISSUE acceptance
+    bar), measured as the engine's own peak_resident counter with zero
+    preemptions (genuine co-residency, not admit/evict thrash)."""
+    import jax
+
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import ContinuousEngine
+
+    model = TransformerLM(vocab_size=8192, hidden_size=256, num_layers=4,
+                          num_heads=4, intermediate_size=1024,
+                          max_position=128)
+    variables = model.init(jax.random.key(0), np.zeros((1, 32), np.int32))
+    kw = dict(max_new_tokens=32, prompt_buckets=(8, 64), ticks_per_step=4)
+    arena = ContinuousEngine(model, variables, max_slots=slots, **kw)
+    rep = arena.capacity_report()
+    arena_bytes = rep["arena_bytes"]
+    # L = 64+32 = 96 tokens; bs=8 -> 12 blocks/row; the arena's
+    # slots*96 token slots buy slots*12 blocks (sink included, so one
+    # block LESS than the arena's bytes).  A short request needs only
+    # ceil((8+32)/8) = 5 blocks, so the same bytes hold
+    # (slots*12 - 1)//5 residents — 2.3x at slots=4.
+    bs = 8
+    n_blocks = (slots * 96) // bs
+    paged_slots = ((n_blocks - 1) * bs) // 40
+    eng = ContinuousEngine(model, variables, max_slots=paged_slots,
+                           paged=True, block_size=bs, n_blocks=n_blocks,
+                           enable_prefix_cache=False, **kw)
+    paged_bytes = eng.capacity_report()["arena_bytes"]
+    assert paged_bytes <= arena_bytes, (paged_bytes, arena_bytes)
+    rng = np.random.default_rng(13)
+    done = []
+    for i in range(3 * paged_slots):
+        eng.submit(f"c{i}", rng.integers(1, 8192, int(rng.integers(
+            4, 9))).astype(np.int32), on_done=lambda u, t: done.append(u))
+    t0 = time.perf_counter()
+    eng.drain()
+    wall = time.perf_counter() - t0
+    m = eng.cache_metrics()
+    return {
+        "model": "lm-capacity",
+        "mode": "paged-vs-arena",
+        "requests": len(done),
+        "req_per_sec": round(len(done) / wall, 1),
+        "arena_slots": slots,
+        "arena_bytes": int(arena_bytes),
+        "paged_bytes": int(paged_bytes),
+        "block_size": bs,
+        "n_blocks": n_blocks,
+        "max_coresident": m["peak_resident"],
+        "coresident_ratio": round(m["peak_resident"] / slots, 2),
+        "preemptions": m["preemptions"],
+        "note": ("equal cache HBM; short prompts; arena pays worst-case "
+                 "L per slot, paged pays actual length in blocks"),
+    }
 
 
 # scenario plan, most-informative-first (the claims a judge needs —
@@ -369,6 +470,12 @@ PLAN = [("resnet18", 64, 10, 64),
         # (per-admission dispatch overhead dominates the tiny prefill it
         # saves); the claim is for real prefill costs — judge on TPU.
         ("lm-prefix-full", 12, 120, 8), ("lm-prefix-cached", 12, 120, 8),
+        # paged KV cache: same mixed workload on the block pool, the
+        # shared-system-prompt workload where the block-level prefix
+        # index dedups automatically (hit-rate column), and the
+        # equal-HBM co-residency head-to-head (>= 2x claim)
+        ("lm-poisson-pg", 12, 150, 8), ("lm-sysprompt-pg", 12, 120, 8),
+        ("lm-capacity", 4, 0, 8),
         ("lm", 16, 10, 32), ("lm-spec", 16, 10, 32),
         ("lm", 64, 5, 32), ("lm", 1, 20, 32),
         ("mlp", 256, 50, 128), ("mlp", 64, 50, 128),
@@ -522,7 +629,16 @@ def _one():
 
     kind, clients, rpc, bs = (sys.argv[2], int(sys.argv[3]),
                               int(sys.argv[4]), int(sys.argv[5]))
-    if kind.startswith("lm-prefix"):
+    if kind == "lm-capacity":
+        r = run_capacity_scenario(slots=clients)
+    elif kind == "lm-poisson-pg":
+        r = run_poisson_scenario(True, rate_per_s=clients,
+                                 n_requests=rpc, slots=bs, paged=True)
+    elif kind == "lm-sysprompt-pg":
+        r = run_poisson_scenario(True, rate_per_s=clients,
+                                 n_requests=rpc, slots=bs,
+                                 prefix_mode="full", paged=True)
+    elif kind.startswith("lm-prefix"):
         r = run_poisson_scenario(True, rate_per_s=clients,
                                  n_requests=rpc, slots=bs,
                                  prefix_mode=kind.split("-")[-1])
@@ -535,11 +651,28 @@ def _one():
     print(json.dumps(r))
 
 
+def _smoke():
+    """``python bench_serving.py --smoke``: the `make serve-smoke` e2e
+    leg — 20 requests through the full wire protocol on the PAGED
+    engine with a shared system prompt, small enough for the CPU test
+    box.  Asserts the paged plumbing end to end: every request served,
+    the prefix cache actually hit, and cache columns present."""
+    r = run_poisson_scenario(True, rate_per_s=20.0, n_requests=20,
+                             slots=4, prefix_mode="full", paged=True)
+    print(json.dumps(r))
+    assert r["requests"] == 20, r
+    assert r["prefix_hit_rate"] > 0.0, r
+    assert r["max_coresident"] >= 1, r
+    print("SMOKE_OK")
+
+
 if __name__ == "__main__":
     import sys
 
     if "--probe" in sys.argv:
         _probe_main()
+    elif "--smoke" in sys.argv:
+        _smoke()
     elif "--one" in sys.argv:
         _one()
     else:
