@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test verify examples bench native serve-smoke clean
+.PHONY: test verify examples bench native serve-smoke lint clean
 
 # full suite on the 8-virtual-device CPU mesh (tests/conftest.py forces it)
 test:
@@ -31,6 +31,12 @@ examples:
 # buffer, image decode)
 native:
 	$(PY) -c "from analytics_zoo_tpu import native; native.load_lib(); print('native data plane:', native.available())"
+
+# JAX staging/tracing lint (rules TZ001..TZ008, docs/lint.md); exits
+# non-zero on any finding not recorded in tpulint_baseline.json
+lint:
+	$(PY) -m analytics_zoo_tpu.lint analytics_zoo_tpu/ \
+	    --baseline tpulint_baseline.json
 
 # one-chip benchmark suite (writes the driver-facing JSON line)
 bench:

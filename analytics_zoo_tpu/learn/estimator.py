@@ -1148,13 +1148,15 @@ def _fetch_stacked(mets_list, chunk: int = 512):
     is also the real epoch completion barrier's work — values must exist.
     """
     keys = list(mets_list[0].keys())
-    out = {}
+    stacked = {}
     for k in keys:
         vals = [m[k] for m in mets_list]
-        parts = [jnp.stack(vals[i:i + chunk])
-                 for i in range(0, len(vals), chunk)]
-        out[k] = np.concatenate(jax.device_get(parts))
-    return out
+        stacked[k] = [jnp.stack(vals[i:i + chunk])
+                      for i in range(0, len(vals), chunk)]
+    # ONE device_get for every metric's chunks — per-key fetches would
+    # pay a full round-trip per metric
+    fetched = jax.device_get(stacked)
+    return {k: np.concatenate(parts) for k, parts in fetched.items()}
 
 
 def _resolve_batch(batch_size, data, attr: str) -> int:

@@ -1,0 +1,52 @@
+"""tpulint — static + runtime staging/tracing analysis for JAX code.
+
+Static half (``analyzer``): a stdlib-``ast`` linter with JAX-specific
+rules (TZ001..TZ008) that understands which functions are traced —
+reachability from ``jax.jit``/``pjit`` seeds through a local call graph
+— so it can tell host orchestration code from staged code instead of
+flagging the whole repo.
+
+Runtime half (``runtime``): :func:`trace_guard`, a context manager that
+counts retraces per jitted callable via the compile-cache size and
+raises when a budget is exceeded — the dynamic complement the static
+rules cannot express ("this decode loop retraces zero times in steady
+state").
+
+Run the CLI with ``python -m analytics_zoo_tpu.lint <paths>``.
+"""
+
+from analytics_zoo_tpu.lint.analyzer import (  # noqa: F401
+    DEFAULT_HOT_PATHS,
+    Finding,
+    RULES,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+)
+from analytics_zoo_tpu.lint.baseline import (  # noqa: F401
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from analytics_zoo_tpu.lint.runtime import (  # noqa: F401
+    RetraceError,
+    TraceGuard,
+    retrace_count,
+    trace_guard,
+)
+
+__all__ = [
+    "DEFAULT_HOT_PATHS",
+    "Finding",
+    "RULES",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "apply_baseline",
+    "load_baseline",
+    "write_baseline",
+    "RetraceError",
+    "TraceGuard",
+    "retrace_count",
+    "trace_guard",
+]

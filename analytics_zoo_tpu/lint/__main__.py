@@ -1,0 +1,6 @@
+import sys
+
+from analytics_zoo_tpu.lint.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""tpulint baseline — the ledger of findings we deliberately keep.
+
+A baseline entry matches a finding on ``(path, rule, text)`` where
+``text`` is the stripped source line.  Matching on line *content*
+instead of line *number* keeps the baseline stable under unrelated
+edits above the finding; if the flagged line itself changes, the entry
+stops matching and the finding resurfaces — which is the behaviour you
+want when someone rewrites a deliberately-kept sync site.
+
+Every entry carries a ``reason``: the one-line justification for why
+the finding stays.  ``--write-baseline`` preserves reasons for entries
+that still match and stamps ``TODO: justify`` on new ones, so an
+unjustified baseline is visible in review.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from analytics_zoo_tpu.lint.analyzer import Finding
+
+_VERSION = 1
+
+
+class Baseline:
+    def __init__(self, entries: Optional[List[Dict[str, str]]] = None):
+        self.entries: List[Dict[str, str]] = entries or []
+        self._index: Dict[Tuple[str, str, str], Dict[str, str]] = {
+            (e["path"], e["rule"], e["text"]): e for e in self.entries}
+
+    def match(self, finding: Finding) -> Optional[Dict[str, str]]:
+        return self._index.get((finding.path, finding.rule, finding.text))
+
+
+def load_baseline(path: str) -> Baseline:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != _VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{data.get('version')!r}")
+    return Baseline(data.get("entries", []))
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Optional[Baseline],
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (kept, suppressed-by-baseline)."""
+    if baseline is None:
+        return list(findings), []
+    kept, suppressed = [], []
+    for f in findings:
+        (suppressed if baseline.match(f) else kept).append(f)
+    return kept, suppressed
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   old: Optional[Baseline] = None) -> int:
+    """Write all ``findings`` as the new baseline, preserving reasons
+    from ``old`` where entries still match.  Returns the entry count."""
+    entries: List[Dict[str, str]] = []
+    seen = set()
+    for f in findings:
+        key = (f.path, f.rule, f.text)
+        if key in seen:
+            continue
+        seen.add(key)
+        prior = old.match(f) if old is not None else None
+        entries.append({
+            "path": f.path,
+            "rule": f.rule,
+            "line": f.line,        # informational; matching ignores it
+            "text": f.text,
+            "reason": (prior or {}).get("reason", "TODO: justify"),
+        })
+    with open(path, "w", encoding="utf-8") as fp:
+        json.dump({"version": _VERSION, "entries": entries}, fp, indent=2,
+                  sort_keys=False)
+        fp.write("\n")
+    return len(entries)

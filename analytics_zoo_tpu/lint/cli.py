@@ -1,0 +1,99 @@
+"""tpulint CLI — ``python -m analytics_zoo_tpu.lint <paths>``.
+
+Exit codes: 0 clean (all findings baselined or none), 1 non-baselined
+findings, 2 parse failures (reported as TZ000 alongside any findings).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from analytics_zoo_tpu.lint.analyzer import (DEFAULT_HOT_PATHS, RULES,
+                                             analyze_paths)
+from analytics_zoo_tpu.lint.baseline import (Baseline, apply_baseline,
+                                             load_baseline, write_baseline)
+
+DEFAULT_BASELINE = "tpulint_baseline.json"
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m analytics_zoo_tpu.lint",
+        description="JAX staging/tracing analyzer (rules TZ001..TZ008). "
+                    "See docs/lint.md for the rule catalog.")
+    p.add_argument("paths", nargs="*", default=["analytics_zoo_tpu"],
+                   help="files or directories to analyze "
+                        "(default: analytics_zoo_tpu)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline file (default: {DEFAULT_BASELINE} "
+                        f"if present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline file "
+                        "(preserving existing reasons) and exit 0")
+    p.add_argument("--select", default=None, metavar="TZ001,TZ007",
+                   help="comma-separated rule IDs to report (default all)")
+    p.add_argument("--hot-path", action="append", default=None,
+                   metavar="PAT", help="hot-path substring pattern for "
+                   "TZ007 (repeatable; default: "
+                   + ", ".join(DEFAULT_HOT_PATHS) + ")")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in sorted(RULES.items()):
+            print(f"{rid}  {desc}")
+        return 0
+
+    hot = tuple(args.hot_path) if args.hot_path else DEFAULT_HOT_PATHS
+    findings = analyze_paths(args.paths, hot_paths=hot)
+
+    if args.select:
+        selected = {r.strip() for r in args.select.split(",")}
+        findings = [f for f in findings if f.rule in selected]
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    baseline: Optional[Baseline] = None
+    if not args.no_baseline and not args.write_baseline and \
+            os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+
+    if args.write_baseline:
+        old = load_baseline(baseline_path) if os.path.exists(baseline_path) \
+            else None
+        n = write_baseline(baseline_path, findings, old)
+        print(f"tpulint: wrote {n} baseline entries to {baseline_path}",
+              file=sys.stderr)
+        return 0
+
+    kept, suppressed = apply_baseline(findings, baseline)
+    parse_failures = [f for f in kept if f.rule == "TZ000"]
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in kept],
+            "baselined": len(suppressed),
+            "total": len(findings),
+        }, indent=2))
+    else:
+        for f in kept:
+            print(f.format())
+        tail = f"tpulint: {len(kept)} finding(s)"
+        if suppressed:
+            tail += f", {len(suppressed)} baselined"
+        print(tail, file=sys.stderr)
+
+    if parse_failures:
+        return 2
+    return 1 if kept else 0

@@ -1,0 +1,140 @@
+"""tpulint runtime — retrace accounting the static rules cannot see.
+
+The static analyzer proves shapes of code; it cannot prove that a
+serving engine's steady-state decode compiles exactly once.  That is a
+*dynamic* property: every new ``(shape, dtype, static-arg)`` signature
+grows a jitted callable's compile cache by one, so the cache size IS
+the retrace counter.  :class:`TraceGuard` snapshots cache sizes for a
+set of jitted callables on entry and diffs them on exit — zero growth
+means zero retraces.
+
+Targets are resolved liberally: a jitted callable is tracked directly;
+a dict/list/tuple is searched for jitted values; any other object has
+``vars()`` walked one level (including dict/list attrs), which picks up
+e.g. ``ContinuousEngine``'s ``_step_cache`` dict and ``_prefill``/
+``_paged_admit`` attributes without the engine knowing the guard
+exists.  Callables that *appear* inside a tracked container during the
+guarded region (a fresh shape-bucket compile) count from zero — which
+is exactly how the "one shape bucket per request" failure mode shows
+up as a nonzero total.
+
+Usage::
+
+    with trace_guard(engine, budget=0):
+        for _ in range(100):
+            engine.step()          # raises RetraceError on any retrace
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["RetraceError", "TraceGuard", "trace_guard", "retrace_count"]
+
+
+class RetraceError(RuntimeError):
+    """A jitted callable retraced more than its budget allows."""
+
+    def __init__(self, message: str, counts: Dict[str, int]):
+        super().__init__(message)
+        self.counts = counts
+
+
+def retrace_count(fn: Any) -> int:
+    """Compile-cache size of a jitted callable (0 if unreadable)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return 0
+
+
+def _is_jitted(obj: Any) -> bool:
+    return callable(obj) and callable(getattr(obj, "_cache_size", None))
+
+
+def _collect(label: str, obj: Any, out: Dict[str, Any], depth: int) -> None:
+    if _is_jitted(obj):
+        out[label] = obj
+        return
+    if depth <= 0:
+        return
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _collect(f"{label}[{k!r}]", v, out, depth - 1)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _collect(f"{label}[{i}]", v, out, depth - 1)
+    else:
+        try:
+            attrs = vars(obj)
+        except TypeError:
+            return
+        for k, v in attrs.items():
+            _collect(f"{label}.{k}" if label else k, v, out, depth - 1)
+
+
+class TraceGuard:
+    """Context manager bounding retraces across a set of jitted
+    callables.  ``budget`` is the total number of *new* traces allowed
+    inside the guarded region (0 = steady state, nothing may compile).
+    """
+
+    def __init__(self, *targets: Any, budget: int = 0,
+                 name: Optional[str] = None):
+        self._targets: Tuple[Any, ...] = targets
+        self.budget = int(budget)
+        self.name = name or "trace_guard"
+        self._before: Dict[str, int] = {}
+        self._entered = False
+
+    def _snapshot(self) -> Dict[str, Any]:
+        fns: Dict[str, Any] = {}
+        for i, t in enumerate(self._targets):
+            root = type(t).__name__ if not isinstance(t, (dict, list, tuple)) \
+                else f"arg{i}"
+            _collect(root if len(self._targets) > 1 or not _is_jitted(t)
+                     else (getattr(t, "__name__", None) or root),
+                     t, fns, depth=2)
+        return fns
+
+    def __enter__(self) -> "TraceGuard":
+        self._before = {label: retrace_count(fn)
+                        for label, fn in self._snapshot().items()}
+        self._entered = True
+        return self
+
+    def counts(self) -> Dict[str, int]:
+        """Retraces per callable since ``__enter__`` (new callables
+        count their full cache size)."""
+        out: Dict[str, int] = {}
+        for label, fn in self._snapshot().items():
+            grew = retrace_count(fn) - self._before.get(label, 0)
+            if grew:
+                out[label] = grew
+        return out
+
+    def total(self) -> int:
+        return sum(self.counts().values())
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._entered = False
+        if exc_type is not None:
+            return False
+        counts = self.counts()
+        total = sum(counts.values())
+        if total > self.budget:
+            detail = ", ".join(f"{k}: +{v}" for k, v in
+                               sorted(counts.items())) or "none"
+            raise RetraceError(
+                f"{self.name}: {total} retrace(s) exceed budget "
+                f"{self.budget} ({detail}) — a steady-state hot loop "
+                f"should not grow any compile cache; look for shape/"
+                f"dtype drift or per-call jit construction", counts)
+        return False
+
+
+def trace_guard(*targets: Any, budget: int = 0,
+                name: Optional[str] = None) -> TraceGuard:
+    """Guard a region against retraces of ``targets`` (jitted
+    callables, dicts of them, or objects holding them)."""
+    return TraceGuard(*targets, budget=budget, name=name)

@@ -133,7 +133,8 @@ def beam_search(model: TransformerLM, variables, prompt,
         if eos_id is not None:
             # frozen beams: the only continuation is eos at logp 0, so
             # the finished score competes unchanged in top-k
-            frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+            frozen = jnp.full((V,), -jnp.inf,
+                              jnp.float32).at[eos_id].set(0.0)
             logp = jnp.where(done[:, :, None], frozen[None, None, :], logp)
         cand = scores[:, :, None] + logp                 # [B, K, V]
         first = (t + 1 == plen)                          # [B]

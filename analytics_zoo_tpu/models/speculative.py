@@ -180,8 +180,12 @@ def speculative_generate(model: TransformerLM, variables,
             model, variables, draft_model, draft_variables, carry,
             k=k, max_new=max_new_tokens, eos_id=eos_id)
         rounds += 1
-        emitted += int(np.asarray(jnp.sum(n_emit)))
-        if bool(np.asarray(carry[-1].all())):
+        # one fetch per round for BOTH loop controls (emit count and
+        # the all-done flag) instead of two separate blocking reads
+        n_round, all_done = jax.device_get((jnp.sum(n_emit),
+                                            carry[-1].all()))
+        emitted += int(n_round)
+        if bool(all_done):
             break
     out = carry[7]
     if eos_id is not None:
@@ -190,7 +194,7 @@ def speculative_generate(model: TransformerLM, variables,
         o = np.asarray(out)
         m = np.cumsum(o == eos_id, axis=1)
         o = np.where((m - (o == eos_id)) > 0, eos_id, o)
-        out = jnp.asarray(o)
+        out = jnp.asarray(o, jnp.int32)
     stats = {"rounds": rounds,
              "emitted_tokens": emitted,
              "batch": B,

@@ -726,12 +726,13 @@ class ContinuousEngine:
         if self.paged:
             return self._register_prefix_paged(tokens)
         _, ks, vs = self.model.apply(self._variables,
-                                     jnp.asarray(tokens[None]),
+                                     jnp.asarray(tokens[None], jnp.int32),
                                      method=TransformerLM.prefill)
         entry = [jax.device_put(ks), jax.device_put(vs), P, None, None]
         if self.draft_model is not None:
             _, dks, dvs = self.draft_model.apply(
-                self._draft_variables, jnp.asarray(tokens[None]),
+                self._draft_variables,
+                jnp.asarray(tokens[None], jnp.int32),
                 method=TransformerLM.prefill)
             entry[3], entry[4] = jax.device_put(dks), jax.device_put(dvs)
         with self._lock:
@@ -877,11 +878,14 @@ class ContinuousEngine:
                     for i, req in enumerate(reqs):
                         padded[i, :len(req.prompt)] = req.prompt
                         plens[i] = len(req.prompt)
-                    pre = self._prefill(jnp.asarray(padded),
-                                        jnp.asarray(plens))
+                    pre = self._prefill(jnp.asarray(padded, jnp.int32),
+                                        jnp.asarray(plens, jnp.int32))
                     if self.draft_model is not None:
                         pre = pre + self._draft_prefill(
-                            jnp.asarray(padded))
+                            jnp.asarray(padded, jnp.int32))
+                    # ONE host fetch of the bucket's first-token logits;
+                    # per-request picks below then stay on numpy
+                    pre = (np.asarray(pre[0]),) + tuple(pre[1:])
                 except Exception as e:
                     logger.exception(
                         "prefill failed for %d request(s), bucket %d",
@@ -953,15 +957,20 @@ class ContinuousEngine:
         slots = real + [self._S] * (kb - n)
         try:
             last, self._ck, self._cv = self._prefix_admit(
-                self._ck, self._cv, pks, pvs, jnp.asarray(padded),
-                jnp.asarray(lens), jnp.asarray(slots, jnp.int32))
+                self._ck, self._cv, pks, pvs,
+                jnp.asarray(padded, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+                jnp.asarray(slots, jnp.int32))
             if self.draft_model is not None:
                 _, self._dck, self._dcv = self._draft_prefix_admit(
-                    self._dck, self._dcv, dks, dvs, jnp.asarray(padded),
-                    jnp.asarray(lens), jnp.asarray(slots, jnp.int32))
+                    self._dck, self._dcv, dks, dvs,
+                    jnp.asarray(padded, jnp.int32),
+                    jnp.asarray(lens, jnp.int32),
+                    jnp.asarray(slots, jnp.int32))
         except Exception:
             self._free.extend(real)
             raise
+        last = np.asarray(last)     # one D2H for the whole group
         admitted = 0
         for i, req in enumerate(reqs):
             try:
@@ -1030,10 +1039,10 @@ class ContinuousEngine:
             tabs = np.full((1, self._M), SINK_BLOCK, np.int32)
             tabs[0, :len(blocks)] = blocks
             _, self._pk, self._pv = self._paged_admit(
-                self._pk, self._pv, jnp.asarray(padded),
-                jnp.asarray(np.array([len(span)], np.int32)),
-                jnp.asarray(tabs),
-                jnp.asarray(np.array([len(matched) * bs], np.int32)))
+                self._pk, self._pv, jnp.asarray(padded, jnp.int32),
+                jnp.asarray([len(span)], jnp.int32),
+                jnp.asarray(tabs, jnp.int32),
+                jnp.asarray([len(matched) * bs], jnp.int32))
             with self._pool_lock:
                 for j in range(len(matched), nfull):
                     self._pool.insert(hashes[j], blocks[j])
@@ -1152,8 +1161,10 @@ class ContinuousEngine:
             pos[i] = n_match * self._bs
             tabs[i, :len(blocks)] = blocks
         last, self._pk, self._pv = self._paged_admit(
-            self._pk, self._pv, jnp.asarray(padded), jnp.asarray(lens),
-            jnp.asarray(tabs), jnp.asarray(pos))
+            self._pk, self._pv, jnp.asarray(padded, jnp.int32),
+            jnp.asarray(lens, jnp.int32), jnp.asarray(tabs, jnp.int32),
+            jnp.asarray(pos, jnp.int32))
+        last = np.asarray(last)     # one D2H for the whole group
         admitted = 0
         for i, (req, full, hashes, n_match, blocks) in enumerate(plans):
             plen = len(full)
@@ -1305,13 +1316,20 @@ class ContinuousEngine:
                     seed, top_p: float = 0.0) -> int:
         """The prefill's last-position logits produce the request's first
         token — same pick semantics (and rng position-fold) as
-        ``generate``'s step at t = plen-1."""
+        ``generate``'s step at t = plen-1.  ``last_logits`` arrives as
+        host numpy: every admission path fetches its whole group's
+        logits in ONE transfer, so the common greedy pick costs zero
+        device round-trips per request."""
         if temp <= 0.0:
-            return int(jnp.argmax(last_logits))
+            return int(np.argmax(last_logits))
         key = jax.random.fold_in(jax.random.key(int(seed)), plen - 1)
-        scaled = last_logits.astype(jnp.float32) / temp
+        scaled = jnp.asarray(last_logits, jnp.float32) / temp
         if top_p > 0.0:
             scaled = top_p_filter(scaled, jnp.float32(top_p))
+        # sampled admission must reproduce pick_next's categorical
+        # bitwise (a preempted-and-readmitted row regenerates the same
+        # token), so the draw stays on device: one sync per SAMPLED
+        # admission only (baselined).
         return int(jax.random.categorical(key, scaled))
 
     def _record_token(self, slot: int, token: int):
@@ -1381,16 +1399,21 @@ class ContinuousEngine:
         step = self._get_step(n_eff, sampled, use_topp)
         if self.paged:
             toks, tok, pos, done, self._pk, self._pv = step(
-                self._pk, self._pv, jnp.asarray(self._tok),
-                jnp.asarray(self._pos), jnp.asarray(self._done),
-                jnp.asarray(self._tables), jnp.asarray(temps),
-                jnp.asarray(seeds), jnp.asarray(topps))
+                self._pk, self._pv, jnp.asarray(self._tok, jnp.int32),
+                jnp.asarray(self._pos, jnp.int32),
+                jnp.asarray(self._done, jnp.bool_),
+                jnp.asarray(self._tables, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32),
+                jnp.asarray(topps, jnp.float32))
         else:
             toks, tok, pos, done, self._ck, self._cv = step(
-                self._ck, self._cv, jnp.asarray(self._tok),
-                jnp.asarray(self._pos), jnp.asarray(self._done),
-                jnp.asarray(temps), jnp.asarray(seeds),
-                jnp.asarray(topps))
+                self._ck, self._cv, jnp.asarray(self._tok, jnp.int32),
+                jnp.asarray(self._pos, jnp.int32),
+                jnp.asarray(self._done, jnp.bool_),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32),
+                jnp.asarray(topps, jnp.float32))
         toks = np.asarray(toks)                     # [n_eff, S]
         # np.asarray of a jax array is a read-only view; _admit writes
         # per-slot entries, so take mutable copies
@@ -1414,8 +1437,10 @@ class ContinuousEngine:
         (toks, n_emit, tok, pos, dpos, done,
          self._ck, self._cv, self._dck, self._dcv) = self._spec_step(
             self._ck, self._cv, self._dck, self._dcv,
-            jnp.asarray(self._tok), jnp.asarray(self._pos),
-            jnp.asarray(self._dpos), jnp.asarray(self._done))
+            jnp.asarray(self._tok, jnp.int32),
+            jnp.asarray(self._pos, jnp.int32),
+            jnp.asarray(self._dpos, jnp.int32),
+            jnp.asarray(self._done, jnp.bool_))
         toks = np.asarray(toks)                 # [k+1, S]
         n_emit = np.asarray(n_emit)
         self._tok = np.array(tok)

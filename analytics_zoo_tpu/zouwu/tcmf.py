@@ -191,7 +191,7 @@ class TCMFForecaster:
         # host-resident factor + Adam moments (float32, [n, k] each) —
         # the moments are the SAME optax.adam state as the dense path,
         # sliced per block (ScaleByAdamState fields are plain arrays)
-        F = np.asarray(jax.random.normal(kf, (n, k))) * 0.1
+        F = jax.device_get(jax.random.normal(kf, (n, k)) * 0.1)
         F = F.astype(np.float32)
         mF = np.zeros((n, k), np.float32)
         vF = np.zeros((n, k), np.float32)
@@ -270,16 +270,21 @@ class TCMFForecaster:
                     peak = max(peak, max(
                         (a.size for a in jax.live_arrays()
                          if id(a) not in alive_baseline), default=0))
-                F[lo:hi] = np.asarray(Fb)
-                mF[lo:hi] = np.asarray(mb)
-                vF[lo:hi] = np.asarray(vb)
+                # one fetch for the block's factor + both Adam moments
+                # (host-resident streaming is the point of this path)
+                Fb, mb, vb = jax.device_get((Fb, mb, vb))
+                F[lo:hi] = Fb
+                mF[lo:hi] = mb
+                vF[lo:hi] = vb
             # reported loss is at epoch-START values, like the dense
-            # value_and_grad (X's l2 term added before X is updated)
-            loss = float(total) + self.l2 * float(jnp.mean(X * X))
+            # value_and_grad (X's l2 term added before X is updated);
+            # it stays a device scalar — only the log point and the
+            # final return ever materialize it on host
+            loss = total + self.l2 * jnp.mean(X * X)
             X, optX = apply_X(X, gX, optX)
             if verbose and (ep + 1) % 50 == 0:
                 logger.info("tcmf recon %d (streamed): %.5f", ep + 1,
-                            loss)
+                            float(loss))
         self.F, self.X = F, X
         if self.collect_memory_stats:
             self.peak_device_elems = int(peak)

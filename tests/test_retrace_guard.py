@@ -1,0 +1,83 @@
+"""Steady-state retrace regression for the serving engine: after a
+warmup pass over every shape bucket the decode loop must not compile
+ANYTHING — in arena mode and in paged mode.  TraceGuard discovers the
+engine's jitted callables (``_step_cache``, ``_prefill``,
+``_paged_admit``, ...) by walking the object, so a new compile anywhere
+in the engine fails the test.
+
+Each round uses DISTINCT prompts of identical lengths: identical
+content would let the paged block pool shortcut admission via prefix
+hits (legitimately different shapes), while identical lengths keep
+every bucket, admission-group size and step signature equal across
+rounds.  Round 2 is also warmup — it covers the shapes that only occur
+once the pool/arena already holds earlier traffic — and round 3 runs
+guarded."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.lint import trace_guard
+from analytics_zoo_tpu.models.lm import TransformerLM
+from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+
+LENGTHS = (4, 6, 7, 5)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = TransformerLM(vocab_size=32, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=64, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+def _round(eng, rng, tag):
+    """Submit one batch of distinct prompts (fixed lengths) and drain."""
+    results = {}
+    for i, n in enumerate(LENGTHS):
+        p = rng.integers(1, 32, n).astype(np.int32)
+        p[0] = 1 + (hash(tag) + i) % 31     # distinct heads: no prefix hits
+        eng.submit(f"{tag}-{i}", p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    assert len(results) == len(LENGTHS)
+    return results
+
+
+@pytest.mark.parametrize("mode", ["arena", "paged"])
+def test_decode_steady_state_zero_retraces(lm, mode):
+    model, variables = lm
+    kw = dict(paged=True, block_size=4) if mode == "paged" else {}
+    eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                           max_slots=3, prompt_buckets=(8, 16), **kw)
+    rng = np.random.default_rng(7)
+    _round(eng, rng, "warm1")       # cold compiles: every bucket + steps
+    _round(eng, rng, "warm2")       # shapes unique to a non-empty engine
+    with trace_guard(eng, name=f"{mode}-steady"):
+        _round(eng, rng, "live")    # raises RetraceError on ANY compile
+
+
+@pytest.mark.parametrize("mode", ["arena", "paged"])
+def test_new_bucket_is_detected(lm, mode):
+    """Control for the test above: the guard actually sees the engine's
+    compiles — a never-seen prompt bucket inside the guard must raise."""
+    from analytics_zoo_tpu.lint import RetraceError
+
+    model, variables = lm
+    kw = dict(paged=True, block_size=4) if mode == "paged" else {}
+    eng = ContinuousEngine(model, variables, max_new_tokens=3,
+                           max_slots=2, prompt_buckets=(8, 16), **kw)
+    rng = np.random.default_rng(11)
+    done = {}
+    eng.submit("w", rng.integers(1, 32, 5).astype(np.int32),
+               on_done=lambda u, t: done.__setitem__(u, t))
+    eng.drain()
+    with pytest.raises(RetraceError):
+        with trace_guard(eng, name=f"{mode}-drift"):
+            eng.submit("big", rng.integers(1, 32, 12).astype(np.int32),
+                       on_done=lambda u, t: done.__setitem__(u, t))
+            eng.drain()

@@ -1,0 +1,254 @@
+"""tpulint tests: every rule fires on its bad fixture at the marked
+lines, host orchestration stays clean, inline suppressions and the
+baseline workflow round-trip, the CLI speaks correct exit codes/JSON,
+and TraceGuard counts real retraces."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.lint import (RetraceError, TraceGuard, analyze_file,
+                                    analyze_source, apply_baseline,
+                                    load_baseline, retrace_count, trace_guard,
+                                    write_baseline)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "tpulint_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _marked_lines(path):
+    """{marker_name: 1-based line} from ``# LINE: name`` comments."""
+    out = {}
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            if "# LINE:" in line:
+                out[line.split("# LINE:")[1].strip()] = i
+    return out
+
+
+def _findings(name, **kw):
+    path = os.path.join(FIXTURES, name)
+    kw.setdefault("hot_paths", ("tpulint_fixtures",))
+    return analyze_file(path, **kw), _marked_lines(path)
+
+
+# ---------------------------------------------------------------------------
+# one test per rule: correct ID at every marked line
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fixture,rule,markers", [
+    ("bad_tz001.py", "TZ001", ["item", "float", "np", "helper", "loop"]),
+    ("bad_tz002.py", "TZ002", ["if", "while"]),
+    ("bad_tz003.py", "TZ003", ["shape", "len"]),
+    ("bad_tz004.py", "TZ004", ["loop", "immediate"]),
+    ("bad_tz005.py", "TZ005", ["list", "array"]),
+    ("bad_tz006.py", "TZ006", ["np", "py"]),
+    ("bad_tz007.py", "TZ007", ["asarray", "full"]),
+    ("bad_tz008.py", "TZ008", ["train", "update"]),
+])
+def test_rule_fires_at_marked_lines(fixture, rule, markers):
+    findings, lines = _findings(fixture)
+    got = {f.line for f in findings if f.rule == rule}
+    for m in markers:
+        assert lines[m] in got, \
+            f"{fixture}: {rule} missing at line {lines[m]} ({m}); got {got}"
+    # no OTHER rule misfires on the fixture's marked lines
+    assert got == {lines[m] for m in markers}
+
+
+def test_bad_tz007_requires_hot_path():
+    path = os.path.join(FIXTURES, "bad_tz007.py")
+    cold = analyze_file(path, hot_paths=("nonexistent/",))
+    assert not [f for f in cold if f.rule == "TZ007"]
+
+
+def test_good_host_is_clean():
+    findings, _ = _findings("good_host.py")
+    assert findings == [], [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+SUPPRESSIBLE = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    s = jnp.sum(x)
+    if s > 0:  # tpulint: disable=TZ002
+        return x
+    return -x
+
+@jax.jit
+def g(x):
+    s = jnp.sum(x)
+    # tpulint: disable-next-line=all
+    if s > 0:
+        return x
+    return -x
+"""
+
+
+def test_inline_suppressions():
+    assert analyze_source(SUPPRESSIBLE, "s.py") == []
+    # without the pragmas both branches flag
+    bare = SUPPRESSIBLE.replace("  # tpulint: disable=TZ002", "") \
+                       .replace("    # tpulint: disable-next-line=all\n", "")
+    assert len(analyze_source(bare, "s.py")) == 2
+
+
+def test_suppression_wrong_rule_still_fires():
+    src = SUPPRESSIBLE.replace("disable=TZ002", "disable=TZ001")
+    assert [f.rule for f in analyze_source(src, "s.py")] == ["TZ002"]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    findings, _ = _findings("bad_tz002.py")
+    bp = str(tmp_path / "base.json")
+    n = write_baseline(bp, findings, None)
+    assert n == len(findings) > 0
+    kept, suppressed = apply_baseline(findings, load_baseline(bp))
+    assert kept == [] and len(suppressed) == len(findings)
+
+
+def test_baseline_is_line_drift_stable_but_text_sensitive(tmp_path):
+    findings, _ = _findings("bad_tz002.py")
+    bp = str(tmp_path / "base.json")
+    write_baseline(bp, findings, None)
+    # same text on a different line: still suppressed (line drift)
+    drifted = [type(f)(f.rule, f.path, f.line + 40, f.col, f.message, f.text)
+               for f in findings]
+    kept, _ = apply_baseline(drifted, load_baseline(bp))
+    assert kept == []
+    # edited source text: the finding resurfaces
+    edited = [type(f)(f.rule, f.path, f.line, f.col, f.message,
+                      f.text + "  # touched") for f in findings]
+    kept, _ = apply_baseline(edited, load_baseline(bp))
+    assert len(kept) == len(findings)
+
+
+def test_write_baseline_preserves_reasons(tmp_path):
+    findings, _ = _findings("bad_tz002.py")
+    bp = str(tmp_path / "base.json")
+    write_baseline(bp, findings, None)
+    data = json.load(open(bp))
+    data["entries"][0]["reason"] = "deliberate: fixture"
+    json.dump(data, open(bp, "w"))
+    write_baseline(bp, findings, load_baseline(bp))
+    data = json.load(open(bp))
+    assert data["entries"][0]["reason"] == "deliberate: fixture"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.lint", *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_exit_codes_and_json():
+    bad = os.path.join("tests", "tpulint_fixtures", "bad_tz002.py")
+    r = _cli(bad, "--no-baseline", "--format", "json")
+    assert r.returncode == 1, r.stderr
+    payload = json.loads(r.stdout)
+    assert {f["rule"] for f in payload["findings"]} == {"TZ002"}
+    good = os.path.join("tests", "tpulint_fixtures", "good_host.py")
+    assert _cli(good, "--no-baseline").returncode == 0
+
+
+def test_cli_select_filters_rules():
+    bad = os.path.join("tests", "tpulint_fixtures", "bad_tz001.py")
+    r = _cli(bad, "--no-baseline", "--select", "TZ006", "--format", "json")
+    assert r.returncode == 0 and json.loads(r.stdout)["findings"] == []
+
+
+def test_cli_parse_failure_exit_2(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    r = _cli(str(broken), "--no-baseline")
+    assert r.returncode == 2 and "TZ000" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# TraceGuard
+# ---------------------------------------------------------------------------
+
+def test_retrace_count_tracks_compile_cache():
+    f = jax.jit(lambda x: x * 2)
+    assert retrace_count(f) == 0
+    f(jnp.zeros((4,), jnp.float32))
+    assert retrace_count(f) == 1
+    f(jnp.ones((4,), jnp.float32))          # same signature: no growth
+    assert retrace_count(f) == 1
+    f(jnp.zeros((8,), jnp.float32))         # new shape: retrace
+    assert retrace_count(f) == 2
+
+
+def test_trace_guard_passes_on_steady_state():
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.zeros((4,), jnp.float32))         # warmup
+    with trace_guard(f, name="steady"):
+        for _ in range(5):
+            f(jnp.zeros((4,), jnp.float32))
+
+
+def test_trace_guard_raises_on_retrace():
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.zeros((4,), jnp.float32))
+    with pytest.raises(RetraceError) as ei:
+        with trace_guard(f, name="drift"):
+            f(jnp.zeros((5,), jnp.float32))     # shape drift
+    assert sum(ei.value.counts.values()) == 1
+
+
+def test_trace_guard_budget_and_counts():
+    f = jax.jit(lambda x: x - 1)
+    with TraceGuard(f, budget=2) as g:      # cold: 2 compiles allowed
+        f(jnp.zeros((2,), jnp.float32))
+        f(jnp.zeros((3,), jnp.float32))
+        assert g.total() == 2
+    holder = {"f": jax.jit(lambda x: x * 3)}
+    with trace_guard(holder, budget=1):     # dict target + fresh compile
+        holder["f"](jnp.zeros((2,), jnp.float32))
+
+
+def test_trace_guard_walks_object_attributes():
+    class Engine:
+        def __init__(self):
+            self.step = jax.jit(lambda x: x * x)
+            self.cache = {}
+
+    eng = Engine()
+    eng.step(jnp.zeros((4,), jnp.float32))
+    with pytest.raises(RetraceError):
+        with trace_guard(eng):
+            # a NEW jitted callable appearing in a tracked container
+            # counts from zero — the per-request-compile failure mode
+            eng.cache["g"] = jax.jit(lambda x: x + 2)
+            eng.cache["g"](jnp.zeros((4,), jnp.float32))
+
+
+def test_trace_guard_no_mask_on_exception():
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.zeros((4,), jnp.float32))
+    with pytest.raises(ValueError):         # original exception wins
+        with trace_guard(f):
+            f(jnp.zeros((9,), jnp.float32))
+            raise ValueError("boom")

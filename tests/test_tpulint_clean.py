@@ -1,0 +1,38 @@
+"""Tier-1 gate: the library must be tpulint-clean.
+
+Any finding not recorded in ``tpulint_baseline.json`` fails this test —
+fix it, suppress it inline with a justification, or (for deliberate
+host/device trade-offs) add it to the baseline with a reason via
+``python -m analytics_zoo_tpu.lint analytics_zoo_tpu/ --write-baseline``.
+See docs/lint.md."""
+
+import os
+
+from analytics_zoo_tpu.lint import (analyze_paths, apply_baseline,
+                                    load_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tpulint_baseline.json")
+
+
+def test_library_is_tpulint_clean():
+    findings = analyze_paths([os.path.join(REPO, "analytics_zoo_tpu")],
+                             rel_to=REPO)
+    baseline = load_baseline(BASELINE) if os.path.exists(BASELINE) else None
+    kept, _ = apply_baseline(findings, baseline)
+    assert kept == [], "non-baselined tpulint findings:\n" + \
+        "\n".join(f.format() for f in kept)
+
+
+def test_baseline_entries_are_justified_and_live():
+    """Every baseline entry still matches a real finding (no stale
+    entries accumulating) and carries a real reason (no TODOs)."""
+    baseline = load_baseline(BASELINE)
+    findings = analyze_paths([os.path.join(REPO, "analytics_zoo_tpu")],
+                             rel_to=REPO)
+    live = {(f.path, f.rule, f.text) for f in findings}
+    for e in baseline.entries:
+        assert e.get("reason") and "TODO" not in e["reason"], \
+            f"baseline entry without justification: {e}"
+        assert (e["path"], e["rule"], e["text"]) in live, \
+            f"stale baseline entry (finding no longer exists): {e}"

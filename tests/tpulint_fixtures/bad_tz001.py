@@ -1,0 +1,39 @@
+"""TZ001 fixture: host-device syncs reachable from a jitted entry and
+sync-per-iteration host loops."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_item(x):
+    s = jnp.sum(x)
+    return s.item()                         # LINE: item
+
+
+@jax.jit
+def traced_float(x):
+    s = jnp.sum(x)
+    return float(s)                         # LINE: float
+
+
+@jax.jit
+def traced_np(x):
+    return np.asarray(jnp.exp(x))           # LINE: np
+
+
+def helper(y):
+    return jax.device_get(y)                # LINE: helper
+
+
+@jax.jit
+def calls_helper(x):
+    return helper(x * 2)
+
+
+def host_loop(xs):
+    total = 0.0
+    for x in xs:
+        loss = jnp.sum(x)
+        total += float(loss)                # LINE: loop
+    return total

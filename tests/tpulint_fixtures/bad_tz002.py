@@ -1,0 +1,19 @@
+"""TZ002 fixture: Python control flow branching on traced values."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_tracer(x):
+    s = jnp.sum(x)
+    if s > 0:                               # LINE: if
+        return x * 2
+    return x
+
+
+@jax.jit
+def while_on_tracer(x):
+    n = jnp.sum(x)
+    while n > 0:                            # LINE: while
+        n = n - 1
+    return n

@@ -1,0 +1,20 @@
+"""TZ003 fixture: unrolled jnp work in Python loops over dynamic or
+shape-dependent ranges."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def unrolled_shape(x):
+    acc = jnp.zeros_like(x[0])
+    for i in range(x.shape[0]):             # LINE: shape
+        acc = acc + jnp.exp(x[i])
+    return acc
+
+
+@jax.jit
+def unrolled_len(x, n):
+    y = x
+    for _ in range(len(x)):                 # LINE: len
+        y = jnp.tanh(y)
+    return y

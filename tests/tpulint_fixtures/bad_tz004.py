@@ -1,0 +1,14 @@
+"""TZ004 fixture: jax.jit constructed per call."""
+import jax
+import jax.numpy as jnp
+
+
+def jit_in_loop(fn, xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(fn)(x))          # LINE: loop
+    return out
+
+
+def jit_immediate(x):
+    return jax.jit(jnp.tanh)(x)             # LINE: immediate

@@ -1,0 +1,13 @@
+"""TZ005 fixture: mutable / array default arguments on jitted functions."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def mutable_default(x, scales=[1.0, 2.0]):  # LINE: list
+    return x * scales[0]
+
+
+@jax.jit
+def array_default(x, bias=jnp.zeros(4)):    # LINE: array
+    return x + bias

@@ -1,0 +1,16 @@
+"""TZ006 fixture: host RNG inside traced code (baked into the trace)."""
+import random
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def np_random(x):
+    noise = np.random.normal(size=4)        # LINE: np
+    return x + noise
+
+
+@jax.jit
+def py_random(x):
+    return x * random.random()              # LINE: py

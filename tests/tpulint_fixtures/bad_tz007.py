@@ -1,0 +1,20 @@
+"""TZ007 fixture: implicit-dtype conversions in serving hot paths.
+
+This file is only flagged when analyzed with a hot-path pattern that
+matches it (the tests pass ``--hot-path tpulint_fixtures``).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def admit(tokens):
+    padded = np.zeros((4, 16), np.int32)
+    return jnp.asarray(padded)              # LINE: asarray
+
+
+def build(v):
+    return jnp.full((v,), -jnp.inf)         # LINE: full
+
+
+def ok_explicit(tokens):
+    return jnp.asarray(tokens, jnp.int32)   # not flagged: explicit dtype

@@ -1,0 +1,25 @@
+"""TZ008 fixture: train-step-shaped jit without donate_argnums."""
+from functools import partial
+
+import jax
+
+
+def train_step(state, batch):
+    return state, 0.0
+
+
+def update_step(state, batch):
+    return state, 0.0
+
+
+def eval_step(state, batch):
+    return state, 0.0
+
+
+jitted_train = jax.jit(train_step)          # LINE: train
+
+jitted_update = jax.jit(partial(update_step, batch=None))  # LINE: update
+
+jitted_eval = jax.jit(eval_step)            # not flagged: not a train step
+
+jitted_good = jax.jit(train_step, donate_argnums=(0,))  # not flagged: donates

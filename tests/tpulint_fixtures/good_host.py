@@ -1,0 +1,59 @@
+"""Negative fixture: ordinary host orchestration that must NOT be
+flagged.  Every pattern here is one the analyzer previously
+false-positived on somewhere, or a near-miss of a rule."""
+import concurrent.futures as cf
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def read_one(path):
+    # host callback handed to a thread pool — pool.map is NOT a JAX
+    # combinator, so nothing here is traced (no TZ001/TZ006)
+    data = np.fromfile(path, np.uint8)
+    return float(data.mean())
+
+
+def load_all(paths):
+    with cf.ThreadPoolExecutor() as pool:
+        return list(pool.map(read_one, paths))
+
+
+@jax.jit
+def static_branch(x, training: bool = False):
+    # bool param is a static argument in spirit: branch compiles away
+    if training:
+        return x * 2
+    return x
+
+
+@jax.jit
+def shape_branch(x):
+    # .shape is trace-static — branching on it is fine (no TZ002)
+    if x.shape[0] > 1:
+        return x.sum(axis=0)
+    return x[0]
+
+
+@jax.jit
+def constant_unroll(x):
+    # range over a literal is a bounded, deliberate unroll (no TZ003)
+    for _ in range(4):
+        x = jnp.tanh(x)
+    return x
+
+
+def epoch(step, state, batches):
+    # the one-sync-per-epoch idiom: fetch AFTER the loop (no TZ001)
+    losses = []
+    for b in batches:
+        state, loss = step(state, b)
+        losses.append(loss)
+    return state, [float(v) for v in jax.device_get(losses)]
+
+
+def final_report(x):
+    # syncs OUTSIDE any loop in host code are normal termination
+    y = jnp.sum(jnp.asarray(x, jnp.float32))
+    return float(jax.device_get(y))
