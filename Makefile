@@ -42,11 +42,13 @@ lint:
 bench:
 	$(PY) bench.py
 
-# paged serving smoke: the paged KV-cache test file + a 20-request e2e
-# wire-protocol bench leg, both forced onto host CPU (fast; fits the
+# serving smoke: the paged KV-cache + chunked-prefill test files + a
+# 20-request e2e wire-protocol bench leg (which drives the chunked
+# scheduler end to end), all forced onto host CPU (fast; fits the
 # tier-1 timeout)
 serve-smoke:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py \
+	    tests/test_chunked_prefill.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
 
 clean:

@@ -333,7 +333,10 @@ class InferenceModel:
                                block_size: int = 16,
                                n_blocks: Optional[int] = None,
                                hbm_fraction: Optional[float] = None,
-                               enable_prefix_cache: bool = True):
+                               enable_prefix_cache: bool = True,
+                               chunked: bool = False,
+                               tick_token_budget: Optional[int] = None,
+                               record_timings: bool = False):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
         loaded via ``load_flax_generator`` (quantized weights dequantize
         once at build — the engine trades the at-rest memory win for
@@ -347,7 +350,12 @@ class InferenceModel:
         cache (serving/paged_cache.py: pay-as-you-grow block
         allocation, automatic prefix sharing, preemption-to-queue —
         docs/serving_memory.md); ``block_size``/``n_blocks``/
-        ``hbm_fraction``/``enable_prefix_cache`` size and tune it."""
+        ``hbm_fraction``/``enable_prefix_cache`` size and tune it.
+
+        ``chunked=True`` turns on the token-budget tick scheduler:
+        prompts prefill in ``tick_token_budget``-bounded chunks fused
+        with active decodes in one device call per tick — long joiners
+        stop stalling residents (docs/serving_memory.md 'Scheduler')."""
         from analytics_zoo_tpu.serving.continuous import ContinuousEngine
 
         if getattr(self, "_gen_max_new_tokens", None) is None:
@@ -374,7 +382,9 @@ class InferenceModel:
             mesh=mesh, partition_rules=partition_rules,
             paged=paged, block_size=block_size, n_blocks=n_blocks,
             hbm_fraction=hbm_fraction,
-            enable_prefix_cache=enable_prefix_cache, **spec)
+            enable_prefix_cache=enable_prefix_cache,
+            chunked=chunked, tick_token_budget=tick_token_budget,
+            record_timings=record_timings, **spec)
 
     def load_openvino(self, xml_path: str, bin_path: str = None,
                       quantize: Optional[str] = None) -> "InferenceModel":

@@ -482,7 +482,7 @@ class DecoderAttention(nn.Module):
         o = o.reshape(B, S, self._h, self._d)
         return self.attn_out(o.astype(self.dtype)), cache_k, cache_v
 
-    def decode_paged(self, xs, pool_k, pool_v, tables, pos):
+    def decode_paged(self, xs, pool_k, pool_v, tables, pos, limit=None):
         """Cached decode of S tokens per row against a PAGED KV cache.
 
         Same contract as :meth:`decode_k` except the cache is one flat
@@ -495,7 +495,9 @@ class DecoderAttention(nn.Module):
         S>1 is the block-causal prefill/verify forward.  Returns (ys
         [B, S, E], pool_k, pool_v) with the S new K/V rows scattered
         through the tables (write precedes the attention read, so each
-        token attends itself).
+        token attends itself).  ``limit`` ([B] int32, optional) drops
+        writes at positions >= limit[b] — chunked prefill's padding
+        guard (see ops.flash_attention.paged_kv_update).
         """
         from analytics_zoo_tpu.ops.flash_attention import (
             paged_attention, paged_kv_update)
@@ -508,7 +510,7 @@ class DecoderAttention(nn.Module):
             q = _apply_rope(q, p, self.rope_base)
             ks = _apply_rope(ks, p, self.rope_base)
         pool_k, pool_v = paged_kv_update(pool_k, pool_v, tables, pos,
-                                         ks, vs)
+                                         ks, vs, limit=limit)
         o = paged_attention(q, pool_k, pool_v, tables, pos)
         return self.attn_out(o.astype(self.dtype)), pool_k, pool_v
 
@@ -614,10 +616,10 @@ class DecoderLayer(nn.Module):
         xs = xs + self._mlp(self.ln_ffn(xs).astype(self.dtype), False)
         return xs, ck, cv
 
-    def decode_paged(self, xs, pool_k, pool_v, tables, pos):
+    def decode_paged(self, xs, pool_k, pool_v, tables, pos, limit=None):
         a, pk, pv = self.attention.decode_paged(
             self.ln_attn(xs).astype(self.dtype), pool_k, pool_v,
-            tables, pos)
+            tables, pos, limit=limit)
         xs = xs + a
         xs = xs + self._mlp(self.ln_ffn(xs).astype(self.dtype), False)
         return xs, pk, pv
@@ -958,12 +960,15 @@ class TransformerLM(nn.Module):
                                              tables, pos)
         return self._logits(h), pk, pv
 
-    def verify_hidden_paged(self, toks, pools_k, pools_v, tables, pos):
+    def verify_hidden_paged(self, toks, pools_k, pools_v, tables, pos,
+                            limit=None):
         """``verify_step_paged`` minus the vocab head: (hidden [B, S,
         H], pools).  The paged-admission prefill consumes ONE position
         per row, gathers that hidden state, and applies the head to
         [B, 1, H] — same logits-residency rationale as
-        :meth:`verify_hidden`."""
+        :meth:`verify_hidden`.  ``limit`` ([B] int32, optional) drops
+        K/V writes at positions >= limit[b] (padding columns of a
+        chunk/suffix grid write nothing at all)."""
         if self.pp_stages > 0:
             raise NotImplementedError(
                 "verify_step is not pipelined (same restriction as "
@@ -977,10 +982,51 @@ class TransformerLM(nn.Module):
         ks, vs = [], []
         for i, layer in enumerate(self.layers):
             x, pk, pv = layer.decode_paged(x, pools_k[i], pools_v[i],
-                                           tables, pos)
+                                           tables, pos, limit=limit)
             ks.append(pk)
             vs.append(pv)
         return self.ln_f(x), jnp.stack(ks), jnp.stack(vs)
+
+    def prefill_chunk(self, toks, caches_k, caches_v, pos, lens):
+        """One CHUNKED-PREFILL step against the slot-arena cache: run a
+        ``[B, C]`` chunk of each row's prompt block-causally at its own
+        position offset (``verify_hidden`` — the same offset attention
+        the speculative verify and prefix admission use), write the
+        chunk's K/V into the per-row cache, and return each row's
+        last-real-position logits ``[B, V]`` (head applied to
+        ``[B, 1, H]`` — never a ``[B, C, V]`` cube).
+
+        toks: [B, C] chunk tokens (right-padded); caches as in
+        :meth:`decode_step`; pos: [B] int32 — row b's chunk starts at
+        cache position pos[b] (its fill frontier); lens: [B] int32 true
+        chunk lengths.  On the FINAL chunk of a prompt the returned
+        logits are exactly the monolithic prefill's last-position
+        logits, so the caller picks the request's first token from
+        them; mid-prompt the return value is dead.  Padding columns
+        write dead K/V past the frontier that the next chunk (or
+        decode) overwrites before anything attends them — the arena
+        rows are private, so unlike the paged twin no write-limit is
+        needed."""
+        h, ck, cv = self.verify_hidden(toks, caches_k, caches_v, pos)
+        last_h = jnp.take_along_axis(h, (lens - 1)[:, None, None],
+                                     axis=1)
+        return self._logits(last_h)[:, 0], ck, cv
+
+    def prefill_chunk_paged(self, toks, pools_k, pools_v, tables, pos,
+                            lens):
+        """The paged twin of :meth:`prefill_chunk`: the chunk's K/V
+        scatter through per-row block tables into the shared pool, with
+        writes LIMITED to ``pos + lens`` — padding columns write
+        nothing, so a narrow table window (sliced to the fill frontier
+        for bounded compile shapes) can never clamp a padding write
+        into a live block.  Also the whole of paged admission: a
+        prompt's unshared suffix IS its one big chunk."""
+        h, pk, pv = self.verify_hidden_paged(toks, pools_k, pools_v,
+                                             tables, pos,
+                                             limit=pos + lens)
+        last_h = jnp.take_along_axis(h, (lens - 1)[:, None, None],
+                                     axis=1)
+        return self._logits(last_h)[:, 0], pk, pv
 
     def prefill(self, tokens):
         """Causal forward that ALSO returns every layer's K/V: ``(logits

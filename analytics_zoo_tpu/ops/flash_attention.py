@@ -402,7 +402,8 @@ def sharded_flash_attention(q, k, v, mesh, kv_mask=None, *,
 # paged attention (block-pool KV cache, serving/paged_cache.py)
 # ---------------------------------------------------------------------------
 
-def paged_kv_update(pool_k, pool_v, tables, pos, new_k, new_v):
+def paged_kv_update(pool_k, pool_v, tables, pos, new_k, new_v,
+                    limit=None):
     """Scatter S new K/V rows per batch row into a block-pool cache.
 
     pool_k/pool_v: ``[N, bs, KH, D]`` — the flat block arena (N physical
@@ -421,6 +422,15 @@ def paged_kv_update(pool_k, pool_v, tables, pos, new_k, new_v):
     checked here): every (row, position) a caller actually cares about
     maps to a PRIVATE tail block of that row, so real writes never
     collide; sink-block collisions are garbage-on-garbage.
+
+    ``limit`` (``[B]`` int32, optional): row b's writes at logical
+    positions ``>= limit[b]`` are DROPPED outright.  Chunked prefill
+    passes its per-row true length here: with tables SLICED to a narrow
+    ``[B, M']`` window (bounded compile shapes proportional to the fill
+    frontier, not the max sequence), a padding position past the window
+    would otherwise clamp to table column M'-1 — a live frontier block
+    — and corrupt real K/V.  Reads are unaffected; attention masking is
+    :func:`paged_attention`'s job.
     """
     N, bs, KH, D = pool_k.shape
     B, S = new_k.shape[:2]
@@ -429,6 +439,9 @@ def paged_kv_update(pool_k, pool_v, tables, pos, new_k, new_v):
     blk = jnp.minimum(p // bs, M - 1)
     phys = jnp.take_along_axis(tables, blk, axis=1)         # [B, S]
     flat_idx = phys * bs + (p % bs)                         # [B, S]
+    if limit is not None:
+        # out-of-range index + mode="drop" = the write never happens
+        flat_idx = jnp.where(p < limit[:, None], flat_idx, N * bs)
     pk = pool_k.reshape(N * bs, KH, D).at[flat_idx].set(
         new_k.astype(pool_k.dtype), mode="drop")
     pv = pool_v.reshape(N * bs, KH, D).at[flat_idx].set(
@@ -452,6 +465,12 @@ def paged_attention(q, pool_k, pool_v, tables, pos):
     is a plain data dependency).  ``KH <= H`` is grouped-query
     attention: q regroups ``[B, S, KH, G, D]`` so each KV head serves
     its G query heads without materialising expanded K/V.
+
+    The table width M is a free parameter: callers may pass a SLICED
+    ``[B, M']`` table whose window covers every position ``<= pos[b] +
+    S - 1`` they attend — chunked prefill does exactly this so the
+    gather/einsum cost tracks the fill frontier (bucketed for a bounded
+    compile count), not the max sequence length.
 
     Implementation is the ``jnp.take``-based fallback — one gather to
     ``[B, M*bs, KH, D]`` rows then the same masked einsum-softmax the

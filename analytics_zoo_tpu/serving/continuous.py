@@ -31,6 +31,7 @@ from __future__ import annotations
 import collections
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import (Callable, Dict, List, NamedTuple, Optional,
@@ -83,6 +84,19 @@ class _Slot:
     # making forward progress, so preemption can never livelock)
     req: Optional[_Req] = None
     admit_seq: int = 0
+    # chunked-prefill state machine: a slot admits as "PREFILLING" and
+    # feeds its prompt to the cache chunk by chunk (fill_pos = next
+    # cache position to write, starting past any spliced/shared
+    # prefix); the tick its last chunk lands it emits its first token
+    # and flips to "DECODE".  ``full`` holds the not-yet-fed tokens
+    # (positions base..plen-1); ``hashes``/``n_pub`` track which full
+    # prompt blocks the paged path has already published for sharing.
+    state: str = "DECODE"
+    fill_pos: int = 0
+    base: int = 0
+    full: Optional[np.ndarray] = None
+    hashes: Optional[list] = None
+    n_pub: int = 0
 
 
 class ContinuousEngine:
@@ -136,7 +150,10 @@ class ContinuousEngine:
                  paged: bool = False, block_size: int = 16,
                  n_blocks: Optional[int] = None,
                  hbm_fraction: Optional[float] = None,
-                 enable_prefix_cache: bool = True):
+                 enable_prefix_cache: bool = True,
+                 chunked: bool = False,
+                 tick_token_budget: Optional[int] = None,
+                 record_timings: bool = False):
         """``mesh`` (with a ``tp`` axis) serves a model LARGER than one
         chip's HBM: weights shard per ``partition_rules`` (default
         ``LM_PARTITION_RULES`` — Megatron layout), the KV arena shards
@@ -282,6 +299,64 @@ class ContinuousEngine:
             # block, so stray writes land in storage nothing attends
             self._tables = np.full((S, M), SINK_BLOCK, np.int32)
             self._row_blocks: List[List[int]] = [[] for _ in range(S)]
+        # ---- chunked prefill (token-budget tick scheduler) -------------
+        # chunked=True replaces monolithic admission prefill with
+        # incremental chunks packed alongside decodes under a per-tick
+        # token budget — long prompts stop stalling active decoders.
+        self.chunked = bool(chunked)
+        self.record_timings = bool(record_timings)
+        self._timings: Dict[str, dict] = {}
+        self._prefill_stall_ticks = 0
+        self._prefill_preemptions = 0
+        self._budget_tokens_used = 0
+        self._budget_ticks = 0
+        self.tick_token_budget: Optional[int] = None
+        if self.chunked:
+            if draft_model is not None:
+                raise NotImplementedError(
+                    "chunked prefill + speculative decoding is not "
+                    "implemented; drop either chunked or draft_model")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "chunked prefill is single-chip for now; drop mesh")
+            if tick_token_budget is None:
+                # default: roughly one decode-bucket of MXU work — all S
+                # decode rows plus at least one smallest-bucket chunk
+                # (and at least one paged block) fit in a tick
+                budget = max(self.prompt_buckets[0] + S, 2 * S)
+                if self.paged:
+                    budget = max(budget, self._bs)
+            else:
+                budget = int(tick_token_budget)
+                if budget < self.prompt_buckets[0]:
+                    raise ValueError(
+                        f"tick_token_budget={budget} is below the "
+                        f"smallest chunk bucket "
+                        f"{self.prompt_buckets[0]}: no prefill chunk "
+                        f"could ever be scheduled and admission would "
+                        f"livelock; raise the budget or add a smaller "
+                        f"prompt bucket")
+                if self.paged and budget < self._bs:
+                    raise ValueError(
+                        f"tick_token_budget={budget} is below "
+                        f"block_size={self._bs}: a chunk could never "
+                        f"cover one paged block per tick; raise the "
+                        f"budget or shrink block_size")
+            self.tick_token_budget = budget
+            # chunk widths reuse the prompt buckets (bounded compile
+            # count), trimmed to what the budget can ever schedule
+            self._chunk_buckets = tuple(
+                b for b in self.prompt_buckets if b <= budget)
+            # arena chunk attention reads a [kb, read_len] cache window
+            # that tracks the fill frontier — pow2 buckets keep the
+            # compile count O(log L) instead of one per frontier
+            rb: List[int] = []
+            v = 8
+            while v < L:
+                rb.append(v)
+                v *= 2
+            rb.append(L)
+            self._read_buckets = tuple(rb)
         tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
         if self.paged:
             self._ck = self._cv = None  # pool replaces the slot arena
@@ -429,20 +504,16 @@ class ContinuousEngine:
             """Paged admission prefill: each row's (unshared) prompt
             suffix runs block-causally against pool K/V its table
             already maps — prefix-matched blocks behind ``pos`` read as
-            if this row had prefilled them itself.  Suffix padding
-            beyond ``slens`` writes dead K/V (sink or masked private
-            tail — decode overwrites each position before attending
-            it); padding ROWS carry all-sink tables.  Returns each
-            row's last-real-position logits (the head applied to [kb,
-            1, H] — never the [kb, sb, V] cube)."""
-            h, pk, pv = model.apply(
-                variables, suffixes, pk, pv, tables, pos,
-                method=TransformerLM.verify_hidden_paged)
-            last_h = jnp.take_along_axis(
-                h, (slens - 1)[:, None, None], axis=1)
-            logits = model.apply(variables, last_h,
-                                 method=TransformerLM._logits)[:, 0]
-            return logits, pk, pv
+            if this row had prefilled them itself.  Monolithic
+            admission IS one maximal chunk, so this is just
+            ``prefill_chunk_paged``: writes limited to ``pos + slens``
+            (suffix padding writes nothing), padding ROWS carry
+            all-sink tables, and the return is each row's
+            last-real-position logits (the head applied to [kb, 1, H]
+            — never the [kb, sb, V] cube)."""
+            return model.apply(
+                variables, suffixes, pk, pv, tables, pos, slens,
+                method=TransformerLM.prefill_chunk_paged)
 
         self._paged_admit = jax.jit(paged_admit_fn,
                                     donate_argnums=(0, 1))
@@ -467,6 +538,101 @@ class ContinuousEngine:
             return ck, cv
 
         self._insert = jax.jit(insert_fn, donate_argnums=(0, 1))
+
+        # ---- fused chunked tick (decode + prefill chunks, ONE call) ----
+        S_arena = S
+
+        def fused_fn(ck, cv, tok, pos, done, temps, seeds, topps,
+                     ctoks, cpos, clens, cslots, ctemps, cseeds,
+                     ctopps, with_decode, use_sample, use_topp,
+                     read_len):
+            """One budget-bounded tick: decode EVERY slot once (bitwise
+            the unfused 1-tick step — PREFILLING rows ride along frozen,
+            their one garbage write at the fill frontier is overwritten
+            by their own chunk below, in this same program), then run
+            the tick's prefill chunks block-causally at their fill
+            offsets via ``prefill_chunk`` on a compact ``[kb,
+            read_len]`` cache window (gathered/scattered exactly like
+            ``_prefix_admit``: padding rows carry the out-of-range slot
+            index S — reads clamp, writes drop).  Returns the decode
+            picks AND each chunk row's next-token pick: a prompt's
+            first token is chosen the tick its last chunk lands, with
+            the same rng position-fold as ``_pick_first``."""
+            if with_decode:
+                logits, ck, cv = model.apply(
+                    variables, tok, ck, cv, pos,
+                    method=TransformerLM.decode_step)
+                nxt, done = pick_next(logits, pos, done, temps, seeds,
+                                      topps, use_sample, use_topp)
+                pos = jnp.minimum(pos + 1, Lmax - 1)
+            else:
+                nxt = tok
+            read_idx = jnp.minimum(cslots, S_arena - 1)
+            rows_k = jnp.take(ck, read_idx, axis=1)[:, :, :read_len]
+            rows_v = jnp.take(cv, read_idx, axis=1)[:, :, :read_len]
+            clog, rows_k, rows_v = model.apply(
+                variables, ctoks, rows_k, rows_v, cpos, clens,
+                method=TransformerLM.prefill_chunk)
+            ck = ck.at[:, cslots, :read_len].set(
+                rows_k.astype(ck.dtype), mode="drop")
+            cv = cv.at[:, cslots, :read_len].set(
+                rows_v.astype(cv.dtype), mode="drop")
+            cnxt, _ = pick_next(
+                clog, cpos + clens - 1,
+                jnp.zeros(clens.shape, jnp.bool_), ctemps, cseeds,
+                ctopps, use_sample, use_topp)
+            return nxt, pos, done, cnxt, ck, cv
+
+        def fused_paged_fn(pk, pv, tok, pos, done, tables, temps,
+                           seeds, topps, ctoks, cpos, clens, ctabs,
+                           ctemps, cseeds, ctopps, with_decode,
+                           use_sample, use_topp):
+            """The paged twin: chunks scatter through NARROW per-row
+            tables (``ctabs`` [kb, Mb], host-sliced to the fill
+            frontier, bucketed) — ``prefill_chunk_paged`` limits writes
+            to ``cpos + clens`` so padding columns write nothing and
+            the narrow window can never clamp a stray write into a
+            live block.  Padding rows carry all-sink tables."""
+            if with_decode:
+                logits, pk, pv = model.apply(
+                    variables, tok, pk, pv, tables, pos,
+                    method=TransformerLM.decode_step_paged)
+                nxt, done = pick_next(logits, pos, done, temps, seeds,
+                                      topps, use_sample, use_topp)
+                pos = jnp.minimum(pos + 1, Lmax - 1)
+            else:
+                nxt = tok
+            clog, pk, pv = model.apply(
+                variables, ctoks, pk, pv, ctabs, cpos, clens,
+                method=TransformerLM.prefill_chunk_paged)
+            cnxt, _ = pick_next(
+                clog, cpos + clens - 1,
+                jnp.zeros(clens.shape, jnp.bool_), ctemps, cseeds,
+                ctopps, use_sample, use_topp)
+            return nxt, pos, done, cnxt, pk, pv
+
+        # one program per (with_decode, sampled, topp, read_len) —
+        # read_len only varies on the arena path (O(log L) buckets)
+        self._fused_cache: Dict[Tuple[bool, bool, bool, int],
+                                Callable] = {}
+
+        def get_fused(with_decode: bool, sampled: bool, use_topp: bool,
+                      read_len: int = 0) -> Callable:
+            key = (with_decode, sampled, use_topp, read_len)
+            if key not in self._fused_cache:
+                if self.paged:
+                    fn = partial(fused_paged_fn,
+                                 with_decode=with_decode,
+                                 use_sample=sampled, use_topp=use_topp)
+                else:
+                    fn = partial(fused_fn, with_decode=with_decode,
+                                 use_sample=sampled, use_topp=use_topp,
+                                 read_len=read_len)
+                self._fused_cache[key] = jax.jit(fn,
+                                                 donate_argnums=(0, 1))
+            return self._fused_cache[key]
+
+        self._get_fused = get_fused
 
         if draft_model is not None:
             self._init_speculative(cdtype)
@@ -506,19 +672,20 @@ class ContinuousEngine:
                     rows_k, pref_k.astype(rows_k.dtype), (0, 0, 0, 0, 0))
                 rows_v = jax.lax.dynamic_update_slice(
                     rows_v, pref_v.astype(rows_v.dtype), (0, 0, 0, 0, 0))
-                logits, rows_k, rows_v = m.apply(
+                # the suffix is ONE chunk at offset P: prefill_chunk is
+                # the block-causal decode_k forward this path always
+                # ran, minus the [kb, sb, V] logits cube (the head only
+                # touches each row's last real position)
+                last, rows_k, rows_v = m.apply(
                     v, suffixes, rows_k, rows_v,
-                    jnp.full((kb,), P, jnp.int32),
-                    method=TransformerLM.verify_step)
+                    jnp.full((kb,), P, jnp.int32), suffix_lens,
+                    method=TransformerLM.prefill_chunk)
                 ck = ck.at[:, slots].set(rows_k.astype(ck.dtype),
                                          mode="drop")
                 cv = cv.at[:, slots].set(rows_v.astype(cv.dtype),
                                          mode="drop")
                 if not want_logits:
                     return None, ck, cv
-                last = jnp.take_along_axis(
-                    logits, (suffix_lens - 1)[:, None, None],
-                    axis=1)[:, 0]
                 return last, ck, cv
 
             return jax.jit(fn, donate_argnums=(0, 1))
@@ -823,6 +990,9 @@ class ContinuousEngine:
             raise ValueError(
                 f"max_new {mn} outside [1, {self.max_new_tokens}]")
         with self._lock:
+            if self.record_timings:
+                self._timings[uri] = {"arrival": time.monotonic(),
+                                      "token_times": []}
             self._waiting.append(_Req(
                 uri, prompt, on_done, on_error, float(temperature),
                 rng_seed, mn, prefix, float(top_p)))
@@ -835,6 +1005,8 @@ class ContinuousEngine:
         to a power of two so a burst costs a handful of compiles, not
         one per burst size); their K/V splice into slots one
         dynamic_update_slice each.  Returns the number admitted."""
+        if self.chunked:
+            return self._admit_chunked()
         if self.paged:
             return self._admit_paged()
         admitted = 0
@@ -987,6 +1159,135 @@ class ContinuousEngine:
                 self._free.append(real[i])
                 self._req_error(req.uri, req.on_error, e)
         return admitted
+
+    # ---- chunked admission (PREFILLING slots, no device call) ---------
+
+    def _admit_chunked(self) -> int:
+        """Chunked admission runs NO prefill: it only claims a slot,
+        installs it in the ``PREFILLING`` state, and (paged) attaches
+        any prefix-matched blocks — the prompt feeds the cache chunk by
+        chunk inside the fused tick, interleaved with decodes under
+        the token budget.  A paged request the pool can't start yet
+        requeues at the front and admission stops (order preserved);
+        mid-prompt growth handles the rest per chunk."""
+        admitted = 0
+        while self._free:
+            with self._lock:
+                req = self._waiting.popleft() if self._waiting else None
+            if req is None:
+                break
+            res = (self._admit_one_chunked_paged(req) if self.paged
+                   else self._admit_one_chunked(req))
+            if res == "admitted":
+                admitted += 1
+            elif res == "blocked":
+                with self._lock:
+                    self._waiting.appendleft(req)
+                break
+        return admitted
+
+    def _admit_one_chunked(self, req: _Req) -> str:
+        """Arena chunked admission: splice a named prefix's stored K/V
+        (chunks then run against it block-causally, like the monolithic
+        prefix path) and install the slot PREFILLING at the prefix
+        boundary."""
+        base = 0
+        pks = pvs = None
+        if req.prefix is not None:
+            with self._lock:
+                entry = self._prefixes.get(req.prefix)
+            if entry is None:
+                self._req_error(req.uri, req.on_error, ValueError(
+                    f"prefix id {req.prefix} was unregistered while "
+                    f"queued"))
+                return "error"
+            pks, pvs, base = entry[0], entry[1], entry[2]
+        slot = self._free.popleft()
+        if pks is not None:
+            try:
+                self._ck, self._cv = self._insert(
+                    self._ck, self._cv, pks, pvs, jnp.int32(slot))
+            except Exception as e:
+                self._free.append(slot)
+                logger.exception("chunked prefix splice failed for %r",
+                                 req.uri)
+                self._req_error(req.uri, req.on_error, e)
+                return "error"
+        self._install_prefill(slot, req, base + len(req.prompt),
+                              base=base, full=req.prompt)
+        return "admitted"
+
+    def _admit_one_chunked_paged(self, req: _Req) -> str:
+        """Paged chunked admission: match + acquire leading full prompt
+        blocks (copy-free sharing, capped at ``(plen-1)//bs`` so the
+        last token always recomputes for its first-token logits) and
+        install PREFILLING at the matched boundary.  Blocks for the
+        unmatched tail are allocated PER CHUNK by the tick scheduler —
+        a mid-prompt dry pool preempts this prefilling row back to the
+        queue, never a decoder."""
+        try:
+            full = self._full_prompt(req)
+        except Exception as e:
+            self._req_error(req.uri, req.on_error, e)
+            return "error"
+        plen = len(full)
+        hashes = self._pool.block_hashes(full)
+        total = -(-plen // self._bs)
+        with self._pool_lock:
+            matched = self._pool.lookup(
+                hashes[:(plen - 1) // self._bs])
+            need = total - len(matched)
+            if need + 1 > self._pool.n_blocks - 1:
+                self._req_error(req.uri, req.on_error, ValueError(
+                    f"prompt needs {need} private blocks + headroom "
+                    f"but the pool holds {self._pool.n_blocks - 1}"))
+                return "error"
+            # per-chunk allocation only needs room to START (first
+            # chunk block + decode headroom); monolithic admission's
+            # need+1 gate would block exactly the long prompts
+            # chunking exists to stream in
+            if self._pool.allocatable() < 2:
+                if self.n_active == 0:
+                    self._req_error(req.uri, req.on_error, RuntimeError(
+                        f"pool dry with no residents: "
+                        f"{self._pool.num_referenced()} of "
+                        f"{self._pool.n_blocks} blocks are pinned "
+                        f"(unregister a prefix or raise n_blocks)"))
+                    return "error"
+                return "blocked"
+            for b in matched:
+                self._pool.acquire(b)
+        slot = self._free.popleft()
+        self._row_blocks[slot] = list(matched)
+        self._tables[slot, :] = SINK_BLOCK
+        self._tables[slot, :len(matched)] = matched
+        self._install_prefill(slot, req, plen, base=0, full=full,
+                              hashes=list(hashes),
+                              fill=len(matched) * self._bs,
+                              n_pub=len(matched))
+        return "admitted"
+
+    def _install_prefill(self, slot: int, req: _Req, plen: int, *,
+                         base: int, full, hashes=None, fill=None,
+                         n_pub: int = 0) -> None:
+        """Install a slot in the PREFILLING state: the decode side sees
+        a frozen row (done=True, fed pad) anchored at the fill frontier
+        until its last chunk lands.  ``fill`` (paged) starts past
+        prefix-matched blocks; arena rows start past the spliced
+        prefix (``base``)."""
+        self._slots[slot] = _Slot(
+            uri=req.uri, plen=plen, max_new=req.max_new,
+            on_done=req.on_done, on_error=req.on_error,
+            temperature=req.temperature, rng_seed=req.rng_seed,
+            top_p=req.top_p, req=req, admit_seq=self._admit_seq,
+            state="PREFILLING",
+            fill_pos=base if fill is None else fill,
+            base=base, full=np.asarray(full, np.int32),
+            hashes=hashes, n_pub=n_pub)
+        self._admit_seq += 1
+        self._tok[slot] = self.pad_id
+        self._pos[slot] = self._slots[slot].fill_pos
+        self._done[slot] = True
 
     # ---- paged mode (block-pool cache) --------------------------------
 
@@ -1208,23 +1509,75 @@ class ContinuousEngine:
             ticks = max(1, min(self.ticks_per_step,
                                st.max_new - len(st.tokens)))
             last_write = min(int(self._pos[i]) + ticks - 1, self._L - 1)
-            need = last_write // self._bs + 1
-            while (self._slots[i] is not None
-                   and len(self._row_blocks[i]) < need):
-                with self._pool_lock:
-                    b = self._pool.allocate()
-                if b is None:
-                    self._preempt(self._pick_victim())
-                    continue
-                j = len(self._row_blocks[i])
-                self._row_blocks[i].append(b)
-                self._tables[i, j] = b
+            self._grow_row(i, last_write // self._bs + 1)
         return [i for i in active if self._slots[i] is not None]
 
+    def _grow_row(self, i: int, need: int) -> None:
+        """Grow row ``i``'s block table to ``need`` blocks, preempting
+        (latest admission, prefilling rows first) whenever the pool is
+        dry — including row ``i`` itself, which ends the loop."""
+        while (self._slots[i] is not None
+               and len(self._row_blocks[i]) < need):
+            with self._pool_lock:
+                b = self._pool.allocate()
+            if b is None:
+                self._preempt(self._pick_victim())
+                continue
+            j = len(self._row_blocks[i])
+            self._row_blocks[i].append(b)
+            self._tables[i, j] = b
+
+    def _grow_chunk_blocks(self, decode_rows, chunks) -> None:
+        """Per-tick paged growth for the fused step: decode rows need
+        their one write position covered; each chunk row needs blocks
+        through its chunk's last write.  Pool-dry preemption targets
+        the LATEST PREFILLING row first (``_pick_victim``) — decoders
+        that already emitted tokens are never evicted to feed a
+        joiner's prompt."""
+        for i in decode_rows:
+            if self._slots[i] is None:
+                continue
+            last_write = min(int(self._pos[i]), self._L - 1)
+            self._grow_row(i, last_write // self._bs + 1)
+        for i, clen in chunks:
+            st = self._slots[i]
+            if st is None:
+                continue
+            self._grow_row(i, (st.fill_pos + clen - 1) // self._bs + 1)
+
+    def _publish_chunk_blocks(self, i: int, st: _Slot) -> None:
+        """Hash-publish the prompt blocks a landed chunk fully covered
+        (never the frontier block — a partially written block must not
+        be shared), so the NEXT identical prompt attaches copy-free,
+        exactly like monolithic admission's post-prefill publish."""
+        if st.hashes is None:
+            return
+        hi = min(st.fill_pos // self._bs, st.plen // self._bs)
+        if hi <= st.n_pub:
+            return
+        blocks = self._row_blocks[i]
+        with self._pool_lock:
+            for j in range(st.n_pub, hi):
+                self._pool.insert(st.hashes[j], blocks[j])
+        st.n_pub = hi
+
+    def _table_width(self, need: int) -> int:
+        """Pow2-bucketed narrow table width for a chunk grid: wide
+        enough for every position the chunks write/attend, capped at
+        the full table width M."""
+        v = 1
+        while v < need:
+            v *= 2
+        return min(v, self._M)
+
     def _pick_victim(self) -> int:
-        return max((i for i in range(self._S)
-                    if self._slots[i] is not None),
-                   key=lambda i: self._slots[i].admit_seq)
+        live = [i for i in range(self._S) if self._slots[i] is not None]
+        pre = [i for i in live
+               if self._slots[i].state == "PREFILLING"]
+        # prefilling rows first: they lost no emitted tokens and
+        # requeue cheaply; among candidates, always the LATEST
+        # admission (earliest admissions keep strict forward progress)
+        return max(pre or live, key=lambda i: self._slots[i].admit_seq)
 
     def _preempt(self, slot: int) -> None:
         """Evict a resident back to the WAITING queue (front, original
@@ -1238,10 +1591,18 @@ class ContinuousEngine:
         self._free.append(slot)
         self._release_slot_blocks(slot)
         self._preemptions += 1
+        if st.state == "PREFILLING":
+            self._prefill_preemptions += 1
         logger.warning("block pool dry: preempted %r (recompute on "
                        "readmission)", st.uri)
         with self._lock:
             self._waiting.appendleft(st.req)
+            if self.record_timings:
+                t = self._timings.get(st.uri)
+                if t is not None:
+                    # TTFT keeps the original arrival; partial tokens
+                    # are discarded, so their stamps go too
+                    t["token_times"] = []
 
     def _release_slot_blocks(self, slot: int) -> None:
         """Drop a finished/preempted row's block references and point
@@ -1265,9 +1626,36 @@ class ContinuousEngine:
             "preemptions": self._preemptions,
             "peak_resident": self._peak_resident,
         }
+        if self.chunked:
+            denom = self._budget_ticks * self.tick_token_budget
+            out.update({
+                "chunked": True,
+                "tick_token_budget": self.tick_token_budget,
+                # mean fraction of each fused tick's budget actually
+                # filled with decode rows + chunk tokens
+                "budget_utilization": (
+                    self._budget_tokens_used / denom if denom else 0.0),
+                "prefill_queue_depth": self.n_waiting,
+                "chunks_in_flight": sum(
+                    1 for s in self._slots
+                    if s is not None and s.state == "PREFILLING"),
+                "prefill_stall_ticks": self._prefill_stall_ticks,
+                "prefill_preemptions": self._prefill_preemptions,
+            })
         if self.paged:
             with self._pool_lock:
                 out.update(self._pool.metrics())
+        return out
+
+    def pop_request_timings(self) -> Dict[str, dict]:
+        """Drain per-request wall-clock stamps collected under
+        ``record_timings=True``: uri -> {"arrival": t, "token_times":
+        [t0, t1, ...]} (``time.monotonic()`` seconds).  TTFT =
+        token_times[0] - arrival; TPOT = consecutive token_times
+        deltas.  Clears the store — the bench pops once per run."""
+        with self._lock:
+            out = self._timings
+            self._timings = {}
         return out
 
     def _install_slot(self, slot, uri, plen, mn, on_done, on_error,
@@ -1336,6 +1724,11 @@ class ContinuousEngine:
         """Append one generated token; finish + free the slot when done."""
         st = self._slots[slot]
         st.tokens.append(token)
+        if self.record_timings:
+            with self._lock:
+                t = self._timings.get(st.uri)
+                if t is not None:
+                    t["token_times"].append(time.monotonic())
         done = len(st.tokens) >= st.max_new or \
             (self.eos_id is not None and token == self.eos_id)
         if not done:
@@ -1376,6 +1769,12 @@ class ContinuousEngine:
             return 0
         if self.draft_model is not None:
             return self._spec_tick(active)
+        if self.chunked and any(self._slots[i].state == "PREFILLING"
+                                for i in active):
+            return self._chunked_tick(active)
+        # a chunked engine with NO prefill in flight decodes on the
+        # ORIGINAL (multi-tick, scan-amortised) path below — chunking
+        # costs nothing in steady state
         if self.paged:
             # grow block tables for the coming chunk; may preempt
             active = self._ensure_blocks(active)
@@ -1427,6 +1826,306 @@ class ContinuousEngine:
                 self._record_token(i, int(toks[j, i]))
         self._admit()       # freed slots recycle on the SAME iteration
         return self.n_active
+
+    def _sampling_vectors(self, rows):
+        """[S]-wide temperature/seed/top_p staging vectors with entries
+        only at ``rows`` (other rows are frozen or empty — their picks
+        are discarded, so zeros are fine)."""
+        temps = np.zeros(self._S, np.float32)
+        seeds = np.zeros(self._S, np.uint32)
+        topps = np.zeros(self._S, np.float32)
+        for i in rows:
+            temps[i] = self._slots[i].temperature
+            seeds[i] = self._slots[i].rng_seed or 0
+            topps[i] = self._slots[i].top_p
+        return temps, seeds, topps
+
+    def _reanchor_prefill(self) -> None:
+        """Re-pin every still-PREFILLING row's decode-side state after
+        a device step: frozen (done=True), fed pad, positioned at the
+        fill frontier — the decode part of the next fused tick then
+        writes its one dead K/V entry exactly where the row's own next
+        chunk will overwrite it."""
+        for i, st in enumerate(self._slots):
+            if st is not None and st.state == "PREFILLING":
+                self._done[i] = True
+                self._pos[i] = st.fill_pos
+                self._tok[i] = self.pad_id
+
+    def _chunked_tick(self, active) -> int:
+        """One budget-bounded fused iteration (the tentpole): every
+        DECODE row advances one token AND up to ``tick_token_budget -
+        n_decode`` tokens of PREFILLING prompts land, in ONE device
+        call.  Chunks are granted FIFO by admission order; a prompt's
+        final chunk also picks its first token inside the same program
+        (no extra admission forward, no decode stall)."""
+        decode_rows = [i for i in active
+                       if self._slots[i].state == "DECODE"]
+        prefill_rows = sorted(
+            (i for i in active
+             if self._slots[i].state == "PREFILLING"),
+            key=lambda i: self._slots[i].admit_seq)
+        remaining = self.tick_token_budget - len(decode_rows)
+        chunks: List[Tuple[int, int]] = []          # (slot, chunk len)
+        for i in prefill_rows:
+            if remaining <= 0:
+                break
+            st = self._slots[i]
+            clen = min(st.plen - st.fill_pos, remaining,
+                       self._chunk_buckets[-1])
+            if clen <= 0:
+                continue
+            chunks.append((i, clen))
+            remaining -= clen
+        if prefill_rows and not chunks:
+            # budget fully consumed by decode rows: prefill waits
+            self._prefill_stall_ticks += 1
+        if self.paged:
+            self._grow_chunk_blocks(decode_rows, chunks)  # may preempt
+            decode_rows = [i for i in decode_rows
+                           if self._slots[i] is not None]
+            chunks = [(i, c) for i, c in chunks
+                      if self._slots[i] is not None]
+        if not decode_rows and not chunks:
+            self._admit()       # preemptions may have freed blocks
+            return self.n_active
+        self._peak_resident = max(self._peak_resident, len(active))
+        self._budget_ticks += 1
+        self._budget_tokens_used += len(decode_rows) \
+            + sum(c for _, c in chunks)
+        if not chunks:
+            return self._decode_only_tick(decode_rows)
+        with_decode = bool(decode_rows)
+        crows = [i for i, _ in chunks]
+        sampled = any(self._slots[i].temperature > 0.0
+                      for i in decode_rows + crows)
+        use_topp = any(self._slots[i].top_p > 0.0
+                       for i in decode_rows + crows)
+        temps, seeds, topps = self._sampling_vectors(decode_rows)
+        # ---- chunk grid: pow2 rows x bucketed width ----
+        k = len(chunks)
+        kb = 1 << (k - 1).bit_length()
+        Cb = _next_bucket(max(c for _, c in chunks),
+                          self._chunk_buckets)
+        ctoks = np.full((kb, Cb), self.pad_id, np.int32)
+        cpos = np.zeros(kb, np.int32)
+        clens = np.ones(kb, np.int32)
+        cslots = np.full(kb, self._S, np.int32)     # pad rows: drop
+        ctemps = np.zeros(kb, np.float32)
+        cseeds = np.zeros(kb, np.uint32)
+        ctopps = np.zeros(kb, np.float32)
+        for j, (i, clen) in enumerate(chunks):
+            st = self._slots[i]
+            off = st.fill_pos - st.base
+            ctoks[j, :clen] = st.full[off:off + clen]
+            cpos[j] = st.fill_pos
+            clens[j] = clen
+            cslots[j] = i
+            ctemps[j] = st.temperature
+            cseeds[j] = st.rng_seed or 0
+            ctopps[j] = st.top_p
+        need = int((cpos + clens).max())
+        if self.paged:
+            Mb = self._table_width(-(-need // self._bs))
+            ctabs = np.full((kb, Mb), SINK_BLOCK, np.int32)
+            for j, (i, _) in enumerate(chunks):
+                ctabs[j] = self._tables[i, :Mb]
+            fused = self._get_fused(with_decode, sampled, use_topp)
+            nxt, pos2, done2, cnxt, self._pk, self._pv = fused(
+                self._pk, self._pv,
+                jnp.asarray(self._tok, jnp.int32),
+                jnp.asarray(self._pos, jnp.int32),
+                jnp.asarray(self._done, jnp.bool_),
+                jnp.asarray(self._tables, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32),
+                jnp.asarray(topps, jnp.float32),
+                jnp.asarray(ctoks, jnp.int32),
+                jnp.asarray(cpos, jnp.int32),
+                jnp.asarray(clens, jnp.int32),
+                jnp.asarray(ctabs, jnp.int32),
+                jnp.asarray(ctemps, jnp.float32),
+                jnp.asarray(cseeds, jnp.uint32),
+                jnp.asarray(ctopps, jnp.float32))
+        else:
+            read_len = next(b for b in self._read_buckets
+                            if b >= need)
+            fused = self._get_fused(with_decode, sampled, use_topp,
+                                    read_len)
+            nxt, pos2, done2, cnxt, self._ck, self._cv = fused(
+                self._ck, self._cv,
+                jnp.asarray(self._tok, jnp.int32),
+                jnp.asarray(self._pos, jnp.int32),
+                jnp.asarray(self._done, jnp.bool_),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32),
+                jnp.asarray(topps, jnp.float32),
+                jnp.asarray(ctoks, jnp.int32),
+                jnp.asarray(cpos, jnp.int32),
+                jnp.asarray(clens, jnp.int32),
+                jnp.asarray(cslots, jnp.int32),
+                jnp.asarray(ctemps, jnp.float32),
+                jnp.asarray(cseeds, jnp.uint32),
+                jnp.asarray(ctopps, jnp.float32))
+        # one host sync for decode picks + chunk first-token picks
+        nxt, pos2, done2, cnxt = jax.device_get(
+            (nxt, pos2, done2, cnxt))
+        if with_decode:
+            self._tok = np.array(nxt)
+            self._pos = np.array(pos2)
+            self._done = np.array(done2)
+        completed: List[Tuple[int, int]] = []
+        for j, (i, clen) in enumerate(chunks):
+            st = self._slots[i]
+            st.fill_pos += clen
+            if self.paged:
+                self._publish_chunk_blocks(i, st)
+            if st.fill_pos >= st.plen:
+                completed.append((i, int(cnxt[j])))
+        for i, first in completed:
+            st = self._slots[i]
+            st.state = "DECODE"
+            st.full = st.hashes = None
+            self._tok[i] = first
+            self._pos[i] = st.plen
+            self._done[i] = False
+            self._record_token(i, first)    # the request's FIRST token
+        self._reanchor_prefill()
+        for i in decode_rows:
+            if self._slots[i] is not None:
+                self._record_token(i, int(nxt[i]))
+        self._admit()       # freed slots recycle on the SAME iteration
+        return self.n_active
+
+    def _decode_only_tick(self, decode_rows) -> int:
+        """Budget tick with no chunk grants (budget exhausted by decode
+        rows, or every prefill row preempted): one unfused 1-tick step
+        — the SAME compiled program as the non-chunked path, so no
+        extra compile — then re-anchor the frozen PREFILLING rows."""
+        sampled = any(self._slots[i].temperature > 0.0
+                      for i in decode_rows)
+        use_topp = any(self._slots[i].top_p > 0.0 for i in decode_rows)
+        temps, seeds, topps = self._sampling_vectors(decode_rows)
+        step = self._get_step(1, sampled, use_topp)
+        if self.paged:
+            toks, tok, pos, done, self._pk, self._pv = step(
+                self._pk, self._pv, jnp.asarray(self._tok, jnp.int32),
+                jnp.asarray(self._pos, jnp.int32),
+                jnp.asarray(self._done, jnp.bool_),
+                jnp.asarray(self._tables, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32),
+                jnp.asarray(topps, jnp.float32))
+        else:
+            toks, tok, pos, done, self._ck, self._cv = step(
+                self._ck, self._cv, jnp.asarray(self._tok, jnp.int32),
+                jnp.asarray(self._pos, jnp.int32),
+                jnp.asarray(self._done, jnp.bool_),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32),
+                jnp.asarray(topps, jnp.float32))
+        toks = np.asarray(toks)
+        self._tok = np.array(tok)
+        self._pos = np.array(pos)
+        self._done = np.array(done)
+        self._reanchor_prefill()
+        for i in decode_rows:
+            if self._slots[i] is not None:
+                self._record_token(i, int(toks[0, i]))
+        self._admit()
+        return self.n_active
+
+    def precompile_chunked(self, sampled: bool = False,
+                           use_topp: bool = False,
+                           max_chunk_rows: Optional[int] = None) -> int:
+        """Eagerly compile the chunked scheduler's whole fused-program
+        shape grid, so steady-state serving compiles NOTHING regardless
+        of arrival timing — a cold-start aid for latency-sensitive
+        deployments (and for benchmarks, where a first-encounter
+        compile inside a percentile would be measured as a stall).
+
+        The grid is exactly the bounded space ``_chunked_tick`` can
+        reach: chunk-row counts (pow2 up to ``max_chunk_rows``, default
+        ``max_slots``), chunk widths (the prompt buckets that fit the
+        budget), with/without live decode rows, and per shape the arena
+        read window (pow2 buckets, capped at the largest prompt bucket)
+        or the paged narrow-table width (pow2, same cap).  Unreachable
+        combinations are pruned: a chunk width bucket ``Cb`` implies
+        some chunk longer than the previous bucket, so windows that
+        cannot contain such a chunk are skipped.  Returns the number of
+        (program, shape) variants visited.  Dummy buffers are used
+        throughout — engine state is untouched."""
+        if not self.chunked:
+            raise ValueError("precompile_chunked requires chunked=True")
+        S = self._S
+        kmax = min(max_chunk_rows or S, S)
+        kbs, kb = [], 1
+        while kb < kmax:
+            kbs.append(kb)
+            kb *= 2
+        kbs.append(kb)
+        max_prompt = self.prompt_buckets[-1]
+        tok = jnp.zeros(S, jnp.int32)
+        pos = jnp.zeros(S, jnp.int32)
+        done = jnp.ones(S, jnp.bool_)
+        temps = jnp.zeros(S, jnp.float32)
+        seeds = jnp.zeros(S, jnp.uint32)
+        topps = jnp.zeros(S, jnp.float32)
+        count = 0
+        for ci, Cb in enumerate(self._chunk_buckets):
+            prev = self._chunk_buckets[ci - 1] if ci else 0
+            # the need (max fill frontier) that selects this Cb spans
+            # (prev, max_prompt]: every window bucket covering part of
+            # that range is reachable, nothing else is
+            if self.paged:
+                lo = self._table_width(-(-(prev + 1) // self._bs))
+                hi = self._table_width(-(-max_prompt // self._bs))
+                widths = []
+                v = lo
+                while v <= hi:
+                    widths.append(v)
+                    if v >= self._M:
+                        break
+                    v *= 2
+            else:
+                # window b serves need in (previous bucket, b]; keep it
+                # iff that range overlaps the reachable (prev,
+                # max_prompt]
+                widths = [b for bi, b in enumerate(self._read_buckets)
+                          if b > prev
+                          and (self._read_buckets[bi - 1] if bi else 0)
+                          < max_prompt]
+            for kb in kbs:
+                ctoks = jnp.full((kb, Cb), self.pad_id, jnp.int32)
+                cpos = jnp.zeros(kb, jnp.int32)
+                clens = jnp.ones(kb, jnp.int32)
+                cslots = jnp.full(kb, S, jnp.int32)
+                czeros = (jnp.zeros(kb, jnp.float32),
+                          jnp.zeros(kb, jnp.uint32),
+                          jnp.zeros(kb, jnp.float32))
+                for width in widths:
+                    for wd in (False, True):
+                        if self.paged:
+                            fn = self._get_fused(wd, sampled, use_topp)
+                            fn(jnp.zeros_like(self._pk),
+                               jnp.zeros_like(self._pv),
+                               tok, pos, done,
+                               jnp.full((S, self._M), SINK_BLOCK,
+                                        jnp.int32),
+                               temps, seeds, topps, ctoks, cpos,
+                               clens,
+                               jnp.full((kb, width), SINK_BLOCK,
+                                        jnp.int32),
+                               *czeros)
+                        else:
+                            fn = self._get_fused(wd, sampled,
+                                                 use_topp, width)
+                            fn(jnp.zeros_like(self._ck),
+                               jnp.zeros_like(self._cv),
+                               tok, pos, done, temps, seeds, topps,
+                               ctoks, cpos, clens, cslots, *czeros)
+                        count += 1
+        return count
 
     def _spec_tick(self, active) -> int:
         """One speculative round for the whole arena: every resident

@@ -89,6 +89,13 @@ class ServingConfig:
     engine_blocks: Optional[int] = None
     engine_hbm_fraction: Optional[float] = None
     engine_prefix_cache: bool = True
+    # Chunked prefill (serving/continuous.py token-budget scheduler):
+    # joiners' prompts stream into the cache in chunks fused with
+    # active decodes under engine_tick_token_budget tokens per tick —
+    # long prompts stop spiking residents' inter-token latency.  None
+    # budget = engine default (about one decode bucket of work).
+    engine_chunked: bool = False
+    engine_tick_token_budget: Optional[int] = None
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -139,6 +146,11 @@ class ServingConfig:
             cfg.engine_hbm_fraction = float(params["engine_hbm_fraction"])
         if "engine_prefix_cache" in params:
             cfg.engine_prefix_cache = bool(params["engine_prefix_cache"])
+        if "engine_chunked" in params:
+            cfg.engine_chunked = bool(params["engine_chunked"])
+        if "engine_tick_token_budget" in params:
+            cfg.engine_tick_token_budget = int(
+                params["engine_tick_token_budget"])
         return cfg
 
 
@@ -277,7 +289,9 @@ class ClusterServing:
                 block_size=self.config.engine_block_size,
                 n_blocks=self.config.engine_blocks,
                 hbm_fraction=self.config.engine_hbm_fraction,
-                enable_prefix_cache=self.config.engine_prefix_cache)
+                enable_prefix_cache=self.config.engine_prefix_cache,
+                chunked=self.config.engine_chunked,
+                tick_token_budget=self.config.engine_tick_token_budget)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
                                  name="zoo-serving-cb")

@@ -35,30 +35,43 @@ def lm():
     return model, variables
 
 
-def _round(eng, rng, tag):
+MODES = {
+    "arena": {},
+    "paged": dict(paged=True, block_size=4),
+    # chunked modes include a 12-token prompt so every round spans two
+    # chunk widths (8 + 4) — chunk-width/row/read-window buckets and
+    # the fused program must not retrace per request
+    "arena-chunked": dict(chunked=True, tick_token_budget=8),
+    "paged-chunked": dict(paged=True, block_size=4, chunked=True,
+                          tick_token_budget=8),
+}
+
+
+def _round(eng, rng, tag, lengths=LENGTHS):
     """Submit one batch of distinct prompts (fixed lengths) and drain."""
     results = {}
-    for i, n in enumerate(LENGTHS):
+    for i, n in enumerate(lengths):
         p = rng.integers(1, 32, n).astype(np.int32)
         p[0] = 1 + (hash(tag) + i) % 31     # distinct heads: no prefix hits
         eng.submit(f"{tag}-{i}", p,
                    on_done=lambda u, t: results.__setitem__(u, t))
     eng.drain()
-    assert len(results) == len(LENGTHS)
+    assert len(results) == len(lengths)
     return results
 
 
-@pytest.mark.parametrize("mode", ["arena", "paged"])
+@pytest.mark.parametrize("mode", list(MODES))
 def test_decode_steady_state_zero_retraces(lm, mode):
     model, variables = lm
-    kw = dict(paged=True, block_size=4) if mode == "paged" else {}
+    kw = MODES[mode]
+    lengths = (4, 12, 7, 5) if "chunked" in mode else LENGTHS
     eng = ContinuousEngine(model, variables, max_new_tokens=5,
                            max_slots=3, prompt_buckets=(8, 16), **kw)
     rng = np.random.default_rng(7)
-    _round(eng, rng, "warm1")       # cold compiles: every bucket + steps
-    _round(eng, rng, "warm2")       # shapes unique to a non-empty engine
+    _round(eng, rng, "warm1", lengths)  # cold: every bucket + steps
+    _round(eng, rng, "warm2", lengths)  # shapes unique to non-empty eng
     with trace_guard(eng, name=f"{mode}-steady"):
-        _round(eng, rng, "live")    # raises RetraceError on ANY compile
+        _round(eng, rng, "live", lengths)  # RetraceError on ANY compile
 
 
 @pytest.mark.parametrize("mode", ["arena", "paged"])
