@@ -42,13 +42,15 @@ lint:
 bench:
 	$(PY) bench.py
 
-# serving smoke: the paged KV-cache + chunked-prefill test files + a
-# 20-request e2e wire-protocol bench leg (which drives the chunked
-# scheduler end to end), all forced onto host CPU (fast; fits the
-# tier-1 timeout)
+# serving smoke: the paged KV-cache + chunked-prefill + telemetry test
+# files + a 20-request e2e wire-protocol bench leg (which drives the
+# chunked scheduler end to end, then scrapes /metrics + /healthz and
+# schema-checks the dumped trace on a live stack), all forced onto
+# host CPU (fast; fits the tier-1 timeout)
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py \
-	    tests/test_chunked_prefill.py -q -m "not slow"
+	    tests/test_chunked_prefill.py tests/test_telemetry.py \
+	    -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
 
 clean:

@@ -336,7 +336,8 @@ class InferenceModel:
                                enable_prefix_cache: bool = True,
                                chunked: bool = False,
                                tick_token_budget: Optional[int] = None,
-                               record_timings: bool = False):
+                               record_timings: bool = False,
+                               telemetry=None):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
         loaded via ``load_flax_generator`` (quantized weights dequantize
         once at build — the engine trades the at-rest memory win for
@@ -384,7 +385,7 @@ class InferenceModel:
             hbm_fraction=hbm_fraction,
             enable_prefix_cache=enable_prefix_cache,
             chunked=chunked, tick_token_budget=tick_token_budget,
-            record_timings=record_timings, **spec)
+            record_timings=record_timings, telemetry=telemetry, **spec)
 
     def load_openvino(self, xml_path: str, bin_path: str = None,
                       quantize: Optional[str] = None) -> "InferenceModel":

@@ -80,10 +80,22 @@ class TraceGuard:
     """
 
     def __init__(self, *targets: Any, budget: int = 0,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, telemetry: Any = None):
         self._targets: Tuple[Any, ...] = targets
         self.budget = int(budget)
         self.name = name or "trace_guard"
+        # duck-typed serving.telemetry.Telemetry (this module must not
+        # import the serving stack): each observed retrace is reported
+        # via telemetry.retrace(label, count, region) on exit, whether
+        # or not the budget tolerates it — the Perfetto timeline shows
+        # WHEN a steady-state compile happened, not just that it did.
+        # Falls back to the first target's own ``telemetry`` attribute
+        # (an engine guard reports into that engine's event log with no
+        # extra plumbing at the call site).
+        if telemetry is None and targets:
+            telemetry = getattr(targets[0], "telemetry", None)
+        self._telemetry = telemetry if callable(
+            getattr(telemetry, "retrace", None)) else None
         self._before: Dict[str, int] = {}
         self._entered = False
 
@@ -122,6 +134,9 @@ class TraceGuard:
             return False
         counts = self.counts()
         total = sum(counts.values())
+        if self._telemetry is not None:
+            for label, grew in sorted(counts.items()):
+                self._telemetry.retrace(label, grew, self.name)
         if total > self.budget:
             detail = ", ".join(f"{k}: +{v}" for k, v in
                                sorted(counts.items())) or "none"
@@ -134,7 +149,11 @@ class TraceGuard:
 
 
 def trace_guard(*targets: Any, budget: int = 0,
-                name: Optional[str] = None) -> TraceGuard:
+                name: Optional[str] = None,
+                telemetry: Any = None) -> TraceGuard:
     """Guard a region against retraces of ``targets`` (jitted
-    callables, dicts of them, or objects holding them)."""
-    return TraceGuard(*targets, budget=budget, name=name)
+    callables, dicts of them, or objects holding them).  ``telemetry``
+    (or the first target's own ``telemetry`` attribute) receives a
+    ``retrace`` event per observed compile-cache growth."""
+    return TraceGuard(*targets, budget=budget, name=name,
+                      telemetry=telemetry)

@@ -4,7 +4,12 @@ from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.resp import RespClient, RespServer
 from analytics_zoo_tpu.serving.server import ClusterServing, ServingConfig
 from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
+from analytics_zoo_tpu.serving.telemetry import (
+    MetricsRegistry, Telemetry, WindowHistogram, render_prometheus,
+    validate_chrome_trace)
 
 __all__ = ["ContinuousEngine", "BlockPool", "InputQueue", "OutputQueue",
            "RespClient", "RespServer", "ClusterServing", "ServingConfig",
-           "HttpFrontend"]
+           "HttpFrontend", "MetricsRegistry", "Telemetry",
+           "WindowHistogram", "render_prometheus",
+           "validate_chrome_trace"]

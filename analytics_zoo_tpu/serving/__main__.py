@@ -28,6 +28,11 @@ def main(argv=None, block=True):
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform (e.g. cpu) — env vars "
                          "are too late once sitecustomize imports jax")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="on shutdown, dump the telemetry event ring "
+                         "as Chrome trace-event JSON to PATH (load at "
+                         "https://ui.perfetto.dev); the same data is "
+                         "live at GET /trace while serving")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -68,6 +73,9 @@ def main(argv=None, block=True):
         if frontend is not None:
             frontend.stop()
         serving.stop()
+        if args.trace:
+            serving.telemetry.dump_trace(args.trace)
+            print(f"trace written to {args.trace}", flush=True)
 
     if not block:       # tests drive the assembled stack directly
         return serving, frontend, shutdown

@@ -47,6 +47,7 @@ from analytics_zoo_tpu.models.lm import (TransformerLM,
                                          top_p_filter)
 from analytics_zoo_tpu.serving.paged_cache import (BlockPool,
                                                    SINK_BLOCK)
+from analytics_zoo_tpu.serving.telemetry import Telemetry
 
 logger = logging.getLogger("analytics_zoo_tpu")
 
@@ -153,7 +154,8 @@ class ContinuousEngine:
                  enable_prefix_cache: bool = True,
                  chunked: bool = False,
                  tick_token_budget: Optional[int] = None,
-                 record_timings: bool = False):
+                 record_timings: bool = False,
+                 telemetry: Optional[Telemetry] = None):
         """``mesh`` (with a ``tp`` axis) serves a model LARGER than one
         chip's HBM: weights shard per ``partition_rules`` (default
         ``LM_PARTITION_RULES`` — Megatron layout), the KV arena shards
@@ -169,6 +171,13 @@ class ContinuousEngine:
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
+        # ---- telemetry (always-on; serving/telemetry.py) ---------------
+        # one facade per engine unless the serving layer passes its own
+        # (to merge registries under one scrape).  Every hook below is
+        # host-side floats/ints only: nothing telemetry does enters a
+        # jitted program, so it can neither sync the device nor retrace.
+        self.telemetry = telemetry if telemetry is not None \
+            else Telemetry()
         # ---- speculative mode (draft arena) ----------------------------
         # the slot arena is ALREADY per-row-positioned, which is exactly
         # what per-slot acceptance rates need: each verify round advances
@@ -288,7 +297,8 @@ class ContinuousEngine:
                     f"sequence: need >= {M + 1} ({M} logical blocks of "
                     f"{bs} positions + the sink block 0)")
             self._bs, self._M = bs, M
-            self._pool = BlockPool(n_blocks, bs, enable_prefix_cache)
+            self._pool = BlockPool(n_blocks, bs, enable_prefix_cache,
+                                   event_cb=self.telemetry.pool_event)
             # pool-mutation guard: admission/growth run on the pump
             # thread, but unregister_prefix releases from client threads
             self._pool_lock = threading.Lock()
@@ -305,7 +315,6 @@ class ContinuousEngine:
         # token budget — long prompts stop stalling active decoders.
         self.chunked = bool(chunked)
         self.record_timings = bool(record_timings)
-        self._timings: Dict[str, dict] = {}
         self._prefill_stall_ticks = 0
         self._prefill_preemptions = 0
         self._budget_tokens_used = 0
@@ -491,6 +500,10 @@ class ContinuousEngine:
                      use_topp: bool = False) -> Callable:
             key = (n, sampled, use_topp)
             if key not in self._step_cache:
+                # cache miss = a program variant XLA must build; in
+                # steady state this event never fires again (the trace
+                # timeline makes a late one — a retrace — stand out)
+                self.telemetry.jit_build("step", key)
                 fn = step_fn_paged if self.paged else step_fn
                 self._step_cache[key] = jax.jit(
                     partial(fn, n_ticks=n, use_sample=sampled,
@@ -620,6 +633,7 @@ class ContinuousEngine:
                       read_len: int = 0) -> Callable:
             key = (with_decode, sampled, use_topp, read_len)
             if key not in self._fused_cache:
+                self.telemetry.jit_build("fused", key)
                 if self.paged:
                     fn = partial(fused_paged_fn,
                                  with_decode=with_decode,
@@ -694,6 +708,66 @@ class ContinuousEngine:
         if self.draft_model is not None:
             self._draft_prefix_admit = _prefix_admit_for(
                 self.draft_model, self._draft_variables, False)
+
+        self._register_engine_gauges()
+
+    def _register_engine_gauges(self) -> None:
+        """Scrape-time gauges over engine/pool state: nothing is
+        updated per tick — each callback reads the live value when
+        /metrics is actually scraped, under the same lock its mutators
+        hold (``n_waiting`` -> engine lock, pool fields -> pool lock),
+        so a scrape can never see a torn value."""
+        m = self.telemetry.metrics
+        m.gauge("zoo_engine_queue_depth",
+                "requests waiting for a slot", fn=lambda: self.n_waiting)
+        m.gauge("zoo_engine_active_slots",
+                "resident requests (decode + prefilling)",
+                fn=lambda: self.n_active)
+        m.gauge("zoo_engine_peak_resident",
+                "max co-resident requests observed",
+                fn=lambda: self._peak_resident)
+        if self.chunked:
+            def _budget_util():
+                denom = self._budget_ticks * self.tick_token_budget
+                return (self._budget_tokens_used / denom) if denom \
+                    else 0.0
+
+            m.gauge("zoo_engine_budget_utilization",
+                    "mean filled fraction of the tick token budget",
+                    fn=_budget_util)
+            m.gauge("zoo_engine_prefill_stall_ticks_total",
+                    "ticks whose budget left no room for any chunk",
+                    fn=lambda: self._prefill_stall_ticks,
+                    kind="counter")
+        if self.paged:
+            def _pool_read(key):
+                def read():
+                    with self._pool_lock:
+                        return self._pool.metrics()[key]
+                return read
+
+            for key, name, kind, hlp in (
+                    ("free_blocks", "zoo_engine_free_blocks", "gauge",
+                     "pool blocks on the free list"),
+                    ("cached_blocks", "zoo_engine_cached_blocks",
+                     "gauge",
+                     "unreferenced blocks parked in the prefix LRU"),
+                    ("referenced_blocks", "zoo_engine_referenced_blocks",
+                     "gauge", "blocks held by live requests"),
+                    ("occupancy", "zoo_engine_pool_occupancy", "gauge",
+                     "referenced fraction of non-sink blocks"),
+                    ("prefix_hit_rate", "zoo_engine_prefix_hit_rate",
+                     "gauge", "prefix-cache block hits / queries"),
+                    ("prefix_queries", "zoo_engine_prefix_queries_total",
+                     "counter", "prompt blocks offered to lookup()"),
+                    ("prefix_hits", "zoo_engine_prefix_hits_total",
+                     "counter", "prompt blocks answered from the index"),
+                    ("evictions", "zoo_engine_pool_evictions_total",
+                     "counter", "LRU evictions of cached blocks"),
+                    ("alloc_failures",
+                     "zoo_engine_pool_alloc_failures_total", "counter",
+                     "allocate() calls the pool could not serve")):
+                m.gauge(name, hlp, fn=_pool_read(key), kind=kind)
 
     def _init_speculative(self, cdtype):
         """Draft arena + the jitted spec-round program.  One round per
@@ -989,10 +1063,10 @@ class ContinuousEngine:
         if not 1 <= mn <= self.max_new_tokens:
             raise ValueError(
                 f"max_new {mn} outside [1, {self.max_new_tokens}]")
+        # stamp AFTER validation: a rejected submit never existed as
+        # far as queue-wait/TTFT accounting is concerned
+        self.telemetry.req_enqueued(uri)
         with self._lock:
-            if self.record_timings:
-                self._timings[uri] = {"arrival": time.monotonic(),
-                                      "token_times": []}
             self._waiting.append(_Req(
                 uri, prompt, on_done, on_error, float(temperature),
                 rng_seed, mn, prefix, float(top_p)))
@@ -1074,8 +1148,8 @@ class ContinuousEngine:
                         self._req_error(req.uri, req.on_error, e)
         return admitted
 
-    @staticmethod
-    def _req_error(uri, on_error, exc):
+    def _req_error(self, uri, on_error, exc):
+        self.telemetry.req_errored(uri, f"{type(exc).__name__}: {exc}")
         if on_error is None:
             return
         try:
@@ -1288,6 +1362,7 @@ class ContinuousEngine:
         self._tok[slot] = self.pad_id
         self._pos[slot] = self._slots[slot].fill_pos
         self._done[slot] = True
+        self.telemetry.req_admitted(req.uri, slot, prefilling=True)
 
     # ---- paged mode (block-pool cache) --------------------------------
 
@@ -1597,12 +1672,10 @@ class ContinuousEngine:
                        "readmission)", st.uri)
         with self._lock:
             self._waiting.appendleft(st.req)
-            if self.record_timings:
-                t = self._timings.get(st.uri)
-                if t is not None:
-                    # TTFT keeps the original arrival; partial tokens
-                    # are discarded, so their stamps go too
-                    t["token_times"] = []
+        # TTFT keeps the original arrival; partial tokens are
+        # discarded, so their stamps go too (telemetry mirrors both)
+        self.telemetry.req_preempted(
+            st.uri, slot, prefilling=st.state == "PREFILLING")
 
     def _release_slot_blocks(self, slot: int) -> None:
         """Drop a finished/preempted row's block references and point
@@ -1617,46 +1690,80 @@ class ContinuousEngine:
                 self._pool.release(b)
 
     def cache_metrics(self) -> dict:
-        """Serving-visible cache counters (bench_serving.py columns):
-        pool occupancy / prefix hit rate / evictions in paged mode,
-        plus preemption count and the peak co-resident request count
-        either mode observed."""
-        out = {
-            "mode": "paged" if self.paged else "arena",
-            "preemptions": self._preemptions,
-            "peak_resident": self._peak_resident,
-        }
-        if self.chunked:
-            denom = self._budget_ticks * self.tick_token_budget
-            out.update({
-                "chunked": True,
-                "tick_token_budget": self.tick_token_budget,
-                # mean fraction of each fused tick's budget actually
-                # filled with decode rows + chunk tokens
-                "budget_utilization": (
-                    self._budget_tokens_used / denom if denom else 0.0),
-                "prefill_queue_depth": self.n_waiting,
-                "chunks_in_flight": sum(
-                    1 for s in self._slots
-                    if s is not None and s.state == "PREFILLING"),
-                "prefill_stall_ticks": self._prefill_stall_ticks,
-                "prefill_preemptions": self._prefill_preemptions,
-            })
+        """Serving-visible cache counters (bench_serving.py columns).
+
+        The snapshot is taken under the ENGINE lock (and, for the pool
+        merge, the pool lock), so a caller on another thread can never
+        see torn state — e.g. a queue depth from before a preemption
+        merged with pool occupancy from after it.  Field semantics:
+
+        - **cumulative** (monotonic since construction): ``preemptions``,
+          ``prefill_stall_ticks``, ``prefill_preemptions``, and the
+          pool's ``prefix_queries`` / ``prefix_hits`` / ``evictions`` /
+          ``alloc_failures``.  ``peak_resident`` and
+          ``budget_utilization`` are cumulative aggregates (running max
+          / running mean), not resettable rates.
+        - **instantaneous** (value at snapshot time):
+          ``prefill_queue_depth``, ``chunks_in_flight``, and the pool's
+          ``free_blocks`` / ``cached_blocks`` / ``referenced_blocks`` /
+          ``occupancy`` (plus the static ``mode`` / ``chunked`` /
+          ``tick_token_budget`` / ``n_blocks`` / ``block_size``).
+
+        The same values are exported continuously (and individually
+        documented) by the telemetry registry — this dict remains for
+        callers that want one coherent point-in-time snapshot."""
+        with self._lock:
+            out = {
+                "mode": "paged" if self.paged else "arena",
+                "preemptions": self._preemptions,
+                "peak_resident": self._peak_resident,
+            }
+            if self.chunked:
+                denom = self._budget_ticks * self.tick_token_budget
+                out.update({
+                    "chunked": True,
+                    "tick_token_budget": self.tick_token_budget,
+                    # mean fraction of each fused tick's budget
+                    # actually filled with decode rows + chunk tokens
+                    "budget_utilization": (
+                        self._budget_tokens_used / denom
+                        if denom else 0.0),
+                    # len() directly: self.n_waiting re-acquires the
+                    # non-reentrant engine lock we already hold
+                    "prefill_queue_depth": len(self._waiting),
+                    "chunks_in_flight": sum(
+                        1 for s in self._slots
+                        if s is not None and s.state == "PREFILLING"),
+                    "prefill_stall_ticks": self._prefill_stall_ticks,
+                    "prefill_preemptions": self._prefill_preemptions,
+                })
         if self.paged:
             with self._pool_lock:
                 out.update(self._pool.metrics())
         return out
+
+    @property
+    def record_timings(self) -> bool:
+        """Back-compat shim: raw per-request stamp retention now lives
+        in the telemetry facade (the percentile histograms are always
+        on regardless — this flag only controls the unbounded per-uri
+        store ``pop_request_timings`` drains)."""
+        return self.telemetry.keep_request_stamps
+
+    @record_timings.setter
+    def record_timings(self, v: bool) -> None:
+        self.telemetry.keep_request_stamps = bool(v)
 
     def pop_request_timings(self) -> Dict[str, dict]:
         """Drain per-request wall-clock stamps collected under
         ``record_timings=True``: uri -> {"arrival": t, "token_times":
         [t0, t1, ...]} (``time.monotonic()`` seconds).  TTFT =
         token_times[0] - arrival; TPOT = consecutive token_times
-        deltas.  Clears the store — the bench pops once per run."""
-        with self._lock:
-            out = self._timings
-            self._timings = {}
-        return out
+        deltas.  Clears the store — the bench pops once per run.
+        The stamps are written by the SAME telemetry hooks that feed
+        the always-on histograms, so the two surfaces agree by
+        construction."""
+        return self.telemetry.pop_request_stamps()
 
     def _install_slot(self, slot, uri, plen, mn, on_done, on_error,
                       temp, seed, first, top_p=0.0, req=None):
@@ -1672,6 +1779,7 @@ class ContinuousEngine:
         if self.draft_model is not None:
             self._dpos[slot] = plen
         self._done[slot] = False
+        self.telemetry.req_admitted(uri, slot)
         self._record_token(slot, int(first))
 
     def _splice_one(self, pre, i: int, req) -> None:
@@ -1724,11 +1832,7 @@ class ContinuousEngine:
         """Append one generated token; finish + free the slot when done."""
         st = self._slots[slot]
         st.tokens.append(token)
-        if self.record_timings:
-            with self._lock:
-                t = self._timings.get(st.uri)
-                if t is not None:
-                    t["token_times"].append(time.monotonic())
+        self.telemetry.req_token(st.uri, slot)
         done = len(st.tokens) >= st.max_new or \
             (self.eos_id is not None and token == self.eos_id)
         if not done:
@@ -1744,6 +1848,7 @@ class ContinuousEngine:
             # refcounts drop + table row -> sink BEFORE the next device
             # step, so a recycled block can never see this row's writes
             self._release_slot_blocks(slot)
+        self.telemetry.req_finished(st.uri, slot, len(st.tokens))
         if st.on_done is not None:
             try:
                 st.on_done(st.uri, out)
@@ -1763,6 +1868,35 @@ class ContinuousEngine:
         Higher ``ticks_per_step`` trades admission latency granularity
         for fewer host round-trips — the dominant per-token cost on
         tunneled devices."""
+        if self.n_active == 0 and not self._waiting:
+            # idle poll (the serving pump spins on step()): no work to
+            # do or measure, and no tick event to spam the ring with
+            return 0
+        t0 = time.monotonic()
+        n = self._step_impl()
+        self.telemetry.tick(t0, time.monotonic() - t0,
+                            self._tick_samples(n))
+        return n
+
+    def _tick_samples(self, n_active: int) -> dict:
+        """Post-tick residency mix + queue/pool pressure, as plain host
+        ints — the per-tick sample row of the ISSUE's event-log spec."""
+        decode = prefill = 0
+        for s in self._slots:
+            if s is not None:
+                if s.state == "DECODE":
+                    decode += 1
+                else:
+                    prefill += 1
+        samples = {"active": n_active, "decode_rows": decode,
+                   "prefill_rows": prefill,
+                   "queue_depth": len(self._waiting)}
+        if self._pool is not None:
+            with self._pool_lock:
+                samples["free_blocks"] = self._pool.allocatable()
+        return samples
+
+    def _step_impl(self) -> int:
         self._admit()
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
@@ -1925,6 +2059,7 @@ class ContinuousEngine:
             cseeds[j] = st.rng_seed or 0
             ctopps[j] = st.top_p
         need = int((cpos + clens).max())
+        t_fused = time.monotonic()
         if self.paged:
             Mb = self._table_width(-(-need // self._bs))
             ctabs = np.full((kb, Mb), SINK_BLOCK, np.int32)
@@ -1970,6 +2105,15 @@ class ContinuousEngine:
         # one host sync for decode picks + chunk first-token picks
         nxt, pos2, done2, cnxt = jax.device_get(
             (nxt, pos2, done2, cnxt))
+        # all of a tick's chunks land in the one fused call above, so
+        # they share its span (per-chunk device timing doesn't exist)
+        dur_fused = time.monotonic() - t_fused
+        for i, clen in chunks:
+            self.telemetry.events.span(
+                "prefill_chunk", t_fused, dur_fused, i,
+                {"uri": self._slots[i].uri, "tokens": int(clen),
+                 "fill_pos": int(self._slots[i].fill_pos)})
+        self.telemetry.c_chunks.inc(len(chunks))
         if with_decode:
             self._tok = np.array(nxt)
             self._pos = np.array(pos2)

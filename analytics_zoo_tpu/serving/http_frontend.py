@@ -13,14 +13,18 @@ exposed as JSON gauges with p50/p90/p99.
 Routes:
   POST /predict   {"instances": [{col: <nested list | {"b64","shape",
                   "dtype"}>, ...}, ...]} -> {"predictions": [...]}
-  GET  /metrics   backlog, served counts, latency percentiles
+  GET  /metrics   Prometheus text exposition merging the frontend's
+                  HTTP latency, the serving job's counters, and the
+                  engine's TTFT/TPOT/queue/pool metrics
+                  (``?format=json`` keeps the legacy JSON dict)
+  GET  /trace     Chrome trace-event JSON of the engine's event ring
+                  (load at https://ui.perfetto.dev)
   GET  /healthz   200 once the loop thread is alive
 """
 
 from __future__ import annotations
 
 import base64
-import collections
 import json
 import ssl
 import threading
@@ -34,6 +38,8 @@ import numpy as np
 from analytics_zoo_tpu.common.log import logger
 from analytics_zoo_tpu.serving.queues import (
     ImageBytes, InputQueue, OutputQueue)
+from analytics_zoo_tpu.serving.telemetry import (
+    MetricsRegistry, WindowHistogram, render_prometheus)
 
 
 def _decode_value(v):
@@ -52,26 +58,30 @@ def _decode_value(v):
 
 
 class _Percentiles:
-    """Sliding-window latency gauge (lock-protected deque)."""
+    """Sliding-window latency gauge — back-compat shim over a telemetry
+    :class:`WindowHistogram` (serving/telemetry.py), which generalized
+    this class's private deque.  Same ms-scaled snapshot keys; same
+    window-count semantics (``count`` is the samples currently in the
+    window, not the cumulative total — that is the histogram's own
+    ``snapshot()["count"]``)."""
 
-    def __init__(self, window: int = 2048):
-        self._lat = collections.deque(maxlen=window)
-        self._lock = threading.Lock()
+    def __init__(self, window: int = 2048,
+                 hist: Optional[WindowHistogram] = None):
+        self._hist = hist if hist is not None else WindowHistogram(
+            "latency_seconds", window=window)
 
     def record(self, seconds: float):
-        with self._lock:
-            self._lat.append(seconds)
+        self._hist.record(seconds)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            lat = np.asarray(self._lat)
-        if lat.size == 0:
+        s = self._hist.snapshot()
+        if not s["window"]:
             return {"count": 0}
         return {
-            "count": int(lat.size),
-            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
-            "p90_ms": round(float(np.percentile(lat, 90)) * 1e3, 3),
-            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "count": int(s["window"]),
+            "p50_ms": round(s["p50"] * 1e3, 3),
+            "p90_ms": round(s["p90"] * 1e3, 3),
+            "p99_ms": round(s["p99"] * 1e3, 3),
         }
 
 
@@ -105,7 +115,17 @@ class HttpFrontend:
             else "prompt")
         self._eos_id = (serving.config.eos_id
                         if serving is not None else None)
-        self.latency = _Percentiles()
+        # frontend-local metrics (zoo_http_*); /metrics merges them
+        # with the serving job's and the engine's registries at scrape
+        self.registry = MetricsRegistry()
+        self.latency = _Percentiles(hist=self.registry.histogram(
+            "zoo_http_request_seconds",
+            "end-to-end POST /predict wall time (failures included)"))
+        if serving is not None:
+            self.registry.gauge(
+                "zoo_http_backlog",
+                "input-stream entries not yet consumed by the backend",
+                fn=lambda: self.serving.backlog())
         # ThreadingHTTPServer spawns a fresh thread per connection, so
         # thread-local caching would never hit: pool the RESP client pairs
         self._pool: list = []
@@ -126,10 +146,30 @@ class HttpFrontend:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
                     self._send(200, {"status": "ok"})
-                elif self.path == "/metrics":
-                    self._send(200, frontend.metrics())
+                elif path == "/metrics":
+                    if "format=json" in query:
+                        self._send(200, frontend.metrics())
+                        return
+                    body = frontend.prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/trace":
+                    trace = frontend.trace()
+                    if trace is None:
+                        self._send(404, {
+                            "error": "no engine telemetry attached "
+                                     "(start the frontend with "
+                                     "serving=...)"})
+                    else:
+                        self._send(200, trace)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
@@ -292,6 +332,7 @@ class HttpFrontend:
     # ---- observability ------------------------------------------------
 
     def metrics(self) -> dict:
+        """Legacy JSON metrics dict (``GET /metrics?format=json``)."""
         out = {"latency": self.latency.snapshot()}
         if self.serving is not None:
             out["serving"] = dict(self.serving.stats)
@@ -300,3 +341,35 @@ class HttpFrontend:
             except Exception:
                 out["backlog"] = None
         return out
+
+    def _registries(self) -> list:
+        regs = [self.registry]
+        if self.serving is not None:
+            tm = getattr(self.serving, "telemetry", None)
+            if tm is not None:
+                regs.append(tm.metrics)
+            etm = getattr(getattr(self.serving, "engine", None),
+                          "telemetry", None)
+            if etm is not None and all(etm.metrics is not r
+                                       for r in regs):
+                regs.append(etm.metrics)
+        return regs
+
+    def prometheus(self) -> str:
+        """Text exposition over every reachable registry: the
+        frontend's own HTTP latency, the serving job's request
+        counters, and (continuous mode) the engine's TTFT/TPOT/queue/
+        pool metrics.  Distinct name prefixes per layer mean the merge
+        cannot collide."""
+        return render_prometheus(*self._registries())
+
+    def trace(self) -> Optional[dict]:
+        """Chrome trace-event JSON from the nearest telemetry (engine
+        first — its event ring holds the request spans), or None when
+        the frontend runs without an attached serving job."""
+        if self.serving is None:
+            return None
+        tm = getattr(getattr(self.serving, "engine", None),
+                     "telemetry", None) \
+            or getattr(self.serving, "telemetry", None)
+        return tm.dump_trace() if tm is not None else None

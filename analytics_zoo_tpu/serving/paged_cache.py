@@ -45,7 +45,7 @@ attends.
 from __future__ import annotations
 
 from collections import OrderedDict, deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 SINK_BLOCK = 0
 
@@ -89,7 +89,8 @@ class BlockPool:
     """
 
     def __init__(self, n_blocks: int, block_size: int,
-                 enable_prefix_cache: bool = True):
+                 enable_prefix_cache: bool = True,
+                 event_cb: Optional[Callable[..., None]] = None):
         if n_blocks < 2:
             raise ValueError(
                 f"n_blocks must be >= 2 (block 0 is the sink), got "
@@ -99,6 +100,13 @@ class BlockPool:
         self.n_blocks = int(n_blocks)
         self.block_size = int(block_size)
         self.enable_prefix_cache = bool(enable_prefix_cache)
+        # observability hook, called as event_cb(kind, **info) for
+        # "eviction" and "alloc_failure" (the two transitions the
+        # cumulative counters alone cannot place on a timeline).  The
+        # caller may hold its pool lock here: the callback must only
+        # record (the engine wires Telemetry.pool_event), never call
+        # back into this pool.
+        self.event_cb = event_cb
         self._free: deque = deque(range(1, self.n_blocks))
         self._ref: Dict[int, int] = {}
         self._hash_of: Dict[int, int] = {}     # block -> published hash
@@ -158,8 +166,12 @@ class BlockPool:
             h = self._hash_of.pop(blk)
             del self._index[h]
             self.evictions += 1
+            if self.event_cb is not None:
+                self.event_cb("eviction", block=blk)
         else:
             self.alloc_failures += 1
+            if self.event_cb is not None:
+                self.event_cb("alloc_failure")
             return None
         self._ref[blk] = 1
         return blk

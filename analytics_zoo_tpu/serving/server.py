@@ -30,6 +30,7 @@ from analytics_zoo_tpu.serving.queues import (
     IMG_MAGIC, INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX, decode_ndarray,
     encode_ndarray)
 from analytics_zoo_tpu.serving.resp import RespClient, RespServer
+from analytics_zoo_tpu.serving.telemetry import Telemetry
 
 
 @dataclasses.dataclass
@@ -191,6 +192,12 @@ class ClusterServing:
         self._written: collections.deque = collections.deque()
         self.stats = {"requests": 0, "batches": 0, "batch_fill": 0.0,
                       "predict_ms": 0.0}
+        # job-level telemetry; continuous mode hands this same facade
+        # to the engine, so one registry carries zoo_serving_* AND
+        # zoo_engine_* metrics and the event ring interleaves engine
+        # spans with serving-side terminal events (abandonment)
+        self.telemetry = Telemetry()
+        self._register_serving_gauges()
         self._img_resize = None
         from concurrent.futures import ThreadPoolExecutor
         import os as _os
@@ -198,6 +205,37 @@ class ClusterServing:
         self._decode_pool = ThreadPoolExecutor(
             max_workers=min(8, _os.cpu_count() or 4),
             thread_name_prefix="zoo-serving-decode")
+
+    def _register_serving_gauges(self) -> None:
+        """Expose the ``stats`` dict through the metrics registry:
+        callbacks read under the stats lock at scrape time, so the
+        Prometheus view and ``stats`` can never disagree."""
+
+        def _stat(key, default=0):
+            def read():
+                with self._stats_lock:
+                    return self.stats.get(key, default)
+            return read
+
+        m = self.telemetry.metrics
+        m.gauge("zoo_serving_requests_total",
+                "requests whose results were published",
+                fn=_stat("requests"), kind="counter")
+        m.gauge("zoo_serving_batches_total", "device dispatches",
+                fn=_stat("batches"), kind="counter")
+        m.gauge("zoo_serving_batch_fill",
+                "fill fraction of the last dispatch (continuous: "
+                "arena occupancy)", fn=_stat("batch_fill"))
+        m.gauge("zoo_serving_predict_ms",
+                "last dispatch latency, ms (continuous: last "
+                "request's submit-to-publish)", fn=_stat("predict_ms"))
+        m.gauge("zoo_serving_pending_results",
+                "published results not yet known consumed",
+                fn=lambda: len(self._written))
+        # pre-register so the counter is scrapeable at zero, not born
+        # on the first pruning (rate() needs the initial sample)
+        m.counter("zoo_serving_requests_abandoned_total",
+                  "published results pruned uncollected after the ttl")
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -291,7 +329,8 @@ class ClusterServing:
                 hbm_fraction=self.config.engine_hbm_fraction,
                 enable_prefix_cache=self.config.engine_prefix_cache,
                 chunked=self.config.engine_chunked,
-                tick_token_budget=self.config.engine_tick_token_budget)
+                tick_token_budget=self.config.engine_tick_token_budget,
+                telemetry=self.telemetry)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
                                  name="zoo-serving-cb")
@@ -514,8 +553,17 @@ class ClusterServing:
                 self.stats["cache"] = cache
                 self._written.append((uri, time.monotonic()))
 
+        # the continuous pump must prune too (the micro-batch path
+        # prunes per publish): time-gated so the idle poll loop isn't
+        # taking the stats lock hundreds of times a second
+        prune_every = max(1.0, self.config.result_ttl_s / 4.0)
+        next_prune = time.monotonic() + prune_every
         try:
             while not self._stop.is_set():
+                now = time.monotonic()
+                if now >= next_prune:
+                    next_prune = now + prune_every
+                    self._prune_abandoned(client, now)
                 busy = engine.n_active > 0 or engine.n_waiting > 0
                 try:
                     requests, ids = self._read_batch(
@@ -805,17 +853,21 @@ class ClusterServing:
     def _prune_abandoned(self, client: RespClient, now: float):
         """One pipeline round-trip per pruned uri, on the calling worker's
         own connection — pruning a TTL burst must not serialise every
-        worker through the shared client's lock."""
+        worker through the shared client's lock.  Each pruned result is
+        counted (``zoo_serving_requests_abandoned_total``) and leaves a
+        terminal ``request_abandoned`` event in the trace — a client
+        that timed out and walked away used to vanish without a sign."""
         ttl = self.config.result_ttl_s
         while True:
             with self._stats_lock:
                 if not self._written or \
                         now - self._written[0][1] <= ttl:
                     return
-                uri, _ = self._written.popleft()
+                uri, written_at = self._written.popleft()
             client.pipeline([
                 ("DEL", RESULT_PREFIX + uri, SIGNAL_PREFIX + uri),
                 ("SREM", "__result_keys__", uri)])
+            self.telemetry.req_abandoned(uri, now - written_at)
 
     # ---- observability (SURVEY §5: queue depth = backlog metric) ------
 

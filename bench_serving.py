@@ -221,6 +221,24 @@ def _latency_percentiles(timings: dict) -> dict:
     }
 
 
+def _stream_percentiles(telemetry) -> dict:
+    """TTFT / TPOT percentile columns straight from the engine's
+    always-on telemetry histograms (``zoo_engine_ttft_seconds`` /
+    ``zoo_engine_tpot_seconds``) — the same numbers ``GET /metrics``
+    exports, no ``record_timings`` flag and no raw-stamp
+    post-processing.  ``telemetry.reset_windows()`` after warmup is
+    what scopes the window to measured traffic (compile time never
+    pollutes the percentiles)."""
+    def cols(h, label):
+        s = h.snapshot()
+        return {f"{label}_p{q}_ms":
+                (round(s[f"p{q}"] * 1e3, 2) if f"p{q}" in s else None)
+                for q in (50, 90, 99)}
+
+    return {**cols(telemetry.h_ttft, "ttft"),
+            **cols(telemetry.h_tpot, "tpot")}
+
+
 def run_poisson_scenario(continuous: bool, rate_per_s: float,
                          n_requests: int, slots: int = 8,
                          prefix_mode: str = "none",
@@ -252,10 +270,11 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     belongs to.
 
     Continuous rows also report **TTFT** (arrival -> first token) and
-    **TPOT** (inter-token gap) p50/p90/p99 from the engine's own
-    per-token stamps — the streaming metrics the end-to-end latency
+    **TPOT** (inter-token gap) p50/p90/p99 from the engine's always-on
+    telemetry histograms — the streaming metrics the end-to-end latency
     column can't see (micro-batch mode delivers all tokens at once, so
-    those columns only exist for the engine).  ``chunked=True`` serves
+    those columns only exist for the engine), and the same numbers a
+    Prometheus scrape of ``GET /metrics`` would report.  ``chunked=True`` serves
     through the token-budget chunked-prefill scheduler."""
     import queue as _q
 
@@ -334,9 +353,11 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
     wq.query("warm-s", timeout=600)
     wq.query("warm-l", timeout=600)
     if continuous:
-        # token stamps for TTFT/TPOT: enabled only after warmup so
-        # compile time never pollutes the percentiles
-        serving.engine.record_timings = True
+        # TTFT/TPOT come from the always-on telemetry histograms; only
+        # the warmup samples (which carry compile time) must go, so
+        # clear the percentile windows and let measured traffic refill
+        # them — cumulative counters are untouched by design
+        serving.engine.telemetry.reset_windows()
 
     enq_t: dict = {}
     kinds: dict = {}
@@ -386,7 +407,7 @@ def run_poisson_scenario(continuous: bool, rate_per_s: float,
         w.join()
     wall = time.perf_counter() - t_start
     cache = serving.engine.cache_metrics() if paged else None
-    stream = _latency_percentiles(serving.engine.pop_request_timings()) \
+    stream = _stream_percentiles(serving.engine.telemetry) \
         if continuous else {}
     if occ_thread is not None:
         occ_stop.set()
@@ -550,6 +571,10 @@ def run_chunked_scenario(slots: int = 6) -> dict:
             eng.precompile_chunked()
         drive_closed(eng, "warm", 0)
         for attempt in range(4):
+            # raw per-uri stamps (the telemetry keep_request_stamps
+            # shim): the short/long TPOT split below needs per-request
+            # attribution that the pooled always-on histograms don't
+            # keep — this scenario is the reason the shim exists
             eng.record_timings = True
             eng.pop_request_timings()       # drop warm/aborted stamps
             try:
@@ -860,14 +885,95 @@ def _one():
     print(json.dumps(r))
 
 
+def _smoke_scrape():
+    """serve-smoke observability leg: a live paged+chunked continuous
+    stack behind ``HttpFrontend``, real wire-protocol traffic, then
+    assert the export surfaces — ``GET /healthz``, ``GET /metrics``
+    (Prometheus text carrying the engine's TTFT quantiles, queue/pool
+    gauges, and the serving job's counters), the legacy
+    ``?format=json`` dict, and a ``GET /trace`` body that passes the
+    Chrome trace-event schema check."""
+    import urllib.request
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, HttpFrontend, InputQueue, OutputQueue,
+        ServingConfig, validate_chrome_trace)
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    im = InferenceModel(batch_buckets=(1, 4))
+    im.load_flax_generator(model, variables, max_new_tokens=8,
+                           prompt_buckets=(16,))
+    cfg = ServingConfig(prompt_col="tokens", batch_size=4,
+                        continuous_batching=True, engine_slots=4,
+                        engine_paged=True, engine_block_size=8,
+                        engine_chunked=True)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    frontend = HttpFrontend(redis_host=serving.config.redis_host,
+                            redis_port=serving.port, http_port=0,
+                            serving=serving).start()
+    inq = InputQueue(port=serving.port)
+    outq = OutputQueue(port=serving.port)
+    rng = np.random.default_rng(3)
+    try:
+        for i in range(6):
+            inq.enqueue(f"sm{i}", tokens=rng.integers(
+                1, 8192, 12).astype(np.int32))
+        for i in range(6):
+            assert outq.query(f"sm{i}", timeout=600) is not None, i
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{frontend.port}{path}",
+                    timeout=30) as r:
+                return r.headers.get("Content-Type", ""), r.read()
+
+        _, body = get("/healthz")
+        assert json.loads(body) == {"status": "ok"}, body
+        ct, body = get("/metrics")
+        assert ct.startswith("text/plain"), ct
+        text = body.decode()
+        for needle in ('zoo_engine_ttft_seconds{quantile="0.5"}',
+                       "zoo_engine_ttft_seconds_count",
+                       "zoo_engine_tpot_seconds_count",
+                       "zoo_engine_queue_depth",
+                       "zoo_engine_free_blocks",
+                       "zoo_engine_prefix_hit_rate",
+                       "zoo_engine_requests_finished_total 6",
+                       "zoo_serving_requests_total",
+                       "zoo_http_request_seconds_count"):
+            assert needle in text, f"{needle!r} missing from /metrics"
+        _, body = get("/metrics?format=json")
+        assert "latency" in json.loads(body), body
+        _, body = get("/trace")
+        trace = json.loads(body)
+        validate_chrome_trace(trace)
+        names = {e.get("name") for e in trace["traceEvents"]}
+        assert {"queue_wait", "first_token", "request"} <= names, names
+    finally:
+        inq.close()
+        outq.close()
+        frontend.stop()
+        serving.stop()
+    print("SCRAPE_OK")
+
+
 def _smoke():
     """``python bench_serving.py --smoke``: the `make serve-smoke` e2e
     leg — 20 requests through the full wire protocol on the PAGED
     engine behind the CHUNKED token-budget scheduler with a shared
     system prompt, small enough for the CPU test box.  Asserts the
     paged + chunked plumbing end to end: every request served, the
-    prefix cache actually hit, cache columns present, and the engine's
-    own TTFT stamps flowing."""
+    prefix cache actually hit, cache columns present, the engine's
+    always-on TTFT/TPOT histograms flowing — then the observability
+    surfaces (/healthz, Prometheus /metrics, /trace) on a live stack
+    via ``_smoke_scrape``."""
     r = run_poisson_scenario(True, rate_per_s=20.0, n_requests=20,
                              slots=4, prefix_mode="full", paged=True,
                              chunked=True)
@@ -878,6 +984,7 @@ def _smoke():
     assert r["max_coresident"] >= 1, r
     assert r["ttft_p50_ms"] is not None, r
     assert r["tpot_p50_ms"] is not None, r
+    _smoke_scrape()
     print("SMOKE_OK")
 
 

@@ -275,12 +275,29 @@ class TestHttpFrontend:
         _, fe = stack
         assert self._get(fe.port, "/healthz")[0] == 200
         self.test_predict_json_lists(stack)
-        status, m = self._get(fe.port, "/metrics")
+        # legacy JSON dict lives behind ?format=json now
+        status, m = self._get(fe.port, "/metrics?format=json")
         assert status == 200
         assert m["latency"]["count"] >= 1
         assert m["latency"]["p50_ms"] > 0
         assert m["serving"]["requests"] >= 2
         assert "backlog" in m
+
+    def test_metrics_default_is_prometheus_text(self, stack):
+        _, fe = stack
+        self.test_predict_json_lists(stack)
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=15)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type", "").startswith("text/plain")
+        text = resp.read().decode()
+        assert "# TYPE zoo_http_request_seconds summary" in text
+        assert 'zoo_http_request_seconds{quantile="0.5"}' in text
+        assert "zoo_http_request_seconds_count" in text
+        assert "zoo_serving_requests_total" in text
+        assert "zoo_http_backlog" in text
 
     def test_unknown_route_404(self, stack):
         _, fe = stack
