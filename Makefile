@@ -42,15 +42,17 @@ lint:
 bench:
 	$(PY) bench.py
 
-# serving smoke: the paged KV-cache + chunked-prefill + telemetry test
-# files + a 20-request e2e wire-protocol bench leg (which drives the
-# chunked scheduler end to end, then scrapes /metrics + /healthz and
-# schema-checks the dumped trace on a live stack), all forced onto
-# host CPU (fast; fits the tier-1 timeout)
+# serving smoke: the paged KV-cache + chunked-prefill + composed-mode
+# (speculative over blocks/chunks) + telemetry test files + a
+# 20-request e2e wire-protocol bench leg (which drives the chunked
+# scheduler end to end, then runs a SPECULATIVE paged+chunked stack
+# and scrapes /metrics + /healthz and schema-checks the dumped trace
+# live), all forced onto host CPU (fast; fits the tier-1 timeout)
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py \
 	    tests/test_chunked_prefill.py tests/test_telemetry.py \
 	    -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_composed.py -q
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
 
 clean:

@@ -336,6 +336,7 @@ class InferenceModel:
                                enable_prefix_cache: bool = True,
                                chunked: bool = False,
                                tick_token_budget: Optional[int] = None,
+                               speculation_k: Optional[int] = None,
                                record_timings: bool = False,
                                telemetry=None):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
@@ -356,7 +357,13 @@ class InferenceModel:
         ``chunked=True`` turns on the token-budget tick scheduler:
         prompts prefill in ``tick_token_budget``-bounded chunks fused
         with active decodes in one device call per tick — long joiners
-        stop stalling residents (docs/serving_memory.md 'Scheduler')."""
+        stop stalling residents (docs/serving_memory.md 'Scheduler').
+
+        A draft-loaded handle (``load_flax_generator(draft_model=...)``)
+        builds a SPECULATIVE engine; it composes with ``paged`` and
+        ``chunked`` freely (docs/serving_memory.md 'Composed modes').
+        ``speculation_k`` overrides the per-round proposal depth stored
+        at load (``None`` keeps it); it is rejected without a draft."""
         from analytics_zoo_tpu.serving.continuous import ContinuousEngine
 
         if getattr(self, "_gen_max_new_tokens", None) is None:
@@ -372,7 +379,14 @@ class InferenceModel:
             # both position tables) are exactly the engine's own limit
             spec = dict(draft_model=self._spec_draft_model,
                         draft_variables=self._spec_draft_variables,
-                        speculation_k=self._spec_k)
+                        speculation_k=(self._spec_k
+                                       if speculation_k is None
+                                       else int(speculation_k)))
+        elif speculation_k is not None:
+            raise ValueError(
+                "speculation_k needs a draft model: load one via "
+                "load_flax_generator(draft_model=..., "
+                "draft_variables=...)")
         return ContinuousEngine(
             self.model, variables,
             max_new_tokens=self._gen_max_new_tokens,

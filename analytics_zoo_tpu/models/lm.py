@@ -955,7 +955,15 @@ class TransformerLM(nn.Module):
     def verify_step_paged(self, toks, pools_k, pools_v, tables, pos):
         """``verify_step`` against a paged cache: S tokens per row in one
         block-causal forward, K/V scattered through the block tables.
-        Returns (logits [B, S, V], pools_k, pools_v)."""
+        Returns (logits [B, S, V], pools_k, pools_v).
+
+        Same rejection mechanism as :meth:`verify_step`, expressed in
+        pages: all S entries are written through the table, and the
+        caller advancing ``pos`` by fewer than S makes the surplus
+        entries dead — the next verify overwrites them in place before
+        the causal mask ever exposes them, so speculative rollback
+        costs zero block copies (ops/flash_attention.paged_kv_update
+        documents the write/clamp contract)."""
         h, pk, pv = self.verify_hidden_paged(toks, pools_k, pools_v,
                                              tables, pos)
         return self._logits(h), pk, pv

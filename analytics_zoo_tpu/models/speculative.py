@@ -46,6 +46,52 @@ from jax import lax
 from analytics_zoo_tpu.models.lm import TransformerLM
 
 
+def accept_proposals(logits, d, last, done, *, k, eos_id,
+                     budget=None):
+    """The speculative acceptance rule — ONE definition shared by
+    batch ``speculative_generate`` and the continuous engine's
+    spec-round programs (arena AND paged), so the greedy contract can
+    never drift between surfaces.
+
+    ``logits`` [B, k+1, V] are the target's verify outputs for inputs
+    [last, d_0..d_{k-1}]; ``d`` [B, k] the draft proposals; ``last``
+    [B] each row's previous emitted token; ``done`` [B] frozen rows.
+    ``budget`` optionally clips emission to each row's remaining token
+    allowance (batch generate; the engine drops surplus host-side).
+
+    Returns ``(t, n_emit, new_last, done)``: ``t`` [B, k+1] the target
+    argmaxes with everything after a row's first in-window eos frozen
+    AT eos (the emitted prefix of a row therefore never needs host
+    patching), ``n_emit`` [B] in 0..k+1 (0 only for done rows or an
+    exhausted budget), ``new_last`` the last emitted token (the old
+    ``last`` where nothing emitted)."""
+    t = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, k+1]
+    match = (t[:, :k] == d)                             # [B, k]
+    a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    n_emit = a + 1                                      # t_0..t_a
+    if budget is not None:
+        n_emit = jnp.minimum(n_emit, budget)
+    if eos_id is not None:
+        js = jnp.arange(k + 1)[None, :]
+        is_eos = (t == eos_id) & (js < n_emit[:, None])
+        first_eos = jnp.where(is_eos.any(axis=1),
+                              jnp.argmax(is_eos, axis=1), k + 1)
+        n_emit = jnp.minimum(n_emit, first_eos + 1)
+        # frozen tail on-device: everything after a row's first eos
+        # reads as eos (emitted entries sit at js <= first_eos, so
+        # freezing changes no emitted value)
+        t = jnp.where(js > first_eos[:, None], jnp.int32(eos_id), t)
+    n_emit = jnp.where(done, 0, n_emit)
+    new_last = jnp.where(
+        n_emit > 0,
+        jnp.take_along_axis(t, jnp.maximum(n_emit - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        last)
+    if eos_id is not None:
+        done = done | ((n_emit > 0) & (new_last == eos_id))
+    return t, n_emit, new_last, done
+
+
 def _prefill_caches(model, variables, prompt, L):
     """One batched causal forward (TransformerLM.prefill) padded into an
     L-long cache — NOT Pn sequential decode steps; the prompt is the
@@ -91,22 +137,11 @@ def _spec_round(model, variables, draft_model, draft_variables,
     logits, tck, tcv = model.apply(
         variables, inputs, tck, tcv, ptr,
         method=TransformerLM.verify_step)
-    t = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [B, k+1]
 
-    # ---- accept the longest matching prefix ---------------------------
-    match = (t[:, :k] == d)                             # [B, k]
-    a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-    n_emit = a + 1                                      # t_0..t_a
-    # budget and eos clipping
-    n_emit = jnp.minimum(n_emit, max_new - gen_len)
-    if eos_id is not None:
-        js = jnp.arange(k + 1)[None, :]
-        is_eos = (t == eos_id) & (js < n_emit[:, None])
-        first_eos = jnp.where(is_eos.any(axis=1),
-                              jnp.argmax(is_eos, axis=1),
-                              k + 1)
-        n_emit = jnp.minimum(n_emit, first_eos + 1)
-    n_emit = jnp.where(done, 0, n_emit)
+    # ---- accept the longest matching prefix (shared rule) -------------
+    t, n_emit, new_last, done = accept_proposals(
+        logits, d, last, done, k=k, eos_id=eos_id,
+        budget=max_new - gen_len)
 
     # ---- scatter emitted tokens into the output buffer ----------------
     js = jnp.arange(k + 1)[None, :]
@@ -118,18 +153,12 @@ def _spec_round(model, variables, draft_model, draft_variables,
         "bjm,bj->bm", hit.astype(jnp.int32), t), out)
 
     # ---- advance ------------------------------------------------------
-    # next round's first input is the last EMITTED token; its KV is not
-    # durable yet (pointer stops just before it), mirroring decode_step
-    new_last = jnp.where(
-        n_emit > 0,
-        jnp.take_along_axis(t, jnp.maximum(n_emit - 1, 0)[:, None],
-                            axis=1)[:, 0],
-        last)
+    # next round's first input is the last EMITTED token (computed by
+    # accept_proposals); its KV is not durable yet (pointer stops just
+    # before it), mirroring decode_step
     ptr = ptr + n_emit
     dptr = dptr + n_emit
     gen_len = gen_len + n_emit
-    if eos_id is not None:
-        done = done | (new_last == eos_id)
     done = done | (gen_len >= max_new)
     return ((new_last, tck, tcv, ptr, dck, dcv, dptr,
              out, gen_len, done),

@@ -423,6 +423,16 @@ def paged_kv_update(pool_k, pool_v, tables, pos, new_k, new_v,
     maps to a PRIVATE tail block of that row, so real writes never
     collide; sink-block collisions are garbage-on-garbage.
 
+    Speculative verify rides this same scatter: the engine writes k+1
+    positions per row per round (``S = k+1``) and REJECTION IS POINTER
+    ROLLBACK — the next round re-enters with ``pos`` advanced only past
+    the accepted prefix, so rejected entries are overwritten in place
+    before any attention read can reach them (reads mask to ``<= pos``)
+    and no block is ever copied.  Rejected positions that spill past a
+    row's allocated table clamp into the sink block per the rule above,
+    which is why the engine only has to allocate blocks through
+    ``pos + k`` rather than the worst-case round end.
+
     ``limit`` (``[B]`` int32, optional): row b's writes at logical
     positions ``>= limit[b]`` are DROPPED outright.  Chunked prefill
     passes its per-row true length here: with tables SLICED to a narrow

@@ -6,6 +6,13 @@ model artifact it names, start the serving loop, block until SIGINT.
 ``--embedded-broker`` runs the bundled RESP broker in-process (local/
 single-box deployments); without it the config's redis host:port must
 already be running.
+
+Engine modes come from the config's ``params`` block (see
+ServingConfig): ``engine_paged`` / ``engine_chunked`` /
+``engine_speculation_k`` compose freely on a draft-loaded model —
+paged blocks, budgeted prefill chunks, and draft-verify decoding are
+one scheduler, not three exclusive engines (docs/serving_memory.md
+'Composed modes').
 """
 
 import argparse

@@ -45,8 +45,10 @@ from analytics_zoo_tpu.learn.inference_model import (
     _next_bucket, filter_prompt_buckets)
 from analytics_zoo_tpu.models.lm import (TransformerLM,
                                          top_p_filter)
+from analytics_zoo_tpu.models.speculative import accept_proposals
 from analytics_zoo_tpu.serving.paged_cache import (BlockPool,
-                                                   SINK_BLOCK)
+                                                   SINK_BLOCK,
+                                                   split_block_budget)
 from analytics_zoo_tpu.serving.telemetry import Telemetry
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -130,8 +132,13 @@ class ContinuousEngine:
     OOMing — its partial tokens are discarded and regenerate
     deterministically on readmission (greedy argmax, and sampled rows
     fold the rng by absolute position).  ``cache_metrics()`` reports
-    occupancy/hit-rate/preemptions.  Paged limitations (ROADMAP open
-    items): no draft-model speculation, no mesh; paged
+    occupancy/hit-rate/preemptions.  A ``draft_model`` composes with
+    paged (and with chunked, and with both): the draft pages its own
+    K/V through a SECOND pool tenant — its own block tables and
+    allocator over a proportionally small slice of HBM — and the
+    verify step writes k+1 positions through the paged write path,
+    rolling rejected positions back by pointer (never by block copy).
+    Remaining paged limitation (ROADMAP open item): no mesh; paged
     ``register_prefix`` must run before the pump starts (it updates
     the donated pool buffers — racing a live ``step()`` is undefined).
 
@@ -150,6 +157,7 @@ class ContinuousEngine:
                  draft_variables=None, speculation_k: int = 4,
                  paged: bool = False, block_size: int = 16,
                  n_blocks: Optional[int] = None,
+                 draft_n_blocks: Optional[int] = None,
                  hbm_fraction: Optional[float] = None,
                  enable_prefix_cache: bool = True,
                  chunked: bool = False,
@@ -163,7 +171,14 @@ class ContinuousEngine:
         slot's cache), and slot bookkeeping (tok/pos/done) replicates.
         XLA propagates the shardings through the jitted step/prefill/
         splice programs — decode runs as one SPMD program with the tp
-        collectives the weight layout implies."""
+        collectives the weight layout implies.
+
+        ``draft_n_blocks`` (paged + draft only) overrides the draft
+        tenant's pool size, which otherwise matches ``n_blocks`` — the
+        draft's K/V is cheap (per-block bytes scale with its
+        layers x kv_heads x head_dim), so equal counts cost little; a
+        smaller override is mainly a test lever for draft-pool-dry
+        preemption."""
         if model.pp_stages > 0:
             raise ValueError("continuous batching serves pp_stages=0 "
                              "models (models.lm.unstack_pp_params)")
@@ -197,9 +212,12 @@ class ContinuousEngine:
             if draft_model.pp_stages > 0:
                 raise ValueError("draft must be pp_stages=0")
             if mesh is not None:
-                raise NotImplementedError(
-                    "speculative continuous batching is single-chip for "
-                    "now; drop either mesh or draft_model")
+                raise ValueError(
+                    "speculative continuous batching is single-chip "
+                    "for now: mesh does not compose with draft_model "
+                    "(see the ROADMAP item 'Tensor-parallel + "
+                    "multi-replica paged serving'); drop mesh or drop "
+                    "draft_model")
             if self._spec_k < 1:
                 raise ValueError("speculation_k must be >= 1")
         # speculative verify writes k+1 entries past the pointer and
@@ -252,22 +270,31 @@ class ContinuousEngine:
         self._pool: Optional[BlockPool] = None
         self._pk = self._pv = None
         self._paged_prefixes: Dict[int, tuple] = {}
+        self._dpool: Optional[BlockPool] = None
+        self._dpk = self._dpv = None
         if self.paged:
-            if draft_model is not None:
-                raise NotImplementedError(
-                    "paged + speculative decoding is a ROADMAP open "
-                    "item; build the paged engine without a draft")
             if mesh is not None:
-                raise NotImplementedError(
-                    "paged mode is single-chip for now (multi-replica "
-                    "routing is a ROADMAP open item); drop mesh")
+                raise ValueError(
+                    "paged mode is single-chip for now: mesh does not "
+                    "compose with paged=True (see the ROADMAP item "
+                    "'Tensor-parallel + multi-replica paged serving'); "
+                    "drop mesh")
             bs = int(block_size)
             if bs < 1:
                 raise ValueError(f"block_size must be >= 1, got {bs}")
             M = -(-L // bs)         # logical blocks per row, ceil(L/bs)
+            per_block = 2 * model.num_layers * bs * H * D \
+                * cdtype.itemsize
+            draft_per_block = 0
+            if draft_model is not None:
+                DHp = getattr(draft_model, "kv_heads",
+                              draft_model.num_heads)
+                DDp = draft_model.hidden_size // draft_model.num_heads
+                draft_per_block = 2 * draft_model.num_layers * bs \
+                    * DHp * DDp * cdtype.itemsize
+            self._per_block_bytes = per_block
+            self._draft_per_block_bytes = draft_per_block
             if n_blocks is None:
-                per_block = 2 * model.num_layers * bs * H * D \
-                    * cdtype.itemsize
                 lim = 0
                 if hbm_fraction is not None:
                     try:
@@ -276,9 +303,13 @@ class ContinuousEngine:
                     except Exception:
                         lim = 0
                 if lim:
-                    n_blocks = max(M + 1,
-                                   int(lim * float(hbm_fraction))
-                                   // per_block)
+                    # with a draft the byte budget covers BOTH tenants:
+                    # the common block count splits it proportionally
+                    # to per-block cost (the draft's slice is small)
+                    n_blocks = max(M + 1, split_block_budget(
+                        int(lim * float(hbm_fraction)),
+                        (per_block, draft_per_block)
+                        if draft_model is not None else (per_block,)))
                 else:
                     if hbm_fraction is not None:
                         logger.warning(
@@ -298,7 +329,8 @@ class ContinuousEngine:
                     f"{bs} positions + the sink block 0)")
             self._bs, self._M = bs, M
             self._pool = BlockPool(n_blocks, bs, enable_prefix_cache,
-                                   event_cb=self.telemetry.pool_event)
+                                   event_cb=self.telemetry.pool_event,
+                                   name="target")
             # pool-mutation guard: admission/growth run on the pump
             # thread, but unregister_prefix releases from client threads
             self._pool_lock = threading.Lock()
@@ -309,6 +341,32 @@ class ContinuousEngine:
             # block, so stray writes land in storage nothing attends
             self._tables = np.full((S, M), SINK_BLOCK, np.int32)
             self._row_blocks: List[List[int]] = [[] for _ in range(S)]
+            if draft_model is not None:
+                # the draft is a second POOL TENANT: its own physical
+                # block arena, block tables, and host allocator (block
+                # ids from one pool mean nothing in the other).  The
+                # draft position pointer tracks the target's, so a
+                # row's draft table grows in LOCKSTEP with its target
+                # table — same block count, per-block bytes scaled by
+                # the draft's layers x kv_heads x head_dim.
+                dnb = n_blocks if draft_n_blocks is None \
+                    else int(draft_n_blocks)
+                if dnb < M + 1:
+                    raise ValueError(
+                        f"draft_n_blocks={dnb} cannot hold one "
+                        f"full-length sequence: need >= {M + 1} "
+                        f"({M} logical blocks of {bs} positions + the "
+                        f"sink block 0)")
+                self._dpool = BlockPool(
+                    dnb, bs, enable_prefix_cache,
+                    event_cb=self.telemetry.pool_event, name="draft")
+                self._dpk = jnp.zeros(
+                    (draft_model.num_layers, dnb, bs, DHp, DDp),
+                    cdtype)
+                self._dpv = jnp.zeros_like(self._dpk)
+                self._dtables = np.full((S, M), SINK_BLOCK, np.int32)
+                self._drow_blocks: List[List[int]] = [
+                    [] for _ in range(S)]
         # ---- chunked prefill (token-budget tick scheduler) -------------
         # chunked=True replaces monolithic admission prefill with
         # incremental chunks packed alongside decodes under a per-tick
@@ -321,18 +379,21 @@ class ContinuousEngine:
         self._budget_ticks = 0
         self.tick_token_budget: Optional[int] = None
         if self.chunked:
-            if draft_model is not None:
-                raise NotImplementedError(
-                    "chunked prefill + speculative decoding is not "
-                    "implemented; drop either chunked or draft_model")
             if mesh is not None:
-                raise NotImplementedError(
-                    "chunked prefill is single-chip for now; drop mesh")
+                raise ValueError(
+                    "chunked prefill is single-chip for now: mesh does "
+                    "not compose with chunked=True (see the ROADMAP "
+                    "item 'Tensor-parallel + multi-replica paged "
+                    "serving'); drop mesh")
             if tick_token_budget is None:
                 # default: roughly one decode-bucket of MXU work — all S
                 # decode rows plus at least one smallest-bucket chunk
-                # (and at least one paged block) fit in a tick
-                budget = max(self.prompt_buckets[0] + S, 2 * S)
+                # (and at least one paged block) fit in a tick.  A
+                # speculative decode row costs k+1 verify positions, so
+                # the default scales with the row's true footprint
+                per_row = self._spec_k + 1
+                budget = max(self.prompt_buckets[0] + per_row * S,
+                             2 * per_row * S)
                 if self.paged:
                     budget = max(budget, self._bs)
             else:
@@ -768,81 +829,202 @@ class ContinuousEngine:
                      "zoo_engine_pool_alloc_failures_total", "counter",
                      "allocate() calls the pool could not serve")):
                 m.gauge(name, hlp, fn=_pool_read(key), kind=kind)
+            if self._dpool is not None:
+                def _dpool_read(key):
+                    def read():
+                        with self._pool_lock:
+                            return self._dpool.metrics()[key]
+                    return read
+
+                for key, name, kind, hlp in (
+                        ("free_blocks", "zoo_engine_draft_free_blocks",
+                         "gauge", "draft-pool blocks on the free list"),
+                        ("referenced_blocks",
+                         "zoo_engine_draft_referenced_blocks", "gauge",
+                         "draft-pool blocks held by live requests"),
+                        ("occupancy", "zoo_engine_draft_pool_occupancy",
+                         "gauge",
+                         "referenced fraction of the draft pool"),
+                        ("alloc_failures",
+                         "zoo_engine_draft_pool_alloc_failures_total",
+                         "counter", "draft-pool allocate() calls it "
+                         "could not serve")):
+                    m.gauge(name, hlp, fn=_dpool_read(key), kind=kind)
 
     def _init_speculative(self, cdtype):
-        """Draft arena + the jitted spec-round program.  One round per
+        """Draft cache + the jitted spec-round programs.  One round per
         device call: draft proposes k per slot (k+1 cached feeds), the
         target verifies all slots' proposals in ONE decode_k forward,
-        each slot advances by its own accepted count (per-row pointers —
-        the arena layout the engine already has)."""
+        each slot advances by its own accepted count (per-row pointers).
+        Arena mode gives the draft its own [layers, S, L, DH, DD] strip;
+        paged mode addresses draft K/V through the second pool tenant's
+        block tables — the SAME round structure, with verify writing its
+        k+1 positions through the paged write path and rejection rolling
+        the pointers back (``pos + n_emit``, never a block copy: entries
+        past the new pointer are dead and the next round overwrites
+        them in-place before anything attends that far)."""
         draft, dvars = self.draft_model, self._draft_variables
         model, variables = self.model, self._variables
         S, L, k = self._S, self._L, self._spec_k
         eos_id = self.eos_id
-        DH = getattr(draft, "kv_heads", draft.num_heads)
-        DD = draft.hidden_size // draft.num_heads
-        self._dck = jnp.zeros((draft.num_layers, S, L, DH, DD), cdtype)
-        self._dcv = jnp.zeros_like(self._dck)
         self._dpos = np.zeros(S, np.int32)
 
-        def spec_step(ck, cv, dck, dcv, tok, pos, dpos, done):
-            # draft: k proposals via k+1 greedy cached feeds (the extra
-            # feed writes d_{k-1}'s KV so a full-acceptance round leaves
-            # the draft cache complete — models/speculative.py)
-            def dstep(c, _):
-                t, dck, dcv, p = c
-                lg, dck, dcv = draft.apply(
-                    dvars, t, dck, dcv, p,
-                    method=TransformerLM.decode_step)
-                nxt = jnp.argmax(lg, -1).astype(jnp.int32)
-                return (nxt, dck, dcv, p + 1), nxt
+        if self.paged:
+            def spec_step_paged(pk, pv, dpk, dpv, tok, pos, dpos, done,
+                                tables, dtables):
+                # draft: k proposals via k+1 greedy cached feeds through
+                # the DRAFT tenant's tables (the extra feed writes
+                # d_{k-1}'s KV so a full-acceptance round leaves the
+                # draft pages complete — models/speculative.py)
+                def dstep(c, _):
+                    t, dpk, dpv, p = c
+                    lg, dpk, dpv = draft.apply(
+                        dvars, t, dpk, dpv, dtables, p,
+                        method=TransformerLM.decode_step_paged)
+                    nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                    return (nxt, dpk, dpv, p + 1), nxt
 
-            (_, dck, dcv, _), d = jax.lax.scan(
-                dstep, (tok, dck, dcv, dpos), None, length=k + 1)
-            d = d.T[:, :k]                              # [S, k]
+                (_, dpk, dpv, _), d = jax.lax.scan(
+                    dstep, (tok, dpk, dpv, dpos), None, length=k + 1)
+                d = d.T[:, :k]                          # [S, k]
 
-            inputs = jnp.concatenate([tok[:, None], d], axis=1)
-            logits, ck, cv = model.apply(
-                variables, inputs, ck, cv, pos,
-                method=TransformerLM.verify_step)
-            t = jnp.argmax(logits, -1).astype(jnp.int32)  # [S, k+1]
+                # verify: k+1 positions written through the paged path
+                # (rows with table rows all SINK — free/frozen — write
+                # only sink-block garbage)
+                inputs = jnp.concatenate([tok[:, None], d], axis=1)
+                logits, pk, pv = model.apply(
+                    variables, inputs, pk, pv, tables, pos,
+                    method=TransformerLM.verify_step_paged)
+                t, n_emit, new_tok, done = accept_proposals(
+                    logits, d, tok, done, k=k, eos_id=eos_id)
+                # pointer rollback IS the advance: rejected positions
+                # stay physically written but unreachable (< pos never
+                # attends past pos+j), and the next round re-writes them
+                pos = jnp.minimum(pos + n_emit, L - 1)
+                dpos = jnp.minimum(dpos + n_emit, L - 1)
+                # [k+1, S] to match the plain step's emission order
+                return (t.T, n_emit, new_tok, pos, dpos, done,
+                        pk, pv, dpk, dpv)
 
-            match = (t[:, :k] == d)
-            a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                        axis=1)
-            n_emit = a + 1
-            if eos_id is not None:
-                js = jnp.arange(k + 1)[None, :]
-                is_eos = (t == eos_id) & (js < n_emit[:, None])
-                first_eos = jnp.where(is_eos.any(axis=1),
-                                      jnp.argmax(is_eos, axis=1), k + 1)
-                n_emit = jnp.minimum(n_emit, first_eos + 1)
-                # frozen tail on-device, like the plain step: everything
-                # after a slot's first eos reads as eos
-                t = jnp.where(js > first_eos[:, None],
-                              jnp.int32(eos_id), t)
-            n_emit = jnp.where(done, 0, n_emit)
-            new_tok = jnp.where(
-                n_emit > 0,
-                jnp.take_along_axis(
-                    t, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0],
-                tok)
-            if eos_id is not None:
-                done = done | ((n_emit > 0) & (new_tok == eos_id))
-            pos = jnp.minimum(pos + n_emit, L - 1)
-            dpos = jnp.minimum(dpos + n_emit, L - 1)
-            # [k+1, S] to match the plain step's emission-order layout
-            return (t.T, n_emit, new_tok, pos, dpos, done,
-                    ck, cv, dck, dcv)
+            self._spec_step_paged = jax.jit(
+                spec_step_paged, donate_argnums=(0, 1, 2, 3))
 
-        self._spec_step = jax.jit(spec_step, donate_argnums=(0, 1, 2, 3))
+            def draft_paged_admit_fn(dpk, dpv, suffixes, slens, dtables,
+                                     pos):
+                """Draft-tenant admission prefill: the same grid the
+                target's ``_paged_admit`` ran, against the draft pool —
+                logits are discarded (only the target picks tokens)."""
+                _, dpk, dpv = draft.apply(
+                    dvars, suffixes, dpk, dpv, dtables, pos, slens,
+                    method=TransformerLM.prefill_chunk_paged)
+                return dpk, dpv
 
-        def draft_prefill_fn(prompts):
-            _, ks, vs = draft.apply(dvars, prompts,
-                                    method=TransformerLM.prefill)
-            return ks, vs
+            self._draft_paged_admit = jax.jit(draft_paged_admit_fn,
+                                              donate_argnums=(0, 1))
+        else:
+            DH = getattr(draft, "kv_heads", draft.num_heads)
+            DD = draft.hidden_size // draft.num_heads
+            self._dck = jnp.zeros((draft.num_layers, S, L, DH, DD),
+                                  cdtype)
+            self._dcv = jnp.zeros_like(self._dck)
 
-        self._draft_prefill = jax.jit(draft_prefill_fn)
+            def spec_step(ck, cv, dck, dcv, tok, pos, dpos, done):
+                # draft: k proposals via k+1 greedy cached feeds (the
+                # extra feed writes d_{k-1}'s KV so a full-acceptance
+                # round leaves the draft cache complete)
+                def dstep(c, _):
+                    t, dck, dcv, p = c
+                    lg, dck, dcv = draft.apply(
+                        dvars, t, dck, dcv, p,
+                        method=TransformerLM.decode_step)
+                    nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                    return (nxt, dck, dcv, p + 1), nxt
+
+                (_, dck, dcv, _), d = jax.lax.scan(
+                    dstep, (tok, dck, dcv, dpos), None, length=k + 1)
+                d = d.T[:, :k]                          # [S, k]
+
+                inputs = jnp.concatenate([tok[:, None], d], axis=1)
+                logits, ck, cv = model.apply(
+                    variables, inputs, ck, cv, pos,
+                    method=TransformerLM.verify_step)
+                t, n_emit, new_tok, done = accept_proposals(
+                    logits, d, tok, done, k=k, eos_id=eos_id)
+                pos = jnp.minimum(pos + n_emit, L - 1)
+                dpos = jnp.minimum(dpos + n_emit, L - 1)
+                # [k+1, S] to match the plain step's emission order
+                return (t.T, n_emit, new_tok, pos, dpos, done,
+                        ck, cv, dck, dcv)
+
+            self._spec_step = jax.jit(spec_step,
+                                      donate_argnums=(0, 1, 2, 3))
+
+            def draft_prefill_fn(prompts):
+                _, ks, vs = draft.apply(dvars, prompts,
+                                        method=TransformerLM.prefill)
+                return ks, vs
+
+            self._draft_prefill = jax.jit(draft_prefill_fn)
+
+        if not self.chunked:
+            return
+
+        # ---- spec chunk program (greedy-only, both tenants) -----------
+        # A spec tick with PREFILLING rows runs TWO device calls under
+        # one token budget: the spec round above for decode rows, then
+        # this chunk program, which lands prompt chunks in BOTH models'
+        # caches (the draft must have the prompt's K/V before it can
+        # propose) and picks each completing prompt's first token from
+        # the TARGET logits.  Fusing the two would square the compile
+        # grid (verify shapes x chunk shapes) to save zero host syncs —
+        # both results are consumed by the same host step.
+        if self.paged:
+            def spec_chunk_paged_fn(pk, pv, dpk, dpv, ctoks, cpos,
+                                    clens, ctabs, dctabs):
+                clog, pk, pv = model.apply(
+                    variables, ctoks, pk, pv, ctabs, cpos, clens,
+                    method=TransformerLM.prefill_chunk_paged)
+                _, dpk, dpv = draft.apply(
+                    dvars, ctoks, dpk, dpv, dctabs, cpos, clens,
+                    method=TransformerLM.prefill_chunk_paged)
+                # greedy-only by the submit() contract, so the first
+                # pick is plain argmax (pick_next minus sampling/eos —
+                # _record_token handles an eos first token host-side)
+                cnxt = jnp.argmax(clog, -1).astype(jnp.int32)
+                return cnxt, pk, pv, dpk, dpv
+
+            self._spec_chunk_paged = jax.jit(
+                spec_chunk_paged_fn, donate_argnums=(0, 1, 2, 3))
+        else:
+            def spec_chunk_fn(ck, cv, dck, dcv, ctoks, cpos, clens,
+                              cslots, read_len):
+                read_idx = jnp.minimum(cslots, S - 1)
+                rows_k = jnp.take(ck, read_idx, axis=1)[:, :, :read_len]
+                rows_v = jnp.take(cv, read_idx, axis=1)[:, :, :read_len]
+                clog, rows_k, rows_v = model.apply(
+                    variables, ctoks, rows_k, rows_v, cpos, clens,
+                    method=TransformerLM.prefill_chunk)
+                ck = ck.at[:, cslots, :read_len].set(
+                    rows_k.astype(ck.dtype), mode="drop")
+                cv = cv.at[:, cslots, :read_len].set(
+                    rows_v.astype(cv.dtype), mode="drop")
+                drows_k = jnp.take(dck, read_idx,
+                                   axis=1)[:, :, :read_len]
+                drows_v = jnp.take(dcv, read_idx,
+                                   axis=1)[:, :, :read_len]
+                _, drows_k, drows_v = draft.apply(
+                    dvars, ctoks, drows_k, drows_v, cpos, clens,
+                    method=TransformerLM.prefill_chunk)
+                dck = dck.at[:, cslots, :read_len].set(
+                    drows_k.astype(dck.dtype), mode="drop")
+                dcv = dcv.at[:, cslots, :read_len].set(
+                    drows_v.astype(dcv.dtype), mode="drop")
+                cnxt = jnp.argmax(clog, -1).astype(jnp.int32)
+                return cnxt, ck, cv, dck, dcv
+
+            self._spec_chunk = jax.jit(
+                spec_chunk_fn, static_argnames=("read_len",),
+                donate_argnums=(0, 1, 2, 3))
 
     @staticmethod
     def _kv_kernels_tp_sharded(shardings) -> bool:
@@ -893,8 +1075,14 @@ class ContinuousEngine:
                 "arena_equivalent_bytes": arena_equiv,
                 "tp": 1,
                 "arena_bytes_per_chip": per_block * self._pool.n_blocks,
-                "draft_arena_bytes": 0,
-                "prefix_bytes": 0,  # pinned prefixes live IN the pool
+                # the draft tenant's pool (0 without a draft model);
+                # pinned prefixes live IN the pools for both tenants
+                "draft_arena_bytes": (
+                    self._draft_per_block_bytes * self._dpool.n_blocks
+                    if self._dpool is not None else 0),
+                "draft_n_blocks": (self._dpool.n_blocks
+                                   if self._dpool is not None else 0),
+                "prefix_bytes": 0,
             }
         H_full = m.num_heads
         H = self._ck.shape[3]
@@ -996,15 +1184,44 @@ class ContinuousEngine:
             with self._lock:
                 if pid not in self._paged_prefixes:
                     raise ValueError(f"unknown prefix id {pid}")
-                _, blocks = self._paged_prefixes.pop(pid)
+                _, blocks, dblocks = self._paged_prefixes.pop(pid)
             with self._pool_lock:
                 for b in blocks:
                     self._pool.release(b)
+                for b in dblocks:
+                    self._dpool.release(b)
             return
         with self._lock:
             if pid not in self._prefixes:
                 raise ValueError(f"unknown prefix id {pid}")
             del self._prefixes[pid]
+
+    def abort(self, uri: str) -> bool:
+        """Drop a request nobody will collect (an abandoned client):
+        remove it from the waiting queue, or free its resident slot —
+        including BOTH pool tenants' blocks for a speculative paged row
+        (``_release_slot_blocks``), so an abandoned row can never strand
+        draft pages.  Call from the pump thread (the serving loop's
+        prune pass runs there); resident-slot teardown touches the same
+        per-slot state the tick mutates.  Returns True if the uri was
+        found.  No callback fires — the caller already decided nobody
+        is listening."""
+        with self._lock:
+            for req in self._waiting:
+                if req.uri == uri:
+                    self._waiting.remove(req)
+                    self.telemetry.req_errored(uri, "aborted")
+                    return True
+        for slot, st in enumerate(self._slots):
+            if st is not None and st.uri == uri:
+                self._slots[slot] = None
+                self._done[slot] = True     # frozen until readmission
+                self._free.append(slot)
+                if self.paged:
+                    self._release_slot_blocks(slot)
+                self.telemetry.req_errored(uri, "aborted")
+                return True
+        return False
 
     def submit(self, uri: str, prompt: np.ndarray,
                on_done: Optional[Callable] = None, *,
@@ -1266,7 +1483,7 @@ class ContinuousEngine:
         prefix path) and install the slot PREFILLING at the prefix
         boundary."""
         base = 0
-        pks = pvs = None
+        pks = pvs = dks = dvs = None
         if req.prefix is not None:
             with self._lock:
                 entry = self._prefixes.get(req.prefix)
@@ -1276,11 +1493,18 @@ class ContinuousEngine:
                     f"queued"))
                 return "error"
             pks, pvs, base = entry[0], entry[1], entry[2]
+            dks, dvs = entry[3], entry[4]
         slot = self._free.popleft()
         if pks is not None:
             try:
                 self._ck, self._cv = self._insert(
                     self._ck, self._cv, pks, pvs, jnp.int32(slot))
+                if self.draft_model is not None:
+                    # the draft's chunks run against the SAME spliced
+                    # prefix boundary, so its cache needs the prefix too
+                    self._dck, self._dcv = self._insert(
+                        self._dck, self._dcv, dks, dvs,
+                        jnp.int32(slot))
             except Exception as e:
                 self._free.append(slot)
                 logger.exception("chunked prefix splice failed for %r",
@@ -1310,17 +1534,31 @@ class ContinuousEngine:
         with self._pool_lock:
             matched = self._pool.lookup(
                 hashes[:(plen - 1) // self._bs])
+            dmatch = None
+            if self._dpool is not None:
+                # the fill frontier is one number for both tenants, so
+                # the usable prefix match is the shorter of the two
+                dmatch = self._dpool.lookup(
+                    hashes[:(plen - 1) // self._bs])
+                m = min(len(matched), len(dmatch))
+                matched, dmatch = matched[:m], dmatch[:m]
             need = total - len(matched)
-            if need + 1 > self._pool.n_blocks - 1:
+            cap = self._pool.n_blocks - 1
+            if self._dpool is not None:
+                cap = min(cap, self._dpool.n_blocks - 1)
+            if need + 1 > cap:
                 self._req_error(req.uri, req.on_error, ValueError(
                     f"prompt needs {need} private blocks + headroom "
-                    f"but the pool holds {self._pool.n_blocks - 1}"))
+                    f"but the pool holds {cap}"))
                 return "error"
             # per-chunk allocation only needs room to START (first
             # chunk block + decode headroom); monolithic admission's
             # need+1 gate would block exactly the long prompts
             # chunking exists to stream in
-            if self._pool.allocatable() < 2:
+            dry = self._pool.allocatable() < 2 or (
+                self._dpool is not None
+                and self._dpool.allocatable() < 2)
+            if dry:
                 if self.n_active == 0:
                     self._req_error(req.uri, req.on_error, RuntimeError(
                         f"pool dry with no residents: "
@@ -1331,10 +1569,17 @@ class ContinuousEngine:
                 return "blocked"
             for b in matched:
                 self._pool.acquire(b)
+            if dmatch is not None:
+                for b in dmatch:
+                    self._dpool.acquire(b)
         slot = self._free.popleft()
         self._row_blocks[slot] = list(matched)
         self._tables[slot, :] = SINK_BLOCK
         self._tables[slot, :len(matched)] = matched
+        if dmatch is not None:
+            self._drow_blocks[slot] = list(dmatch)
+            self._dtables[slot, :] = SINK_BLOCK
+            self._dtables[slot, :len(dmatch)] = dmatch
         self._install_prefill(slot, req, plen, base=0, full=full,
                               hashes=list(hashes),
                               fill=len(matched) * self._bs,
@@ -1361,6 +1606,8 @@ class ContinuousEngine:
         self._admit_seq += 1
         self._tok[slot] = self.pad_id
         self._pos[slot] = self._slots[slot].fill_pos
+        if self.draft_model is not None:
+            self._dpos[slot] = self._slots[slot].fill_pos
         self._done[slot] = True
         self.telemetry.req_admitted(req.uri, slot, prefilling=True)
 
@@ -1392,40 +1639,64 @@ class ContinuousEngine:
         bs = self._bs
         nfull = P // bs
         hashes = self._pool.block_hashes(tokens[:nfull * bs])
-        with self._pool_lock:
-            matched = self._pool.lookup(hashes)
-            for b in matched:
-                self._pool.acquire(b)
-            blocks = list(matched)
-            for _ in range(nfull - len(matched)):
-                b = self._pool.allocate()
-                if b is None:
-                    for bb in blocks:
-                        self._pool.release(bb)
-                    raise RuntimeError(
-                        f"block pool has no room to pin a {nfull}-block "
-                        f"prefix ({self._pool.num_referenced()} of "
-                        f"{self._pool.n_blocks} blocks referenced)")
-                blocks.append(b)
-        if len(matched) < nfull:
-            span = tokens[len(matched) * bs:nfull * bs]
-            sb = _next_bucket(len(span), self.prompt_buckets)
-            padded = np.full((1, sb), self.pad_id, np.int32)
-            padded[0, :len(span)] = span
-            tabs = np.full((1, self._M), SINK_BLOCK, np.int32)
-            tabs[0, :len(blocks)] = blocks
-            _, self._pk, self._pv = self._paged_admit(
-                self._pk, self._pv, jnp.asarray(padded, jnp.int32),
-                jnp.asarray([len(span)], jnp.int32),
-                jnp.asarray(tabs, jnp.int32),
-                jnp.asarray([len(matched) * bs], jnp.int32))
+
+        def pin(pool, admit, pk, pv):
+            """Pin one tenant's full prefix blocks: match, allocate the
+            rest, prefill the unmatched span through the tenant's paged
+            path, publish.  Returns (blocks, pk, pv) — the buffers come
+            back because ``admit`` donates its inputs."""
             with self._pool_lock:
-                for j in range(len(matched), nfull):
-                    self._pool.insert(hashes[j], blocks[j])
+                matched = pool.lookup(hashes)
+                for b in matched:
+                    pool.acquire(b)
+                blocks = list(matched)
+                for _ in range(nfull - len(matched)):
+                    b = pool.allocate()
+                    if b is None:
+                        for bb in blocks:
+                            pool.release(bb)
+                        raise RuntimeError(
+                            f"{pool.name} block pool has no room to pin "
+                            f"a {nfull}-block prefix "
+                            f"({pool.num_referenced()} of "
+                            f"{pool.n_blocks} blocks referenced)")
+                    blocks.append(b)
+            if len(matched) < nfull:
+                span = tokens[len(matched) * bs:nfull * bs]
+                sb = _next_bucket(len(span), self.prompt_buckets)
+                padded = np.full((1, sb), self.pad_id, np.int32)
+                padded[0, :len(span)] = span
+                tabs = np.full((1, self._M), SINK_BLOCK, np.int32)
+                tabs[0, :len(blocks)] = blocks
+                # target admit returns (logits, pk, pv); draft (pk, pv)
+                out = admit(pk, pv, jnp.asarray(padded, jnp.int32),
+                            jnp.asarray([len(span)], jnp.int32),
+                            jnp.asarray(tabs, jnp.int32),
+                            jnp.asarray([len(matched) * bs], jnp.int32))
+                pk, pv = out[-2:]
+                with self._pool_lock:
+                    for j in range(len(matched), nfull):
+                        pool.insert(hashes[j], blocks[j])
+            return blocks, pk, pv
+
+        blocks, self._pk, self._pv = pin(
+            self._pool, self._paged_admit, self._pk, self._pv)
+        dblocks: tuple = ()
+        if self._dpool is not None:
+            try:
+                dblocks, self._dpk, self._dpv = pin(
+                    self._dpool, self._draft_paged_admit,
+                    self._dpk, self._dpv)
+            except Exception:
+                # a half-pinned prefix would leak target blocks forever
+                with self._pool_lock:
+                    for b in blocks:
+                        self._pool.release(b)
+                raise
         with self._lock:
             pid = self._next_prefix_id
             self._next_prefix_id += 1
-            self._paged_prefixes[pid] = (tokens, blocks)
+            self._paged_prefixes[pid] = (tokens, blocks, dblocks)
         return pid
 
     def _admit_paged(self) -> int:
@@ -1462,16 +1733,30 @@ class ContinuousEngine:
                 with self._pool_lock:
                     matched = self._pool.lookup(
                         hashes[:(plen - 1) // self._bs])
+                    if self._dpool is not None:
+                        # both tenants must prefill the SAME suffix, so
+                        # the usable match is the shorter of the two
+                        # (identical op sequences keep the pools mirror
+                        # images; the min is a safety net, not a tax)
+                        dmatch = self._dpool.lookup(
+                            hashes[:(plen - 1) // self._bs])
+                        m = min(len(matched), len(dmatch))
+                        matched, dmatch = matched[:m], dmatch[:m]
                     need = total - len(matched)
                     # +1 headroom: the first decode tokens must not
                     # instantly preempt what admission just built
-                    if need + 1 > self._pool.n_blocks - 1:
+                    cap = self._pool.n_blocks - 1
+                    if self._dpool is not None:
+                        cap = min(cap, self._dpool.n_blocks - 1)
+                    if need + 1 > cap:
                         self._req_error(req.uri, req.on_error, ValueError(
                             f"prompt needs {need} private blocks + "
-                            f"headroom but the pool holds "
-                            f"{self._pool.n_blocks - 1}"))
+                            f"headroom but the pool holds {cap}"))
                         continue
-                    if self._pool.allocatable() < need + 1:
+                    dry = self._pool.allocatable() < need + 1 or (
+                        self._dpool is not None
+                        and self._dpool.allocatable() < need + 1)
+                    if dry:
                         if (self.n_active == 0 and not plans
                                 and admitted == 0):
                             # nothing in flight will ever free blocks:
@@ -1491,7 +1776,15 @@ class ContinuousEngine:
                     blocks = list(matched)
                     for _ in range(need):
                         blocks.append(self._pool.allocate())
-                plans.append((req, full, hashes, len(matched), blocks))
+                    dblocks = None
+                    if self._dpool is not None:
+                        for b in dmatch:
+                            self._dpool.acquire(b)
+                        dblocks = list(dmatch)
+                        for _ in range(need):
+                            dblocks.append(self._dpool.allocate())
+                plans.append((req, full, hashes, len(matched), blocks,
+                              dblocks))
             if blocked:
                 with self._lock:
                     for req in reversed(blocked):
@@ -1508,10 +1801,12 @@ class ContinuousEngine:
                     logger.exception("paged admission failed for %d "
                                      "request(s)", len(plist))
                     with self._pool_lock:
-                        for req, _, _, _, blocks in plist:
+                        for req, _, _, _, blocks, dblocks in plist:
                             for b in blocks:
                                 self._pool.release(b)
-                    for req, _, _, _, _ in plist:
+                            for b in dblocks or ():
+                                self._dpool.release(b)
+                    for req, _, _, _, _, _ in plist:
                         self._req_error(req.uri, req.on_error, e)
             if blocked:
                 break
@@ -1530,30 +1825,51 @@ class ContinuousEngine:
         lens = np.ones(kb, np.int32)
         pos = np.zeros(kb, np.int32)
         tabs = np.full((kb, self._M), SINK_BLOCK, np.int32)
-        for i, (req, full, hashes, n_match, blocks) in enumerate(plans):
+        dtabs = np.full((kb, self._M), SINK_BLOCK, np.int32)
+        for i, (req, full, hashes, n_match, blocks,
+                dblocks) in enumerate(plans):
             sfx = full[n_match * self._bs:]
             padded[i, :len(sfx)] = sfx
             lens[i] = len(sfx)
             pos[i] = n_match * self._bs
             tabs[i, :len(blocks)] = blocks
+            if dblocks is not None:
+                dtabs[i, :len(dblocks)] = dblocks
         last, self._pk, self._pv = self._paged_admit(
             self._pk, self._pv, jnp.asarray(padded, jnp.int32),
             jnp.asarray(lens, jnp.int32), jnp.asarray(tabs, jnp.int32),
             jnp.asarray(pos, jnp.int32))
+        if self._dpool is not None:
+            # the SAME suffix grid against the draft tenant (min-match
+            # keeps the two prefills byte-aligned); draft logits are
+            # discarded — only the target picks tokens
+            self._dpk, self._dpv = self._draft_paged_admit(
+                self._dpk, self._dpv, jnp.asarray(padded, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+                jnp.asarray(dtabs, jnp.int32),
+                jnp.asarray(pos, jnp.int32))
         last = np.asarray(last)     # one D2H for the whole group
         admitted = 0
-        for i, (req, full, hashes, n_match, blocks) in enumerate(plans):
+        for i, (req, full, hashes, n_match, blocks,
+                dblocks) in enumerate(plans):
             plen = len(full)
             slot = self._free.popleft()
             self._row_blocks[slot] = blocks
             self._tables[slot, :] = SINK_BLOCK
             self._tables[slot, :len(blocks)] = blocks
+            if dblocks is not None:
+                self._drow_blocks[slot] = dblocks
+                self._dtables[slot, :] = SINK_BLOCK
+                self._dtables[slot, :len(dblocks)] = dblocks
             # publish BEFORE install: the prefill succeeded, so the
             # blocks' content is valid for sharing even if this
             # particular install fails below
             with self._pool_lock:
                 for j in range(n_match, plen // self._bs):
                     self._pool.insert(hashes[j], blocks[j])
+                if dblocks is not None:
+                    for j in range(n_match, plen // self._bs):
+                        self._dpool.insert(hashes[j], dblocks[j])
             try:
                 first = self._pick_first(last[i], plen,
                                          req.temperature, req.rng_seed,
@@ -1581,26 +1897,45 @@ class ContinuousEngine:
             st = self._slots[i]
             if st is None:
                 continue
-            ticks = max(1, min(self.ticks_per_step,
-                               st.max_new - len(st.tokens)))
-            last_write = min(int(self._pos[i]) + ticks - 1, self._L - 1)
+            if self.draft_model is not None:
+                # a spec round writes k+1 verify positions pos..pos+k
+                # (both tenants — dpos == pos)
+                last_write = min(int(self._pos[i]) + self._spec_k,
+                                 self._L - 1)
+            else:
+                ticks = max(1, min(self.ticks_per_step,
+                                   st.max_new - len(st.tokens)))
+                last_write = min(int(self._pos[i]) + ticks - 1,
+                                 self._L - 1)
             self._grow_row(i, last_write // self._bs + 1)
         return [i for i in active if self._slots[i] is not None]
 
     def _grow_row(self, i: int, need: int) -> None:
-        """Grow row ``i``'s block table to ``need`` blocks, preempting
-        (latest admission, prefilling rows first) whenever the pool is
-        dry — including row ``i`` itself, which ends the loop."""
+        """Grow row ``i``'s block table(s) to ``need`` blocks,
+        preempting (latest admission, prefilling rows first) whenever a
+        pool is dry — including row ``i`` itself, which ends the loop.
+        With a draft model the two tenants grow in LOCKSTEP to the same
+        block count: either pool running dry preempts the victim from
+        BOTH (``_release_slot_blocks``), so a row's verify pointer can
+        never outrun its draft pages."""
+        self._grow_tenant(i, need, self._pool, self._row_blocks,
+                          self._tables)
+        if self._dpool is not None:
+            self._grow_tenant(i, need, self._dpool, self._drow_blocks,
+                              self._dtables)
+
+    def _grow_tenant(self, i: int, need: int, pool, row_blocks,
+                     tables) -> None:
         while (self._slots[i] is not None
-               and len(self._row_blocks[i]) < need):
+               and len(row_blocks[i]) < need):
             with self._pool_lock:
-                b = self._pool.allocate()
+                b = pool.allocate()
             if b is None:
                 self._preempt(self._pick_victim())
                 continue
-            j = len(self._row_blocks[i])
-            self._row_blocks[i].append(b)
-            self._tables[i, j] = b
+            j = len(row_blocks[i])
+            row_blocks[i].append(b)
+            tables[i, j] = b
 
     def _grow_chunk_blocks(self, decode_rows, chunks) -> None:
         """Per-tick paged growth for the fused step: decode rows need
@@ -1612,7 +1947,10 @@ class ContinuousEngine:
         for i in decode_rows:
             if self._slots[i] is None:
                 continue
-            last_write = min(int(self._pos[i]), self._L - 1)
+            # spec decode rows write k+1 verify positions (spec_k is 0
+            # without a draft, reducing to the single decode write)
+            last_write = min(int(self._pos[i]) + self._spec_k,
+                             self._L - 1)
             self._grow_row(i, last_write // self._bs + 1)
         for i, clen in chunks:
             st = self._slots[i]
@@ -1634,6 +1972,12 @@ class ContinuousEngine:
         with self._pool_lock:
             for j in range(st.n_pub, hi):
                 self._pool.insert(st.hashes[j], blocks[j])
+            if self._dpool is not None:
+                # same hashes (keys are token chains, not tenant-
+                # specific); lockstep growth keeps the lists aligned
+                dblocks = self._drow_blocks[i]
+                for j in range(st.n_pub, hi):
+                    self._dpool.insert(st.hashes[j], dblocks[j])
         st.n_pub = hi
 
     def _table_width(self, need: int) -> int:
@@ -1681,13 +2025,22 @@ class ContinuousEngine:
         """Drop a finished/preempted row's block references and point
         its whole table row at the sink, so the frozen row's future
         writes can NEVER touch a block the pool hands to someone else
-        — the paged form of the arena's recycled-slot isolation."""
+        — the paged form of the arena's recycled-slot isolation.  Both
+        tenants release together: a row never holds draft pages after
+        its target pages are gone (or vice versa)."""
         blocks = self._row_blocks[slot]
         self._row_blocks[slot] = []
         self._tables[slot, :] = SINK_BLOCK
+        dblocks = []
+        if self._dpool is not None:
+            dblocks = self._drow_blocks[slot]
+            self._drow_blocks[slot] = []
+            self._dtables[slot, :] = SINK_BLOCK
         with self._pool_lock:
             for b in blocks:
                 self._pool.release(b)
+            for b in dblocks:
+                self._dpool.release(b)
 
     def cache_metrics(self) -> dict:
         """Serving-visible cache counters (bench_serving.py columns).
@@ -1737,9 +2090,25 @@ class ContinuousEngine:
                     "prefill_stall_ticks": self._prefill_stall_ticks,
                     "prefill_preemptions": self._prefill_preemptions,
                 })
+            if self.draft_model is not None:
+                out.update({
+                    "speculation_k": self._spec_k,
+                    "spec_rounds": getattr(self, "_spec_rounds", 0),
+                    "spec_emitted": getattr(self, "_spec_emitted", 0),
+                    # cumulative draft proposals / acceptances (same
+                    # counters /metrics exports); the ratio is the
+                    # acceptance rate the bench records
+                    "spec_proposed": self.telemetry.c_spec_proposed.value,
+                    "spec_accepted": self.telemetry.c_spec_accepted.value,
+                })
         if self.paged:
             with self._pool_lock:
                 out.update(self._pool.metrics())
+                if self._dpool is not None:
+                    # draft tenant, prefixed — one snapshot shows both
+                    # pools' pressure side by side
+                    out.update({"draft_" + kk: vv for kk, vv in
+                                self._dpool.metrics().items()})
         return out
 
     @property
@@ -1894,6 +2263,9 @@ class ContinuousEngine:
         if self._pool is not None:
             with self._pool_lock:
                 samples["free_blocks"] = self._pool.allocatable()
+                if self._dpool is not None:
+                    samples["draft_free_blocks"] = \
+                        self._dpool.allocatable()
         return samples
 
     def _step_impl(self) -> int:
@@ -1902,6 +2274,17 @@ class ContinuousEngine:
         if not active:
             return 0
         if self.draft_model is not None:
+            if self.chunked and any(
+                    self._slots[i].state == "PREFILLING"
+                    for i in active):
+                return self._spec_chunked_tick(active)
+            if self.paged:
+                # grow BOTH tenants' tables to cover the round's k+1
+                # verify writes; may preempt
+                active = self._ensure_blocks(active)
+                if not active:
+                    self._admit()   # preemptions freed blocks
+                    return self.n_active
             return self._spec_tick(active)
         if self.chunked and any(self._slots[i].state == "PREFILLING"
                                 for i in active):
@@ -1984,6 +2367,8 @@ class ContinuousEngine:
             if st is not None and st.state == "PREFILLING":
                 self._done[i] = True
                 self._pos[i] = st.fill_pos
+                if self.draft_model is not None:
+                    self._dpos[i] = st.fill_pos
                 self._tok[i] = self.pad_id
 
     def _chunked_tick(self, active) -> int:
@@ -2248,6 +2633,33 @@ class ContinuousEngine:
                           jnp.zeros(kb, jnp.uint32),
                           jnp.zeros(kb, jnp.float32))
                 for width in widths:
+                    if self.draft_model is not None:
+                        # spec engines never run the fused program —
+                        # their chunk half is the two-tenant spec chunk
+                        # program (one variant per grid shape, no
+                        # with_decode/sampled axes: greedy-only, and
+                        # the decode half is the separate spec round)
+                        if self.paged:
+                            self._spec_chunk_paged(
+                                jnp.zeros_like(self._pk),
+                                jnp.zeros_like(self._pv),
+                                jnp.zeros_like(self._dpk),
+                                jnp.zeros_like(self._dpv),
+                                ctoks, cpos, clens,
+                                jnp.full((kb, width), SINK_BLOCK,
+                                         jnp.int32),
+                                jnp.full((kb, width), SINK_BLOCK,
+                                         jnp.int32))
+                        else:
+                            self._spec_chunk(
+                                jnp.zeros_like(self._ck),
+                                jnp.zeros_like(self._cv),
+                                jnp.zeros_like(self._dck),
+                                jnp.zeros_like(self._dcv),
+                                ctoks, cpos, clens, cslots,
+                                read_len=width)
+                        count += 1
+                        continue
                     for wd in (False, True):
                         if self.paged:
                             fn = self._get_fused(wd, sampled, use_topp)
@@ -2269,21 +2681,63 @@ class ContinuousEngine:
                                tok, pos, done, temps, seeds, topps,
                                ctoks, cpos, clens, cslots, *czeros)
                         count += 1
+        if self.draft_model is not None:
+            # the decode half of a spec chunk tick: one shape-stable
+            # spec-round program
+            if self.paged:
+                self._spec_step_paged(
+                    jnp.zeros_like(self._pk), jnp.zeros_like(self._pv),
+                    jnp.zeros_like(self._dpk),
+                    jnp.zeros_like(self._dpv),
+                    tok, pos, pos, done,
+                    jnp.full((S, self._M), SINK_BLOCK, jnp.int32),
+                    jnp.full((S, self._M), SINK_BLOCK, jnp.int32))
+            else:
+                self._spec_step(
+                    jnp.zeros_like(self._ck), jnp.zeros_like(self._cv),
+                    jnp.zeros_like(self._dck),
+                    jnp.zeros_like(self._dcv),
+                    tok, pos, pos, done)
+            count += 1
         return count
 
     def _spec_tick(self, active) -> int:
-        """One speculative round for the whole arena: every resident
+        """One speculative round for the whole batch: every resident
         advances by its own accepted count (1..k+1 tokens) in one device
-        call.  Emission recording mirrors the plain path: per slot, in
-        order, stopping when the slot finishes (budget surplus dropped
-        host-side)."""
-        (toks, n_emit, tok, pos, dpos, done,
-         self._ck, self._cv, self._dck, self._dcv) = self._spec_step(
-            self._ck, self._cv, self._dck, self._dcv,
-            jnp.asarray(self._tok, jnp.int32),
-            jnp.asarray(self._pos, jnp.int32),
-            jnp.asarray(self._dpos, jnp.int32),
-            jnp.asarray(self._done, jnp.bool_))
+        call.  Paged dispatch already grew both tenants' block tables
+        (``_ensure_blocks``)."""
+        self._peak_resident = max(self._peak_resident, len(active))
+        self._spec_round(active)
+        self._admit()       # freed slots recycle on the SAME iteration
+        return self.n_active
+
+    def _spec_round(self, rows) -> None:
+        """Run the spec-round program (arena or paged) and record each
+        row's emitted tokens.  Emission recording mirrors the plain
+        path: per slot, in order, stopping when the slot finishes
+        (budget surplus dropped host-side).  PREFILLING rows ride along
+        frozen (done=True -> n_emit=0); their k+1 garbage writes land at
+        or past the fill frontier, where their own chunks (and, after
+        the flip, their first verify) overwrite them before anything
+        attends that far."""
+        if self.paged:
+            (toks, n_emit, tok, pos, dpos, done, self._pk, self._pv,
+             self._dpk, self._dpv) = self._spec_step_paged(
+                self._pk, self._pv, self._dpk, self._dpv,
+                jnp.asarray(self._tok, jnp.int32),
+                jnp.asarray(self._pos, jnp.int32),
+                jnp.asarray(self._dpos, jnp.int32),
+                jnp.asarray(self._done, jnp.bool_),
+                jnp.asarray(self._tables, jnp.int32),
+                jnp.asarray(self._dtables, jnp.int32))
+        else:
+            (toks, n_emit, tok, pos, dpos, done, self._ck, self._cv,
+             self._dck, self._dcv) = self._spec_step(
+                self._ck, self._cv, self._dck, self._dcv,
+                jnp.asarray(self._tok, jnp.int32),
+                jnp.asarray(self._pos, jnp.int32),
+                jnp.asarray(self._dpos, jnp.int32),
+                jnp.asarray(self._done, jnp.bool_))
         toks = np.asarray(toks)                 # [k+1, S]
         n_emit = np.asarray(n_emit)
         self._tok = np.array(tok)
@@ -2292,14 +2746,148 @@ class ContinuousEngine:
         self._done = np.array(done)
         self._spec_rounds = getattr(self, "_spec_rounds", 0) + 1
         self._spec_emitted = getattr(self, "_spec_emitted", 0) + int(
-            n_emit[active].sum())
-        for i in active:
+            n_emit[rows].sum())
+        # acceptance accounting: every live row consumed k proposals;
+        # n_emit-1 of them matched (eos clipping only shortens usage)
+        emitting = [i for i in rows if int(n_emit[i]) > 0]
+        lens = [int(n_emit[i]) - 1 for i in emitting]
+        self.telemetry.spec_round(self._spec_k * len(emitting),
+                                  sum(lens), lens)
+        for i in rows:
             for j in range(int(n_emit[i])):
                 if self._slots[i] is None:
                     break       # finished mid-round; the rest is frozen
                 self._record_token(i, int(toks[j, i]))
+
+    def _spec_chunked_tick(self, active) -> int:
+        """Chunked tick with a draft model: ONE token budget covers
+        both work-item kinds — each DECODE row costs ``k+1`` verify
+        positions, the remainder grants prefill chunks FIFO by
+        admission order, exactly like ``_chunked_tick``.  Two device
+        calls (spec round + spec chunk program, see
+        ``_init_speculative``); with no PREFILLING rows in flight the
+        dispatcher never enters here, so steady-state decoding pays
+        the plain one-call spec tick."""
+        decode_rows = [i for i in active
+                       if self._slots[i].state == "DECODE"]
+        prefill_rows = sorted(
+            (i for i in active
+             if self._slots[i].state == "PREFILLING"),
+            key=lambda i: self._slots[i].admit_seq)
+        per_row = self._spec_k + 1
+        remaining = self.tick_token_budget - per_row * len(decode_rows)
+        chunks: List[Tuple[int, int]] = []          # (slot, chunk len)
+        for i in prefill_rows:
+            if remaining <= 0:
+                break
+            st = self._slots[i]
+            clen = min(st.plen - st.fill_pos, remaining,
+                       self._chunk_buckets[-1])
+            if clen <= 0:
+                continue
+            chunks.append((i, clen))
+            remaining -= clen
+        if prefill_rows and not chunks:
+            # budget fully consumed by verify rows: prefill waits
+            self._prefill_stall_ticks += 1
+        if self.paged:
+            self._grow_chunk_blocks(decode_rows, chunks)  # may preempt
+            decode_rows = [i for i in decode_rows
+                           if self._slots[i] is not None]
+            chunks = [(i, c) for i, c in chunks
+                      if self._slots[i] is not None]
+        if not decode_rows and not chunks:
+            self._admit()       # preemptions may have freed blocks
+            return self.n_active
+        self._peak_resident = max(self._peak_resident, len(active))
+        self._budget_ticks += 1
+        self._budget_tokens_used += per_row * len(decode_rows) \
+            + sum(c for _, c in chunks)
+        if decode_rows:
+            self._spec_round(decode_rows)
+        # a round can finish rows but never kills chunk rows (they are
+        # PREFILLING — frozen in the round); re-filter for safety
+        chunks = [(i, c) for i, c in chunks
+                  if self._slots[i] is not None]
+        if chunks:
+            self._spec_chunks(chunks)
+        self._reanchor_prefill()
         self._admit()       # freed slots recycle on the SAME iteration
         return self.n_active
+
+    def _spec_chunks(self, chunks) -> None:
+        """Land this tick's prefill chunks in BOTH models' caches (one
+        device call) and flip prompts whose last chunk landed into
+        DECODE with their first token — the spec twin of
+        ``_chunked_tick``'s chunk half, greedy-only."""
+        k = len(chunks)
+        kb = 1 << (k - 1).bit_length()
+        Cb = _next_bucket(max(c for _, c in chunks),
+                          self._chunk_buckets)
+        ctoks = np.full((kb, Cb), self.pad_id, np.int32)
+        cpos = np.zeros(kb, np.int32)
+        clens = np.ones(kb, np.int32)
+        cslots = np.full(kb, self._S, np.int32)     # pad rows: drop
+        for j, (i, clen) in enumerate(chunks):
+            st = self._slots[i]
+            off = st.fill_pos - st.base
+            ctoks[j, :clen] = st.full[off:off + clen]
+            cpos[j] = st.fill_pos
+            clens[j] = clen
+            cslots[j] = i
+        need = int((cpos + clens).max())
+        t_chunk = time.monotonic()
+        if self.paged:
+            Mb = self._table_width(-(-need // self._bs))
+            ctabs = np.full((kb, Mb), SINK_BLOCK, np.int32)
+            dctabs = np.full((kb, Mb), SINK_BLOCK, np.int32)
+            for j, (i, _) in enumerate(chunks):
+                ctabs[j] = self._tables[i, :Mb]
+                dctabs[j] = self._dtables[i, :Mb]
+            (cnxt, self._pk, self._pv, self._dpk,
+             self._dpv) = self._spec_chunk_paged(
+                self._pk, self._pv, self._dpk, self._dpv,
+                jnp.asarray(ctoks, jnp.int32),
+                jnp.asarray(cpos, jnp.int32),
+                jnp.asarray(clens, jnp.int32),
+                jnp.asarray(ctabs, jnp.int32),
+                jnp.asarray(dctabs, jnp.int32))
+        else:
+            read_len = next(b for b in self._read_buckets
+                            if b >= need)
+            (cnxt, self._ck, self._cv, self._dck,
+             self._dcv) = self._spec_chunk(
+                self._ck, self._cv, self._dck, self._dcv,
+                jnp.asarray(ctoks, jnp.int32),
+                jnp.asarray(cpos, jnp.int32),
+                jnp.asarray(clens, jnp.int32),
+                jnp.asarray(cslots, jnp.int32),
+                read_len=read_len)
+        cnxt = np.asarray(cnxt)     # one host sync for first-token picks
+        dur_chunk = time.monotonic() - t_chunk
+        for i, clen in chunks:
+            self.telemetry.events.span(
+                "prefill_chunk", t_chunk, dur_chunk, i,
+                {"uri": self._slots[i].uri, "tokens": int(clen),
+                 "fill_pos": int(self._slots[i].fill_pos)})
+        self.telemetry.c_chunks.inc(len(chunks))
+        completed: List[Tuple[int, int]] = []
+        for j, (i, clen) in enumerate(chunks):
+            st = self._slots[i]
+            st.fill_pos += clen
+            if self.paged:
+                self._publish_chunk_blocks(i, st)
+            if st.fill_pos >= st.plen:
+                completed.append((i, int(cnxt[j])))
+        for i, first in completed:
+            st = self._slots[i]
+            st.state = "DECODE"
+            st.full = st.hashes = None
+            self._tok[i] = first
+            self._pos[i] = st.plen
+            self._dpos[i] = st.plen
+            self._done[i] = False
+            self._record_token(i, first)    # the request's FIRST token
 
     def drain(self, max_ticks: int = 100_000) -> None:
         """Run ticks until every submitted request has finished (tests /

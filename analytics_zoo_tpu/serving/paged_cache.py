@@ -40,6 +40,16 @@ Block 0 is the SINK: never allocated, never indexed, permanently
 garbage.  The engine points every unallocated block-table entry at it
 so out-of-range or padding-row writes land in storage nothing ever
 attends.
+
+Two-tenant accounting: a speculative engine runs a SECOND pool for
+the draft model's K/V (its own device arena and block tables — block
+ids from one pool mean nothing in the other).  Each pool carries a
+``name`` ("target" / "draft") that labels its metrics and event
+callbacks so a scrape can tell whose blocks ran dry, and
+:func:`split_block_budget` turns one HBM byte budget into the common
+block count both tenants can afford — the split is proportional to
+per-block cost (layers x kv_heads x head_dim x dtype), which is why a
+small draft is nearly free to page alongside its target.
 """
 
 from __future__ import annotations
@@ -48,6 +58,20 @@ from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 SINK_BLOCK = 0
+
+
+def split_block_budget(budget_bytes: int,
+                       per_block_costs: Sequence[int]) -> int:
+    """The COMMON block count every tenant can hold inside one HBM
+    byte budget: tenants grow in lockstep (the engine mirrors a row's
+    draft table onto its target table positions), so the budget splits
+    proportionally to per-block cost rather than evenly — ``n`` blocks
+    for each tenant where ``n * sum(costs) <= budget``."""
+    total = sum(int(c) for c in per_block_costs)
+    if total <= 0:
+        raise ValueError(f"per-block costs must sum > 0, got "
+                         f"{per_block_costs!r}")
+    return int(budget_bytes) // total
 
 
 def chain_hashes(tokens: Sequence[int], block_size: int) -> List[int]:
@@ -90,7 +114,8 @@ class BlockPool:
 
     def __init__(self, n_blocks: int, block_size: int,
                  enable_prefix_cache: bool = True,
-                 event_cb: Optional[Callable[..., None]] = None):
+                 event_cb: Optional[Callable[..., None]] = None,
+                 name: str = "target"):
         if n_blocks < 2:
             raise ValueError(
                 f"n_blocks must be >= 2 (block 0 is the sink), got "
@@ -100,6 +125,10 @@ class BlockPool:
         self.n_blocks = int(n_blocks)
         self.block_size = int(block_size)
         self.enable_prefix_cache = bool(enable_prefix_cache)
+        # tenant label ("target" / "draft" in the speculative engine):
+        # stamped on every event callback so a timeline can tell WHOSE
+        # pool evicted or ran dry when two tenants share one telemetry
+        self.name = str(name)
         # observability hook, called as event_cb(kind, **info) for
         # "eviction" and "alloc_failure" (the two transitions the
         # cumulative counters alone cannot place on a timeline).  The
@@ -167,11 +196,11 @@ class BlockPool:
             del self._index[h]
             self.evictions += 1
             if self.event_cb is not None:
-                self.event_cb("eviction", block=blk)
+                self.event_cb("eviction", block=blk, tenant=self.name)
         else:
             self.alloc_failures += 1
             if self.event_cb is not None:
-                self.event_cb("alloc_failure")
+                self.event_cb("alloc_failure", tenant=self.name)
             return None
         self._ref[blk] = 1
         return blk
@@ -233,6 +262,7 @@ class BlockPool:
 
     def metrics(self) -> Dict[str, float]:
         return {
+            "tenant": self.name,
             "n_blocks": self.n_blocks,
             "block_size": self.block_size,
             "referenced_blocks": len(self._ref),

@@ -97,6 +97,11 @@ class ServingConfig:
     # budget = engine default (about one decode bucket of work).
     engine_chunked: bool = False
     engine_tick_token_budget: Optional[int] = None
+    # Speculative decoding depth override (proposals per round).  Only
+    # meaningful when the model was loaded with a draft
+    # (load_flax_generator(draft_model=...)); composes with paged and
+    # chunked.  None keeps the depth stored at model load.
+    engine_speculation_k: Optional[int] = None
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -152,6 +157,9 @@ class ServingConfig:
         if "engine_tick_token_budget" in params:
             cfg.engine_tick_token_budget = int(
                 params["engine_tick_token_budget"])
+        if "engine_speculation_k" in params:
+            cfg.engine_speculation_k = int(
+                params["engine_speculation_k"])
         return cfg
 
 
@@ -190,6 +198,13 @@ class ClusterServing:
         # ones (client timed out / died) are pruned after result_ttl_s so
         # broker memory stays bounded in long-lived deployments
         self._written: collections.deque = collections.deque()
+        # continuous mode: uri -> (submit_time, stream entry id) of
+        # requests still inside the engine.  A row older than the ttl
+        # has no client left to collect it — _prune_abandoned aborts it
+        # so its KV blocks (both pool tenants under speculation) free
+        # instead of finishing dead work
+        self._inflight: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
         self.stats = {"requests": 0, "batches": 0, "batch_fill": 0.0,
                       "predict_ms": 0.0}
         # job-level telemetry; continuous mode hands this same facade
@@ -330,6 +345,7 @@ class ClusterServing:
                 enable_prefix_cache=self.config.engine_prefix_cache,
                 chunked=self.config.engine_chunked,
                 tick_token_budget=self.config.engine_tick_token_budget,
+                speculation_k=self.config.engine_speculation_k,
                 telemetry=self.telemetry)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
@@ -552,6 +568,7 @@ class ClusterServing:
                 # preemptions + peak co-residency)
                 self.stats["cache"] = cache
                 self._written.append((uri, time.monotonic()))
+                self._inflight.pop(uri, None)
 
         # the continuous pump must prune too (the micro-batch path
         # prunes per publish): time-gated so the idle poll loop isn't
@@ -610,12 +627,15 @@ class ClusterServing:
                                      _r=ureq: publish(u, toks, _eid,
                                                       _t0, _r)),
                             on_error=(lambda u, exc, _eid=eid, _r=ureq:
-                                      (self._publish_error(
+                                      (self._drop_inflight(u),
+                                       self._publish_error(
                                           _r, f"admission failed: "
                                               f"{exc!r}"),
                                        self._finish_entries(client,
                                                             [_eid]))),
                             **kw)
+                        with self._stats_lock:
+                            self._inflight[uri] = (time.monotonic(), eid)
                     except Exception as e:
                         self._publish_error(r, f"submit failed: {e!r}")
                         self._finish_entries(client, [eid])
@@ -856,8 +876,28 @@ class ClusterServing:
         worker through the shared client's lock.  Each pruned result is
         counted (``zoo_serving_requests_abandoned_total``) and leaves a
         terminal ``request_abandoned`` event in the trace — a client
-        that timed out and walked away used to vanish without a sign."""
+        that timed out and walked away used to vanish without a sign.
+
+        Continuous mode also prunes IN-FLIGHT rows here: a request
+        resident (or queued) in the engine longer than the ttl has no
+        collector left, so it is aborted — the engine frees its slot
+        and every KV block it holds, target AND draft pools alike for
+        a speculative row — and its stream entry is acked so the group
+        never redelivers dead work."""
         ttl = self.config.result_ttl_s
+        engine = getattr(self, "engine", None)
+        if engine is not None:
+            with self._stats_lock:
+                stale = [(u, te) for u, te in self._inflight.items()
+                         if now - te[0] > ttl]
+                for u, _ in stale:
+                    del self._inflight[u]
+            for u, (t_sub, eid) in stale:
+                # False = the row completed in the race window; its
+                # publish already handled the entry
+                if engine.abort(u):
+                    self.telemetry.req_abandoned(u, now - t_sub)
+                    self._finish_entries(client, [eid])
         while True:
             with self._stats_lock:
                 if not self._written or \
@@ -868,6 +908,10 @@ class ClusterServing:
                 ("DEL", RESULT_PREFIX + uri, SIGNAL_PREFIX + uri),
                 ("SREM", "__result_keys__", uri)])
             self.telemetry.req_abandoned(uri, now - written_at)
+
+    def _drop_inflight(self, uri: str) -> None:
+        with self._stats_lock:
+            self._inflight.pop(uri, None)
 
     # ---- observability (SURVEY §5: queue depth = backlog metric) ------
 

@@ -470,6 +470,13 @@ class Telemetry:
             p + "retraces_total",
             "retraces counted by TraceGuard regions wired to this "
             "telemetry")
+        self.c_spec_proposed = m.counter(
+            p + "spec_proposed_total",
+            "draft tokens proposed to speculative verify rounds")
+        self.c_spec_accepted = m.counter(
+            p + "spec_accepted_total",
+            "proposed draft tokens the target verify accepted "
+            "(acceptance rate = accepted / proposed)")
         self.h_ttft = m.histogram(
             p + "ttft_seconds",
             "arrival -> first token (queueing + prefill)",
@@ -483,6 +490,10 @@ class Telemetry:
             window=window)
         self.h_tick = m.histogram(
             p + "tick_seconds", "engine step wall time",
+            window=window)
+        self.h_spec_accept = m.histogram(
+            p + "spec_accept_len",
+            "accepted draft tokens per row per verify round (0..k)",
             window=window)
 
     # -- request lifecycle (engine state transitions) ----------------
@@ -607,6 +618,21 @@ class Telemetry:
                          samples or None)
         if samples:
             self.events.counter_sample("engine", samples, start)
+
+    def spec_round(self, proposed: int, accepted: int,
+                   accept_lens) -> None:
+        """One speculative verify round across the live rows: counter
+        food for the acceptance rate (accepted/proposed, both
+        cumulative), the per-row acceptance-length histogram, and an
+        instant on the engine track so a Perfetto timeline shows how
+        acceptance moves with the workload."""
+        self.c_spec_proposed.inc(proposed)
+        self.c_spec_accepted.inc(accepted)
+        for n in accept_lens:
+            self.h_spec_accept.record(float(n))
+        self.events.instant("spec_round", None, EventLog.TID_ENGINE,
+                            {"proposed": proposed,
+                             "accepted": accepted})
 
     def jit_build(self, program: str, key: Any) -> None:
         """A jitted-program cache MISS (new (program, shape) variant):

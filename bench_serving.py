@@ -684,6 +684,153 @@ def run_capacity_scenario(slots: int = 4) -> dict:
     }
 
 
+def run_spec_scenario(chunked: bool = False, slots: int = 2) -> dict:
+    """Speculative decoding over the paged pool (and, for the second
+    row, under the chunked scheduler) at EQUAL TOTAL KV HBM: the
+    baseline engine gets the speculative engine's two tenants'
+    combined block budget (off: n_blocks = 2N, no draft; on: N target
+    + N draft), so the row answers "given these cache bytes, does
+    spending half of them on a draft tenant buy decode throughput?".
+    The draft is the TARGET MODEL ITSELF (the ``lm-spec`` batch row's
+    precedent): greedy self-drafting accepts every proposal, so the
+    acceptance rate — and the tokens/s uplift — is the k+1 UPPER
+    BOUND; real drafts sit between this row and the plain one, at a
+    FRACTION of the draft-tenant bytes (``split_block_budget`` charges
+    per-block cost, and ``models/distill.py`` trains exactly that
+    draft).  Self-draft also makes equal-HBM exact: both tenants'
+    per-block bytes are identical, so halving the block budget halves
+    the bytes.
+
+    The workload is the LOW-BATCH decode-bound traffic speculation
+    exists for: ``slots`` (few!) short-prompt streams held in flight,
+    each decoding ``max_new`` greedy tokens, so wall time is decode
+    rounds (prefill is a rounding error) and the column is decode
+    tokens/s.  Few streams is the point, not a simplification: a spec
+    round is ONE fused device call (k+1 draft feeds + one decode_k
+    verify) emitting up to k+1 tokens per row, vs one call per token
+    plain — but plain decode already amortises its dispatch across
+    every co-resident row, so at high batch the batch dimension buys
+    what speculation would have.  Speculation monetises when the
+    device is under-fed per call — exactly the latency-bound
+    few-streams regime accelerator decode lives in (dispatch + weight
+    streaming, not FLOPs; measured here: the uplift at ``slots=2``
+    inverts by ``slots=6`` on this host).  The self-draft also pays
+    the FULL target forward per proposal — a real 5-10x-smaller draft
+    widens every number here.
+
+    The measured passes run under ``trace_guard`` — a steady-state
+    retrace would bill compile time to one side and invalidate the
+    ratio."""
+    import jax
+
+    from analytics_zoo_tpu.lint import RetraceError, trace_guard
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import ContinuousEngine
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=128)
+    variables = model.init(jax.random.key(0), np.zeros((1, 32), np.int32))
+    rng = np.random.default_rng(29)
+    prompts = [rng.integers(1, 8192, int(rng.integers(8, 29))).astype(
+        np.int32) for _ in range(24)]
+    n_requests = 24 * slots
+    max_new, k, bs = 32, 4, 8
+    # spec verify writes through pos + k, so the speculative engine's
+    # rows are ceil((32 + 32 + k+1)/8) = 9 blocks vs the baseline's 8;
+    # the BUDGETS are what equal-HBM fixes: N blocks per tenant for
+    # the speculative engine, 2N for the baseline
+    N = slots * 12
+
+    def drive(eng, tag):
+        done: list = []
+        issued = 0
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            while issued < n_requests and issued - len(done) < slots:
+                eng.submit(f"{tag}-r{issued}",
+                           prompts[issued % len(prompts)],
+                           on_done=lambda u, t: done.append(u))
+                issued += 1
+            eng.step()
+            if len(done) == n_requests and eng.n_active == 0:
+                return time.perf_counter() - t0
+        raise RuntimeError(f"spec bench stalled: {tag}")
+
+    def run(spec):
+        # prefix cache off on BOTH sides: these prompts repeat across
+        # the warm and measured passes, and a block-index hit would
+        # skip prefill work asymmetrically between runs — the claim
+        # here is about decode rounds, not sharing
+        kw = dict(max_new_tokens=max_new, max_slots=slots,
+                  prompt_buckets=(32,), paged=True, block_size=bs,
+                  enable_prefix_cache=False)
+        if spec:
+            kw.update(draft_model=model, draft_variables=variables,
+                      speculation_k=k, n_blocks=N, draft_n_blocks=N)
+        else:
+            kw.update(n_blocks=2 * N)
+        if chunked:
+            # one smallest-bucket chunk plus every decode row's
+            # worst-case tick cost (a speculative row bills k+1 verify
+            # positions against the budget) fits each fused tick
+            kw.update(chunked=True,
+                      tick_token_budget=32 + slots * (k + 1))
+        eng = ContinuousEngine(model, variables, **kw)
+        if chunked:
+            eng.precompile_chunked()
+        drive(eng, "warm")
+        # best-of-3 measured passes: each pass is only ~1 s of wall, so
+        # a host scheduler hiccup on the shared CPU box can swing one
+        # pass more than the effect under measurement; min-wall is the
+        # standard de-noiser and both sides get the same treatment
+        walls: list = []
+        for attempt in range(6):
+            try:
+                with trace_guard(eng, name="spec-bench"):
+                    walls.append(drive(eng, f"run{attempt}"))
+                if len(walls) == 3:
+                    break
+            except RetraceError:
+                eng.drain()             # finish the aborted pass
+        if not walls:
+            raise RuntimeError("spec bench shapes did not converge")
+        wall = min(walls)
+        m = eng.cache_metrics()
+        col = {"decode_tok_per_sec":
+               round(n_requests * max_new / wall, 1),
+               "req_per_sec": round(n_requests / wall, 1)}
+        if spec:
+            col["accept_rate"] = round(
+                m["spec_accepted"] / max(1, m["spec_proposed"]), 3)
+            col["spec_rounds"] = m["spec_rounds"]
+        rep = eng.capacity_report()
+        return col, rep["arena_bytes"] + rep.get("draft_arena_bytes", 0)
+
+    off, bytes_off = run(False)
+    on, bytes_on = run(True)
+    assert bytes_off == bytes_on, (bytes_off, bytes_on)
+    return {
+        "model": "lm-spec-ck-pg" if chunked else "lm-spec-pg",
+        "mode": "spec-vs-plain" + ("-chunked" if chunked else ""),
+        "slots": slots,
+        "speculation_k": k,
+        "kv_bytes": int(bytes_off),
+        "off": off,
+        "on": on,
+        "tok_per_sec_ratio": round(
+            on["decode_tok_per_sec"] / off["decode_tok_per_sec"], 2),
+        "note": ("equal TOTAL KV HBM (the baseline gets both tenants' "
+                 "blocks); few streams by design — speculation's "
+                 "regime is latency-bound low-batch decode (at high "
+                 "batch the batch dimension already amortises "
+                 "dispatch); self-draft => acceptance ~1.0, the k+1 "
+                 "upper bound, AND full target compute per proposal — "
+                 "a distilled 5-10x-smaller draft widens the ratio at "
+                 "a fraction of the draft-tenant bytes"),
+    }
+
+
 # scenario plan, most-informative-first (the claims a judge needs —
 # int8-mxu head-to-head, continuous-vs-convoy, generative load — land
 # even if a tunnel wedge cuts the run short); (kind, clients, rpc, bs)
@@ -708,6 +855,11 @@ PLAN = [("resnet18", 64, 10, 64),
         # chunked-prefill scheduler off-vs-on at equal HBM (>= 2x lower
         # p99 inter-token latency claim); clients = engine slots
         ("lm-chunked", 6, 0, 8),
+        # speculative decoding over the paged pool, plain and chunked,
+        # at equal TOTAL KV HBM (self-draft upper bound; acceptance
+        # rate column); clients = engine slots — FEW by design,
+        # speculation's regime is latency-bound low-batch decode
+        ("lm-spec-pg", 2, 0, 8), ("lm-spec-ck-pg", 2, 0, 8),
         ("lm", 16, 10, 32), ("lm-spec", 16, 10, 32),
         ("lm", 64, 5, 32), ("lm", 1, 20, 32),
         ("mlp", 256, 50, 128), ("mlp", 64, 50, 128),
@@ -865,6 +1017,10 @@ def _one():
         r = run_capacity_scenario(slots=clients)
     elif kind == "lm-chunked":
         r = run_chunked_scenario(slots=clients)
+    elif kind == "lm-spec-pg":
+        r = run_spec_scenario(chunked=False, slots=clients)
+    elif kind == "lm-spec-ck-pg":
+        r = run_spec_scenario(chunked=True, slots=clients)
     elif kind == "lm-poisson-pg":
         r = run_poisson_scenario(True, rate_per_s=clients,
                                  n_requests=rpc, slots=bs, paged=True)
@@ -886,11 +1042,14 @@ def _one():
 
 
 def _smoke_scrape():
-    """serve-smoke observability leg: a live paged+chunked continuous
-    stack behind ``HttpFrontend``, real wire-protocol traffic, then
-    assert the export surfaces — ``GET /healthz``, ``GET /metrics``
-    (Prometheus text carrying the engine's TTFT quantiles, queue/pool
-    gauges, and the serving job's counters), the legacy
+    """serve-smoke observability leg: a live SPECULATIVE paged+chunked
+    continuous stack behind ``HttpFrontend`` (all three engine modes
+    composed — the draft rides the Python API, ``engine_speculation_k``
+    rides config, exercising the YAML override path), real
+    wire-protocol traffic, then assert the export surfaces —
+    ``GET /healthz``, ``GET /metrics`` (Prometheus text carrying the
+    engine's TTFT quantiles, queue/pool/draft-pool gauges, spec
+    counters, and the serving job's counters), the legacy
     ``?format=json`` dict, and a ``GET /trace`` body that passes the
     Chrome trace-event schema check."""
     import urllib.request
@@ -909,11 +1068,12 @@ def _smoke_scrape():
     variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
     im = InferenceModel(batch_buckets=(1, 4))
     im.load_flax_generator(model, variables, max_new_tokens=8,
-                           prompt_buckets=(16,))
+                           prompt_buckets=(16,),
+                           draft_model=model, draft_variables=variables)
     cfg = ServingConfig(prompt_col="tokens", batch_size=4,
                         continuous_batching=True, engine_slots=4,
                         engine_paged=True, engine_block_size=8,
-                        engine_chunked=True)
+                        engine_chunked=True, engine_speculation_k=2)
     serving = ClusterServing(im, cfg, embedded_broker=True).start()
     frontend = HttpFrontend(redis_host=serving.config.redis_host,
                             redis_port=serving.port, http_port=0,
@@ -946,6 +1106,10 @@ def _smoke_scrape():
                        "zoo_engine_free_blocks",
                        "zoo_engine_prefix_hit_rate",
                        "zoo_engine_requests_finished_total 6",
+                       "zoo_engine_spec_proposed_total",
+                       "zoo_engine_spec_accepted_total",
+                       "zoo_engine_spec_accept_len",
+                       "zoo_engine_draft_free_blocks",
                        "zoo_serving_requests_total",
                        "zoo_http_request_seconds_count"):
             assert needle in text, f"{needle!r} missing from /metrics"
@@ -955,7 +1119,8 @@ def _smoke_scrape():
         trace = json.loads(body)
         validate_chrome_trace(trace)
         names = {e.get("name") for e in trace["traceEvents"]}
-        assert {"queue_wait", "first_token", "request"} <= names, names
+        assert {"queue_wait", "first_token", "request",
+                "spec_round"} <= names, names
     finally:
         inq.close()
         outq.close()

@@ -196,12 +196,28 @@ def test_budget_below_block_size_rejected(lm):
                          tick_token_budget=8)
 
 
-def test_chunked_draft_not_implemented(lm):
+@pytest.mark.slow       # parity compiles; tests/test_spec_composed.py
+# carries the tier-1 composed-mode contracts
+def test_chunked_draft_composes(lm):
+    """chunked+draft is no longer refused: a self-draft chunked engine
+    (acceptance rate 1.0 by construction) emits exactly the plain
+    chunked engine's greedy tokens."""
     model, variables = lm
-    with pytest.raises(NotImplementedError):
-        ContinuousEngine(model, variables, max_new_tokens=4,
-                         chunked=True, draft_model=model,
-                         draft_variables=variables)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(1, 32, n).astype(np.int32)
+               for n in LENGTHS]
+    want, _ = _run(lm, prompts,
+                   engine_kw=dict(chunked=True, tick_token_budget=16))
+    got, eng = _run(lm, prompts,
+                    engine_kw=dict(chunked=True, tick_token_budget=16,
+                                   draft_model=model,
+                                   draft_variables=variables,
+                                   speculation_k=2))
+    for k in want:
+        assert np.array_equal(got[k], want[k]), k
+    m = eng.cache_metrics()
+    assert m["spec_proposed"] > 0
+    assert m["spec_accepted"] > 0
 
 
 def test_scheduler_metrics_keys(lm):

@@ -656,7 +656,7 @@ def test_spec_engine_validation(lm):
         bv = bad.init(jax.random.key(2), np.zeros((1, 8), np.int32))
         ContinuousEngine(model, variables, max_new_tokens=4,
                          draft_model=bad, draft_variables=bv)
-    with pytest.raises(NotImplementedError, match="single-chip"):
+    with pytest.raises(ValueError, match="single-chip"):
         from analytics_zoo_tpu.parallel.mesh import make_mesh
 
         ContinuousEngine(model, variables, max_new_tokens=4,

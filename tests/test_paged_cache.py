@@ -366,11 +366,14 @@ def test_paged_validation_and_cache_dtype_errors(lm):
                          paged=True, block_size=4, n_blocks=3)
     draft = _tiny_lm(num_layers=1)
     dvars = draft.init(jax.random.key(1), np.zeros((1, 8), np.int32))
-    with pytest.raises(NotImplementedError, match="paged"):
+    with pytest.raises(ValueError, match="draft_n_blocks"):
+        # paged+draft now composes, but the draft tenant still needs a
+        # table-width's worth of blocks plus the sink
         ContinuousEngine(model, variables, max_new_tokens=4, paged=True,
-                         draft_model=draft, draft_variables=dvars)
+                         block_size=4, draft_model=draft,
+                         draft_variables=dvars, draft_n_blocks=2)
     mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("dp",))
-    with pytest.raises(NotImplementedError, match="paged"):
+    with pytest.raises(ValueError, match="paged"):
         ContinuousEngine(model, variables, max_new_tokens=4, paged=True,
                          mesh=mesh)
 

@@ -44,6 +44,14 @@ MODES = {
     "arena-chunked": dict(chunked=True, tick_token_budget=8),
     "paged-chunked": dict(paged=True, block_size=4, chunked=True,
                           tick_token_budget=8),
+    # speculative composed modes (_spec resolves to a real small draft
+    # in the test): acceptance varies per round — the spec step /
+    # spec-chunk programs must absorb that variety with zero compiles
+    "spec-paged": dict(paged=True, block_size=4, _spec=True),
+    "spec-chunked": dict(chunked=True, tick_token_budget=12,
+                         _spec=True),
+    "spec-paged-chunked": dict(paged=True, block_size=4, chunked=True,
+                               tick_token_budget=12, _spec=True),
 }
 
 
@@ -60,10 +68,30 @@ def _round(eng, rng, tag, lengths=LENGTHS):
     return results
 
 
-@pytest.mark.parametrize("mode", list(MODES))
-def test_decode_steady_state_zero_retraces(lm, mode):
+@pytest.fixture(scope="module")
+def draft_lm():
+    model = TransformerLM(vocab_size=32, hidden_size=16, num_layers=1,
+                          num_heads=2, intermediate_size=32,
+                          max_position=64, dtype=jnp.float32)
+    variables = model.init(jax.random.key(9),
+                           np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+@pytest.mark.parametrize("mode", [
+    # the three-way composition rides the slow lane: spec-paged and
+    # spec-chunked pin the two new program families individually, and
+    # `make test` / serve-smoke still sweep the full product
+    pytest.param(m, marks=pytest.mark.slow)
+    if m == "spec-paged-chunked" else m
+    for m in MODES])
+def test_decode_steady_state_zero_retraces(lm, draft_lm, mode):
     model, variables = lm
-    kw = MODES[mode]
+    kw = dict(MODES[mode])
+    if kw.pop("_spec", False):
+        dm, dvv = draft_lm
+        kw.update(draft_model=dm, draft_variables=dvv,
+                  speculation_k=2)
     lengths = (4, 12, 7, 5) if "chunked" in mode else LENGTHS
     eng = ContinuousEngine(model, variables, max_new_tokens=5,
                            max_slots=3, prompt_buckets=(8, 16), **kw)

@@ -1,0 +1,283 @@
+"""Composed-mode speculative serving (serving/continuous.py): the
+draft model now rides paged KV blocks and chunked ticks.  Contracts
+pinned here:
+
+- solo-equality: every supported {paged, chunked} combination under
+  speculation emits bitwise what ``models.lm.generate`` produces, for
+  a low-acceptance independent draft AND the full-acceptance self
+  draft, with recycling pressure (more requests than slots);
+- two-tenant memory safety: a dry DRAFT pool mid-flight preempts to
+  queue (never corrupts the verify pointer — the preempted request
+  still finishes with correct tokens), abort() and prefix
+  unregistration return BOTH pools to their idle reference counts,
+  and ``BlockPool.check()`` holds throughout;
+- observability: acceptance counters flow to cache_metrics() and the
+  Prometheus rendering.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.lm import TransformerLM, generate
+from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+from analytics_zoo_tpu.serving.telemetry import render_prometheus
+
+
+def _tiny_lm(**kw):
+    cfg = dict(vocab_size=32, hidden_size=32, num_layers=2, num_heads=2,
+               intermediate_size=64, max_position=64, dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = _tiny_lm()
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def draft():
+    model = _tiny_lm(hidden_size=16, num_layers=1, intermediate_size=32)
+    variables = model.init(jax.random.key(9),
+                           np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+MODES = {
+    "paged": dict(paged=True, block_size=4),
+    "chunked": dict(chunked=True, tick_token_budget=16),
+    "paged-chunked": dict(paged=True, block_size=4, chunked=True,
+                          tick_token_budget=16),
+}
+
+
+def _run_spec(lm, dm, dvv, prompts, extra):
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                           max_slots=3, prompt_buckets=(8, 16),
+                           draft_model=dm, draft_variables=dvv,
+                           speculation_k=2, **extra)
+    results = {}
+    for uri, p in prompts.items():
+        eng.submit(uri, p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    return results, eng
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity vs solo generation, every composed mode
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow       # ~75s of compiles across the 6 variants; the
+# tier-1 budget keeps only the cheap contracts (abort, metrics) and
+# leaves the compile-heavy sweeps to `make test` / `make serve-smoke`
+# (which run this file unfiltered)
+@pytest.mark.parametrize("mode", sorted(MODES))
+@pytest.mark.parametrize("self_draft", [False, True])
+def test_spec_composed_matches_solo_generation(lm, draft, mode,
+                                               self_draft):
+    model, variables = lm
+    dm, dvv = (model, variables) if self_draft else draft
+    rng = np.random.default_rng(0)
+    prompts = {f"r{i}": rng.integers(1, 32, rng.integers(2, 15)).astype(
+        np.int32) for i in range(7)}
+    results, eng = _run_spec(lm, dm, dvv, prompts, MODES[mode])
+    assert set(results) == set(prompts)
+    for uri, p in prompts.items():
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(p[None]), 5))[0]
+        np.testing.assert_array_equal(results[uri], solo, err_msg=uri)
+    if eng.paged:
+        with eng._pool_lock:
+            eng._pool.check()
+            eng._dpool.check()
+            assert eng._pool.num_referenced() == 0
+            assert eng._dpool.num_referenced() == 0
+    m = eng.cache_metrics()
+    assert m["spec_proposed"] > 0
+    if self_draft:
+        # full acceptance: every proposal lands
+        assert m["spec_accepted"] == m["spec_proposed"]
+
+
+@pytest.mark.slow
+def test_spec_composed_eos_matches_generate(lm, draft):
+    """EOS mid-round through the paged write path: frozen eos tail,
+    early slot free and recycling stay identical to generate."""
+    model, variables = lm
+    dm, dvv = draft
+    rng = np.random.default_rng(1)
+    prompts = {f"e{i}": rng.integers(1, 32, 4).astype(np.int32)
+               for i in range(4)}
+    first_tok = int(np.asarray(generate(
+        model, variables,
+        jnp.asarray(prompts["e0"][None]), 1))[0, 0])
+    eng = ContinuousEngine(model, variables, max_new_tokens=6,
+                           max_slots=2, prompt_buckets=(8,),
+                           eos_id=first_tok, paged=True, block_size=4,
+                           draft_model=dm, draft_variables=dvv,
+                           speculation_k=2)
+    results = {}
+    for uri, p in prompts.items():
+        eng.submit(uri, p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    for uri, p in prompts.items():
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(p[None]), 6,
+                                   eos_id=first_tok))[0]
+        np.testing.assert_array_equal(results[uri], solo, err_msg=uri)
+
+
+# ---------------------------------------------------------------------------
+# two-tenant memory pressure
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_draft_pool_exhaustion_preempts_cleanly(lm, draft):
+    """A draft pool sized for barely one full-length row: concurrent
+    rows dry it MID-FLIGHT, the loser preempts to queue, and every
+    request still completes with solo-equal tokens — the verify
+    pointer survives preemption/resume intact."""
+    model, variables = lm
+    dm, dvv = draft
+    # L = 16 + 5 + k + 1 = 24 -> M = 6 logical blocks; dnb = M + 2
+    # holds ONE row plus a single spare, so two growing rows collide
+    rng = np.random.default_rng(7)
+    prompts = {f"x{i}": rng.integers(1, 32, rng.integers(10, 15)).astype(
+        np.int32) for i in range(5)}
+    eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                           max_slots=3, prompt_buckets=(8, 16),
+                           draft_model=dm, draft_variables=dvv,
+                           speculation_k=2, paged=True, block_size=4,
+                           n_blocks=64, draft_n_blocks=8,
+                           enable_prefix_cache=False)
+    results = {}
+    for uri, p in prompts.items():
+        eng.submit(uri, p,
+                   on_done=lambda u, t: results.__setitem__(u, t))
+    eng.drain()
+    assert set(results) == set(prompts)
+    for uri, p in prompts.items():
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(p[None]), 5))[0]
+        np.testing.assert_array_equal(results[uri], solo, err_msg=uri)
+    # the squeeze actually happened, through the DRAFT tenant
+    assert eng._preemptions > 0
+    assert eng._dpool.alloc_failures > 0
+    with eng._pool_lock:
+        eng._pool.check()
+        eng._dpool.check()
+        assert eng._pool.num_referenced() == 0
+        assert eng._dpool.num_referenced() == 0
+
+
+def test_abort_frees_both_pools(lm, draft):
+    """abort() on resident and queued speculative rows returns BOTH
+    tenants to their idle reference counts (the serving loop's
+    abandoned-request pruning relies on this)."""
+    model, variables = lm
+    dm, dvv = draft
+    rng = np.random.default_rng(11)
+    eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                           max_slots=2, prompt_buckets=(8, 16),
+                           draft_model=dm, draft_variables=dvv,
+                           speculation_k=2, paged=True, block_size=4)
+    done = {}
+    for i in range(4):          # 2 resident + 2 queued
+        eng.submit(f"a{i}", rng.integers(1, 32, 12).astype(np.int32),
+                   on_done=lambda u, t: done.__setitem__(u, t))
+    eng.step()                  # admit (and possibly a first round)
+    assert eng.n_active > 0
+    with eng._pool_lock:
+        assert eng._pool.num_referenced() > 0
+        assert eng._dpool.num_referenced() > 0
+    finished = set(done)        # completed before we could abort
+    aborted = {f"a{i}" for i in range(4)} - finished
+    for u in aborted:
+        assert eng.abort(u) is True
+    assert eng.n_active == 0 and eng.n_waiting == 0
+    with eng._pool_lock:
+        eng._pool.check()
+        eng._dpool.check()
+        assert eng._pool.num_referenced() == 0
+        assert eng._dpool.num_referenced() == 0
+    for u in aborted:
+        assert eng.abort(u) is False    # idempotent on gone rows
+        assert u not in done            # no callback for aborted rows
+
+
+@pytest.mark.slow
+def test_spec_paged_prefix_pins_and_frees_draft_blocks(lm, draft):
+    """register_prefix on a speculative paged engine pins full prefix
+    blocks in BOTH pools; requests share them; unregister_prefix
+    returns both pools to idle."""
+    model, variables = lm
+    dm, dvv = draft
+    rng = np.random.default_rng(13)
+    sys_p = rng.integers(1, 32, 8).astype(np.int32)
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8, 16),
+                           draft_model=dm, draft_variables=dvv,
+                           speculation_k=2, paged=True, block_size=4)
+    pid = eng.register_prefix(sys_p)
+    with eng._pool_lock:
+        pinned_t = eng._pool.num_referenced()
+        pinned_d = eng._dpool.num_referenced()
+    assert pinned_t == len(sys_p) // 4
+    assert pinned_d == len(sys_p) // 4
+    results = {}
+    for i in range(3):
+        eng.submit(f"p{i}", rng.integers(1, 32, 5).astype(np.int32),
+                   on_done=lambda u, t: results.__setitem__(u, t),
+                   prefix=pid)
+    eng.drain()
+    assert len(results) == 3
+    with eng._pool_lock:
+        assert eng._pool.num_referenced() == pinned_t
+        assert eng._dpool.num_referenced() == pinned_d
+    eng.unregister_prefix(pid)
+    with eng._pool_lock:
+        eng._pool.check()
+        eng._dpool.check()
+        assert eng._pool.num_referenced() == 0
+        assert eng._dpool.num_referenced() == 0
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_spec_metrics_surface(lm):
+    """Acceptance counters reach cache_metrics, the draft pool's
+    tenant-prefixed keys reach the same snapshot, and the always-on
+    registry renders them for /metrics."""
+    model, variables = lm
+    rng = np.random.default_rng(17)
+    prompts = {f"m{i}": rng.integers(1, 32, 6).astype(np.int32)
+               for i in range(3)}
+    results, eng = _run_spec(
+        lm, model, variables, prompts,
+        dict(paged=True, block_size=4))
+    m = eng.cache_metrics()
+    assert m["speculation_k"] == 2
+    assert m["spec_rounds"] > 0
+    assert 0 < m["spec_accepted"] <= m["spec_proposed"]
+    assert m["draft_tenant"] == "draft" and m["tenant"] == "target"
+    assert m["draft_n_blocks"] == m["n_blocks"]
+    text = render_prometheus(eng.telemetry.metrics)
+    for needle in ("zoo_engine_spec_proposed_total",
+                   "zoo_engine_spec_accepted_total",
+                   "zoo_engine_spec_accept_len",
+                   "zoo_engine_draft_free_blocks",
+                   "zoo_engine_draft_pool_occupancy"):
+        assert needle in text, needle
+    # the trace carries per-round instant events
+    assert any(name == "spec_round" for _, name, *_ in
+               eng.telemetry.events.snapshot())
