@@ -43,15 +43,18 @@ bench:
 	$(PY) bench.py
 
 # serving smoke: the paged KV-cache + chunked-prefill + composed-mode
-# (speculative over blocks/chunks) + telemetry test files + a
-# 20-request e2e wire-protocol bench leg (which drives the chunked
-# scheduler end to end, then runs a SPECULATIVE paged+chunked stack
+# (speculative over blocks/chunks) + telemetry + QoS front-door test
+# files + a 20-request e2e wire-protocol bench leg (which drives the
+# chunked scheduler end to end, runs a SPECULATIVE paged+chunked stack
 # and scrapes /metrics + /healthz and schema-checks the dumped trace
-# live), all forced onto host CPU (fast; fits the tier-1 timeout)
+# live, then the front-door leg: SSE streaming e2e, a mid-stream
+# client disconnect with both KV pools reclaimed, and a 429 +
+# Retry-After off a saturated admission queue), all forced onto host
+# CPU (fast; fits the tier-1 timeout)
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py \
 	    tests/test_chunked_prefill.py tests/test_telemetry.py \
-	    -q -m "not slow"
+	    tests/test_frontdoor.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_composed.py -q
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
 

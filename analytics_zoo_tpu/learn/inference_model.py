@@ -338,7 +338,7 @@ class InferenceModel:
                                tick_token_budget: Optional[int] = None,
                                speculation_k: Optional[int] = None,
                                record_timings: bool = False,
-                               telemetry=None):
+                               telemetry=None, qos=None):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
         loaded via ``load_flax_generator`` (quantized weights dequantize
         once at build — the engine trades the at-rest memory win for
@@ -363,7 +363,12 @@ class InferenceModel:
         builds a SPECULATIVE engine; it composes with ``paged`` and
         ``chunked`` freely (docs/serving_memory.md 'Composed modes').
         ``speculation_k`` overrides the per-round proposal depth stored
-        at load (``None`` keeps it); it is rejected without a draft."""
+        at load (``None`` keeps it); it is rejected without a draft.
+
+        ``qos`` (a ``serving.frontdoor.QosPolicy``) turns admission and
+        prefill-grant order into a weighted fair share over (priority
+        class, tenant) — the serving front door's scheduler
+        (docs/serving_qos.md).  ``None`` keeps plain FIFO."""
         from analytics_zoo_tpu.serving.continuous import ContinuousEngine
 
         if getattr(self, "_gen_max_new_tokens", None) is None:
@@ -399,7 +404,8 @@ class InferenceModel:
             hbm_fraction=hbm_fraction,
             enable_prefix_cache=enable_prefix_cache,
             chunked=chunked, tick_token_budget=tick_token_budget,
-            record_timings=record_timings, telemetry=telemetry, **spec)
+            record_timings=record_timings, telemetry=telemetry,
+            qos=qos, **spec)
 
     def load_openvino(self, xml_path: str, bin_path: str = None,
                       quantize: Optional[str] = None) -> "InferenceModel":

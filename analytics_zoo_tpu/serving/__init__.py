@@ -1,6 +1,11 @@
 from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
+                                                 TokenEmitter,
+                                                 WeightedWaitQueue,
+                                                 retry_after_s)
 from analytics_zoo_tpu.serving.paged_cache import BlockPool
-from analytics_zoo_tpu.serving.queues import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.queues import (BacklogFull, InputQueue,
+                                              OutputQueue)
 from analytics_zoo_tpu.serving.resp import RespClient, RespServer
 from analytics_zoo_tpu.serving.server import ClusterServing, ServingConfig
 from analytics_zoo_tpu.serving.http_frontend import HttpFrontend
@@ -12,4 +17,6 @@ __all__ = ["ContinuousEngine", "BlockPool", "InputQueue", "OutputQueue",
            "RespClient", "RespServer", "ClusterServing", "ServingConfig",
            "HttpFrontend", "MetricsRegistry", "Telemetry",
            "WindowHistogram", "render_prometheus",
-           "validate_chrome_trace"]
+           "validate_chrome_trace",
+           "BacklogFull", "PRIORITIES", "QosPolicy", "TokenEmitter",
+           "WeightedWaitQueue", "retry_after_s"]

@@ -46,6 +46,8 @@ from analytics_zoo_tpu.learn.inference_model import (
 from analytics_zoo_tpu.models.lm import (TransformerLM,
                                          top_p_filter)
 from analytics_zoo_tpu.models.speculative import accept_proposals
+from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
+                                                 WeightedWaitQueue)
 from analytics_zoo_tpu.serving.paged_cache import (BlockPool,
                                                    SINK_BLOCK,
                                                    split_block_budget)
@@ -68,6 +70,12 @@ class _Req(NamedTuple):
     max_new: int
     prefix: Optional[int]
     top_p: float
+    # front-door fields (serving/frontdoor.py) — appended with defaults
+    # so positional construction at older arity keeps working
+    on_token: Optional[Callable] = None
+    priority: str = "standard"
+    tenant: str = ""
+    enq_t: float = 0.0
 
 
 @dataclass
@@ -81,6 +89,11 @@ class _Slot:
     temperature: float = 0.0
     rng_seed: Optional[int] = None
     top_p: float = 0.0
+    # streaming: fires per generated token from the pump thread
+    # (``on_token(uri, token, index)``) — the index survives preemption
+    # dedup because a readmitted row regenerates tokens
+    # deterministically at the same positions
+    on_token: Optional[Callable] = None
     # paged mode: the original request (requeued verbatim on
     # preemption) and an admission sequence number (the preemption
     # victim is always the LATEST admission — earliest admissions keep
@@ -163,7 +176,8 @@ class ContinuousEngine:
                  chunked: bool = False,
                  tick_token_budget: Optional[int] = None,
                  record_timings: bool = False,
-                 telemetry: Optional[Telemetry] = None):
+                 telemetry: Optional[Telemetry] = None,
+                 qos: Optional[QosPolicy] = None):
         """``mesh`` (with a ``tp`` axis) serves a model LARGER than one
         chip's HBM: weights shard per ``partition_rules`` (default
         ``LM_PARTITION_RULES`` — Megatron layout), the KV arena shards
@@ -475,7 +489,13 @@ class ContinuousEngine:
         self._slots: List[Optional[_Slot]] = [None] * S
         self._free = collections.deque(range(S))
         self._lock = threading.Lock()
-        self._waiting: collections.deque = collections.deque()
+        # QoS off (default): a plain FIFO deque — bit-identical
+        # admission and grant order to the pre-front-door engine.  QoS
+        # on: a weighted stride scheduler with the same deque surface,
+        # so every admission/requeue call site below is mode-blind.
+        self._qos = qos
+        self._waiting = (WeightedWaitQueue(qos) if qos is not None
+                         else collections.deque())
         self._step_count = 0
 
         Lmax = L
@@ -1230,7 +1250,10 @@ class ContinuousEngine:
                rng_seed: Optional[int] = None,
                max_new: Optional[int] = None,
                prefix: Optional[int] = None,
-               top_p: float = 0.0) -> None:
+               top_p: float = 0.0,
+               on_token: Optional[Callable] = None,
+               priority: str = "standard",
+               tenant: str = "") -> None:
         """Queue one request.  ``prompt``: 1-D int32 token array.
         ``on_done(uri, tokens)`` fires from the pump thread when the
         request finishes (tokens: ``[max_new]`` int32, eos-padded frozen
@@ -1240,7 +1263,14 @@ class ContinuousEngine:
         request's tokens — slot-level budgets are a capability the
         whole-batch path structurally lacks (its one scan runs every
         row to the same length).  Raises on bounds violations — the
-        serving layer error-publishes per request before calling this."""
+        serving layer error-publishes per request before calling this.
+
+        Front-door fields (serving/frontdoor.py): ``on_token(uri,
+        token, index)`` streams every generated token from the pump
+        thread (the index dedups re-emissions after preemption);
+        ``priority`` / ``tenant`` feed the QoS scheduler when the
+        engine was built with a ``qos`` policy (recorded but inert
+        otherwise)."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be 1-D, got {prompt.shape}")
@@ -1280,13 +1310,17 @@ class ContinuousEngine:
         if not 1 <= mn <= self.max_new_tokens:
             raise ValueError(
                 f"max_new {mn} outside [1, {self.max_new_tokens}]")
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
         # stamp AFTER validation: a rejected submit never existed as
         # far as queue-wait/TTFT accounting is concerned
         self.telemetry.req_enqueued(uri)
         with self._lock:
             self._waiting.append(_Req(
                 uri, prompt, on_done, on_error, float(temperature),
-                rng_seed, mn, prefix, float(top_p)))
+                rng_seed, mn, prefix, float(top_p), on_token,
+                priority, str(tenant), time.monotonic()))
 
     # ---- pump ---------------------------------------------------------
 
@@ -1444,7 +1478,9 @@ class ContinuousEngine:
                 self._install_slot(real[i], req.uri, plen, req.max_new,
                                    req.on_done, req.on_error,
                                    req.temperature, req.rng_seed,
-                                   first, req.top_p)
+                                   first, req.top_p,
+                                   on_token=req.on_token,
+                                   priority=req.priority)
                 admitted += 1
             except Exception as e:
                 self._free.append(real[i])
@@ -1599,6 +1635,7 @@ class ContinuousEngine:
             on_done=req.on_done, on_error=req.on_error,
             temperature=req.temperature, rng_seed=req.rng_seed,
             top_p=req.top_p, req=req, admit_seq=self._admit_seq,
+            on_token=req.on_token,
             state="PREFILLING",
             fill_pos=base if fill is None else fill,
             base=base, full=np.asarray(full, np.int32),
@@ -1609,7 +1646,8 @@ class ContinuousEngine:
         if self.draft_model is not None:
             self._dpos[slot] = self._slots[slot].fill_pos
         self._done[slot] = True
-        self.telemetry.req_admitted(req.uri, slot, prefilling=True)
+        self.telemetry.req_admitted(req.uri, slot, prefilling=True,
+                                    priority=req.priority)
 
     # ---- paged mode (block-pool cache) --------------------------------
 
@@ -1877,7 +1915,9 @@ class ContinuousEngine:
                 self._install_slot(slot, req.uri, plen, req.max_new,
                                    req.on_done, req.on_error,
                                    req.temperature, req.rng_seed,
-                                   first, req.top_p, req=req)
+                                   first, req.top_p, req=req,
+                                   on_token=req.on_token,
+                                   priority=req.priority)
                 admitted += 1
             except Exception as e:
                 self._free.append(slot)
@@ -2070,7 +2110,12 @@ class ContinuousEngine:
                 "mode": "paged" if self.paged else "arena",
                 "preemptions": self._preemptions,
                 "peak_resident": self._peak_resident,
+                "qos": self._qos is not None,
             }
+            if self._qos is not None:
+                out["qos_waiting"] = {
+                    f"{cls}/{tenant}": d for (cls, tenant), d in
+                    self._waiting.depths().items()}
             if self.chunked:
                 denom = self._budget_ticks * self.tick_token_budget
                 out.update({
@@ -2135,20 +2180,22 @@ class ContinuousEngine:
         return self.telemetry.pop_request_stamps()
 
     def _install_slot(self, slot, uri, plen, mn, on_done, on_error,
-                      temp, seed, first, top_p=0.0, req=None):
+                      temp, seed, first, top_p=0.0, req=None,
+                      on_token=None, priority=None):
         """Shared slot-state installation for every admission path —
         plain bucket splice and prefix admission must never drift."""
         self._slots[slot] = _Slot(
             uri=uri, plen=plen, max_new=mn, on_done=on_done,
             on_error=on_error, temperature=temp, rng_seed=seed,
-            top_p=top_p, req=req, admit_seq=self._admit_seq)
+            top_p=top_p, req=req, admit_seq=self._admit_seq,
+            on_token=on_token)
         self._admit_seq += 1
         self._tok[slot] = first
         self._pos[slot] = plen
         if self.draft_model is not None:
             self._dpos[slot] = plen
         self._done[slot] = False
-        self.telemetry.req_admitted(uri, slot)
+        self.telemetry.req_admitted(uri, slot, priority=priority)
         self._record_token(slot, int(first))
 
     def _splice_one(self, pre, i: int, req) -> None:
@@ -2175,7 +2222,8 @@ class ContinuousEngine:
             self._free.append(slot)
             raise
         self._install_slot(slot, uri, plen, mn, on_done, on_error,
-                           temp, seed, first, tp)
+                           temp, seed, first, tp,
+                           on_token=req.on_token, priority=req.priority)
 
     def _pick_first(self, last_logits, plen: int, temp: float,
                     seed, top_p: float = 0.0) -> int:
@@ -2202,6 +2250,14 @@ class ContinuousEngine:
         st = self._slots[slot]
         st.tokens.append(token)
         self.telemetry.req_token(st.uri, slot)
+        if st.on_token is not None:
+            # host-side emission hook (streaming): two list appends in
+            # the serving emitter — no Redis I/O, no device sync here
+            try:
+                st.on_token(st.uri, token, len(st.tokens) - 1)
+            except Exception:
+                logger.exception("continuous-batching on_token callback "
+                                 "failed for %r", st.uri)
         done = len(st.tokens) >= st.max_new or \
             (self.eos_id is not None and token == self.eos_id)
         if not done:
@@ -2371,19 +2427,37 @@ class ContinuousEngine:
                     self._dpos[i] = st.fill_pos
                 self._tok[i] = self.pad_id
 
+    def _grant_rank(self, slot: int):
+        """Prefill-grant sort key for the chunked ticks.  QoS off: the
+        admission sequence number — bit-identical FIFO to the
+        pre-front-door engine (the parity guarantee).  QoS on: aged
+        priority class first, FIFO within a class, so an interactive
+        prompt's chunks land ahead of a batch prompt admitted earlier
+        while aging still bounds how long batch can be outranked."""
+        st = self._slots[slot]
+        if self._qos is None:
+            return st.admit_seq
+        req = st.req
+        if req is None:
+            return (self._qos.class_rank("standard", 0.0), st.admit_seq)
+        waited = time.monotonic() - req.enq_t
+        return (self._qos.class_rank(req.priority, waited),
+                st.admit_seq)
+
     def _chunked_tick(self, active) -> int:
         """One budget-bounded fused iteration (the tentpole): every
         DECODE row advances one token AND up to ``tick_token_budget -
         n_decode`` tokens of PREFILLING prompts land, in ONE device
-        call.  Chunks are granted FIFO by admission order; a prompt's
-        final chunk also picks its first token inside the same program
-        (no extra admission forward, no decode stall)."""
+        call.  Chunks are granted FIFO by admission order (aged
+        priority class first under a QoS policy — ``_grant_rank``); a
+        prompt's final chunk also picks its first token inside the same
+        program (no extra admission forward, no decode stall)."""
         decode_rows = [i for i in active
                        if self._slots[i].state == "DECODE"]
         prefill_rows = sorted(
             (i for i in active
              if self._slots[i].state == "PREFILLING"),
-            key=lambda i: self._slots[i].admit_seq)
+            key=self._grant_rank)
         remaining = self.tick_token_budget - len(decode_rows)
         chunks: List[Tuple[int, int]] = []          # (slot, chunk len)
         for i in prefill_rows:
@@ -2773,7 +2847,7 @@ class ContinuousEngine:
         prefill_rows = sorted(
             (i for i in active
              if self._slots[i].state == "PREFILLING"),
-            key=lambda i: self._slots[i].admit_seq)
+            key=self._grant_rank)
         per_row = self._spec_k + 1
         remaining = self.tick_token_budget - per_row * len(decode_rows)
         chunks: List[Tuple[int, int]] = []          # (slot, chunk len)

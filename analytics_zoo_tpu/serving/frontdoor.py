@@ -1,0 +1,342 @@
+"""QoS front door for the serving stack: the policy + plumbing pieces
+that turn the engine's primitives (``abort``, per-token emissions, the
+token-budget scheduler) into a production-shaped ingress.
+
+Four pillars live here (docs/serving_qos.md):
+
+* **Priority classes + per-tenant fair share** — ``QosPolicy`` names
+  the three classes and their weights; ``WeightedWaitQueue`` is a
+  drop-in replacement for the engine's plain waiting ``deque`` that
+  pops in weighted stride-scheduling order over (priority class,
+  tenant) subqueues, with aging promoting starved batch work.
+* **Per-token streaming** — ``TokenEmitter`` is the bounded per-request
+  emission queue between the engine's pump-thread ``on_token`` hook and
+  the wire: the pump drains it once per ``step()`` and publishes every
+  buffered token in ONE Redis pipeline (never a per-token round trip,
+  never a device sync).
+* **Backpressure** — ``retry_after_s`` / ``ThroughputEstimator`` turn
+  queue depth + recent completion throughput into the finite
+  ``Retry-After`` a 429 must carry.
+* **Wire codecs** — the input queue transports ndarrays only (a str
+  field is a client bug it rejects loudly), so the control fields the
+  front door adds travel encoded: ``priority`` as an int32 index into
+  ``PRIORITIES``, ``tenant`` as a uint8 byte array
+  (``encode_str_field``/``decode_str_field``), ``stream`` as an int32
+  flag.  ``sse_event`` formats the HTTP frontend's
+  ``text/event-stream`` chunks.
+
+This module is imported by ``continuous.py`` (scheduler swap-in), so it
+must stay dependency-light: stdlib + numpy only, no jax, no imports
+from the rest of the serving package.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: Priority classes, best-first.  The wire encodes a priority as its
+#: index in this tuple (the input queue transports ints, not strings);
+#: aging promotes a waiting request one index at a time toward 0.
+PRIORITIES: Tuple[str, ...] = ("interactive", "standard", "batch")
+
+DEFAULT_WEIGHTS: Dict[str, float] = {
+    "interactive": 8.0, "standard": 4.0, "batch": 1.0}
+
+
+@dataclass(frozen=True)
+class QosPolicy:
+    """Admission policy knobs: per-class weights and the aging bound.
+
+    ``weights`` are stride-scheduling shares — a class with weight 8
+    gets ~8x the admission slots of weight 1 under contention, it does
+    NOT strictly preempt it.  ``aging_s`` is the starvation bound: a
+    request that has waited ``aging_s`` is treated as one class better
+    (both for its subqueue's stride and for prefill-grant ordering),
+    two intervals promotes two classes, so batch work can wait at most
+    ``2 * aging_s`` before it competes as interactive.  ``aging_s <= 0``
+    disables promotion (weights alone still prevent total starvation:
+    a never-popped subqueue's virtual pass stands still while every
+    other queue's advances, so it eventually holds the minimum)."""
+
+    weights: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_WEIGHTS))
+    aging_s: float = 30.0
+
+    def __post_init__(self):
+        for cls in PRIORITIES:
+            w = self.weights.get(cls, DEFAULT_WEIGHTS[cls])
+            if w <= 0:
+                raise ValueError(f"qos weight for {cls!r} must be > 0, "
+                                 f"got {w}")
+            self.weights.setdefault(cls, DEFAULT_WEIGHTS[cls])
+
+    def class_rank(self, priority: str, waited_s: float) -> int:
+        """Aged class index (0 best).  Unknown priorities rank as
+        ``standard`` rather than raising — the pump must never die on a
+        stale wire value."""
+        try:
+            idx = PRIORITIES.index(priority)
+        except ValueError:
+            idx = PRIORITIES.index("standard")
+        if self.aging_s > 0 and waited_s > 0:
+            idx -= int(waited_s // self.aging_s)
+        return max(0, idx)
+
+    def effective_weight(self, priority: str, waited_s: float) -> float:
+        return self.weights[PRIORITIES[self.class_rank(priority,
+                                                       waited_s)]]
+
+
+class WeightedWaitQueue:
+    """Weighted deficit/stride scheduler over (priority class, tenant)
+    FIFO subqueues, exposing the exact ``collections.deque`` surface
+    the engine uses for ``self._waiting`` (``append`` / ``appendleft``
+    / ``popleft`` / ``remove`` / iteration / ``len``) so QoS admission
+    is a constructor-time swap, not a call-site rewrite.
+
+    Entries are the engine's ``_Req`` tuples; the scheduler reads only
+    their ``priority`` / ``tenant`` / ``enq_t`` attributes (absent
+    attributes degrade to standard/shared/now).  Each subqueue carries
+    a virtual ``pass``; ``popleft`` serves the minimum-pass nonempty
+    subqueue and advances its pass by ``1 / effective_weight`` — equal
+    passes per unit work means admission slots divide proportionally to
+    weight across classes and EQUALLY across tenants inside a class
+    (each (class, tenant) pair is its own subqueue at the class
+    weight).  Aging shrinks a promoted subqueue's stride, so a starved
+    batch tenant catches up instead of merely not falling further
+    behind.
+
+    ``appendleft`` is the engine's requeue path (preemption, blocked
+    admission): the entry returns to the FRONT of its own subqueue and
+    the pop's stride charge is refunded, so bouncing off a full pool
+    costs a tenant nothing.  All call sites run under the engine lock —
+    no internal locking.
+    """
+
+    def __init__(self, policy: QosPolicy):
+        self.policy = policy
+        self._queues: "collections.OrderedDict[Tuple[str, str], collections.deque]" = \
+            collections.OrderedDict()
+        self._pass: Dict[Tuple[str, str], float] = {}
+        self._clock = 0.0
+        self._charges: Dict[int, Tuple[Tuple[str, str], float]] = {}
+        self._n = 0
+
+    @staticmethod
+    def _key(req) -> Tuple[str, str]:
+        return (getattr(req, "priority", "standard"),
+                getattr(req, "tenant", ""))
+
+    def _subqueue(self, req) -> collections.deque:
+        key = self._key(req)
+        q = self._queues.get(key)
+        if q is None:
+            q = self._queues[key] = collections.deque()
+        if not q:
+            # (re)arming an idle subqueue: clamp its pass to the global
+            # virtual clock, or a long-idle tenant would bank credit
+            # and burst past everyone on return
+            self._pass[key] = max(self._pass.get(key, 0.0), self._clock)
+        return q
+
+    def append(self, req) -> None:
+        self._subqueue(req).append(req)
+        self._n += 1
+
+    def appendleft(self, req) -> None:
+        self._subqueue(req).appendleft(req)
+        self._n += 1
+        ent = self._charges.pop(id(req), None)
+        if ent is not None:
+            key, prior_pass = ent
+            if key == self._key(req):
+                self._pass[key] = prior_pass    # requeue is cost-neutral
+
+    def popleft(self):
+        if self._n == 0:
+            raise IndexError("pop from an empty WeightedWaitQueue")
+        now = time.monotonic()
+        best_key = None
+        best_rank: Optional[Tuple[float, float]] = None
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            pv = self._pass[key]
+            rank = (pv, getattr(q[0], "enq_t", now))
+            if best_rank is None or rank < best_rank:
+                best_key, best_rank = key, rank
+        q = self._queues[best_key]
+        req = q.popleft()
+        self._n -= 1
+        pv = self._pass[best_key]
+        self._clock = max(self._clock, pv)
+        waited = now - getattr(req, "enq_t", now)
+        self._pass[best_key] = pv + 1.0 / self.policy.effective_weight(
+            best_key[0], waited)
+        if len(self._charges) > 4096:   # requeues long consumed
+            self._charges.clear()
+        self._charges[id(req)] = (best_key, pv)
+        return req
+
+    def remove(self, req) -> None:
+        key = self._key(req)
+        q = self._queues.get(key)
+        if q is None:
+            raise ValueError("WeightedWaitQueue.remove(x): x not in queue")
+        q.remove(req)       # raises ValueError like deque when absent
+        self._n -= 1
+
+    def __iter__(self):
+        for q in self._queues.values():
+            yield from q
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def depths(self) -> Dict[Tuple[str, str], int]:
+        """Per-(class, tenant) backlog snapshot (telemetry food)."""
+        return {k: len(q) for k, q in self._queues.items() if q}
+
+
+class TokenEmitter:
+    """Bounded per-request emission buffer between the engine's
+    ``on_token`` hook and the wire.
+
+    ``emit`` runs inside ``engine.step()`` on the pump thread and does
+    two list appends — no Redis I/O, no locks, no device syncs, so the
+    hot decode loop's cost profile is unchanged.  After each ``step()``
+    the pump calls ``drain()`` and publishes everything in one
+    pipeline.  Terminal markers (``finish``/``error``/``cancelled``)
+    ride the same per-request buffer, so a request's final tokens are
+    always published BEFORE its done marker even though ``on_done``
+    fires mid-step.
+
+    The per-request bound is the engine's ``max_new`` ceiling plus the
+    terminal marker — the buffer structurally cannot outgrow it between
+    drains; ``max_events`` is a belt-and-suspenders cap (oldest events
+    drop, which a bound this size never triggers in practice)."""
+
+    def __init__(self, max_events: int = 8192):
+        self.max_events = int(max_events)
+        self._buf: "collections.OrderedDict[str, collections.deque]" = \
+            collections.OrderedDict()
+        self.dropped = 0
+
+    def _events(self, uri: str) -> collections.deque:
+        q = self._buf.get(uri)
+        if q is None:
+            q = self._buf[uri] = collections.deque()
+        return q
+
+    def emit(self, uri: str, token: int, index: int) -> None:
+        """Engine ``on_token`` hook (pump thread, mid-step)."""
+        q = self._events(uri)
+        if len(q) >= self.max_events:
+            q.popleft()
+            self.dropped += 1
+        q.append(("tok", index, token))
+
+    def finish(self, uri: str) -> None:
+        self._events(uri).append(("done", 0, 0))
+
+    def error(self, uri: str, message: str) -> None:
+        self._events(uri).append(("error", 0, message))
+
+    def cancelled(self, uri: str) -> None:
+        self._events(uri).append(("cancelled", 0, 0))
+
+    def discard(self, uri: str) -> None:
+        self._buf.pop(uri, None)
+
+    def drain(self) -> List[Tuple[str, List[tuple]]]:
+        """Take everything buffered since the last drain, in emission
+        order per request."""
+        if not self._buf:
+            return []
+        out = [(uri, list(q)) for uri, q in self._buf.items() if q]
+        self._buf.clear()
+        return out
+
+
+class ThroughputEstimator:
+    """EWMA completions/sec from a cumulative finished counter —
+    ``Retry-After`` needs a recent-throughput denominator, and sampling
+    the counter the engine already increments costs nothing.  Returns
+    ``fallback_rate`` until two observations exist (a cold or idle
+    server must still send a FINITE Retry-After)."""
+
+    def __init__(self, fallback_rate: float = 4.0, alpha: float = 0.3):
+        self.fallback_rate = float(fallback_rate)
+        self.alpha = float(alpha)
+        self._last: Optional[Tuple[float, float]] = None
+        self._rate = 0.0
+
+    def observe(self, total_finished: float,
+                now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        if self._last is not None:
+            dt = now - self._last[1]
+            if dt > 0:
+                inst = max(0.0, total_finished - self._last[0]) / dt
+                self._rate = (inst if self._rate == 0.0 else
+                              self.alpha * inst +
+                              (1 - self.alpha) * self._rate)
+        self._last = (float(total_finished), now)
+
+    def rate(self) -> float:
+        return self._rate if self._rate > 0 else self.fallback_rate
+
+
+def retry_after_s(depth: int, rate: float, lo: float = 1.0,
+                  hi: float = 120.0) -> int:
+    """Seconds a 429'd client should wait: queue depth over recent
+    completion throughput, clamped to ``[lo, hi]`` so the header is
+    always finite and never tells a client to hammer back instantly."""
+    if rate <= 0:
+        return int(hi)
+    return int(min(hi, max(lo, float(depth) / rate)))
+
+
+# ---- wire codecs ------------------------------------------------------
+
+def encode_str_field(s: str) -> np.ndarray:
+    """A string control field as the uint8 byte array the input queue
+    transports (it rejects str/bytes fields by design)."""
+    return np.frombuffer(s.encode("utf-8"), np.uint8).copy()
+
+
+def decode_str_field(a) -> str:
+    return bytes(np.asarray(a, np.uint8).reshape(-1).tolist()) \
+        .decode("utf-8", "replace")
+
+
+def encode_priority(priority: str) -> np.ndarray:
+    try:
+        return np.int32(PRIORITIES.index(priority))
+    except ValueError:
+        raise ValueError(
+            f"priority must be one of {PRIORITIES}, got {priority!r}")
+
+
+def decode_priority(v) -> str:
+    idx = int(np.asarray(v).reshape(-1)[0])
+    if not 0 <= idx < len(PRIORITIES):
+        return "standard"
+    return PRIORITIES[idx]
+
+
+def sse_event(event: str, data: dict) -> bytes:
+    """One ``text/event-stream`` frame (docs/serving_qos.md wire
+    format)."""
+    return (f"event: {event}\ndata: "
+            f"{json.dumps(data, separators=(',', ':'))}\n\n"
+            ).encode("utf-8")

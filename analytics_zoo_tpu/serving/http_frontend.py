@@ -13,13 +13,23 @@ exposed as JSON gauges with p50/p90/p99.
 Routes:
   POST /predict   {"instances": [{col: <nested list | {"b64","shape",
                   "dtype"}>, ...}, ...]} -> {"predictions": [...]}
+  POST /v1/generate  generative front door (docs/serving_qos.md):
+                  {"text" | <prompt_col>, "stream", "priority",
+                  "tenant", "max_new", "temperature", "seed", "top_p",
+                  "prefix"}.  ``stream: true`` answers
+                  ``text/event-stream`` (SSE token/done/cancelled/
+                  error events); otherwise one JSON body.  A full
+                  admission queue answers 429 + ``Retry-After``.
+  POST /v1/cancel {"uri": ...} — live-cancel an in-flight request
+                  (frees its KV blocks ahead of the TTL prune)
   GET  /metrics   Prometheus text exposition merging the frontend's
                   HTTP latency, the serving job's counters, and the
                   engine's TTFT/TPOT/queue/pool metrics
                   (``?format=json`` keeps the legacy JSON dict)
   GET  /trace     Chrome trace-event JSON of the engine's event ring
                   (load at https://ui.perfetto.dev)
-  GET  /healthz   200 once the loop thread is alive
+  GET  /healthz   readiness JSON: admission-queue depth vs. cap,
+                  accepting/backpressure state, engine mode flags
 """
 
 from __future__ import annotations
@@ -36,8 +46,12 @@ from typing import Optional
 import numpy as np
 
 from analytics_zoo_tpu.common.log import logger
+from analytics_zoo_tpu.serving.frontdoor import (ThroughputEstimator,
+                                                 encode_priority,
+                                                 encode_str_field,
+                                                 retry_after_s, sse_event)
 from analytics_zoo_tpu.serving.queues import (
-    ImageBytes, InputQueue, OutputQueue)
+    BacklogFull, ImageBytes, InputQueue, OutputQueue)
 from analytics_zoo_tpu.serving.telemetry import (
     MetricsRegistry, WindowHistogram, render_prometheus)
 
@@ -94,10 +108,19 @@ class HttpFrontend:
                  certfile: Optional[str] = None,
                  keyfile: Optional[str] = None,
                  serving=None, tokenizer=None,
-                 prompt_col: Optional[str] = None):
+                 prompt_col: Optional[str] = None,
+                 max_backlog: Optional[int] = None):
         self.redis_host, self.redis_port = redis_host, redis_port
         self.timeout = timeout
         self.serving = serving          # optional ClusterServing for stats
+        # bounded admission (backpressure): the pooled InputQueues
+        # reject past this backlog with BacklogFull -> 429.  None
+        # inherits the serving config's cap when attached.
+        if max_backlog is None:
+            max_backlog = (getattr(serving.config, "max_backlog", 10000)
+                           if serving is not None else 10000)
+        self.max_backlog = int(max_backlog)
+        self._throughput = ThroughputEstimator()
         # text-in / text-out generative serving: a ``tokenizers``
         # Tokenizer instance or a tokenizer.json path.  Instances with a
         # "text" field encode into the prompt column; their results
@@ -121,6 +144,13 @@ class HttpFrontend:
         self.latency = _Percentiles(hist=self.registry.histogram(
             "zoo_http_request_seconds",
             "end-to-end POST /predict wall time (failures included)"))
+        self.c_rejected = self.registry.counter(
+            "zoo_http_backpressure_rejections_total",
+            "requests answered 429 under a full admission queue")
+        self.c_disconnects = self.registry.counter(
+            "zoo_http_stream_disconnects_total",
+            "SSE clients that disconnected mid-stream (each triggers "
+            "a live cancel)")
         if serving is not None:
             self.registry.gauge(
                 "zoo_http_backlog",
@@ -145,10 +175,21 @@ class HttpFrontend:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_429(self, depth, message):
+                ra = frontend._retry_after(depth)
+                body = json.dumps({"error": message,
+                                   "retry_after_s": ra}).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", str(ra))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 if path == "/healthz":
-                    self._send(200, {"status": "ok"})
+                    self._send(200, frontend.health())
                 elif path == "/metrics":
                     if "format=json" in query:
                         self._send(200, frontend.metrics())
@@ -174,6 +215,12 @@ class HttpFrontend:
                     self._send(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if self.path == "/v1/generate":
+                    self._do_generate()
+                    return
+                if self.path == "/v1/cancel":
+                    self._do_cancel()
+                    return
                 if self.path != "/predict":
                     self._send(404, {"error": f"no route {self.path}"})
                     return
@@ -230,6 +277,10 @@ class HttpFrontend:
                                    {"error": f"{type(e).__name__}: {e}"})
                         return
                     preds = frontend._predict(decoded, text_rows)
+                except BacklogFull as e:
+                    frontend._count_rejection()
+                    self._send_429(e.depth, str(e))
+                    return
                 except TimeoutError as e:
                     self._send(504, {"error": str(e)})
                     return
@@ -239,6 +290,145 @@ class HttpFrontend:
                 finally:
                     frontend.latency.record(time.perf_counter() - t0)
                 self._send(200, {"predictions": preds})
+
+            def _do_cancel(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    uri = req.get("uri")
+                    if not uri or not isinstance(uri, str):
+                        raise ValueError("body needs a string 'uri'")
+                except (json.JSONDecodeError, ValueError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                pair = frontend._acquire()
+                try:
+                    pair[0].cancel(uri)
+                except Exception as e:
+                    pair[0].close()
+                    pair[1].close()
+                    self._send(502, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                frontend._release(pair)
+                self._send(200, {"uri": uri, "status": "cancelling"})
+
+            def _do_generate(self):
+                t0 = time.perf_counter()
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(req, dict):
+                        raise ValueError("body must be a JSON object")
+                    fields, stream = frontend._generate_fields(req)
+                except (json.JSONDecodeError, KeyError, ValueError,
+                        TypeError, AttributeError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                pair = frontend._acquire()
+                inq, outq = pair
+                uri = str(uuid.uuid4())
+                try:
+                    try:
+                        inq.enqueue(uri, **fields)
+                    except BacklogFull as e:
+                        # the rejecting XADD/XDEL completed cleanly —
+                        # the pair is protocol-safe to pool again
+                        frontend._count_rejection()
+                        self._send_429(e.depth, str(e))
+                        frontend._release(pair)
+                        return
+                    if not stream:
+                        r = outq.query(uri, timeout=frontend.timeout)
+                        if r is None:
+                            raise TimeoutError(
+                                f"result for {uri} not ready within "
+                                f"{frontend.timeout}s")
+                        frontend._release(pair)
+                        self._send(200, frontend._generate_result(
+                            uri, np.asarray(r)))
+                        return
+                except TimeoutError as e:
+                    pair[0].close()
+                    pair[1].close()
+                    self._send(504, {"error": str(e), "uri": uri})
+                    return
+                except Exception as e:
+                    pair[0].close()
+                    pair[1].close()
+                    self._send(502, {"error": f"{type(e).__name__}: {e}",
+                                     "uri": uri})
+                    return
+                finally:
+                    frontend.latency.record(time.perf_counter() - t0)
+                self._stream_sse(pair, uri)
+
+            def _stream_sse(self, pair, uri):
+                """Tail the request's token stream onto the socket as
+                SSE.  A failed write means the client hung up: cancel
+                the request so its KV blocks free NOW, not at the TTL
+                prune."""
+                inq, outq = pair
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+                clean = False
+                try:
+                    self.wfile.write(sse_event("start", {"uri": uri}))
+                    self.wfile.flush()
+                    for ev in outq.stream_events(
+                            uri, timeout=frontend.timeout):
+                        if "ping" in ev:
+                            # heartbeat: touches the socket so a dead
+                            # client surfaces between tokens
+                            self.wfile.write(b": ping\n\n")
+                        elif "token" in ev:
+                            self.wfile.write(sse_event(
+                                "token", {"index": ev["index"],
+                                          "token": ev["token"]}))
+                        elif "done" in ev:
+                            self.wfile.write(sse_event(
+                                "done", {"uri": uri}))
+                            clean = True
+                        elif "cancelled" in ev:
+                            self.wfile.write(sse_event(
+                                "cancelled", {"uri": uri}))
+                            clean = True
+                        else:
+                            self.wfile.write(sse_event(
+                                "error", {"uri": uri,
+                                          "error": ev.get("error", "")}))
+                            clean = True
+                        self.wfile.flush()
+                        if clean:
+                            break
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    frontend._count_disconnect(uri)
+                    try:
+                        inq.cancel(uri)
+                    except Exception:
+                        logger.exception(
+                            "disconnect cancel failed for %r", uri)
+                except TimeoutError as e:
+                    try:
+                        self.wfile.write(sse_event(
+                            "error", {"uri": uri, "error": str(e)}))
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                    clean = True
+                if clean:
+                    frontend._release(pair)
+                else:
+                    # abandoned mid-generator: the RESP read state is
+                    # clean (each execute completed) but the token
+                    # stream wasn't consumed — don't pool a pair whose
+                    # tok: key may still receive events
+                    pair[0].close()
+                    pair[1].close()
 
         self._server = ThreadingHTTPServer(("0.0.0.0", http_port), Handler)
         if certfile:
@@ -259,7 +449,8 @@ class HttpFrontend:
         with self._pool_lock:
             if self._pool:
                 return self._pool.pop()
-        return (InputQueue(self.redis_host, self.redis_port),
+        return (InputQueue(self.redis_host, self.redis_port,
+                           max_backlog=self.max_backlog),
                 OutputQueue(self.redis_host, self.redis_port))
 
     def _release(self, pair):
@@ -312,6 +503,131 @@ class HttpFrontend:
         else:
             self._release(pair)
             return preds
+
+    # ---- front door (docs/serving_qos.md) -----------------------------
+
+    def _generate_fields(self, req: dict):
+        """/v1/generate JSON body -> input-queue fields.  Raises
+        ``ValueError`` on anything payload-shaped (mapped to 400)."""
+        body = dict(req)
+        stream = bool(body.pop("stream", False))
+        prompt = None
+        if "text" in body:
+            if self.tokenizer is None:
+                raise ValueError("'text' needs the frontend started "
+                                 "with tokenizer=...")
+            if self.prompt_col in body:
+                raise ValueError(
+                    f"body carries BOTH 'text' and "
+                    f"{self.prompt_col!r}: ambiguous prompt — send one")
+            ids = np.asarray(
+                self.tokenizer.encode(str(body.pop("text"))).ids,
+                np.int32)
+            if ids.size == 0:
+                raise ValueError("text tokenized to zero tokens")
+            prompt = ids
+        elif self.prompt_col in body:
+            prompt = np.asarray(
+                _decode_value(body.pop(self.prompt_col)), np.int32)
+        if prompt is None or prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(
+                f"body needs 'text' or a 1-D {self.prompt_col!r} "
+                f"token array")
+        fields = {self.prompt_col: prompt}
+        if "priority" in body:
+            fields["priority"] = encode_priority(
+                str(body.pop("priority")))
+        if "tenant" in body:
+            fields["tenant"] = encode_str_field(str(body.pop("tenant")))
+        if "max_new" in body:
+            fields["max_new"] = np.int32(int(body.pop("max_new")))
+        if "temperature" in body:
+            fields["temperature"] = np.float32(
+                float(body.pop("temperature")))
+        if "seed" in body:
+            fields["seed"] = np.int64(int(body.pop("seed")))
+        if "top_p" in body:
+            fields["top_p"] = np.float32(float(body.pop("top_p")))
+        if "prefix" in body:
+            fields["prefix"] = np.int32(int(body.pop("prefix")))
+        if stream:
+            fields["stream"] = np.int32(1)
+        if body:
+            raise ValueError(
+                f"unknown /v1/generate fields {sorted(body)}")
+        return fields, stream
+
+    def _generate_result(self, uri: str, tokens: np.ndarray) -> dict:
+        out = {"uri": uri,
+               "tokens": tokens.astype(np.int64).ravel().tolist()}
+        if self.tokenizer is not None:
+            ids = tokens.astype(np.int64).ravel()
+            if self._eos_id is not None:
+                hits = np.nonzero(ids == self._eos_id)[0]
+                if hits.size:
+                    ids = ids[:hits[0]]
+            out["text"] = self.tokenizer.decode(ids.tolist())
+        return out
+
+    def _retry_after(self, depth=None) -> int:
+        """Finite Retry-After for a 429: queue depth over the engine's
+        recent completion throughput (frontdoor.retry_after_s clamps
+        it, and the estimator falls back to a default rate, so the
+        header is finite even on a cold or detached frontend)."""
+        if depth is None and self.serving is not None:
+            try:
+                depth = self.serving.backlog()
+            except Exception:
+                depth = None
+        if depth is None:
+            depth = self.max_backlog
+        if self.serving is not None:
+            try:
+                self._throughput.observe(
+                    float(self.serving.telemetry.c_finished.value))
+            except Exception:
+                pass
+        return retry_after_s(int(depth), self._throughput.rate())
+
+    def health(self) -> dict:
+        """/healthz body: readiness for LOAD, not just liveness —
+        admission-queue depth vs. cap, accepting/backpressure state,
+        and the engine mode flags."""
+        out = {"status": "ok", "accepting": True,
+               "max_backlog": self.max_backlog}
+        if self.serving is None:
+            return out
+        try:
+            depth = int(self.serving.backlog())
+        except Exception:
+            depth = None
+        accepting = (depth is None or not self.max_backlog
+                     or depth < self.max_backlog)
+        out.update({
+            "backlog": depth,
+            "accepting": accepting,
+            "backpressure": not accepting,
+            "engine": self.serving.mode_flags(),
+        })
+        if not accepting:
+            out["retry_after_s"] = self._retry_after(depth)
+        return out
+
+    def _count_rejection(self) -> None:
+        self.c_rejected.inc()
+        if self.serving is not None:
+            try:
+                self.serving.telemetry.backpressure_rejection()
+            except Exception:
+                pass
+
+    def _count_disconnect(self, uri: str) -> None:
+        self.c_disconnects.inc()
+        if self.serving is not None:
+            try:
+                self.serving.telemetry.stream_disconnect(uri)
+            except Exception:
+                pass
 
     # ---- lifecycle ----------------------------------------------------
 

@@ -26,10 +26,28 @@ from analytics_zoo_tpu.serving.resp import RespClient
 INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
 SIGNAL_PREFIX = "rsig:"   # per-uri wakeup stream: XREAD BLOCK, not polling
+TOKEN_PREFIX = "tok:"     # per-uri token stream (streaming requests):
+#                           the pump publishes generated tokens + a
+#                           terminal marker; stream_events() tails it
+CANCEL_STREAM = "serving_cancel"  # client -> pump live-cancel requests
 IMG_MAGIC = b"IMG!"       # field prefix: raw encoded image (JPEG/PNG bytes)
 #                           decoded server-side — ref: Cluster Serving
 #                           clients enqueued base64 image bytes and the
 #                           Flink job decoded/resized before inference
+
+
+class BacklogFull(RuntimeError):
+    """The bounded admission queue refused an enqueue.  Subclasses
+    ``RuntimeError`` so pre-existing ``except RuntimeError`` callers
+    keep working; carries the observed depth and the cap so the HTTP
+    frontend can map it to ``429`` with a computed ``Retry-After``."""
+
+    def __init__(self, depth: int, max_backlog: int):
+        self.depth = int(depth)
+        self.max_backlog = int(max_backlog)
+        super().__init__(
+            f"serving backlog {self.depth} >= max_backlog "
+            f"{self.max_backlog}; request rejected (not trimmed)")
 
 
 def encode_ndarray(a: np.ndarray) -> str:
@@ -55,7 +73,7 @@ class InputQueue:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
                  stream: str = INPUT_STREAM, max_backlog: int = 10000):
-        """max_backlog > 0 rejects enqueues (RuntimeError) once the pending
+        """max_backlog > 0 rejects enqueues (BacklogFull) once the pending
         stream holds that many entries; 0 disables the cap.  No MAXLEN
         trimming is used: the server XDELs entries as it consumes them, so
         trimming could only ever drop requests that were never read."""
@@ -101,10 +119,17 @@ class InputQueue:
             ("XLEN", self.stream)])
         if int(depth or 0) > self.max_backlog:
             self.client.execute("XDEL", self.stream, entry_id)
-            raise RuntimeError(
-                f"serving backlog {int(depth) - 1} >= max_backlog "
-                f"{self.max_backlog}; request rejected (not trimmed)")
+            raise BacklogFull(int(depth) - 1, self.max_backlog)
         return uri
+
+    def cancel(self, uri: str) -> None:
+        """Request live cancellation of an in-flight request: the
+        serving pump drains the cancel stream every loop iteration and
+        calls ``engine.abort(uri)`` on its own thread, freeing BOTH
+        pool tenants' blocks immediately instead of waiting for the
+        ``result_ttl_s`` prune.  Idempotent; unknown uris are ignored
+        server-side."""
+        self.client.execute("XADD", CANCEL_STREAM, "*", "uri", uri)
 
     def enqueue_image(self, uri: Optional[str] = None, *,
                       image: bytes, col: str = "x") -> str:
@@ -162,6 +187,63 @@ class OutputQueue:
                 f"serving error for {uri!r}: "
                 f"{fields['error'].decode(errors='replace')}")
         return decode_ndarray(fields["value"])
+
+    def stream_events(self, uri: str, timeout: float = 30.0,
+                      poll_s: float = 1.0):
+        """Tail the per-token stream of a ``stream=True`` request.
+
+        Yields dicts in emission order: ``{"token": t, "index": i}``
+        per generated token, then exactly one terminal —
+        ``{"done": True}`` / ``{"cancelled": True}`` /
+        ``{"error": msg}`` — after which the stream key is deleted and
+        the generator returns.  ``{"ping": True}`` heartbeats surface
+        between events (at most every ``poll_s``) so an SSE writer can
+        touch its socket and detect a dead client while the engine is
+        between tokens.  Re-emitted tokens after an engine preemption
+        are deduplicated by index (a readmitted row regenerates its
+        tokens deterministically).  Raises ``TimeoutError`` when no
+        event lands for ``timeout`` seconds."""
+        key = TOKEN_PREFIX + uri
+        last = b"0-0"
+        next_index = 0
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.client.execute("DEL", key)
+                raise TimeoutError(
+                    f"no stream event for {uri!r} in {timeout}s")
+            block_ms = max(1, int(min(remaining, poll_s) * 1000))
+            resp = self.client.execute(
+                "XREAD", "COUNT", 256, "BLOCK", block_ms,
+                "STREAMS", key, last)
+            if not resp:
+                yield {"ping": True}
+                continue
+            for eid, flat in resp[0][1]:
+                last = eid
+                f = {flat[i].decode(): flat[i + 1]
+                     for i in range(0, len(flat), 2)}
+                if "t" in f:
+                    idx = int(f.get("i", b"-1"))
+                    if idx < next_index:    # preemption re-emission
+                        continue
+                    next_index = idx + 1
+                    deadline = time.monotonic() + timeout
+                    yield {"token": int(f["t"]), "index": idx}
+                elif "done" in f:
+                    self.client.execute("DEL", key)
+                    yield {"done": True}
+                    return
+                elif "cancelled" in f:
+                    self.client.execute("DEL", key)
+                    yield {"cancelled": True}
+                    return
+                elif "error" in f:
+                    self.client.execute("DEL", key)
+                    yield {"error":
+                           f["error"].decode(errors="replace")}
+                    return
 
     def dequeue(self) -> Dict[str, np.ndarray]:
         """Drain every available result (ref: OutputQueue.dequeue).

@@ -26,9 +26,12 @@ import numpy as np
 
 from analytics_zoo_tpu.common.log import logger
 from analytics_zoo_tpu.learn.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.frontdoor import (QosPolicy, TokenEmitter,
+                                                 decode_priority,
+                                                 decode_str_field)
 from analytics_zoo_tpu.serving.queues import (
-    IMG_MAGIC, INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX, decode_ndarray,
-    encode_ndarray)
+    CANCEL_STREAM, IMG_MAGIC, INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX,
+    TOKEN_PREFIX, OutputQueue, decode_ndarray, encode_ndarray)
 from analytics_zoo_tpu.serving.resp import RespClient, RespServer
 from analytics_zoo_tpu.serving.telemetry import Telemetry
 
@@ -102,6 +105,17 @@ class ServingConfig:
     # (load_flax_generator(draft_model=...)); composes with paged and
     # chunked.  None keeps the depth stored at model load.
     engine_speculation_k: Optional[int] = None
+    # QoS front door (serving/frontdoor.py; default OFF for parity):
+    # admission + prefill-grant order become a weighted fair share over
+    # (priority class, tenant) with aging as the starvation bound.
+    qos_enabled: bool = False
+    qos_weight_interactive: float = 8.0
+    qos_weight_standard: float = 4.0
+    qos_weight_batch: float = 1.0
+    qos_aging_s: float = 30.0
+    # bounded admission: the HTTP frontend's InputQueues reject past
+    # this backlog with 429 + Retry-After (0 disables the cap)
+    max_backlog: int = 10000
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -160,6 +174,20 @@ class ServingConfig:
         if "engine_speculation_k" in params:
             cfg.engine_speculation_k = int(
                 params["engine_speculation_k"])
+        if "qos_enabled" in params:
+            cfg.qos_enabled = bool(params["qos_enabled"])
+        if "qos_weight_interactive" in params:
+            cfg.qos_weight_interactive = float(
+                params["qos_weight_interactive"])
+        if "qos_weight_standard" in params:
+            cfg.qos_weight_standard = float(
+                params["qos_weight_standard"])
+        if "qos_weight_batch" in params:
+            cfg.qos_weight_batch = float(params["qos_weight_batch"])
+        if "qos_aging_s" in params:
+            cfg.qos_aging_s = float(params["qos_aging_s"])
+        if "max_backlog" in params:
+            cfg.max_backlog = int(params["max_backlog"])
         return cfg
 
 
@@ -247,10 +275,17 @@ class ClusterServing:
         m.gauge("zoo_serving_pending_results",
                 "published results not yet known consumed",
                 fn=lambda: len(self._written))
-        # pre-register so the counter is scrapeable at zero, not born
-        # on the first pruning (rate() needs the initial sample)
+        # pre-register so the counters are scrapeable at zero, not born
+        # on the first event (rate() needs the initial sample)
         m.counter("zoo_serving_requests_abandoned_total",
                   "published results pruned uncollected after the ttl")
+        m.counter("zoo_serving_requests_cancelled_total",
+                  "requests aborted by live cancellation (explicit "
+                  "cancel or mid-stream disconnect)")
+        m.counter("zoo_serving_stream_disconnects_total",
+                  "streaming clients that disconnected mid-response")
+        m.counter("zoo_serving_backpressure_rejections_total",
+                  "admissions refused with 429 under a full backlog")
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -331,6 +366,16 @@ class ClusterServing:
             # ONE pump thread owns the engine's device arena; horizontal
             # scale for continuous mode is more engine slots (or more
             # ClusterServing processes, each with its own arena)
+            qos = None
+            if self.config.qos_enabled:
+                qos = QosPolicy(
+                    weights={
+                        "interactive":
+                            float(self.config.qos_weight_interactive),
+                        "standard":
+                            float(self.config.qos_weight_standard),
+                        "batch": float(self.config.qos_weight_batch)},
+                    aging_s=float(self.config.qos_aging_s))
             self.engine = self.model.make_continuous_engine(
                 max_slots=self.config.engine_slots,
                 eos_id=self.config.eos_id,
@@ -346,7 +391,8 @@ class ClusterServing:
                 chunked=self.config.engine_chunked,
                 tick_token_budget=self.config.engine_tick_token_budget,
                 speculation_k=self.config.engine_speculation_k,
-                telemetry=self.telemetry)
+                telemetry=self.telemetry,
+                qos=qos)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
                                  name="zoo-serving-cb")
@@ -532,9 +578,21 @@ class ClusterServing:
             return
         engine = self.engine
         pcol = self.config.prompt_col or "prompt"
+        # streaming state is PUMP-THREAD-ONLY (on_done/on_token fire
+        # inside engine.step() on this thread): the emitter buffers
+        # per-token events between steps; one pipeline per step ships
+        # them — never a per-token broker round-trip
+        emitter = TokenEmitter(max_events=engine.max_new_tokens + 4)
+        streaming: set = set()              # uris with a live tok: stream
+        cancelled_pending: set = set()      # cancels that beat admission
 
         def publish(uri: str, toks: np.ndarray, eid: bytes, t0: float,
                     req):
+            if uri in streaming:
+                # terminal marker rides the emitter BEHIND the final
+                # tokens, so the flush preserves emission order
+                streaming.discard(uri)
+                emitter.finish(uri)
             try:
                 client.pipeline([
                     ("HSET", RESULT_PREFIX + uri, "value",
@@ -572,15 +630,30 @@ class ClusterServing:
 
         # the continuous pump must prune too (the micro-batch path
         # prunes per publish): time-gated so the idle poll loop isn't
-        # taking the stats lock hundreds of times a second
-        prune_every = max(1.0, self.config.result_ttl_s / 4.0)
-        next_prune = time.monotonic() + prune_every
+        # taking the stats lock hundreds of times a second.  The cadence
+        # re-reads result_ttl_s (it is runtime-tunable) and caps at 5s
+        # so a shortened ttl takes effect promptly.
+        def _prune_cadence():
+            return min(max(1.0, self.config.result_ttl_s / 4.0), 5.0)
+
+        next_prune = time.monotonic() + _prune_cadence()
+
+        def fail(u, exc, eid, ureq):
+            self._drop_inflight(u)
+            self._publish_error(ureq, f"admission failed: {exc!r}")
+            if u in streaming:
+                streaming.discard(u)
+                emitter.error(u, f"admission failed: {exc!r}"[:200])
+            self._finish_entries(client, [eid])
+
         try:
             while not self._stop.is_set():
                 now = time.monotonic()
                 if now >= next_prune:
-                    next_prune = now + prune_every
+                    next_prune = now + _prune_cadence()
                     self._prune_abandoned(client, now)
+                self._drain_cancels(client, emitter, streaming,
+                                    cancelled_pending)
                 busy = engine.n_active > 0 or engine.n_waiting > 0
                 try:
                     requests, ids = self._read_batch(
@@ -616,6 +689,32 @@ class ClusterServing:
                             # ClusterServing.register_prefix
                             kw["prefix"] = int(np.asarray(
                                 self._decode_value(r["prefix"])))
+                        # front-door control fields (frontdoor.py wire
+                        # codecs: the input queue transports ndarrays,
+                        # so priority is an index and tenant a byte
+                        # array)
+                        if "priority" in r:
+                            kw["priority"] = decode_priority(
+                                self._decode_value(r["priority"]))
+                        if "tenant" in r:
+                            kw["tenant"] = decode_str_field(
+                                self._decode_value(r["tenant"]))
+                        stream = "stream" in r and bool(int(np.asarray(
+                            self._decode_value(r["stream"])
+                        ).reshape(-1)[0]))
+                        if uri in cancelled_pending:
+                            # the cancel raced ahead of admission:
+                            # never enters the engine
+                            cancelled_pending.discard(uri)
+                            self._publish_error(
+                                {"uri": r["uri"]}, "cancelled")
+                            if stream:
+                                emitter.cancelled(uri)
+                            self._finish_entries(client, [eid])
+                            continue
+                        if stream:
+                            kw["on_token"] = emitter.emit
+                            streaming.add(uri)
                         # capture only the uri, not the whole request
                         # dict (it holds the encoded prompt payload —
                         # a needless second copy for the generation's
@@ -627,16 +726,19 @@ class ClusterServing:
                                      _r=ureq: publish(u, toks, _eid,
                                                       _t0, _r)),
                             on_error=(lambda u, exc, _eid=eid, _r=ureq:
-                                      (self._drop_inflight(u),
-                                       self._publish_error(
-                                          _r, f"admission failed: "
-                                              f"{exc!r}"),
-                                       self._finish_entries(client,
-                                                            [_eid]))),
+                                      fail(u, exc, _eid, _r)),
                             **kw)
                         with self._stats_lock:
                             self._inflight[uri] = (time.monotonic(), eid)
                     except Exception as e:
+                        try:
+                            u = r["uri"].decode()
+                            if u in streaming:
+                                streaming.discard(u)
+                                emitter.error(
+                                    u, f"submit failed: {e!r}"[:200])
+                        except Exception:
+                            pass
                         self._publish_error(r, f"submit failed: {e!r}")
                         self._finish_entries(client, [eid])
                 try:
@@ -649,8 +751,87 @@ class ClusterServing:
                     # a persistent fault keeps logging loudly).
                     logger.exception("continuous engine step failed")
                     time.sleep(0.2)
+                self._flush_emitter(client, emitter)
         finally:
             client.close()
+
+    def _flush_emitter(self, client: RespClient,
+                       emitter: TokenEmitter) -> None:
+        """Publish every token/terminal event buffered since the last
+        engine step in ONE pipeline — per-step, never per-token."""
+        batch = emitter.drain()
+        if not batch:
+            return
+        cmds = []
+        for uri, events in batch:
+            key = TOKEN_PREFIX + uri
+            for kind, idx, val in events:
+                if kind == "tok":
+                    cmds.append(("XADD", key, "*", "i", idx, "t", val))
+                elif kind == "done":
+                    cmds.append(("XADD", key, "*", "done", "1"))
+                elif kind == "cancelled":
+                    cmds.append(("XADD", key, "*", "cancelled", "1"))
+                else:
+                    cmds.append(("XADD", key, "*", "error",
+                                 str(val)[:500]))
+        try:
+            client.pipeline(cmds)
+        except Exception:
+            logger.exception("token-stream publish failed")
+
+    def _drain_cancels(self, client: RespClient, emitter: TokenEmitter,
+                       streaming: set, cancelled_pending: set) -> int:
+        """Serve ``serving_cancel`` entries on the pump thread (the
+        engine's ``abort`` contract): free the row's slot + BOTH pool
+        tenants' blocks immediately, publish a fail-fast "cancelled"
+        result, and terminate any live token stream.  Cancels that
+        arrive before their request was claimed from the input stream
+        park in ``cancelled_pending`` so admission skips them."""
+        try:
+            entries = client.execute("XRANGE", CANCEL_STREAM, "-", "+")
+        except Exception:
+            return 0
+        if not entries:
+            return 0
+        ids = []
+        for eid, flat in entries:
+            ids.append(eid)
+            f = {flat[i].decode(): flat[i + 1]
+                 for i in range(0, len(flat), 2)}
+            uri = f.get("uri", b"").decode()
+            if uri:
+                self._cancel_one(client, uri, emitter, streaming,
+                                 cancelled_pending)
+        try:
+            client.execute("XDEL", CANCEL_STREAM, *ids)
+        except Exception:
+            logger.exception("cancel-stream trim failed")
+        return len(ids)
+
+    def _cancel_one(self, client: RespClient, uri: str,
+                    emitter: TokenEmitter, streaming: set,
+                    cancelled_pending: set) -> None:
+        with self._stats_lock:
+            info = self._inflight.pop(uri, None)
+        aborted = self.engine.abort(uri)
+        if not aborted and info is None:
+            # not in the engine and not tracked: either it already
+            # published (don't clobber the result) or it is still in
+            # the input stream — park the uri so admission skips it
+            if uri not in streaming:
+                if len(cancelled_pending) < 4096:
+                    cancelled_pending.add(uri)
+                return
+        if uri in streaming:
+            streaming.discard(uri)
+            emitter.cancelled(uri)
+        self.telemetry.req_cancelled(uri)
+        # fail-fast error result so a blocked query() client returns
+        # now instead of riding out its timeout
+        self._publish_error({"uri": uri.encode()}, "cancelled")
+        if info is not None:
+            self._finish_entries(client, [info[1]])
 
     def _finish_entries(self, client: RespClient, ids):
         """Ack + delete consumed stream entries (after their results —
@@ -898,6 +1079,11 @@ class ClusterServing:
                 if engine.abort(u):
                     self.telemetry.req_abandoned(u, now - t_sub)
                     self._finish_entries(client, [eid])
+                    # a streaming abandoner's token stream dies with it
+                    try:
+                        client.execute("DEL", TOKEN_PREFIX + u)
+                    except Exception:
+                        pass
         while True:
             with self._stats_lock:
                 if not self._written or \
@@ -905,13 +1091,52 @@ class ClusterServing:
                     return
                 uri, written_at = self._written.popleft()
             client.pipeline([
-                ("DEL", RESULT_PREFIX + uri, SIGNAL_PREFIX + uri),
+                ("DEL", RESULT_PREFIX + uri, SIGNAL_PREFIX + uri,
+                 TOKEN_PREFIX + uri),
                 ("SREM", "__result_keys__", uri)])
             self.telemetry.req_abandoned(uri, now - written_at)
 
     def _drop_inflight(self, uri: str) -> None:
         with self._stats_lock:
             self._inflight.pop(uri, None)
+
+    # ---- front door (serving/frontdoor.py) ----------------------------
+
+    def stream_events(self, uri: str, timeout: float = 30.0,
+                      poll_s: float = 1.0):
+        """Tail a ``stream=True`` request's per-token stream — the
+        Redis-queue analog of the HTTP SSE path (same events:
+        token / done / cancelled / error, plus ping heartbeats).
+        Opens its own broker connection so it can block without
+        serialising the shared client."""
+        outq = OutputQueue(self.config.redis_host, self.port)
+        try:
+            yield from outq.stream_events(uri, timeout=timeout,
+                                          poll_s=poll_s)
+        finally:
+            outq.close()
+
+    def cancel(self, uri: str) -> None:
+        """Request live cancellation: the pump aborts the row on its
+        next loop iteration, freeing both pool tenants' blocks
+        immediately (vs. the ``result_ttl_s`` prune).  Idempotent;
+        callable from any thread."""
+        self.client.execute("XADD", CANCEL_STREAM, "*", "uri", uri)
+
+    def mode_flags(self) -> Dict[str, bool]:
+        """Engine mode booleans for /healthz: which serving features
+        this job composed (the engine object is authoritative for
+        speculation — it knows whether a draft actually loaded)."""
+        eng = getattr(self, "engine", None)
+        return {
+            "continuous": bool(self.config.continuous_batching),
+            "paged": bool(self.config.engine_paged),
+            "chunked": bool(self.config.engine_chunked),
+            "speculative": bool(
+                eng is not None and
+                getattr(eng, "draft_model", None) is not None),
+            "qos": bool(self.config.qos_enabled),
+        }
 
     # ---- observability (SURVEY §5: queue depth = backlog metric) ------
 

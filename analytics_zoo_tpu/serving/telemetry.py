@@ -488,6 +488,22 @@ class Telemetry:
         self.h_queue_wait = m.histogram(
             p + "queue_wait_seconds", "arrival -> slot admission",
             window=window)
+        # QoS front door (serving/frontdoor.py): per-priority-class
+        # splits of queue wait and admission grants.  The registry is
+        # label-free by design, so classes are name suffixes.  Always
+        # registered (scrapes keep a stable catalog); only populated
+        # when requests carry a priority.
+        self.h_queue_wait_cls = {
+            cls: m.histogram(
+                p + f"queue_wait_seconds_{cls}",
+                f"arrival -> slot admission, {cls}-class requests",
+                window=window)
+            for cls in ("interactive", "standard", "batch")}
+        self.c_class_grants = {
+            cls: m.counter(
+                p + f"qos_grants_total_{cls}",
+                f"slot admissions granted to {cls}-class requests")
+            for cls in ("interactive", "standard", "batch")}
         self.h_tick = m.histogram(
             p + "tick_seconds", "engine step wall time",
             window=window)
@@ -509,7 +525,8 @@ class Telemetry:
                             {"uri": uri})
 
     def req_admitted(self, uri: str, slot: int,
-                     prefilling: bool = False) -> None:
+                     prefilling: bool = False,
+                     priority: Optional[str] = None) -> None:
         now = time.monotonic()
         with self._lock:
             ck = self._clocks.get(uri)
@@ -517,6 +534,11 @@ class Telemetry:
                 ck = self._clocks[uri] = _Clock(now)
             ck.admitted = now
         self.h_queue_wait.record(now - ck.arrival)
+        if priority is not None:
+            h = self.h_queue_wait_cls.get(priority)
+            if h is not None:
+                h.record(now - ck.arrival)
+                self.c_class_grants[priority].inc()
         self.events.span("queue_wait", ck.arrival, now - ck.arrival,
                          EventLog.TID_QUEUE, {"uri": uri})
         self.events.instant(
@@ -602,6 +624,34 @@ class Telemetry:
         self.events.instant("request_abandoned", None,
                             EventLog.TID_QUEUE,
                             {"uri": uri, "age_s": round(age_s, 3)})
+
+    # -- front door (serving/frontdoor.py) ---------------------------
+
+    def req_cancelled(self, uri: str) -> None:
+        """A live cancellation (explicit /v1/cancel or a mid-stream
+        client disconnect) aborted the request ahead of the TTL path."""
+        self.metrics.counter(
+            "zoo_serving_requests_cancelled_total",
+            "requests aborted by live cancellation (explicit cancel "
+            "or mid-stream disconnect)").inc()
+        self.events.instant("request_cancelled", None,
+                            EventLog.TID_QUEUE, {"uri": uri})
+
+    def stream_disconnect(self, uri: str) -> None:
+        """An SSE write failed mid-stream — the client hung up; the
+        cancel path fires next."""
+        self.metrics.counter(
+            "zoo_serving_stream_disconnects_total",
+            "streaming clients that disconnected mid-response").inc()
+        self.events.instant("stream_disconnect", None,
+                            EventLog.TID_QUEUE, {"uri": uri})
+
+    def backpressure_rejection(self) -> None:
+        """An admission was refused because the bounded queue was full
+        (the client got a 429 + Retry-After)."""
+        self.metrics.counter(
+            "zoo_serving_backpressure_rejections_total",
+            "admissions refused with 429 under a full backlog").inc()
 
     # -- engine loop -------------------------------------------------
 

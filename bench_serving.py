@@ -834,6 +834,205 @@ def run_spec_scenario(chunked: bool = False, slots: int = 2) -> dict:
 # scenario plan, most-informative-first (the claims a judge needs —
 # int8-mxu head-to-head, continuous-vs-convoy, generative load — land
 # even if a tunnel wedge cuts the run short); (kind, clients, rpc, bs)
+def run_qos_scenario(slots: int = 4, n_requests: int = 80) -> dict:
+    """Heavy-traffic QoS front-door scenario (docs/serving_qos.md): a
+    saturating mixed interactive/batch burst through the full wire
+    protocol with per-tenant fair share on, a bounded admission queue
+    rejecting the overflow, and mid-stream client aborts freeing KV
+    blocks live.
+
+    Reported per class: p50/p99 TTFT and TPOT from the engine's
+    per-request stamps (the admission reorder IS the product — under
+    saturation interactive p99 TTFT must sit well below batch), plus
+    the rejected-request count (client-side ``BacklogFull`` and HTTP
+    429s, whose finite ``Retry-After`` is asserted here), mid-stream
+    aborts, and a ``starved_batch`` column that must be 0 — aging
+    bounds how long weight-1 work can wait."""
+    import http.client as _http
+    import queue as _q
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        BacklogFull, ClusterServing, HttpFrontend, InputQueue,
+        OutputQueue, ServingConfig)
+    from analytics_zoo_tpu.serving.frontdoor import (
+        encode_priority, encode_str_field)
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    im = InferenceModel(batch_buckets=(1, slots))
+    im.load_flax_generator(model, variables, max_new_tokens=16,
+                           prompt_buckets=(16,))
+    max_backlog = max(8, n_requests // 3)
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=slots, engine_ticks=2,
+                        engine_paged=True, engine_block_size=8,
+                        engine_chunked=True, qos_enabled=True,
+                        max_backlog=max_backlog)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = HttpFrontend(redis_port=serving.port, timeout=600,
+                      serving=serving).start()
+    inq = InputQueue(port=serving.port, max_backlog=max_backlog)
+    wq = OutputQueue(port=serving.port)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(1, 8192, int(rng.integers(6, 14))).astype(
+        np.int32) for _ in range(16)]
+    inq.enqueue("warm", tokens=prompts[0])
+    assert wq.query("warm", timeout=600) is not None
+    serving.engine.telemetry.reset_windows()
+    serving.engine.record_timings = True
+
+    lock = threading.Lock()
+    served: set = set()
+    aborted: set = set()
+    uris_q: "_q.Queue" = _q.Queue()
+
+    def waiter():
+        outq = OutputQueue(port=serving.port)
+        try:
+            while True:
+                u = uris_q.get()
+                if u is None:
+                    return
+                r = outq.query(u, timeout=300, poll_interval=0.001)
+                if r is not None:
+                    with lock:
+                        served.add(u)
+        except Exception:
+            pass
+        finally:
+            outq.close()
+
+    def abort_after_first_token(u):
+        # a streaming client that hangs up one token in: live cancel,
+        # blocks must come back without waiting for the TTL prune
+        my_inq = InputQueue(port=serving.port)
+        outq = OutputQueue(port=serving.port)
+        try:
+            for ev in outq.stream_events(u, timeout=300):
+                if "token" in ev:
+                    my_inq.cancel(u)
+                if any(k in ev for k in ("done", "cancelled", "error")):
+                    with lock:
+                        aborted.add(u)
+                    return
+        except TimeoutError:
+            pass
+        finally:
+            my_inq.close()
+            outq.close()
+
+    waiters = [threading.Thread(target=waiter) for _ in range(12)]
+    for w in waiters:
+        w.start()
+    abort_threads = []
+    offered = rejected = 0
+    enqueued: list = []
+    t_start = time.perf_counter()
+    for i in range(n_requests):
+        # batch-heavy mix: 1 interactive per 3 batch — the regime
+        # where the weights matter
+        cls = "interactive" if i % 4 == 0 else "batch"
+        uri = f"{cls[0]}{i}"
+        streaming = len(abort_threads) < 6 and i % 10 == 5
+        kw = dict(tokens=prompts[int(rng.integers(16))],
+                  priority=encode_priority(cls),
+                  tenant=encode_str_field(f"t{i % 2}"))
+        if streaming:
+            kw["stream"] = np.int32(1)
+        offered += 1
+        try:
+            inq.enqueue(uri, **kw)
+        except BacklogFull:
+            rejected += 1
+            continue
+        enqueued.append((uri, cls))
+        if streaming:
+            th = threading.Thread(target=abort_after_first_token,
+                                  args=(uri,))
+            th.start()
+            abort_threads.append(th)
+        else:
+            uris_q.put(uri)
+        time.sleep(0.01)            # ~100 req/s offered: saturating
+    # the queue is deep right now: a 429 + finite Retry-After must be
+    # observable over HTTP while the backlog stands
+    retry_after = None
+    for _ in range(5):
+        conn = _http.HTTPConnection("127.0.0.1", fe.port, timeout=60)
+        conn.request("POST", "/v1/generate", json.dumps(
+            {"tokens": prompts[0].tolist(), "stream": True,
+             "priority": "batch"}),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status == 429:
+            rejected += 1
+            retry_after = int(resp.getheader("Retry-After", "0"))
+            assert 1 <= retry_after <= 120, retry_after
+            resp.read()
+            conn.close()
+            break
+        resp.close()
+        conn.close()
+    for _ in waiters:
+        uris_q.put(None)
+    for w in waiters:
+        w.join()
+    for th in abort_threads:
+        th.join()
+    wall = time.perf_counter() - t_start
+    timings = serving.engine.pop_request_timings()
+    cache = serving.engine.cache_metrics()
+    fe.stop()
+    serving.stop()
+    inq.close()
+    wq.close()
+
+    def pct(cls, vals, q):
+        a = np.asarray(vals.get(cls, []))
+        return round(float(np.percentile(a, q)) * 1e3, 2) if a.size \
+            else None
+
+    ttft: dict = {"i": [], "b": []}
+    tpot: dict = {"i": [], "b": []}
+    for u, t in timings.items():
+        if u[0] not in ttft or u in aborted or not t["token_times"]:
+            continue
+        ttft[u[0]].append(t["token_times"][0] - t["arrival"])
+        tpot[u[0]].extend(np.diff(t["token_times"]).tolist())
+    starved_batch = sum(1 for u, cls in enqueued
+                        if cls == "batch" and u not in served
+                        and u not in aborted)
+    return {
+        "model": "lm-qos",
+        "mode": "continuous-qos",
+        "slots": slots,
+        "max_backlog": max_backlog,
+        "offered": offered,
+        "served": len(served),
+        "rejected": rejected,
+        "retry_after_s": retry_after,
+        "aborted_midstream": len(aborted),
+        "starved_batch": starved_batch,
+        "req_per_sec": round(len(served) / wall, 1),
+        "ttft_p50_interactive_ms": pct("i", ttft, 50),
+        "ttft_p99_interactive_ms": pct("i", ttft, 99),
+        "ttft_p50_batch_ms": pct("b", ttft, 50),
+        "ttft_p99_batch_ms": pct("b", ttft, 99),
+        "tpot_p50_interactive_ms": pct("i", tpot, 50),
+        "tpot_p99_interactive_ms": pct("i", tpot, 99),
+        "tpot_p50_batch_ms": pct("b", tpot, 50),
+        "tpot_p99_batch_ms": pct("b", tpot, 99),
+        "preemptions": cache["preemptions"],
+        "max_coresident": cache["peak_resident"],
+    }
+
+
 PLAN = [("resnet18", 64, 10, 64),
         ("resnet18-int8mxu", 64, 10, 64),
         ("resnet18-int8", 64, 10, 64),
@@ -860,6 +1059,11 @@ PLAN = [("resnet18", 64, 10, 64),
         # rate column); clients = engine slots — FEW by design,
         # speculation's regime is latency-bound low-batch decode
         ("lm-spec-pg", 2, 0, 8), ("lm-spec-ck-pg", 2, 0, 8),
+        # QoS front door under heavy mixed traffic: weighted fair-share
+        # admission (interactive p99 TTFT < batch under saturation),
+        # bounded backlog with 429 + Retry-After, mid-stream aborts
+        # freeing blocks live; clients = engine slots, rpc = offered
+        ("lm-qos", 4, 80, 8),
         ("lm", 16, 10, 32), ("lm-spec", 16, 10, 32),
         ("lm", 64, 5, 32), ("lm", 1, 20, 32),
         ("mlp", 256, 50, 128), ("mlp", 64, 50, 128),
@@ -1021,6 +1225,8 @@ def _one():
         r = run_spec_scenario(chunked=False, slots=clients)
     elif kind == "lm-spec-ck-pg":
         r = run_spec_scenario(chunked=True, slots=clients)
+    elif kind == "lm-qos":
+        r = run_qos_scenario(slots=clients, n_requests=rpc)
     elif kind == "lm-poisson-pg":
         r = run_poisson_scenario(True, rate_per_s=clients,
                                  n_requests=rpc, slots=bs, paged=True)
@@ -1095,7 +1301,11 @@ def _smoke_scrape():
                 return r.headers.get("Content-Type", ""), r.read()
 
         _, body = get("/healthz")
-        assert json.loads(body) == {"status": "ok"}, body
+        h = json.loads(body)
+        assert h["status"] == "ok", h
+        assert h["accepting"] is True and "backlog" in h, h
+        assert h["engine"]["paged"] and h["engine"]["chunked"] \
+            and h["engine"]["speculative"], h
         ct, body = get("/metrics")
         assert ct.startswith("text/plain"), ct
         text = body.decode()
@@ -1129,6 +1339,125 @@ def _smoke_scrape():
     print("SCRAPE_OK")
 
 
+def _smoke_frontdoor():
+    """serve-smoke front-door leg (docs/serving_qos.md): the QoS engine
+    behind ``HttpFrontend`` with speculation + paged + chunked composed.
+    Asserts the three wire-level contracts end to end: (1) an SSE
+    stream delivers >= 2 per-token chunks and a ``done`` terminal;
+    (2) a client that drops its socket mid-stream frees BOTH the
+    target and draft block pools immediately (no waiting on the TTL
+    prune) and bumps the disconnect counters; (3) a saturated
+    admission queue answers 429 with a finite ``Retry-After``."""
+    import http.client as _http
+    import socket
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, HttpFrontend, ServingConfig)
+    from analytics_zoo_tpu.serving.resp import RespServer
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    im = InferenceModel(batch_buckets=(1, 4))
+    im.load_flax_generator(model, variables, max_new_tokens=24,
+                           prompt_buckets=(16,),
+                           draft_model=model, draft_variables=variables)
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=4, engine_ticks=2,
+                        engine_paged=True, engine_block_size=8,
+                        engine_chunked=True, engine_speculation_k=2,
+                        qos_enabled=True)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = HttpFrontend(redis_port=serving.port, timeout=600,
+                      serving=serving).start()
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, 8192, 10).astype(np.int32).tolist()
+    try:
+        # --- SSE streaming e2e: >= 2 token chunks, then done ---
+        conn = _http.HTTPConnection("127.0.0.1", fe.port, timeout=600)
+        conn.request("POST", "/v1/generate", json.dumps(
+            {"tokens": prompt, "stream": True,
+             "priority": "interactive", "tenant": "smoke"}),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.status
+        assert resp.getheader("Content-Type", "").startswith(
+            "text/event-stream")
+        raw = resp.read().decode()
+        conn.close()
+        events = [c for c in raw.split("\n\n") if c.strip()
+                  and not c.startswith(":")]
+        n_tok = sum(1 for c in events if c.startswith("event: token"))
+        assert n_tok >= 2, events
+        assert any(c.startswith("event: done") for c in events), events
+
+        # --- mid-stream disconnect reclaims both pools ---
+        s = socket.create_connection(("127.0.0.1", fe.port), timeout=600)
+        body = json.dumps({"tokens": prompt, "stream": True}).encode()
+        s.sendall(b"POST /v1/generate HTTP/1.1\r\n"
+                  b"Host: x\r\nContent-Type: application/json\r\n"
+                  b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+        buf = b""
+        while b"event: token" not in buf:
+            chunk = s.recv(4096)
+            assert chunk, "stream closed before first token"
+            buf += chunk
+        # hard close (RST via SO_LINGER 0): the write side must see the
+        # broken pipe and cancel into the engine
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     b"\x01\x00\x00\x00\x00\x00\x00\x00")
+        s.close()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            m = serving.engine.cache_metrics()
+            if (m["referenced_blocks"] == 0
+                    and m["draft_referenced_blocks"] == 0
+                    and fe.c_disconnects.value >= 1):
+                break
+            time.sleep(0.05)
+        m = serving.engine.cache_metrics()
+        assert m["referenced_blocks"] == 0, m
+        assert m["draft_referenced_blocks"] == 0, m
+        assert fe.c_disconnects.value >= 1, fe.c_disconnects.value
+    finally:
+        fe.stop()
+        serving.stop()
+
+    # --- 429 under a saturated queue: broker with no consumer ---
+    broker = RespServer(port=0).start()
+    fe2 = HttpFrontend(redis_port=broker.port, timeout=5,
+                       max_backlog=2).start()
+    try:
+        saw_429 = False
+        for _ in range(4):
+            conn = _http.HTTPConnection("127.0.0.1", fe2.port,
+                                        timeout=30)
+            conn.request("POST", "/v1/generate", json.dumps(
+                {"prompt": [1, 2, 3], "stream": True}),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status == 429:
+                ra = resp.getheader("Retry-After")
+                payload = json.loads(resp.read())
+                assert ra is not None and 1 <= int(ra) <= 120, ra
+                assert payload["retry_after_s"] == int(ra), payload
+                saw_429 = True
+                conn.close()
+                break
+            resp.close()
+            conn.close()
+        assert saw_429, "no 429 from saturated admission queue"
+    finally:
+        fe2.stop()
+        broker.stop()
+    print("FRONTDOOR_OK")
+
+
 def _smoke():
     """``python bench_serving.py --smoke``: the `make serve-smoke` e2e
     leg — 20 requests through the full wire protocol on the PAGED
@@ -1150,6 +1479,7 @@ def _smoke():
     assert r["ttft_p50_ms"] is not None, r
     assert r["tpot_p50_ms"] is not None, r
     _smoke_scrape()
+    _smoke_frontdoor()
     print("SMOKE_OK")
 
 
