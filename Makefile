@@ -55,7 +55,8 @@ serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py \
 	    tests/test_chunked_prefill.py tests/test_telemetry.py \
 	    tests/test_frontdoor.py -q -m "not slow"
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_composed.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_composed.py \
+	    tests/test_flight.py -q
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
 
 clean:

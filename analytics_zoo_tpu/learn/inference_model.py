@@ -338,7 +338,8 @@ class InferenceModel:
                                tick_token_budget: Optional[int] = None,
                                speculation_k: Optional[int] = None,
                                record_timings: bool = False,
-                               telemetry=None, qos=None):
+                               telemetry=None, qos=None,
+                               flight=None, flight_capacity: int = 2048):
         """Build a ``serving.continuous.ContinuousEngine`` from a model
         loaded via ``load_flax_generator`` (quantized weights dequantize
         once at build — the engine trades the at-rest memory win for
@@ -368,7 +369,13 @@ class InferenceModel:
         ``qos`` (a ``serving.frontdoor.QosPolicy``) turns admission and
         prefill-grant order into a weighted fair share over (priority
         class, tenant) — the serving front door's scheduler
-        (docs/serving_qos.md).  ``None`` keeps plain FIFO."""
+        (docs/serving_qos.md).  ``None`` keeps plain FIFO.
+
+        ``flight`` / ``flight_capacity`` configure the engine's
+        always-on per-tick flight recorder (serving/flight.py;
+        ``flight_capacity=0`` disables, a shared
+        ``flight.FlightRecorder`` can be passed in so the serving
+        layer can bundle it — docs/debugging.md)."""
         from analytics_zoo_tpu.serving.continuous import ContinuousEngine
 
         if getattr(self, "_gen_max_new_tokens", None) is None:
@@ -405,7 +412,8 @@ class InferenceModel:
             enable_prefix_cache=enable_prefix_cache,
             chunked=chunked, tick_token_budget=tick_token_budget,
             record_timings=record_timings, telemetry=telemetry,
-            qos=qos, **spec)
+            qos=qos, flight=flight, flight_capacity=flight_capacity,
+            **spec)
 
     def load_openvino(self, xml_path: str, bin_path: str = None,
                       quantize: Optional[str] = None) -> "InferenceModel":

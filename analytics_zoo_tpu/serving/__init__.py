@@ -1,7 +1,16 @@
 from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+from analytics_zoo_tpu.serving.flight import (AnomalyMonitor,
+                                              FlightRecorder,
+                                              JsonLogFormatter,
+                                              RingLogHandler, SloPolicy,
+                                              SloWatchdog, dump_bundle,
+                                              install_flight_logging,
+                                              prune_bundles,
+                                              request_uri_context)
 from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
                                                  TokenEmitter,
                                                  WeightedWaitQueue,
+                                                 normalize_request_id,
                                                  retry_after_s)
 from analytics_zoo_tpu.serving.paged_cache import BlockPool
 from analytics_zoo_tpu.serving.queues import (BacklogFull, InputQueue,
@@ -19,4 +28,8 @@ __all__ = ["ContinuousEngine", "BlockPool", "InputQueue", "OutputQueue",
            "WindowHistogram", "render_prometheus",
            "validate_chrome_trace",
            "BacklogFull", "PRIORITIES", "QosPolicy", "TokenEmitter",
-           "WeightedWaitQueue", "retry_after_s"]
+           "WeightedWaitQueue", "retry_after_s",
+           "FlightRecorder", "SloPolicy", "SloWatchdog", "AnomalyMonitor",
+           "dump_bundle", "prune_bundles", "JsonLogFormatter",
+           "RingLogHandler", "install_flight_logging",
+           "request_uri_context", "normalize_request_id"]

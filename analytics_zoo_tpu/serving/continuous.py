@@ -51,6 +51,7 @@ from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
 from analytics_zoo_tpu.serving.paged_cache import (BlockPool,
                                                    SINK_BLOCK,
                                                    split_block_budget)
+from analytics_zoo_tpu.serving.flight import FlightRecorder
 from analytics_zoo_tpu.serving.telemetry import Telemetry
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -177,7 +178,9 @@ class ContinuousEngine:
                  tick_token_budget: Optional[int] = None,
                  record_timings: bool = False,
                  telemetry: Optional[Telemetry] = None,
-                 qos: Optional[QosPolicy] = None):
+                 qos: Optional[QosPolicy] = None,
+                 flight: Optional[FlightRecorder] = None,
+                 flight_capacity: int = 2048):
         """``mesh`` (with a ``tp`` axis) serves a model LARGER than one
         chip's HBM: weights shard per ``partition_rules`` (default
         ``LM_PARTITION_RULES`` — Megatron layout), the KV arena shards
@@ -207,6 +210,25 @@ class ContinuousEngine:
         # jitted program, so it can neither sync the device nor retrace.
         self.telemetry = telemetry if telemetry is not None \
             else Telemetry()
+        # ---- flight recorder (serving/flight.py) -----------------------
+        # always-on bounded ring of per-tick state snapshots — the
+        # incident lookback a diagnostic bundle ships.  One plain dict
+        # of host ints per tick (no device reads beyond what telemetry
+        # already sampled), so greedy outputs are bitwise-identical
+        # with it on or off.  ``flight_capacity=0`` disables it (the
+        # overhead benchmark's lever); a shared recorder can be passed
+        # in so the serving layer can bundle it after an engine crash.
+        self.flight = flight if flight is not None else (
+            FlightRecorder(flight_capacity) if flight_capacity > 0
+            else None)
+        self._tick_kind = "decode"
+        self._alloc_fail_streak = 0
+        # cumulative-counter baselines for the per-tick deltas the
+        # flight record carries
+        self._flight_last = {"preempt": 0, "compiles": 0, "chunks": 0,
+                             "budget_tokens": 0, "alloc_fail": 0,
+                             "draft_alloc_fail": 0, "spec_proposed": 0,
+                             "spec_accepted": 0}
         # ---- speculative mode (draft arena) ----------------------------
         # the slot arena is ALREADY per-row-positioned, which is exactly
         # what per-slot acceptance rates need: each verify round advances
@@ -2299,8 +2321,11 @@ class ContinuousEngine:
             return 0
         t0 = time.monotonic()
         n = self._step_impl()
-        self.telemetry.tick(t0, time.monotonic() - t0,
-                            self._tick_samples(n))
+        dur = time.monotonic() - t0
+        samples = self._tick_samples(n)
+        self.telemetry.tick(t0, dur, samples)
+        if self.flight is not None:
+            self._flight_record(t0, dur, samples)
         return n
 
     def _tick_samples(self, n_active: int) -> dict:
@@ -2324,7 +2349,77 @@ class ContinuousEngine:
                         self._dpool.allocatable()
         return samples
 
+    def _flight_record(self, ts: float, dur: float,
+                       samples: dict) -> None:
+        """Append one tick snapshot to the flight ring: the telemetry
+        samples plus resident row sets, tick kind, and the per-tick
+        DELTAS of every cumulative counter an incident reader wants on
+        a timeline (preemptions, compiles, chunk/budget consumption,
+        spec acceptance, pool allocation failures).  All host ints
+        already in hand — O(slots) work, no locks beyond one pool
+        read, no device interaction."""
+        last = self._flight_last
+
+        def delta(key: str, cur: int) -> int:
+            d = cur - last[key]
+            last[key] = cur
+            return d
+
+        rec = dict(samples)
+        rec["seq"] = self.flight.next_seq()
+        rec["ts"] = round(ts, 6)
+        rec["dur_ms"] = round(dur * 1e3, 3)
+        rec["kind"] = self._tick_kind
+        rec["decode_uris"] = [s.uri for s in self._slots
+                              if s is not None and s.state == "DECODE"]
+        rec["prefill_uris"] = [s.uri for s in self._slots
+                               if s is not None and s.state != "DECODE"]
+        rec["preempted"] = delta("preempt", self._preemptions)
+        rec["compiles"] = delta(
+            "compiles", self.telemetry.c_jit_builds.value
+            + self.telemetry.c_retraces.value)
+        if self.chunked:
+            rec["budget"] = self.tick_token_budget
+            rec["budget_used"] = delta("budget_tokens",
+                                       self._budget_tokens_used)
+            rec["chunks"] = delta("chunks",
+                                  self.telemetry.c_chunks.value)
+        if self.draft_model is not None:
+            rec["spec_proposed"] = delta(
+                "spec_proposed", self.telemetry.c_spec_proposed.value)
+            rec["spec_accepted"] = delta(
+                "spec_accepted", self.telemetry.c_spec_accepted.value)
+        if self._pool is not None:
+            with self._pool_lock:
+                af = self._pool.alloc_failures
+                rec["used_blocks"] = self._pool.num_referenced()
+                daf = (self._dpool.alloc_failures
+                       if self._dpool is not None else 0)
+                if self._dpool is not None:
+                    rec["draft_used_blocks"] = \
+                        self._dpool.num_referenced()
+            fails = delta("alloc_fail", af) \
+                + delta("draft_alloc_fail", daf)
+            rec["alloc_failures"] = fails
+            # consecutive ticks with at least one failed allocation —
+            # the anomaly monitor's "pool is dry and STAYING dry"
+            self._alloc_fail_streak = \
+                self._alloc_fail_streak + 1 if fails else 0
+            rec["alloc_fail_streak"] = self._alloc_fail_streak
+        if self._qos is not None:
+            rec["qos_depths"] = {f"{c}/{t}" if t else c: n
+                                 for (c, t), n in
+                                 self._waiting.depths().items()}
+        self.flight.record(rec)
+
+    @property
+    def alloc_fail_streak(self) -> int:
+        """Consecutive ticks whose flight record saw >= 1 block-pool
+        allocation failure (0 when not paged or currently healthy)."""
+        return self._alloc_fail_streak
+
     def _step_impl(self) -> int:
+        self._tick_kind = "decode"
         self._admit()
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
@@ -2333,7 +2428,9 @@ class ContinuousEngine:
             if self.chunked and any(
                     self._slots[i].state == "PREFILLING"
                     for i in active):
+                self._tick_kind = "spec_chunked"
                 return self._spec_chunked_tick(active)
+            self._tick_kind = "spec"
             if self.paged:
                 # grow BOTH tenants' tables to cover the round's k+1
                 # verify writes; may preempt
@@ -2344,6 +2441,7 @@ class ContinuousEngine:
             return self._spec_tick(active)
         if self.chunked and any(self._slots[i].state == "PREFILLING"
                                 for i in active):
+            self._tick_kind = "chunked"
             return self._chunked_tick(active)
         # a chunked engine with NO prefill in flight decodes on the
         # ORIGINAL (multi-tick, scan-amortised) path below — chunking

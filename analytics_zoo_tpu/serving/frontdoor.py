@@ -340,3 +340,27 @@ def sse_event(event: str, data: dict) -> bytes:
     return (f"event: {event}\ndata: "
             f"{json.dumps(data, separators=(',', ':'))}\n\n"
             ).encode("utf-8")
+
+
+# request ids travel through queue field names, log lines, span args,
+# and response headers — keep the accepted alphabet boring enough that
+# none of those surfaces needs escaping
+_REQUEST_ID_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+    "-_.:")
+
+
+def normalize_request_id(value) -> Optional[str]:
+    """A client-supplied ``X-Request-Id`` as a usable request uri, or
+    None when it is absent/empty/oversized/outside the safe alphabet
+    (the frontend then falls back to a generated uuid — a bad header
+    never rejects the request, it just loses client-side
+    correlation)."""
+    if not isinstance(value, str):
+        return None
+    value = value.strip()
+    if not value or len(value) > 128:
+        return None
+    if not all(c in _REQUEST_ID_CHARS for c in value):
+        return None
+    return value

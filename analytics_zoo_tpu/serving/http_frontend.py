@@ -29,7 +29,18 @@ Routes:
   GET  /trace     Chrome trace-event JSON of the engine's event ring
                   (load at https://ui.perfetto.dev)
   GET  /healthz   readiness JSON: admission-queue depth vs. cap,
-                  accepting/backpressure state, engine mode flags
+                  accepting/backpressure state, engine mode flags,
+                  per-class SLO goodput/breach summary
+  GET  /debug/flight  live flight-recorder inspection (docs/
+                  debugging.md): the last ``?n=`` tick records, the
+                  SLO watchdog's status, and the anomaly-bundle
+                  history — the bundle's content without waiting for
+                  a trigger
+
+A client-supplied ``X-Request-Id`` header on /v1/generate becomes the
+request's uri end-to-end (spans, structured logs, SSE ``start``
+event) and is echoed back in the response headers; absent or unusable
+ids fall back to a generated uuid.
 """
 
 from __future__ import annotations
@@ -46,9 +57,11 @@ from typing import Optional
 import numpy as np
 
 from analytics_zoo_tpu.common.log import logger
+from analytics_zoo_tpu.serving.flight import request_uri_context
 from analytics_zoo_tpu.serving.frontdoor import (ThroughputEstimator,
                                                  encode_priority,
                                                  encode_str_field,
+                                                 normalize_request_id,
                                                  retry_after_s, sse_event)
 from analytics_zoo_tpu.serving.queues import (
     BacklogFull, ImageBytes, InputQueue, OutputQueue)
@@ -167,11 +180,14 @@ class HttpFrontend:
             def log_message(self, *a):   # route through our logger
                 logger.debug("http: " + a[0], *a[1:])
 
-            def _send(self, code: int, payload: dict):
+            def _send(self, code: int, payload: dict,
+                      headers: Optional[dict] = None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -211,6 +227,22 @@ class HttpFrontend:
                                      "serving=...)"})
                     else:
                         self._send(200, trace)
+                elif path == "/debug/flight":
+                    n = 100
+                    for part in query.split("&"):
+                        if part.startswith("n="):
+                            try:
+                                n = max(1, int(part[2:]))
+                            except ValueError:
+                                pass
+                    body = frontend.debug_flight(last=n)
+                    if body is None:
+                        self._send(404, {
+                            "error": "no flight recorder attached "
+                                     "(start the frontend with "
+                                     "serving=...)"})
+                    else:
+                        self._send(200, body)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 
@@ -326,41 +358,50 @@ class HttpFrontend:
                     return
                 pair = frontend._acquire()
                 inq, outq = pair
-                uri = str(uuid.uuid4())
-                try:
+                # a client-supplied X-Request-Id becomes the uri end to
+                # end (spans, logs, SSE start event) so the caller's own
+                # correlation id works on every surface; unusable values
+                # silently fall back to a uuid rather than rejecting
+                uri = normalize_request_id(
+                    self.headers.get("X-Request-Id")) or str(uuid.uuid4())
+                echo = {"X-Request-Id": uri}
+                with request_uri_context(uri):
                     try:
-                        inq.enqueue(uri, **fields)
-                    except BacklogFull as e:
-                        # the rejecting XADD/XDEL completed cleanly —
-                        # the pair is protocol-safe to pool again
-                        frontend._count_rejection()
-                        self._send_429(e.depth, str(e))
-                        frontend._release(pair)
+                        try:
+                            inq.enqueue(uri, **fields)
+                        except BacklogFull as e:
+                            # the rejecting XADD/XDEL completed cleanly —
+                            # the pair is protocol-safe to pool again
+                            frontend._count_rejection()
+                            self._send_429(e.depth, str(e))
+                            frontend._release(pair)
+                            return
+                        if not stream:
+                            r = outq.query(uri, timeout=frontend.timeout)
+                            if r is None:
+                                raise TimeoutError(
+                                    f"result for {uri} not ready within "
+                                    f"{frontend.timeout}s")
+                            frontend._release(pair)
+                            self._send(200, frontend._generate_result(
+                                uri, np.asarray(r)), headers=echo)
+                            return
+                    except TimeoutError as e:
+                        pair[0].close()
+                        pair[1].close()
+                        self._send(504, {"error": str(e), "uri": uri},
+                                   headers=echo)
                         return
-                    if not stream:
-                        r = outq.query(uri, timeout=frontend.timeout)
-                        if r is None:
-                            raise TimeoutError(
-                                f"result for {uri} not ready within "
-                                f"{frontend.timeout}s")
-                        frontend._release(pair)
-                        self._send(200, frontend._generate_result(
-                            uri, np.asarray(r)))
+                    except Exception as e:
+                        pair[0].close()
+                        pair[1].close()
+                        self._send(502,
+                                   {"error": f"{type(e).__name__}: {e}",
+                                    "uri": uri}, headers=echo)
                         return
-                except TimeoutError as e:
-                    pair[0].close()
-                    pair[1].close()
-                    self._send(504, {"error": str(e), "uri": uri})
-                    return
-                except Exception as e:
-                    pair[0].close()
-                    pair[1].close()
-                    self._send(502, {"error": f"{type(e).__name__}: {e}",
-                                     "uri": uri})
-                    return
-                finally:
-                    frontend.latency.record(time.perf_counter() - t0)
-                self._stream_sse(pair, uri)
+                    finally:
+                        frontend.latency.record(time.perf_counter() - t0)
+                    self._stream_sse(pair, uri)
 
             def _stream_sse(self, pair, uri):
                 """Tail the request's token stream onto the socket as
@@ -372,6 +413,7 @@ class HttpFrontend:
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                self.send_header("X-Request-Id", uri)
                 self.end_headers()
                 self.close_connection = True
                 clean = False
@@ -609,6 +651,17 @@ class HttpFrontend:
             "backpressure": not accepting,
             "engine": self.serving.mode_flags(),
         })
+        wd = getattr(self.serving, "watchdog", None)
+        if wd is not None:
+            # the routing view of the SLO score: per-class goodput and
+            # total breach counts (full detail lives at /debug/flight)
+            st = wd.status()["per_class"]
+            out["slo"] = {
+                "goodput": {c: round(s["goodput"], 4)
+                            for c, s in st.items()},
+                "breaches": {c: sum(s["breaches"].values())
+                             for c, s in st.items()},
+            }
         if not accepting:
             out["retry_after_s"] = self._retry_after(depth)
         return out
@@ -689,3 +742,26 @@ class HttpFrontend:
                      "telemetry", None) \
             or getattr(self.serving, "telemetry", None)
         return tm.dump_trace() if tm is not None else None
+
+    def debug_flight(self, last: int = 100) -> Optional[dict]:
+        """``GET /debug/flight``: the live view of what a diagnostic
+        bundle would capture — the flight ring's newest ``last`` tick
+        records, the SLO watchdog's status, and the anomaly-bundle
+        history.  None without an attached serving job."""
+        if self.serving is None:
+            return None
+        fl = getattr(getattr(self.serving, "engine", None),
+                     "flight", None) \
+            or getattr(self.serving, "flight", None)
+        out = {
+            "capacity": fl.capacity if fl is not None else 0,
+            "n_retained": len(fl) if fl is not None else 0,
+            "ticks": fl.snapshot(last=last) if fl is not None else [],
+        }
+        wd = getattr(self.serving, "watchdog", None)
+        if wd is not None:
+            out["slo"] = wd.status()
+        an = getattr(self.serving, "anomalies", None)
+        if an is not None:
+            out["anomalies"] = an.history()
+        return out

@@ -200,7 +200,12 @@ class BlockPool:
         else:
             self.alloc_failures += 1
             if self.event_cb is not None:
-                self.event_cb("alloc_failure", tenant=self.name)
+                # every block is referenced — stamp who holds them so a
+                # flight-ring/timeline reader sees the dry pool's shape
+                # without a separate scrape
+                self.event_cb("alloc_failure", tenant=self.name,
+                              referenced=len(self._ref),
+                              n_blocks=self.n_blocks)
             return None
         self._ref[blk] = 1
         return blk

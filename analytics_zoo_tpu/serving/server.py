@@ -20,13 +20,20 @@ import collections
 import dataclasses
 import threading
 import time
+import traceback
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from analytics_zoo_tpu.common.log import logger
 from analytics_zoo_tpu.learn.inference_model import InferenceModel
-from analytics_zoo_tpu.serving.frontdoor import (QosPolicy, TokenEmitter,
+from analytics_zoo_tpu.serving.flight import (SLO_METRICS, AnomalyMonitor,
+                                              FlightRecorder, SloPolicy,
+                                              SloWatchdog, dump_bundle,
+                                              install_flight_logging,
+                                              prune_bundles)
+from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
+                                                 TokenEmitter,
                                                  decode_priority,
                                                  decode_str_field)
 from analytics_zoo_tpu.serving.queues import (
@@ -116,6 +123,36 @@ class ServingConfig:
     # bounded admission: the HTTP frontend's InputQueues reject past
     # this backlog with 429 + Retry-After (0 disables the cap)
     max_backlog: int = 10000
+    # SLO watchdog (serving/flight.py): per-priority-class latency
+    # targets, seconds.  A finished request is GOOD when none of its
+    # queue-wait / TTFT / mean-TPOT exceeded its class target;
+    # zoo_slo_* gauges and breach counters keep the score.  A target
+    # of 0 disables that dimension for that class.
+    slo_ttft_s_interactive: float = 1.0
+    slo_ttft_s_standard: float = 2.5
+    slo_ttft_s_batch: float = 10.0
+    slo_tpot_s_interactive: float = 0.25
+    slo_tpot_s_standard: float = 0.5
+    slo_tpot_s_batch: float = 2.0
+    slo_queue_wait_s_interactive: float = 0.5
+    slo_queue_wait_s_standard: float = 2.0
+    slo_queue_wait_s_batch: float = 30.0
+    # flight recorder: per-tick snapshots retained for diagnostic
+    # bundles and GET /debug/flight (0 disables the recorder)
+    flight_capacity: int = 2048
+    # anomaly-triggered diagnostic bundles (docs/debugging.md): where
+    # they land, how often at most, how many survive pruning
+    diag_dir: str = "diagnostics"
+    diag_min_interval_s: float = 30.0
+    diag_max_bundles: int = 8
+    # triggers: >= anomaly_breach_burst SLO breaches inside
+    # anomaly_breach_window_s; >= anomaly_alloc_streak consecutive
+    # ticks with a block-pool allocation failure; any compile after
+    # the first anomaly_steady_ticks ticks (0 disables a trigger)
+    anomaly_breach_burst: int = 8
+    anomaly_breach_window_s: float = 10.0
+    anomaly_alloc_streak: int = 8
+    anomaly_steady_ticks: int = 500
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -188,7 +225,28 @@ class ServingConfig:
             cfg.qos_aging_s = float(params["qos_aging_s"])
         if "max_backlog" in params:
             cfg.max_backlog = int(params["max_backlog"])
+        for cls in PRIORITIES:
+            for dim in SLO_METRICS:
+                key = f"slo_{dim}_s_{cls}"
+                if key in params:
+                    setattr(cfg, key, float(params[key]))
+        for key, cast in (("flight_capacity", int), ("diag_dir", str),
+                          ("diag_min_interval_s", float),
+                          ("diag_max_bundles", int),
+                          ("anomaly_breach_burst", int),
+                          ("anomaly_breach_window_s", float),
+                          ("anomaly_alloc_streak", int),
+                          ("anomaly_steady_ticks", int)):
+            if key in params:
+                setattr(cfg, key, cast(params[key]))
         return cfg
+
+    def slo_policy(self) -> SloPolicy:
+        """The per-class target table the ``slo_*`` knobs resolve to."""
+        return SloPolicy(targets={
+            cls: {dim: float(getattr(self, f"slo_{dim}_s_{cls}"))
+                  for dim in SLO_METRICS}
+            for cls in PRIORITIES})
 
 
 class ClusterServing:
@@ -241,6 +299,25 @@ class ClusterServing:
         # spans with serving-side terminal events (abandonment)
         self.telemetry = Telemetry()
         self._register_serving_gauges()
+        # ---- incident pillar (serving/flight.py) -----------------------
+        # SLO watchdog fed from the shared telemetry's request hooks;
+        # its zoo_slo_* families land in the same registry a /metrics
+        # scrape merges.  The flight recorder is created HERE (not by
+        # the engine) so a crash bundle can still ship the ring after
+        # the engine is gone; start() hands it to the engine.
+        self.watchdog = SloWatchdog(self.config.slo_policy(),
+                                    registry=self.telemetry.metrics)
+        self.telemetry.watchdog = self.watchdog
+        self.flight = (FlightRecorder(self.config.flight_capacity)
+                       if self.config.flight_capacity > 0 else None)
+        self.log_ring = install_flight_logging()
+        self.anomalies = AnomalyMonitor(
+            self._dump_bundle,
+            min_interval_s=self.config.diag_min_interval_s,
+            breach_burst=self.config.anomaly_breach_burst,
+            breach_window_s=self.config.anomaly_breach_window_s,
+            alloc_streak=self.config.anomaly_alloc_streak,
+            steady_after_ticks=self.config.anomaly_steady_ticks)
         self._img_resize = None
         from concurrent.futures import ThreadPoolExecutor
         import os as _os
@@ -392,7 +469,9 @@ class ClusterServing:
                 tick_token_budget=self.config.engine_tick_token_budget,
                 speculation_k=self.config.engine_speculation_k,
                 telemetry=self.telemetry,
-                qos=qos)
+                qos=qos,
+                flight=self.flight,
+                flight_capacity=self.config.flight_capacity)
             t = threading.Thread(target=self._loop_continuous,
                                  args=("w0",), daemon=True,
                                  name="zoo-serving-cb")
@@ -750,10 +829,41 @@ class ClusterServing:
                     # serving (admission of new work may still succeed;
                     # a persistent fault keeps logging loudly).
                     logger.exception("continuous engine step failed")
+                    # the flight ring holds the ticks leading here —
+                    # exactly what a post-mortem needs; dump now (rate-
+                    # limited, failure-isolated) while the state is hot
+                    self.anomalies.crash(traceback.format_exc())
                     time.sleep(0.2)
+                else:
+                    self._diag_poll(engine)
                 self._flush_emitter(client, emitter)
         finally:
             client.close()
+
+    def _diag_poll(self, engine) -> None:
+        """One cheap anomaly check per pump iteration: three counter
+        reads and a deque scan — the monitor only gets expensive when
+        it actually triggers a bundle."""
+        self.anomalies.poll(
+            alloc_fail_streak=engine.alloc_fail_streak,
+            ticks=self.telemetry.c_ticks.value,
+            compiles=(self.telemetry.c_jit_builds.value
+                      + self.telemetry.c_retraces.value),
+            watchdog=self.watchdog)
+
+    def _dump_bundle(self, reason: str, detail: dict) -> str:
+        """AnomalyMonitor's dump callback: one self-contained bundle
+        directory under ``diag_dir`` (docs/debugging.md), then prune
+        to ``diag_max_bundles``."""
+        path = dump_bundle(
+            self.config.diag_dir, reason=reason, detail=detail,
+            flight=self.flight, telemetries=(self.telemetry,),
+            config=dataclasses.asdict(self.config),
+            logs=self.log_ring.snapshot(),
+            slo=self.watchdog.status())
+        prune_bundles(self.config.diag_dir,
+                      max(1, self.config.diag_max_bundles))
+        return path
 
     def _flush_emitter(self, client: RespClient,
                        emitter: TokenEmitter) -> None:

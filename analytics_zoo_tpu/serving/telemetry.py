@@ -408,7 +408,7 @@ class _Clock:
     """Host-side per-request lifecycle state (plain floats)."""
 
     __slots__ = ("arrival", "admitted", "first_token", "last_token",
-                 "n_tokens")
+                 "n_tokens", "priority")
 
     def __init__(self, arrival: float):
         self.arrival = arrival
@@ -416,6 +416,10 @@ class _Clock:
         self.first_token: Optional[float] = None
         self.last_token: Optional[float] = None
         self.n_tokens = 0
+        # priority class, learned at admission — the SLO watchdog needs
+        # it at first-token and finish time, where the engine no longer
+        # passes it
+        self.priority: Optional[str] = None
 
 
 class Telemetry:
@@ -441,6 +445,11 @@ class Telemetry:
         self._stamps: Dict[str, dict] = {}
         self._clocks: Dict[str, _Clock] = {}
         self._lock = threading.Lock()
+        # Optional SloWatchdog (serving/flight.py) fed from the request
+        # hooks below — it sees the SAME stamps the histograms and
+        # spans record, so SLO judgements and percentiles agree by
+        # construction.  None when nobody attached one.
+        self.watchdog = None
         p = prefix
         m = self.metrics
         self.c_submitted = m.counter(
@@ -533,12 +542,17 @@ class Telemetry:
             if ck is None:      # engine driven without submit telemetry
                 ck = self._clocks[uri] = _Clock(now)
             ck.admitted = now
+            if priority is not None:
+                ck.priority = priority
         self.h_queue_wait.record(now - ck.arrival)
         if priority is not None:
             h = self.h_queue_wait_cls.get(priority)
             if h is not None:
                 h.record(now - ck.arrival)
                 self.c_class_grants[priority].inc()
+        if self.watchdog is not None:
+            self.watchdog.observe_queue_wait(ck.priority, now - ck.arrival,
+                                             uri)
         self.events.span("queue_wait", ck.arrival, now - ck.arrival,
                          EventLog.TID_QUEUE, {"uri": uri})
         self.events.instant(
@@ -568,6 +582,9 @@ class Telemetry:
             self.h_ttft.record(now - ck.arrival)
             self.events.instant("first_token", now, slot,
                                 {"uri": uri})
+            if self.watchdog is not None:
+                self.watchdog.observe_ttft(ck.priority, now - ck.arrival,
+                                           uri)
         else:
             self.h_tpot.record(gap)
 
@@ -577,6 +594,14 @@ class Telemetry:
         with self._lock:
             ck = self._clocks.pop(uri, None)
         self.c_finished.inc()
+        if self.watchdog is not None:
+            # mean inter-token gap over the whole response — the SLO
+            # view of TPOT (a single-token response has no gap)
+            tpot = None
+            if ck and ck.first_token is not None and ck.n_tokens > 1:
+                tpot = (ck.last_token - ck.first_token) / (ck.n_tokens - 1)
+            self.watchdog.observe_finish(ck.priority if ck else None,
+                                         uri, tpot)
         start = ck.admitted if ck and ck.admitted is not None else now
         self.events.span(
             "request", start, now - start, slot,
@@ -610,6 +635,8 @@ class Telemetry:
     def req_errored(self, uri: str, exc: Optional[str] = None) -> None:
         with self._lock:
             self._clocks.pop(uri, None)
+        if self.watchdog is not None:
+            self.watchdog.drop(uri)
         self.c_errored.inc()
         self.events.instant("request_error", None, EventLog.TID_QUEUE,
                             {"uri": uri, "error": exc or ""})
@@ -634,6 +661,8 @@ class Telemetry:
             "zoo_serving_requests_cancelled_total",
             "requests aborted by live cancellation (explicit cancel "
             "or mid-stream disconnect)").inc()
+        if self.watchdog is not None:
+            self.watchdog.drop(uri)
         self.events.instant("request_cancelled", None,
                             EventLog.TID_QUEUE, {"uri": uri})
 

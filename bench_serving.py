@@ -13,6 +13,7 @@ Prints one JSON line per scenario and writes SERVING_BENCH.json.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 
@@ -1458,6 +1459,154 @@ def _smoke_frontdoor():
     print("FRONTDOOR_OK")
 
 
+def _smoke_flight():
+    """serve-smoke flight-recorder overhead leg (docs/debugging.md):
+    the recorder is ALWAYS ON in production, so its cost must be noise.
+    One paged+chunked engine, alternating reps with the ring attached
+    vs detached (``engine.flight = None`` is the disable lever), best
+    ticks/sec per mode — asserts the recorder costs < 2% and prints the
+    comparison column."""
+    import jax
+
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import ContinuousEngine
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    eng = ContinuousEngine(model, variables, max_new_tokens=32,
+                           max_slots=4, prompt_buckets=(16,),
+                           paged=True, block_size=8, chunked=True,
+                           tick_token_budget=32)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 8192, 12).astype(np.int32)
+               for _ in range(16)]
+    recorder = eng.flight
+    assert recorder is not None
+    seq = iter(range(10 ** 6))
+
+    def rep() -> float:
+        t0 = eng.telemetry.c_ticks.value
+        start = time.monotonic()
+        for p in prompts:
+            eng.submit(f"fl{next(seq)}", p)
+        eng.drain()
+        dur = time.monotonic() - start
+        return (eng.telemetry.c_ticks.value - t0) / dur
+
+    rep()                                   # warm the jit caches
+    best = {"on": 0.0, "off": 0.0}
+    for _ in range(5):                      # alternate to decorrelate
+        eng.flight = recorder
+        best["on"] = max(best["on"], rep())
+        eng.flight = None
+        best["off"] = max(best["off"], rep())
+    eng.flight = recorder
+    overhead = max(0.0, 1.0 - best["on"] / best["off"])
+    print(f"flight recorder overhead: on={best['on']:.1f} ticks/s "
+          f"off={best['off']:.1f} ticks/s overhead={overhead * 100:.2f}%")
+    assert overhead < 0.02, (best, overhead)
+    assert len(recorder) > 0, "recorder captured no ticks"
+    print("FLIGHT_OK")
+
+
+def _smoke_anomaly():
+    """serve-smoke anomaly leg (docs/debugging.md): a live spec+paged+
+    chunked ``ClusterServing`` stack given a block pool far too small
+    for its concurrency, so every tick fights the allocator — the
+    alloc-failure streak must fire the ``AnomalyMonitor``, the bundle
+    on disk must hold the triggering ticks in its flight ring, and the
+    stdlib debug CLI must render it (including one affected request's
+    history by uri) with exit code 0."""
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, InputQueue, OutputQueue, ServingConfig)
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    im = InferenceModel(batch_buckets=(1, 4))
+    im.load_flax_generator(model, variables, max_new_tokens=12,
+                           prompt_buckets=(16,),
+                           draft_model=model, draft_variables=variables)
+    diag_dir = tempfile.mkdtemp(prefix="zoo-diag-")
+    # 10 blocks of 4 at ~6 blocks/request: concurrency > pool, so
+    # growth preempts + the allocator fails on consecutive ticks.  The
+    # SLO/retrace triggers are pushed out of reach so the one bundle is
+    # unambiguously the alloc streak.
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=4, engine_paged=True,
+                        engine_block_size=4, engine_blocks=10,
+                        engine_chunked=True, engine_speculation_k=2,
+                        diag_dir=diag_dir, diag_min_interval_s=0.0,
+                        anomaly_alloc_streak=3,
+                        anomaly_breach_burst=10 ** 9,
+                        anomaly_steady_ticks=10 ** 9)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    inq = InputQueue(port=serving.port)
+    outq = OutputQueue(port=serving.port)
+    rng = np.random.default_rng(5)
+    try:
+        for i in range(6):
+            inq.enqueue(f"an{i}", tokens=rng.integers(
+                1, 8192, 12).astype(np.int32))
+        # earliest admissions keep forward progress, so the contended
+        # pool still finishes every request — after the streak fired
+        for i in range(6):
+            assert outq.query(f"an{i}", timeout=600) is not None, i
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not serving.anomalies.bundles:
+            time.sleep(0.05)
+        hist = serving.anomalies.history()
+        assert hist, "no bundle despite a starved block pool"
+        assert hist[0]["reason"] == "alloc_failure_streak", hist
+        bundle = hist[0]["path"]
+        assert bundle and os.path.isdir(bundle), hist
+    finally:
+        inq.close()
+        outq.close()
+        serving.stop()
+    try:
+        with open(os.path.join(bundle, "flight.json")) as f:
+            flight = json.load(f)
+        streaks = [t.get("alloc_fail_streak", 0) for t in flight["ticks"]]
+        assert max(streaks) >= 3, streaks
+        assert any(t.get("alloc_failures", 0) > 0
+                   for t in flight["ticks"]), flight["ticks"][-3:]
+        # the debug CLI renders the bundle — and one affected request's
+        # history by its uri — from a bare python, rc 0
+        proc = subprocess.run(
+            [_sys.executable, "-m", "analytics_zoo_tpu.serving.debug",
+             bundle], capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "tick timeline" in proc.stdout, proc.stdout
+        with open(os.path.join(bundle, "trace.json")) as f:
+            trace = json.load(f)
+        uris = {e.get("args", {}).get("uri")
+                for e in trace.get("traceEvents", [])}
+        uri = next(u for u in sorted(u for u in uris if u)
+                   if u.startswith("an"))
+        proc = subprocess.run(
+            [_sys.executable, "-m", "analytics_zoo_tpu.serving.debug",
+             bundle, "--uri", uri], capture_output=True, text=True,
+            timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert uri in proc.stdout, proc.stdout
+    finally:
+        shutil.rmtree(diag_dir, ignore_errors=True)
+    print("ANOMALY_OK")
+
+
 def _smoke():
     """``python bench_serving.py --smoke``: the `make serve-smoke` e2e
     leg — 20 requests through the full wire protocol on the PAGED
@@ -1467,7 +1616,10 @@ def _smoke():
     prefix cache actually hit, cache columns present, the engine's
     always-on TTFT/TPOT histograms flowing — then the observability
     surfaces (/healthz, Prometheus /metrics, /trace) on a live stack
-    via ``_smoke_scrape``."""
+    via ``_smoke_scrape``, the front-door wire contracts via
+    ``_smoke_frontdoor``, the flight-recorder overhead bound via
+    ``_smoke_flight``, and the anomaly-to-bundle-to-CLI path via
+    ``_smoke_anomaly``."""
     r = run_poisson_scenario(True, rate_per_s=20.0, n_requests=20,
                              slots=4, prefix_mode="full", paged=True,
                              chunked=True)
@@ -1480,6 +1632,8 @@ def _smoke():
     assert r["tpot_p50_ms"] is not None, r
     _smoke_scrape()
     _smoke_frontdoor()
+    _smoke_flight()
+    _smoke_anomaly()
     print("SMOKE_OK")
 
 
