@@ -56,7 +56,7 @@ serve-smoke:
 	    tests/test_chunked_prefill.py tests/test_telemetry.py \
 	    tests/test_frontdoor.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_composed.py \
-	    tests/test_flight.py -q
+	    tests/test_flight.py tests/test_paged_fused.py -q
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
 
 clean:

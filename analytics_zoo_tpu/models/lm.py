@@ -304,6 +304,13 @@ def _apply_rope(x, pos, base: float):
     return out.astype(x.dtype)
 
 
+def _stack_kv(xs):
+    """``jnp.stack`` over per-layer KV pools that also works for the
+    quantized pools (``ops.flash_attention.QuantKV`` pytrees): every
+    leaf (data, scale) is stacked along a new leading layers axis."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *xs)
+
+
 class DecoderAttention(nn.Module):
     """Causal self-attention with a training path and a cached decode path
     sharing the same projections (setup-style module).
@@ -482,22 +489,26 @@ class DecoderAttention(nn.Module):
         o = o.reshape(B, S, self._h, self._d)
         return self.attn_out(o.astype(self.dtype)), cache_k, cache_v
 
-    def decode_paged(self, xs, pool_k, pool_v, tables, pos, limit=None):
+    def decode_paged(self, xs, pool_k, pool_v, tables, pos, limit=None,
+                     kernel="gather"):
         """Cached decode of S tokens per row against a PAGED KV cache.
 
         Same contract as :meth:`decode_k` except the cache is one flat
-        block pool shared by every resident: pool_k/pool_v ``[N, bs,
-        KH, D]``, tables ``[B, M]`` int32 mapping row b's logical block
-        j to a physical pool block (the serving BlockPool keeps
-        unallocated table entries pointed at the sink block 0).  xs:
-        [B, S, E]; pos: [B] int32, row b's tokens occupy logical
-        positions pos[b]..pos[b]+S-1.  S=1 is the plain decode step;
-        S>1 is the block-causal prefill/verify forward.  Returns (ys
-        [B, S, E], pool_k, pool_v) with the S new K/V rows scattered
-        through the tables (write precedes the attention read, so each
-        token attends itself).  ``limit`` ([B] int32, optional) drops
-        writes at positions >= limit[b] — chunked prefill's padding
-        guard (see ops.flash_attention.paged_kv_update).
+        head-major block pool shared by every resident: pool_k/pool_v
+        ``[N, KH, bs, D]`` (or QuantKV int8 pools of that geometry),
+        tables ``[B, M]`` int32 mapping row b's logical block j to a
+        physical pool block (the serving BlockPool keeps unallocated
+        table entries pointed at the sink block 0).  xs: [B, S, E];
+        pos: [B] int32, row b's tokens occupy logical positions
+        pos[b]..pos[b]+S-1.  S=1 is the plain decode step; S>1 is the
+        block-causal prefill/verify forward.  Returns (ys [B, S, E],
+        pool_k, pool_v) with the S new K/V rows scattered through the
+        tables (write precedes the attention read, so each token
+        attends itself).  ``limit`` ([B] int32, optional) drops writes
+        at positions >= limit[b] — chunked prefill's padding guard (see
+        ops.flash_attention.paged_kv_update).  ``kernel`` selects the
+        attention read path (``"gather"`` fallback or the ``"fused"``
+        Pallas kernel — ops.flash_attention.paged_attention).
         """
         from analytics_zoo_tpu.ops.flash_attention import (
             paged_attention, paged_kv_update)
@@ -511,7 +522,8 @@ class DecoderAttention(nn.Module):
             ks = _apply_rope(ks, p, self.rope_base)
         pool_k, pool_v = paged_kv_update(pool_k, pool_v, tables, pos,
                                          ks, vs, limit=limit)
-        o = paged_attention(q, pool_k, pool_v, tables, pos)
+        o = paged_attention(q, pool_k, pool_v, tables, pos,
+                            kernel=kernel)
         return self.attn_out(o.astype(self.dtype)), pool_k, pool_v
 
 
@@ -616,10 +628,11 @@ class DecoderLayer(nn.Module):
         xs = xs + self._mlp(self.ln_ffn(xs).astype(self.dtype), False)
         return xs, ck, cv
 
-    def decode_paged(self, xs, pool_k, pool_v, tables, pos, limit=None):
+    def decode_paged(self, xs, pool_k, pool_v, tables, pos, limit=None,
+                     kernel="gather"):
         a, pk, pv = self.attention.decode_paged(
             self.ln_attn(xs).astype(self.dtype), pool_k, pool_v,
-            tables, pos, limit=limit)
+            tables, pos, limit=limit, kernel=kernel)
         xs = xs + a
         xs = xs + self._mlp(self.ln_ffn(xs).astype(self.dtype), False)
         return xs, pk, pv
@@ -922,17 +935,20 @@ class TransformerLM(nn.Module):
             vs.append(cv)
         return self.ln_f(x), jnp.stack(ks), jnp.stack(vs)
 
-    def decode_step_paged(self, tok, pools_k, pools_v, tables, pos):
+    def decode_step_paged(self, tok, pools_k, pools_v, tables, pos,
+                          kernel="gather"):
         """One cached decode step against a PAGED KV cache.
 
-        tok: [B] current tokens; pools_k/v: [n_layers, N, bs, kv_heads,
-        D] — ONE flat block pool per layer shared by all residents;
+        tok: [B] current tokens; pools_k/v: [n_layers, N, kv_heads, bs,
+        D] (plain arrays or ops.flash_attention.QuantKV int8 pools) —
+        ONE flat block pool per layer shared by all residents;
         tables: [B, M] int32 per-row block tables (logical block j ->
         physical pool block); pos: [B] int32 per-row positions.
         Returns (logits [B, V], pools_k, pools_v) with each row's new
         K/V written through its table at position pos[b] — attention
         reads only logical positions <= pos[b], so garbage in
-        unwritten/sink blocks is never attended.
+        unwritten/sink blocks is never attended.  ``kernel`` picks the
+        gather fallback or the fused Pallas paged-attention kernel.
         """
         if self.pp_stages > 0:
             raise NotImplementedError(
@@ -946,13 +962,14 @@ class TransformerLM(nn.Module):
         ks, vs = [], []
         for i, layer in enumerate(self.layers):
             x, pk, pv = layer.decode_paged(x, pools_k[i], pools_v[i],
-                                           tables, pos)
+                                           tables, pos, kernel=kernel)
             ks.append(pk)
             vs.append(pv)
         logits = self._logits(self.ln_f(x))[:, 0]
-        return logits, jnp.stack(ks), jnp.stack(vs)
+        return logits, _stack_kv(ks), _stack_kv(vs)
 
-    def verify_step_paged(self, toks, pools_k, pools_v, tables, pos):
+    def verify_step_paged(self, toks, pools_k, pools_v, tables, pos,
+                          kernel="gather"):
         """``verify_step`` against a paged cache: S tokens per row in one
         block-causal forward, K/V scattered through the block tables.
         Returns (logits [B, S, V], pools_k, pools_v).
@@ -965,11 +982,11 @@ class TransformerLM(nn.Module):
         costs zero block copies (ops/flash_attention.paged_kv_update
         documents the write/clamp contract)."""
         h, pk, pv = self.verify_hidden_paged(toks, pools_k, pools_v,
-                                             tables, pos)
+                                             tables, pos, kernel=kernel)
         return self._logits(h), pk, pv
 
     def verify_hidden_paged(self, toks, pools_k, pools_v, tables, pos,
-                            limit=None):
+                            limit=None, kernel="gather"):
         """``verify_step_paged`` minus the vocab head: (hidden [B, S,
         H], pools).  The paged-admission prefill consumes ONE position
         per row, gathers that hidden state, and applies the head to
@@ -990,10 +1007,11 @@ class TransformerLM(nn.Module):
         ks, vs = [], []
         for i, layer in enumerate(self.layers):
             x, pk, pv = layer.decode_paged(x, pools_k[i], pools_v[i],
-                                           tables, pos, limit=limit)
+                                           tables, pos, limit=limit,
+                                           kernel=kernel)
             ks.append(pk)
             vs.append(pv)
-        return self.ln_f(x), jnp.stack(ks), jnp.stack(vs)
+        return self.ln_f(x), _stack_kv(ks), _stack_kv(vs)
 
     def prefill_chunk(self, toks, caches_k, caches_v, pos, lens):
         """One CHUNKED-PREFILL step against the slot-arena cache: run a
@@ -1021,7 +1039,7 @@ class TransformerLM(nn.Module):
         return self._logits(last_h)[:, 0], ck, cv
 
     def prefill_chunk_paged(self, toks, pools_k, pools_v, tables, pos,
-                            lens):
+                            lens, kernel="gather"):
         """The paged twin of :meth:`prefill_chunk`: the chunk's K/V
         scatter through per-row block tables into the shared pool, with
         writes LIMITED to ``pos + lens`` — padding columns write
@@ -1031,7 +1049,8 @@ class TransformerLM(nn.Module):
         prompt's unshared suffix IS its one big chunk."""
         h, pk, pv = self.verify_hidden_paged(toks, pools_k, pools_v,
                                              tables, pos,
-                                             limit=pos + lens)
+                                             limit=pos + lens,
+                                             kernel=kernel)
         last_h = jnp.take_along_axis(h, (lens - 1)[:, None, None],
                                      axis=1)
         return self._logits(last_h)[:, 0], pk, pv

@@ -400,28 +400,124 @@ def sharded_flash_attention(q, k, v, mesh, kv_mask=None, *,
 
 # ---------------------------------------------------------------------------
 # paged attention (block-pool KV cache, serving/paged_cache.py)
+#
+# Pool layout is HEAD-MAJOR: ``[N, KH, bs, D]`` (physical block, kv
+# head, position-in-block, head dim).  The fused kernel streams one
+# (block, head) tile per grid step, so the minor-most two dims of its
+# K/V BlockSpec must be the Mosaic-tiled ``(bs, D)`` pair — the same
+# page layout jax's production TPU paged-attention kernel uses.  The
+# gather fallback and the scatter below address the identical storage.
 # ---------------------------------------------------------------------------
+
+KV_SCALE_DTYPE = jnp.bfloat16   # per-(block, position, head) int8 scales
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantKV:
+    """int8 KV block arena + per-(block, position, kv-head) scales.
+
+    ``data``: int8 ``[..., N, KH, bs, D]`` (leading dims free — the
+    engine stacks a layers axis in front); ``scale``: ``data.shape[:-1]``
+    in :data:`KV_SCALE_DTYPE`.  One scale per stored K/V row (amax over
+    D / 127) keeps the scatter in :func:`paged_kv_update` local — a
+    write never has to re-read or re-scale the rest of its block — and
+    at bf16 scales the storage cost is ``D + 2`` bytes per row vs
+    ``2*D`` for bf16 K/V: ~1.94x the blocks at equal HBM for D=64.
+
+    Registered as a pytree so it threads OPAQUELY through jit / scan /
+    donate_argnums / ``flax.apply`` exactly like the plain array pool it
+    replaces; ``__getitem__`` mirrors the per-layer ``pools[i]``
+    indexing the model's decode loop does.
+    """
+
+    __slots__ = ("data", "scale")
+
+    def __init__(self, data, scale):
+        self.data, self.scale = data, scale
+
+    def tree_flatten(self):
+        return (self.data, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __getitem__(self, idx):
+        return QuantKV(self.data[idx], self.scale[idx])
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+
+def quantize_kv(x, scale_dtype=KV_SCALE_DTYPE):
+    """Symmetric per-row int8 quantization over the LAST axis.
+
+    Returns ``(q int8 x.shape, scale scale_dtype x.shape[:-1])`` with
+    ``x ~= q * scale``.  The scale is rounded to its STORAGE dtype
+    before the divide, so :func:`dequantize_kv` reproduces exactly what
+    any reader of the stored (data, scale) pair computes — round-trip
+    error is pure integer rounding, identical for the gather fallback
+    and the fused kernel.  All-zero rows quantize to (0, scale 1)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(scale_dtype)
+    sf = scale.astype(jnp.float32)[..., None]
+    q = jnp.clip(jnp.round(xf / sf), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(data, scale):
+    """Inverse of :func:`quantize_kv`: f32 ``data * scale[..., None]``."""
+    return data.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+
+
+def _paged_scatter_index(tables, pos, S, bs, N, limit):
+    """(physical block, offset) per written position, drop-encoded.
+
+    Logical position p of row b maps to (``tables[b, p // bs]``,
+    ``p % bs``); block indices past the table width clamp to the last
+    column (the allocator keeps unallocated entries at the sink block),
+    and positions ``>= limit[b]`` get the out-of-range block id N so a
+    ``mode="drop"`` scatter skips them outright."""
+    B = pos.shape[0]
+    M = tables.shape[1]
+    p = pos[:, None] + jnp.arange(S)[None, :]               # [B, S]
+    blk = jnp.minimum(p // bs, M - 1)
+    phys = jnp.take_along_axis(tables, blk, axis=1)         # [B, S]
+    if limit is not None:
+        # out-of-range index + mode="drop" = the write never happens
+        phys = jnp.where(p < limit[:, None], phys, N)
+    return phys, p % bs
+
 
 def paged_kv_update(pool_k, pool_v, tables, pos, new_k, new_v,
                     limit=None):
     """Scatter S new K/V rows per batch row into a block-pool cache.
 
-    pool_k/pool_v: ``[N, bs, KH, D]`` — the flat block arena (N physical
-    blocks of bs token positions each).  tables: ``[B, M]`` int32 — row
-    b's logical block j lives in physical block ``tables[b, j]``.
-    pos: ``[B]`` int32 — row b's tokens land at logical positions
-    ``pos[b] .. pos[b]+S-1``.  new_k/new_v: ``[B, S, KH, D]``.
+    pool_k/pool_v: ``[N, KH, bs, D]`` — the flat head-major block arena
+    (N physical blocks of bs token positions each) — or a
+    :class:`QuantKV` pair of the same geometry, in which case the new
+    rows are QUANTIZED ON WRITE (:func:`quantize_kv`) and both the int8
+    data and the per-row scales scatter through the same index.
+    tables: ``[B, M]`` int32 — row b's logical block j lives in
+    physical block ``tables[b, j]``.  pos: ``[B]`` int32 — row b's
+    tokens land at logical positions ``pos[b] .. pos[b]+S-1``.
+    new_k/new_v: ``[B, S, KH, D]``.
 
     Logical position p maps to (physical block ``tables[b, p // bs]``,
-    offset ``p % bs``); the scatter goes through ONE flattened
-    ``[N*bs, KH, D]`` index per tensor — positions whose logical block
-    index exceeds the table width clamp to the last table entry, which
-    the allocator keeps pointed at the sink block for anything
-    unallocated, so overshoot writes land in garbage space instead of a
-    live block.  Distinctness contract (the allocator's invariant, not
-    checked here): every (row, position) a caller actually cares about
-    maps to a PRIVATE tail block of that row, so real writes never
-    collide; sink-block collisions are garbage-on-garbage.
+    offset ``p % bs``); positions whose logical block index exceeds the
+    table width clamp to the last table entry, which the allocator
+    keeps pointed at the sink block for anything unallocated, so
+    overshoot writes land in garbage space instead of a live block.
+    Distinctness contract (the allocator's invariant, not checked
+    here): every (row, position) a caller actually cares about maps to
+    a PRIVATE tail block of that row, so real writes never collide;
+    sink-block collisions are garbage-on-garbage.
 
     Speculative verify rides this same scatter: the engine writes k+1
     positions per row per round (``S = k+1``) and REJECTION IS POINTER
@@ -442,29 +538,180 @@ def paged_kv_update(pool_k, pool_v, tables, pos, new_k, new_v,
     — and corrupt real K/V.  Reads are unaffected; attention masking is
     :func:`paged_attention`'s job.
     """
-    N, bs, KH, D = pool_k.shape
-    B, S = new_k.shape[:2]
+    if isinstance(pool_k, QuantKV):
+        N, KH, bs, D = pool_k.data.shape
+        S = new_k.shape[1]
+        phys, off = _paged_scatter_index(tables, pos, S, bs, N, limit)
+        qk, sk = quantize_kv(new_k, pool_k.scale.dtype)
+        qv, sv = quantize_kv(new_v, pool_v.scale.dtype)
+        # advanced indices (phys, off) straddle the KH slice, so the
+        # indexed dims lead the result: [B, S, KH, D] — new_k's own
+        # layout, no transpose needed.  Same for the [B, S, KH] scales.
+        pk = QuantKV(
+            pool_k.data.at[phys, :, off].set(qk, mode="drop"),
+            pool_k.scale.at[phys, :, off].set(sk, mode="drop"))
+        pv = QuantKV(
+            pool_v.data.at[phys, :, off].set(qv, mode="drop"),
+            pool_v.scale.at[phys, :, off].set(sv, mode="drop"))
+        return pk, pv
+    N, KH, bs, D = pool_k.shape
+    S = new_k.shape[1]
+    phys, off = _paged_scatter_index(tables, pos, S, bs, N, limit)
+    pk = pool_k.at[phys, :, off].set(new_k.astype(pool_k.dtype),
+                                     mode="drop")
+    pv = pool_v.at[phys, :, off].set(new_v.astype(pool_v.dtype),
+                                     mode="drop")
+    return pk, pv
+
+
+# ---------------------------------------------------------------------------
+# fused paged-attention kernel
+#
+# Grid (B, KH, M): one program per (batch row, kv head, logical block),
+# the M dimension ``arbitrary`` so online-softmax state carries across
+# it in VMEM scratch while Mosaic pipelines the next block's DMA against
+# compute.  The block-table indirection lives in the K/V BlockSpec
+# index_maps — ``tables``/``pos`` ride as scalar-prefetch operands, so
+# each grid step DMAs exactly ONE [bs, D] tile per tensor straight from
+# the pool in HBM: the [B, M*bs, KH, D] gather is never materialised.
+#
+# Queries are regrouped head-major ([B, KH, S*G, D], row r = s*G + g,
+# padded to 8 sublanes): each program owns ALL G query heads of its KV
+# head, which is what makes grouped-query attention free here.  VMEM
+# per program: q/acc [SGp, D] + m/l columns + one [bs, D] K/V tile each.
+# Masking matches the gather fallback exactly — query s attends logical
+# positions <= pos[b] + s; blocks past the frontier skip compute via
+# pl.when (their table entries point at the sink, so the DMA is
+# harmless), in-block tails mask element-wise to NEG_INF.
+#
+# int8 pools add two [bs]-lane scale operands: k-scales multiply the
+# logits columns post-matmul, v-scales fold into p pre-matmul — both
+# in-register, algebraically identical to dequantizing the tiles.
+# ---------------------------------------------------------------------------
+
+def _paged_fused_kernel(tables_ref, pos_ref, *refs, scale, bs, G, S,
+                        quant):
+    if quant:
+        (q_ref, k_ref, v_ref, sk_ref, sv_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    b, j = pl.program_id(0), pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # block j holds logical positions [j*bs, (j+1)*bs); the furthest
+    # position any query row attends is pos[b] + S - 1
+    @pl.when(j * bs <= pos_ref[b] + (S - 1))
+    def _accumulate():
+        q = q_ref[0, 0]                                # [SGp, D]
+        k = k_ref[0, 0]                                # [bs, D]
+        s = scale * jax.lax.dot_general(               # [SGp, bs] f32
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if quant:
+            s = s * sk_ref[0].astype(jnp.float32)      # [1, bs] bcast
+        rows = acc_ref.shape[0]
+        lpos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, bs), 1)
+        qrow = jax.lax.broadcasted_iota(
+            jnp.int32, (rows, bs), 0) // G             # row r -> s=r//G
+        s = jnp.where(lpos <= pos_ref[b] + qrow, s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # fully-masked row: subtract 0 instead of NEG_INF so
+        # exp(NEG_INF) underflows to 0 (same trick as _fwd_kernel)
+        m_sub = jnp.where(m_new > NEG_INF * 0.5, m_new, 0.0)
+        p = jnp.exp(s - m_sub)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if quant:
+            # fold the v scales into p's columns: (p * sv) @ v_int8
+            # == p @ (v_int8 * sv[:, None]) without a [bs, D] dequant
+            p = p * sv_ref[0].astype(jnp.float32)
+            v = v_ref[0, 0].astype(jnp.float32)
+        else:
+            v = v_ref[0, 0]
+            p = p.astype(v.dtype)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_attention_fused(q, pool_k, pool_v, tables, pos, interpret):
+    B, S, H, D = q.shape
+    quant = isinstance(pool_k, QuantKV)
+    kd = pool_k.data if quant else pool_k
+    vd = pool_v.data if quant else pool_v
+    N, KH, bs, _ = kd.shape
+    if H % KH:
+        raise ValueError(f"query heads {H} not a multiple of KV heads "
+                         f"{KH}")
+    G = H // KH
     M = tables.shape[1]
-    p = pos[:, None] + jnp.arange(S)[None, :]               # [B, S]
-    blk = jnp.minimum(p // bs, M - 1)
-    phys = jnp.take_along_axis(tables, blk, axis=1)         # [B, S]
-    flat_idx = phys * bs + (p % bs)                         # [B, S]
-    if limit is not None:
-        # out-of-range index + mode="drop" = the write never happens
-        flat_idx = jnp.where(p < limit[:, None], flat_idx, N * bs)
-    pk = pool_k.reshape(N * bs, KH, D).at[flat_idx].set(
-        new_k.astype(pool_k.dtype), mode="drop")
-    pv = pool_v.reshape(N * bs, KH, D).at[flat_idx].set(
-        new_v.astype(pool_v.dtype), mode="drop")
-    return pk.reshape(N, bs, KH, D), pv.reshape(N, bs, KH, D)
+    SG = S * G
+    SGp = -(-SG // 8) * 8          # Mosaic sublane multiple
+    # [B, S, H, D] -> [B, KH, S*G, D]: row r of kv head h is query
+    # (s = r // G, head h*G + r % G), padded rows are mask-dead
+    qf = q.reshape(B, S, KH, G, D).transpose(0, 2, 1, 3, 4)
+    qf = _pad_to(qf.reshape(B, KH, SG, D), 8, axis=2)
+    scale = 1.0 / float(np.sqrt(D))
+    kernel = functools.partial(_paged_fused_kernel, scale=scale,
+                               bs=bs, G=G, S=S, quant=quant)
+    in_specs = [
+        pl.BlockSpec((1, 1, SGp, D), lambda b, h, j, t, p: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D),
+                     lambda b, h, j, t, p: (t[b, j], h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D),
+                     lambda b, h, j, t, p: (t[b, j], h, 0, 0)),
+    ]
+    operands = [qf, kd, vd]
+    if quant:
+        sspec = pl.BlockSpec((1, 1, bs),
+                             lambda b, h, j, t, p: (t[b, j], h, 0))
+        in_specs += [sspec, sspec]
+        operands += [pool_k.scale, pool_v.scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KH, M),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, SGp, D),
+                               lambda b, h, j, t, p: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((SGp, D), jnp.float32),
+            pltpu.VMEM((SGp, 1), jnp.float32),
+            pltpu.VMEM((SGp, 1), jnp.float32),
+        ])
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KH, SGp, D), jnp.float32),
+        **_params(interpret, 1),
+    )(tables.astype(jnp.int32), pos.astype(jnp.int32), *operands)
+    out = out[:, :, :SG, :].reshape(B, KH, S, G, D)
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, S, H, D)
 
 
-def paged_attention(q, pool_k, pool_v, tables, pos):
+def paged_attention(q, pool_k, pool_v, tables, pos, *,
+                    kernel: str = "gather",
+                    interpret: Optional[bool] = None):
     """Block-causal attention of S query tokens per row against a PAGED
-    KV cache: keys/values are gathered through per-row block tables from
-    one flat ``[N, bs, KH, D]`` pool, so co-resident sequences share
-    physical blocks (prefix caching) and only occupy the blocks they
-    have actually filled.
+    KV cache: keys/values live behind per-row block tables in one flat
+    head-major ``[N, KH, bs, D]`` pool (or a :class:`QuantKV` int8 pool
+    of the same geometry), so co-resident sequences share physical
+    blocks (prefix caching) and only occupy the blocks they have
+    actually filled.
 
     q: ``[B, S, H, D]`` (already rope'd/scaled upstream conventions —
     this op applies the 1/sqrt(D) scale itself, matching the dense
@@ -474,34 +721,66 @@ def paged_attention(q, pool_k, pool_v, tables, pos):
     call :func:`paged_kv_update` first; write-then-read inside one jit
     is a plain data dependency).  ``KH <= H`` is grouped-query
     attention: q regroups ``[B, S, KH, G, D]`` so each KV head serves
-    its G query heads without materialising expanded K/V.
+    its G query heads without materialising expanded K/V.  Output is
+    f32 (the accumulation dtype) under both kernels.
 
     The table width M is a free parameter: callers may pass a SLICED
     ``[B, M']`` table whose window covers every position ``<= pos[b] +
     S - 1`` they attend — chunked prefill does exactly this so the
-    gather/einsum cost tracks the fill frontier (bucketed for a bounded
+    attention cost tracks the fill frontier (bucketed for a bounded
     compile count), not the max sequence length.
 
-    Implementation is the ``jnp.take``-based fallback — one gather to
-    ``[B, M*bs, KH, D]`` rows then the same masked einsum-softmax the
-    dense decode path runs, f32 accumulation.  The gather costs the
-    bandwidth the attention read pays anyway; a fused Pallas kernel that
-    streams blocks HBM->VMEM without the materialised gather (the
-    flash-kernel structure above with a block-table indirection on the
-    k-grid) is the follow-on once measured to win on real HBM.
+    ``kernel`` selects the implementation; both honor the identical
+    masking/GQA/quantization contract, so greedy decode is
+    token-identical across them:
+
+    - ``"fused"`` — the Pallas TPU kernel above: grid ``(B, KH, M)``
+      with the block dimension ``arbitrary``, block tables as
+      scalar-prefetch operands indirecting the K/V BlockSpecs, one
+      ``[bs, D]`` tile DMA'd HBM->VMEM per grid step, online softmax in
+      VMEM scratch (the dense flash kernel's structure), int8 scales
+      applied in-register.  The decode hot path on TPU.
+    - ``"gather"`` — the ``jnp.take`` fallback: one materialised
+      ``[B, M, KH, bs, D]`` gather (int8 pools dequantize the gathered
+      rows) then the masked einsum-softmax the dense decode path runs,
+      f32 accumulation.  The CPU / interpret-free reference path —
+      tier-1 parity tests pin the fused kernel (in Pallas interpret
+      mode) against it.
+
+    ``interpret`` (fused only): run the kernel in Pallas interpret mode;
+    defaults to True off-TPU, like :func:`flash_attention`.
     """
+    if kernel not in ("gather", "fused"):
+        raise ValueError(f"kernel must be 'gather' or 'fused', got "
+                         f"{kernel!r}")
+    if kernel == "fused":
+        if interpret is None:
+            interpret = _interpret_default()
+        return _paged_attention_fused(q, pool_k, pool_v, tables, pos,
+                                      bool(interpret))
     B, S, H, D = q.shape
-    N, bs, KH, _ = pool_k.shape
+    quant = isinstance(pool_k, QuantKV)
+    N, KH, bs, _ = (pool_k.data if quant else pool_k).shape
     if H % KH:
         raise ValueError(f"query heads {H} not a multiple of KV heads "
                          f"{KH}")
     G = H // KH
     M = tables.shape[1]
     L = M * bs
-    # [B, M] tables -> [B, M*bs(=L), KH, D] gathered rows: logical
-    # position l of row b is pool[tables[b, l // bs], l % bs]
-    cache_k = jnp.take(pool_k, tables, axis=0).reshape(B, L, KH, D)
-    cache_v = jnp.take(pool_v, tables, axis=0).reshape(B, L, KH, D)
+
+    def gathered(pool):
+        # [B, M] tables -> [B, M*bs(=L), KH, D] rows: logical position
+        # l of row b is pool[tables[b, l // bs], :, l % bs]
+        if isinstance(pool, QuantKV):
+            data = jnp.take(pool.data, tables, axis=0)  # [B,M,KH,bs,D]
+            cache = dequantize_kv(data,
+                                  jnp.take(pool.scale, tables, axis=0))
+        else:
+            cache = jnp.take(pool, tables, axis=0)
+        return jnp.moveaxis(cache, 2, 3).reshape(B, L, KH, D)
+
+    cache_k = gathered(pool_k)
+    cache_v = gathered(pool_v)
     p = pos[:, None] + jnp.arange(S)[None, :]               # [B, S]
     mask = (jnp.arange(L)[None, None, :]
             <= p[:, :, None])[:, None, None, :, :]          # [B,1,1,S,L]

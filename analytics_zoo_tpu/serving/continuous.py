@@ -46,15 +46,33 @@ from analytics_zoo_tpu.learn.inference_model import (
 from analytics_zoo_tpu.models.lm import (TransformerLM,
                                          top_p_filter)
 from analytics_zoo_tpu.models.speculative import accept_proposals
+from analytics_zoo_tpu.ops.flash_attention import (KV_SCALE_DTYPE,
+                                                   QuantKV)
 from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
                                                  WeightedWaitQueue)
 from analytics_zoo_tpu.serving.paged_cache import (BlockPool,
                                                    SINK_BLOCK,
+                                                   block_bytes,
                                                    split_block_budget)
 from analytics_zoo_tpu.serving.flight import FlightRecorder
 from analytics_zoo_tpu.serving.telemetry import Telemetry
 
 logger = logging.getLogger("analytics_zoo_tpu")
+
+
+def _zeros_like(x):
+    """``jnp.zeros_like`` that also accepts the quantized KV pools
+    (``QuantKV`` pytrees — int8 data + per-row scales): every leaf is
+    zeroed independently."""
+    return jax.tree_util.tree_map(jnp.zeros_like, x)
+
+
+def _kv_label(dtype) -> str:
+    """Short storage-mode label for a floating cache dtype, matching
+    the ``paged_cache.KV_DTYPE_BYTES`` keys where one exists."""
+    return {"bfloat16": "bf16", "float32": "f32",
+            "float16": "f16", "float64": "f64"}.get(
+        jnp.dtype(dtype).name, jnp.dtype(dtype).name)
 
 
 class _Req(NamedTuple):
@@ -166,6 +184,8 @@ class ContinuousEngine:
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  ticks_per_step: int = 1,
                  cache_dtype=None,
+                 kernel: str = "gather",
+                 kv_dtype: Optional[str] = None,
                  mesh=None, partition_rules=None,
                  draft_model: Optional[TransformerLM] = None,
                  draft_variables=None, speculation_k: int = 4,
@@ -195,7 +215,19 @@ class ContinuousEngine:
         draft's K/V is cheap (per-block bytes scale with its
         layers x kv_heads x head_dim), so equal counts cost little; a
         smaller override is mainly a test lever for draft-pool-dry
-        preemption."""
+        preemption.
+
+        ``kernel`` picks the paged-attention read path:
+        ``"gather"`` (default) is the materialising ``jnp.take``
+        reference, ``"fused"`` the Pallas kernel that streams KV
+        blocks HBM→VMEM per grid step (interpret mode off-TPU, so
+        greedy parity holds on CPU too).  ``kv_dtype`` picks the
+        TARGET pool's storage: ``None`` follows ``cache_dtype``,
+        ``"bf16"`` forces a bfloat16 pool, ``"int8"`` stores
+        quantized blocks with per-row bfloat16 scales (~1.9x more
+        blocks at equal HBM; both kernels dequantize on read).  Both
+        knobs require ``paged=True``; the draft tenant's pool stays
+        in ``cache_dtype`` (it is already small)."""
         if model.pp_stages > 0:
             raise ValueError("continuous batching serves pp_stages=0 "
                              "models (models.lm.unstack_pp_params)")
@@ -297,6 +329,27 @@ class ContinuousEngine:
                     f"{cdtype.name}, which is not a floating dtype — "
                     f"K/V projections cannot be stored in it without "
                     f"corrupting attention")
+        # ---- paged-attention kernel / KV storage knobs -----------------
+        # both only change how the PAGED read/write path runs; default
+        # (gather + cache_dtype storage) is bit-for-bit the pre-knob
+        # behavior.
+        if kernel not in ("gather", "fused"):
+            raise ValueError(f"kernel must be 'gather' or 'fused', got "
+                             f"{kernel!r}")
+        if kv_dtype not in (None, "bf16", "int8"):
+            raise ValueError(f"kv_dtype must be None, 'bf16' or "
+                             f"'int8', got {kv_dtype!r}")
+        if not paged and (kernel != "gather" or kv_dtype is not None):
+            raise ValueError(
+                f"kernel={kernel!r} / kv_dtype={kv_dtype!r} require "
+                f"paged=True: both select the paged-attention path "
+                f"(the arena engine has no block pool to apply them to)")
+        self.kernel = kernel
+        if kv_dtype == "bf16":
+            # explicit storage request wins over cache_dtype/model dtype
+            cdtype = jnp.dtype(jnp.bfloat16)
+        self._kv_int8 = kv_dtype == "int8"
+        self.kv_dtype = "int8" if self._kv_int8 else _kv_label(cdtype)
         self.mesh = mesh
         # ---- paged mode (block-pool cache, serving/paged_cache.py) -----
         self.paged = bool(paged)
@@ -319,8 +372,15 @@ class ContinuousEngine:
             if bs < 1:
                 raise ValueError(f"block_size must be >= 1, got {bs}")
             M = -(-L // bs)         # logical blocks per row, ceil(L/bs)
-            per_block = 2 * model.num_layers * bs * H * D \
-                * cdtype.itemsize
+            # int8 rows cost D + 2 bytes (1/elt + a bf16 scale) vs
+            # 2D for bf16 — block_bytes() is the shared ledger the
+            # budget split, capacity report, and bench all bill at
+            if self._kv_int8:
+                per_block = block_bytes(model.num_layers, bs, H, D,
+                                        "int8")
+            else:
+                per_block = 2 * model.num_layers * bs * H * D \
+                    * cdtype.itemsize
             draft_per_block = 0
             if draft_model is not None:
                 DHp = getattr(draft_model, "kv_heads",
@@ -366,13 +426,28 @@ class ContinuousEngine:
             self._bs, self._M = bs, M
             self._pool = BlockPool(n_blocks, bs, enable_prefix_cache,
                                    event_cb=self.telemetry.pool_event,
-                                   name="target")
+                                   name="target",
+                                   kv_dtype=self.kv_dtype,
+                                   bytes_per_block=per_block)
             # pool-mutation guard: admission/growth run on the pump
             # thread, but unregister_prefix releases from client threads
             self._pool_lock = threading.Lock()
-            self._pk = jnp.zeros((model.num_layers, n_blocks, bs, H, D),
-                                 cdtype)
-            self._pv = jnp.zeros_like(self._pk)
+            # HEAD-MAJOR pool layout [layers, N, KH, bs, D]: the fused
+            # kernel's block specs carve (1, 1, bs, D) tiles per
+            # (table[b, j], head) grid step, which only squeezes
+            # LEADING singletons — Mosaic-clean on TPU (jax's own paged
+            # kernel uses the same order).  int8 pools are QuantKV
+            # pytrees (int8 data + per-(block, position, head) bf16
+            # scales) — every jitted program moves them like arrays.
+            shape = (model.num_layers, n_blocks, H, bs, D)
+            if self._kv_int8:
+                self._pk = QuantKV(jnp.zeros(shape, jnp.int8),
+                                   jnp.ones(shape[:-1], KV_SCALE_DTYPE))
+                self._pv = QuantKV(jnp.zeros(shape, jnp.int8),
+                                   jnp.ones(shape[:-1], KV_SCALE_DTYPE))
+            else:
+                self._pk = jnp.zeros(shape, cdtype)
+                self._pv = jnp.zeros_like(self._pk)
             # per-slot block tables; SINK everywhere a row holds no
             # block, so stray writes land in storage nothing attends
             self._tables = np.full((S, M), SINK_BLOCK, np.int32)
@@ -395,14 +470,32 @@ class ContinuousEngine:
                         f"sink block 0)")
                 self._dpool = BlockPool(
                     dnb, bs, enable_prefix_cache,
-                    event_cb=self.telemetry.pool_event, name="draft")
+                    event_cb=self.telemetry.pool_event, name="draft",
+                    kv_dtype=_kv_label(cdtype),
+                    bytes_per_block=draft_per_block)
                 self._dpk = jnp.zeros(
-                    (draft_model.num_layers, dnb, bs, DHp, DDp),
+                    (draft_model.num_layers, dnb, DHp, bs, DDp),
                     cdtype)
                 self._dpv = jnp.zeros_like(self._dpk)
                 self._dtables = np.full((S, M), SINK_BLOCK, np.int32)
                 self._drow_blocks: List[List[int]] = [
                     [] for _ in range(S)]
+        # kv-bytes-per-token: all-layer, both-tenant HBM cost of ONE
+        # cached token position — the gauge/flight-record figure that
+        # makes bf16 and int8 runs comparable at a glance.
+        if self.paged:
+            self._kv_bytes_per_token = \
+                (self._per_block_bytes
+                 + self._draft_per_block_bytes) // self._bs
+        else:
+            bpt = 2 * model.num_layers * H * D * cdtype.itemsize
+            if draft_model is not None:
+                dH = getattr(draft_model, "kv_heads",
+                             draft_model.num_heads)
+                dD = draft_model.hidden_size // draft_model.num_heads
+                bpt += 2 * draft_model.num_layers * dH * dD \
+                    * cdtype.itemsize
+            self._kv_bytes_per_token = bpt
         # ---- chunked prefill (token-budget tick scheduler) -------------
         # chunked=True replaces monolithic admission prefill with
         # incremental chunks packed alongside decodes under a per-tick
@@ -521,6 +614,9 @@ class ContinuousEngine:
         self._step_count = 0
 
         Lmax = L
+        # static under jit: every paged program below compiles in the
+        # selected read kernel (gather reference / fused Pallas)
+        kern = self.kernel
 
         def pick_next(logits, pos, done, temps, seeds, topps,
                       use_sample, use_topp):
@@ -583,7 +679,7 @@ class ContinuousEngine:
             def one(carry, _):
                 tok, pos, done, pk, pv = carry
                 logits, pk, pv = model.apply(
-                    variables, tok, pk, pv, tables, pos,
+                    variables, tok, pk, pv, tables, pos, kernel=kern,
                     method=TransformerLM.decode_step_paged)
                 nxt, done = pick_next(logits, pos, done, temps, seeds,
                                       topps, use_sample, use_topp)
@@ -629,6 +725,7 @@ class ContinuousEngine:
             — never the [kb, sb, V] cube)."""
             return model.apply(
                 variables, suffixes, pk, pv, tables, pos, slens,
+                kernel=kern,
                 method=TransformerLM.prefill_chunk_paged)
 
         self._paged_admit = jax.jit(paged_admit_fn,
@@ -711,7 +808,7 @@ class ContinuousEngine:
             live block.  Padding rows carry all-sink tables."""
             if with_decode:
                 logits, pk, pv = model.apply(
-                    variables, tok, pk, pv, tables, pos,
+                    variables, tok, pk, pv, tables, pos, kernel=kern,
                     method=TransformerLM.decode_step_paged)
                 nxt, done = pick_next(logits, pos, done, temps, seeds,
                                       topps, use_sample, use_topp)
@@ -720,6 +817,7 @@ class ContinuousEngine:
                 nxt = tok
             clog, pk, pv = model.apply(
                 variables, ctoks, pk, pv, ctabs, cpos, clens,
+                kernel=kern,
                 method=TransformerLM.prefill_chunk_paged)
             cnxt, _ = pick_next(
                 clog, cpos + clens - 1,
@@ -829,6 +927,22 @@ class ContinuousEngine:
         m.gauge("zoo_engine_peak_resident",
                 "max co-resident requests observed",
                 fn=lambda: self._peak_resident)
+        # storage economics: constant per engine config, exported so a
+        # scrape can compute tokens/sec/HBM-byte without knowing the
+        # model geometry (int8 pools halve this vs bf16)
+        m.gauge("zoo_engine_kv_bytes_per_token",
+                "HBM bytes one cached token position costs across all "
+                "layers and tenants",
+                fn=lambda: self._kv_bytes_per_token)
+        if self.paged:
+            m.gauge("zoo_engine_kv_pool_bytes",
+                    "total HBM bytes of the paged KV pools (target + "
+                    "draft, all blocks)",
+                    fn=lambda: (
+                        self._per_block_bytes * self._pool.n_blocks
+                        + (self._draft_per_block_bytes
+                           * self._dpool.n_blocks
+                           if self._dpool is not None else 0)))
         if self.chunked:
             def _budget_util():
                 denom = self._budget_ticks * self.tick_token_budget
@@ -909,6 +1023,7 @@ class ContinuousEngine:
         model, variables = self.model, self._variables
         S, L, k = self._S, self._L, self._spec_k
         eos_id = self.eos_id
+        kern = self.kernel
         self._dpos = np.zeros(S, np.int32)
 
         if self.paged:
@@ -921,7 +1036,7 @@ class ContinuousEngine:
                 def dstep(c, _):
                     t, dpk, dpv, p = c
                     lg, dpk, dpv = draft.apply(
-                        dvars, t, dpk, dpv, dtables, p,
+                        dvars, t, dpk, dpv, dtables, p, kernel=kern,
                         method=TransformerLM.decode_step_paged)
                     nxt = jnp.argmax(lg, -1).astype(jnp.int32)
                     return (nxt, dpk, dpv, p + 1), nxt
@@ -936,6 +1051,7 @@ class ContinuousEngine:
                 inputs = jnp.concatenate([tok[:, None], d], axis=1)
                 logits, pk, pv = model.apply(
                     variables, inputs, pk, pv, tables, pos,
+                    kernel=kern,
                     method=TransformerLM.verify_step_paged)
                 t, n_emit, new_tok, done = accept_proposals(
                     logits, d, tok, done, k=k, eos_id=eos_id)
@@ -958,6 +1074,7 @@ class ContinuousEngine:
                 logits are discarded (only the target picks tokens)."""
                 _, dpk, dpv = draft.apply(
                     dvars, suffixes, dpk, dpv, dtables, pos, slens,
+                    kernel=kern,
                     method=TransformerLM.prefill_chunk_paged)
                 return dpk, dpv
 
@@ -1025,9 +1142,11 @@ class ContinuousEngine:
                                     clens, ctabs, dctabs):
                 clog, pk, pv = model.apply(
                     variables, ctoks, pk, pv, ctabs, cpos, clens,
+                    kernel=kern,
                     method=TransformerLM.prefill_chunk_paged)
                 _, dpk, dpv = draft.apply(
                     dvars, ctoks, dpk, dpv, dctabs, cpos, clens,
+                    kernel=kern,
                     method=TransformerLM.prefill_chunk_paged)
                 # greedy-only by the submit() contract, so the first
                 # pick is plain argmax (pick_next minus sampling/eos —
@@ -1094,19 +1213,22 @@ class ContinuousEngine:
         full-head model-dtype arena of the same geometry."""
         m = self.model
         if self.paged:
-            H = self._pk.shape[3]
-            D = self._pk.shape[4]
-            per_block = 2 * m.num_layers * self._bs * H * D \
-                * self._pk.dtype.itemsize
+            # pool layout is [layers, N, KH, bs, D] (head-major for
+            # the fused kernel); int8 pools are QuantKV, so bill from
+            # the init-time ledger rather than re-deriving off dtypes
+            H = self._pk.shape[2]
+            per_block = self._per_block_bytes
             per_slot_max = per_block * self._M
-            arena_equiv = 2 * m.num_layers * self._L * H * D \
-                * self._pk.dtype.itemsize * self._S
+            arena_equiv = (per_block // self._bs) * self._L * self._S
             return {
                 "mode": "paged",
                 "slots": self._S,
                 "cache_len": self._L,
                 "kv_heads": H,
                 "cache_dtype": str(self._pk.dtype),
+                "kv_dtype": self.kv_dtype,
+                "kernel": self.kernel,
+                "kv_bytes_per_token": self._kv_bytes_per_token,
                 "block_size": self._bs,
                 "n_blocks": self._pool.n_blocks,
                 "blocks_per_row_max": self._M,
@@ -2370,6 +2492,11 @@ class ContinuousEngine:
         rec["ts"] = round(ts, 6)
         rec["dur_ms"] = round(dur * 1e3, 3)
         rec["kind"] = self._tick_kind
+        # which read path / storage mode this tick ran on — a bundle
+        # reader's first question when a regression bisects to config
+        rec["kernel"] = self.kernel if self.paged else "dense"
+        rec["kv_dtype"] = self.kv_dtype
+        rec["kv_bytes_per_token"] = self._kv_bytes_per_token
         rec["decode_uris"] = [s.uri for s in self._slots
                               if s is not None and s.state == "DECODE"]
         rec["prefill_uris"] = [s.uri for s in self._slots
@@ -2813,10 +2940,10 @@ class ContinuousEngine:
                         # the decode half is the separate spec round)
                         if self.paged:
                             self._spec_chunk_paged(
-                                jnp.zeros_like(self._pk),
-                                jnp.zeros_like(self._pv),
-                                jnp.zeros_like(self._dpk),
-                                jnp.zeros_like(self._dpv),
+                                _zeros_like(self._pk),
+                                _zeros_like(self._pv),
+                                _zeros_like(self._dpk),
+                                _zeros_like(self._dpv),
                                 ctoks, cpos, clens,
                                 jnp.full((kb, width), SINK_BLOCK,
                                          jnp.int32),
@@ -2835,8 +2962,8 @@ class ContinuousEngine:
                     for wd in (False, True):
                         if self.paged:
                             fn = self._get_fused(wd, sampled, use_topp)
-                            fn(jnp.zeros_like(self._pk),
-                               jnp.zeros_like(self._pv),
+                            fn(_zeros_like(self._pk),
+                               _zeros_like(self._pv),
                                tok, pos, done,
                                jnp.full((S, self._M), SINK_BLOCK,
                                         jnp.int32),
@@ -2858,9 +2985,9 @@ class ContinuousEngine:
             # spec-round program
             if self.paged:
                 self._spec_step_paged(
-                    jnp.zeros_like(self._pk), jnp.zeros_like(self._pv),
-                    jnp.zeros_like(self._dpk),
-                    jnp.zeros_like(self._dpv),
+                    _zeros_like(self._pk), _zeros_like(self._pv),
+                    _zeros_like(self._dpk),
+                    _zeros_like(self._dpv),
                     tok, pos, pos, done,
                     jnp.full((S, self._M), SINK_BLOCK, jnp.int32),
                     jnp.full((S, self._M), SINK_BLOCK, jnp.int32))

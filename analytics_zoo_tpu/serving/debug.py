@@ -169,7 +169,8 @@ def render_bundle(path: str, *, ticks: int = 20, requests: int = 10,
     config = _load_json(os.path.join(path, "config.json")) or {}
     if config:
         keys = ("continuous_batching", "engine_slots", "engine_paged",
-                "engine_blocks", "engine_block_size", "engine_chunked",
+                "engine_blocks", "engine_block_size", "engine_kernel",
+                "engine_kv_dtype", "engine_chunked",
                 "engine_speculation_k", "qos_enabled",
                 "flight_capacity")
         print("config: " + " ".join(

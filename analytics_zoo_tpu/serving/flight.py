@@ -12,7 +12,11 @@ jax — same contract as telemetry.py):
 
 - :class:`FlightRecorder` — an always-on bounded ring of per-tick
   engine state snapshots (tick kind, budget split, decode/prefill row
-  sets, per-pool block levels, preemption/retrace/spec deltas).  One
+  sets, per-pool block levels, preemption/retrace/spec deltas, and the
+  active attention read path: ``kernel`` (gather/fused/dense),
+  ``kv_dtype`` (bf16/int8/...), ``kv_bytes_per_token`` — so a
+  regression bundle states which kernel and KV storage mode the engine
+  was actually running when it went wrong).  One
   plain dict appended to a ``deque(maxlen=...)`` per tick: O(1) host
   work, no device interaction, so greedy outputs are bitwise-identical
   with the recorder on or off.

@@ -59,6 +59,32 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 SINK_BLOCK = 0
 
+# bytes per stored K (or V) element, keyed by the pool's ``kv_dtype``
+# mode.  int8 rows carry a per-(block, position, kv-head) bfloat16
+# scale alongside the 1-byte elements (see
+# ``ops/flash_attention.quantize_kv``), so its cost is accounted per
+# ROW as ``head_dim + KV_SCALE_BYTES`` rather than per element.
+KV_DTYPE_BYTES = {"bf16": 2, "f16": 2, "f32": 4, "f64": 8, "int8": 1}
+KV_SCALE_BYTES = 2  # bfloat16 scale per int8 row
+
+
+def block_bytes(n_layers: int, block_size: int, kv_heads: int,
+                head_dim: int, kv_dtype: str = "bf16") -> int:
+    """HBM bytes ONE physical block costs across all layers, K and V
+    both.  This is the quantity :func:`split_block_budget` splits a
+    byte budget by, and the engine's capacity report bills.  For
+    ``kv_dtype="int8"`` each ``head_dim`` row additionally stores a
+    ``KV_SCALE_BYTES`` quantization scale, so the int8 pool fits
+    ``(2*D)/(D+2)`` ≈ 1.94x (at D=64) as many blocks as bf16 in the
+    same budget."""
+    if kv_dtype not in KV_DTYPE_BYTES:
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}; expected one "
+                         f"of {sorted(KV_DTYPE_BYTES)}")
+    row = head_dim * KV_DTYPE_BYTES[kv_dtype]
+    if kv_dtype == "int8":
+        row += KV_SCALE_BYTES
+    return 2 * int(n_layers) * int(block_size) * int(kv_heads) * row
+
 
 def split_block_budget(budget_bytes: int,
                        per_block_costs: Sequence[int]) -> int:
@@ -115,7 +141,9 @@ class BlockPool:
     def __init__(self, n_blocks: int, block_size: int,
                  enable_prefix_cache: bool = True,
                  event_cb: Optional[Callable[..., None]] = None,
-                 name: str = "target"):
+                 name: str = "target",
+                 kv_dtype: str = "bf16",
+                 bytes_per_block: Optional[int] = None):
         if n_blocks < 2:
             raise ValueError(
                 f"n_blocks must be >= 2 (block 0 is the sink), got "
@@ -129,6 +157,16 @@ class BlockPool:
         # stamped on every event callback so a timeline can tell WHOSE
         # pool evicted or ran dry when two tenants share one telemetry
         self.name = str(name)
+        # storage-mode accounting (the pool itself is jax-free — the
+        # device arena actually quantizes/dequantizes; this is the
+        # label and cost a scrape bills blocks at).  ``bytes_per_block``
+        # is the all-layer K+V cost the engine computed via
+        # :func:`block_bytes`; 0 when the caller did not say.
+        if kv_dtype not in KV_DTYPE_BYTES:
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}; expected "
+                             f"one of {sorted(KV_DTYPE_BYTES)}")
+        self.kv_dtype = kv_dtype
+        self.bytes_per_block = int(bytes_per_block or 0)
         # observability hook, called as event_cb(kind, **info) for
         # "eviction" and "alloc_failure" (the two transitions the
         # cumulative counters alone cannot place on a timeline).  The
@@ -268,6 +306,8 @@ class BlockPool:
     def metrics(self) -> Dict[str, float]:
         return {
             "tenant": self.name,
+            "kv_dtype": self.kv_dtype,
+            "bytes_per_block": self.bytes_per_block,
             "n_blocks": self.n_blocks,
             "block_size": self.block_size,
             "referenced_blocks": len(self._ref),

@@ -94,6 +94,14 @@ class ServingConfig:
     # copy-free, and a dry pool preempts-to-queue instead of OOMing.
     engine_paged: bool = False
     engine_block_size: int = 16
+    # Paged-attention read kernel: "gather" (materialising jnp.take
+    # reference — the CPU/interpret-safe default) or "fused" (Pallas
+    # kernel streaming KV blocks HBM->VMEM).  Paged-only.
+    engine_kernel: str = "gather"
+    # Paged KV block storage: None follows engine_cache_dtype, "bf16"
+    # forces a bfloat16 pool, "int8" stores quantized blocks with
+    # per-row scales (~1.9x n_blocks at equal HBM).  Paged-only.
+    engine_kv_dtype: Optional[str] = None
     # pool size: engine_blocks wins when set; else engine_hbm_fraction
     # of device HBM (where the backend reports it); else arena-
     # equivalent (every slot can run full-length)
@@ -197,6 +205,11 @@ class ServingConfig:
             cfg.engine_paged = bool(params["engine_paged"])
         if "engine_block_size" in params:
             cfg.engine_block_size = int(params["engine_block_size"])
+        if "engine_kernel" in params:
+            cfg.engine_kernel = str(params["engine_kernel"])
+        if "engine_kv_dtype" in params:
+            v = params["engine_kv_dtype"]
+            cfg.engine_kv_dtype = None if v is None else str(v)
         if "engine_blocks" in params:
             cfg.engine_blocks = int(params["engine_blocks"])
         if "engine_hbm_fraction" in params:
@@ -460,6 +473,8 @@ class ClusterServing:
                 cache_dtype=self.config.engine_cache_dtype,
                 mesh=self.engine_mesh,
                 partition_rules=self.engine_partition_rules,
+                kernel=self.config.engine_kernel,
+                kv_dtype=self.config.engine_kv_dtype,
                 paged=self.config.engine_paged,
                 block_size=self.config.engine_block_size,
                 n_blocks=self.config.engine_blocks,

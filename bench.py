@@ -530,11 +530,16 @@ def _main_inner():
         return
     ok, reason = _device_preflight()
     if not ok:
+        # A wedged/absent device is an ENVIRONMENT condition, not a
+        # bench failure: emit a structured "skipped" record and exit 0
+        # so the driver records a clean skip instead of rc=1 with a
+        # null metric (BENCH_r05 did exactly that).
         print(json.dumps({
             "metric": "bert_base_ft_samples_per_sec_per_chip",
             "value": None, "unit": "samples/sec", "vs_baseline": None,
-            "extra": {"error": f"device preflight failed: {reason}"}}))
-        sys.exit(1)
+            "status": "skipped",
+            "extra": {"skipped": f"device preflight failed: {reason}"}}))
+        return
     # Priority order (VERDICT r4 ask #1b): a mid-run re-wedge keeps what
     # was won.  After any bench FAILURE, a cheap re-probe decides between
     # "that bench broke" (continue) and "the tunnel wedged" (bail with

@@ -672,6 +672,10 @@ def run_capacity_scenario(slots: int = 4) -> dict:
         "mode": "paged-vs-arena",
         "requests": len(done),
         "req_per_sec": round(len(done) / wall, 1),
+        # composite HBM-efficiency column (32 greedy tokens/request):
+        # comparable against the lm-kernel rows' same-named figure
+        "tok_per_sec_per_kv_gib": round(
+            (len(done) * 32 / wall) / (paged_bytes / 2**30), 1),
         "arena_slots": slots,
         "arena_bytes": int(arena_bytes),
         "paged_bytes": int(paged_bytes),
@@ -829,6 +833,134 @@ def run_spec_scenario(chunked: bool = False, slots: int = 2) -> dict:
                  "upper bound, AND full target compute per proposal — "
                  "a distilled 5-10x-smaller draft widens the ratio at "
                  "a fraction of the draft-tenant bytes"),
+    }
+
+
+def run_kernel_scenario(slots: int = 4) -> dict:
+    """Paged-attention read path head-to-head at EQUAL TOTAL KV HBM:
+    {gather, fused} x {bf16, int8} on the same closed-loop greedy
+    workload.  The figure of merit is ``tok_per_sec_per_kv_gib`` —
+    decode tokens/sec per GiB of KV pool — because the two levers
+    attack different factors: the fused kernel raises tokens/sec (no
+    materialised ``[B, M*bs, KH, D]`` gather on the tick), int8
+    roughly doubles the blocks the same bytes buy (rows cost D+2
+    bytes vs 2D; at D=64 that is ~1.94x ``n_blocks``, asserted
+    here >= 1.9).  Every row's pool is sized to the bf16 row's byte
+    budget, so the int8 rows really do hold ~2x the blocks rather
+    than just billing fewer bytes.
+
+    Rows run independently and RESILIENTLY: a row that fails (e.g. a
+    Mosaic lowering gap on some TPU generation for the fused kernel)
+    records its error and the others still land.  Measured passes run
+    under ``trace_guard`` — the acceptance bar is zero steady-state
+    retraces in every mode."""
+    import jax
+
+    from analytics_zoo_tpu.lint import RetraceError, trace_guard
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import ContinuousEngine
+    from analytics_zoo_tpu.serving.paged_cache import block_bytes
+
+    # hidden 256 / 4 heads -> head_dim 64: the geometry the ~1.9x int8
+    # claim is stated at ((2*64)/(64+2) = 1.94)
+    model = TransformerLM(vocab_size=8192, hidden_size=256, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=128)
+    variables = model.init(jax.random.key(0), np.zeros((1, 32), np.int32))
+    H = getattr(model, "kv_heads", model.num_heads)
+    D = model.hidden_size // model.num_heads
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(1, 8192, int(rng.integers(8, 29))).astype(
+        np.int32) for _ in range(24)]
+    n_requests = 12 * slots
+    max_new, bs = 32, 8
+    # equal HBM: the bf16 row's pool bytes are THE budget; each mode
+    # gets however many blocks those bytes buy at its per-block cost
+    bf16_blocks = slots * 12
+    budget = bf16_blocks * block_bytes(model.num_layers, bs, H, D,
+                                       "bf16")
+
+    def drive(eng, tag):
+        done: list = []
+        issued = 0
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            while issued < n_requests and issued - len(done) < slots:
+                eng.submit(f"{tag}-r{issued}",
+                           prompts[issued % len(prompts)],
+                           on_done=lambda u, t: done.append(u))
+                issued += 1
+            eng.step()
+            if len(done) == n_requests and eng.n_active == 0:
+                return time.perf_counter() - t0
+        raise RuntimeError(f"kernel bench stalled: {tag}")
+
+    def run(kernel, kv_dtype):
+        n_blocks = budget // block_bytes(model.num_layers, bs, H, D,
+                                         kv_dtype)
+        eng = ContinuousEngine(
+            model, variables, max_new_tokens=max_new, max_slots=slots,
+            prompt_buckets=(32,), paged=True, block_size=bs,
+            n_blocks=n_blocks, enable_prefix_cache=False,
+            cache_dtype="bfloat16", kernel=kernel, kv_dtype=kv_dtype)
+        pool_bytes = eng._per_block_bytes * n_blocks
+        assert pool_bytes <= budget, (pool_bytes, budget)
+        drive(eng, "warm")
+        walls: list = []
+        for attempt in range(6):
+            try:
+                with trace_guard(eng, name="kernel-bench"):
+                    walls.append(drive(eng, f"run{attempt}"))
+                if len(walls) == 3:
+                    break
+            except RetraceError:
+                eng.drain()             # finish the aborted pass
+        if not walls:
+            raise RuntimeError("kernel bench shapes did not converge")
+        wall = min(walls)
+        tok_s = n_requests * max_new / wall
+        return {"kernel": kernel, "kv_dtype": kv_dtype,
+                "n_blocks": int(n_blocks),
+                "kv_pool_bytes": int(pool_bytes),
+                "kv_bytes_per_token": int(eng._kv_bytes_per_token),
+                "decode_tok_per_sec": round(tok_s, 1),
+                "tok_per_sec_per_kv_gib": round(
+                    tok_s / (pool_bytes / 2**30), 1)}
+
+    rows = []
+    for kernel, kv_dtype in (("gather", "bf16"), ("fused", "bf16"),
+                             ("gather", "int8"), ("fused", "int8")):
+        try:
+            rows.append(run(kernel, kv_dtype))
+        except Exception as e:          # a broken row must not kill
+            rows.append({"kernel": kernel, "kv_dtype": kv_dtype,
+                         "error": f"{type(e).__name__}: {e}"})
+    by = {(r["kernel"], r["kv_dtype"]): r for r in rows}
+    ok = [r for r in rows if "error" not in r]
+    ratio = None
+    if ("gather", "int8") in by and "error" not in by[("gather", "int8")]:
+        ratio = round(by[("gather", "int8")]["n_blocks"]
+                      / bf16_blocks, 2)
+        assert ratio >= 1.9, f"int8 blocks ratio {ratio} < 1.9"
+    return {
+        "model": "lm-kernel",
+        "mode": "fused-vs-gather-x-bf16-vs-int8",
+        "slots": slots,
+        "kv_budget_bytes": int(budget),
+        "rows": rows,
+        "int8_blocks_ratio": ratio,
+        "fused_tok_per_sec_ratio": (round(
+            by[("fused", "bf16")]["decode_tok_per_sec"]
+            / by[("gather", "bf16")]["decode_tok_per_sec"], 2)
+            if len(ok) >= 2 and "error" not in by[("fused", "bf16")]
+            and "error" not in by[("gather", "bf16")] else None),
+        "note": ("equal total KV HBM per row (pool sized to the bf16 "
+                 "budget at each mode's per-block cost); greedy "
+                 "closed-loop shorts; tok_per_sec_per_kv_gib is the "
+                 "composite figure — kernel choice moves the "
+                 "numerator, int8 moves the denominator; off-TPU the "
+                 "fused kernel runs in Pallas interpret mode, so "
+                 "judge its SPEED on TPU only (parity holds anywhere)"),
     }
 
 
@@ -1052,6 +1184,10 @@ PLAN = [("resnet18", 64, 10, 64),
         # equal-HBM co-residency head-to-head (>= 2x claim)
         ("lm-poisson-pg", 12, 150, 8), ("lm-sysprompt-pg", 12, 120, 8),
         ("lm-capacity", 4, 0, 8),
+        # paged-attention read path: {gather, fused} x {bf16, int8} at
+        # equal KV HBM — tokens/sec/HBM-byte composite column, ~1.9x
+        # int8 block-count claim, trace-guard pinned
+        ("lm-kernel", 4, 0, 8),
         # chunked-prefill scheduler off-vs-on at equal HBM (>= 2x lower
         # p99 inter-token latency claim); clients = engine slots
         ("lm-chunked", 6, 0, 8),
@@ -1220,6 +1356,8 @@ def _one():
                               int(sys.argv[4]), int(sys.argv[5]))
     if kind == "lm-capacity":
         r = run_capacity_scenario(slots=clients)
+    elif kind == "lm-kernel":
+        r = run_kernel_scenario(slots=clients)
     elif kind == "lm-chunked":
         r = run_chunked_scenario(slots=clients)
     elif kind == "lm-spec-pg":

@@ -389,7 +389,7 @@ def test_paged_gqa_cache_dtype_parity():
                            paged=True, block_size=4,
                            cache_dtype="bfloat16")
     assert eng._pk.dtype == jnp.bfloat16
-    assert eng._pk.shape[3] == 1            # kv_heads, not num_heads
+    assert eng._pk.shape[2] == 1            # kv_heads, not num_heads
     p = np.asarray([3, 7, 2, 9], np.int32)
     results = {}
     eng.submit("g", p, on_done=_collect(results))

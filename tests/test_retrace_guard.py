@@ -38,6 +38,11 @@ def lm():
 MODES = {
     "arena": {},
     "paged": dict(paged=True, block_size=4),
+    # the fused Pallas read kernel (interpret mode on CPU) and int8 KV
+    # blocks must hold the same zero-steady-state-compiles bar — the
+    # bench's equal-HBM ratios assume no retrace bills either side
+    "paged-fused-int8": dict(paged=True, block_size=4, kernel="fused",
+                             kv_dtype="int8"),
     # chunked modes include a 12-token prompt so every round spans two
     # chunk widths (8 + 4) — chunk-width/row/read-window buckets and
     # the fused program must not retrace per request
