@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test verify examples bench native serve-smoke lint clean
+.PHONY: test verify examples bench native serve-smoke sim-gate lint clean
 
 # full suite on the 8-virtual-device CPU mesh (tests/conftest.py forces it)
 test:
@@ -57,7 +57,18 @@ serve-smoke:
 	    tests/test_frontdoor.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_composed.py \
 	    tests/test_flight.py tests/test_paged_fused.py -q
+	# fresh-bundle -> replay round trip + engine/sim decision equivalence
+	# (slow-marked classes in test_sim.py run unfiltered here, like
+	# test_flight.py above; docs/simulation.md)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sim.py -q
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
+
+# CI gate for scheduler regressions: run the pinned golden scenario
+# (tests/golden/sim_golden.json) through the offline discrete-event
+# simulator and assert its envelopes (docs/simulation.md).  jax-free:
+# also part of tier-1 via tests/test_sim.py::TestGoldenGate.
+sim-gate:
+	$(PY) -m analytics_zoo_tpu.serving.sim gate tests/golden/sim_golden.json
 
 clean:
 	rm -rf build dist *.egg-info analytics_zoo_tpu/native/*.so

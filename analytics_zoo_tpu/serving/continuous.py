@@ -50,6 +50,7 @@ from analytics_zoo_tpu.ops.flash_attention import (KV_SCALE_DTYPE,
                                                    QuantKV)
 from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
                                                  WeightedWaitQueue)
+from analytics_zoo_tpu.serving import policy as scheduler_policy
 from analytics_zoo_tpu.serving.paged_cache import (BlockPool,
                                                    SINK_BLOCK,
                                                    block_bytes,
@@ -2174,13 +2175,11 @@ class ContinuousEngine:
         return min(v, self._M)
 
     def _pick_victim(self) -> int:
-        live = [i for i in range(self._S) if self._slots[i] is not None]
-        pre = [i for i in live
-               if self._slots[i].state == "PREFILLING"]
-        # prefilling rows first: they lost no emitted tokens and
-        # requeue cheaply; among candidates, always the LATEST
-        # admission (earliest admissions keep strict forward progress)
-        return max(pre or live, key=lambda i: self._slots[i].admit_seq)
+        # the choice itself is pure policy (serving/policy.py): the
+        # simulator makes the identical decision from modelled state
+        return scheduler_policy.pick_victim(
+            (i, s.state, s.admit_seq)
+            for i, s in enumerate(self._slots) if s is not None)
 
     def _preempt(self, slot: int) -> None:
         """Evict a resident back to the WAITING queue (front, original
@@ -2545,6 +2544,20 @@ class ContinuousEngine:
         allocation failure (0 when not paged or currently healthy)."""
         return self._alloc_fail_streak
 
+    def spec_acceptance(self) -> Optional[dict]:
+        """The recorded speculative-acceptance distribution (exact
+        counts of accepted draft tokens per row per verify round,
+        0..k), or None when the engine has no draft model.  This is
+        the calibration section ``dump_bundle`` ships so the
+        discrete-event simulator (docs/simulation.md) models
+        acceptance from RECORDED data instead of re-deriving it from
+        raw ticks."""
+        if self.draft_model is None:
+            return None
+        section = self.telemetry.spec_acceptance()
+        section["k"] = self._spec_k
+        return section
+
     def _step_impl(self) -> int:
         self._tick_kind = "decode"
         self._admit()
@@ -2658,16 +2671,17 @@ class ContinuousEngine:
         pre-front-door engine (the parity guarantee).  QoS on: aged
         priority class first, FIFO within a class, so an interactive
         prompt's chunks land ahead of a batch prompt admitted earlier
-        while aging still bounds how long batch can be outranked."""
+        while aging still bounds how long batch can be outranked.
+        Delegates to the pure ``serving/policy.py`` key — the
+        simulator sorts with the same function on virtual time."""
         st = self._slots[slot]
-        if self._qos is None:
-            return st.admit_seq
         req = st.req
         if req is None:
-            return (self._qos.class_rank("standard", 0.0), st.admit_seq)
-        waited = time.monotonic() - req.enq_t
-        return (self._qos.class_rank(req.priority, waited),
-                st.admit_seq)
+            return scheduler_policy.grant_rank(
+                self._qos, None, 0.0, st.admit_seq)
+        return scheduler_policy.grant_rank(
+            self._qos, req.priority, time.monotonic() - req.enq_t,
+            st.admit_seq)
 
     def _chunked_tick(self, active) -> int:
         """One budget-bounded fused iteration (the tentpole): every
@@ -2683,19 +2697,15 @@ class ContinuousEngine:
             (i for i in active
              if self._slots[i].state == "PREFILLING"),
             key=self._grant_rank)
-        remaining = self.tick_token_budget - len(decode_rows)
-        chunks: List[Tuple[int, int]] = []          # (slot, chunk len)
-        for i in prefill_rows:
-            if remaining <= 0:
-                break
-            st = self._slots[i]
-            clen = min(st.plen - st.fill_pos, remaining,
-                       self._chunk_buckets[-1])
-            if clen <= 0:
-                continue
-            chunks.append((i, clen))
-            remaining -= clen
-        if prefill_rows and not chunks:
+        # budget billing is pure policy (serving/policy.py): decode
+        # rows cost 1 position each, the remainder grants chunks in
+        # grant order
+        chunks, stalled = scheduler_policy.plan_chunks(
+            self.tick_token_budget, 1, len(decode_rows),
+            [(i, self._slots[i].plen - self._slots[i].fill_pos)
+             for i in prefill_rows],
+            self._chunk_buckets[-1])
+        if stalled:
             # budget fully consumed by decode rows: prefill waits
             self._prefill_stall_ticks += 1
         if self.paged:
@@ -3074,19 +3084,14 @@ class ContinuousEngine:
              if self._slots[i].state == "PREFILLING"),
             key=self._grant_rank)
         per_row = self._spec_k + 1
-        remaining = self.tick_token_budget - per_row * len(decode_rows)
-        chunks: List[Tuple[int, int]] = []          # (slot, chunk len)
-        for i in prefill_rows:
-            if remaining <= 0:
-                break
-            st = self._slots[i]
-            clen = min(st.plen - st.fill_pos, remaining,
-                       self._chunk_buckets[-1])
-            if clen <= 0:
-                continue
-            chunks.append((i, clen))
-            remaining -= clen
-        if prefill_rows and not chunks:
+        # same pure billing as _chunked_tick, with every decode row
+        # costing its k+1 verify positions
+        chunks, stalled = scheduler_policy.plan_chunks(
+            self.tick_token_budget, per_row, len(decode_rows),
+            [(i, self._slots[i].plen - self._slots[i].fill_pos)
+             for i in prefill_rows],
+            self._chunk_buckets[-1])
+        if stalled:
             # budget fully consumed by verify rows: prefill waits
             self._prefill_stall_ticks += 1
         if self.paged:

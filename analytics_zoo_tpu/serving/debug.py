@@ -11,17 +11,26 @@ is the runbook).
 Usage::
 
     python -m analytics_zoo_tpu.serving.debug <bundle-dir> \\
-        [--ticks N] [--requests N] [--uri URI] [--logs N]
+        [--ticks N] [--requests N] [--uri URI] [--logs N] [--replay]
 
 ``--uri`` filters the request histories to one request id (the same
 id the X-Request-Id header / SSE start event / structured logs
-carry).  Exit code 0 on a rendered bundle, 2 on an unreadable one.
+carry).  ``--replay`` additionally runs the discrete-event simulator
+(``serving/sim/``, docs/simulation.md) over the bundle: re-derives the
+request metrics from the trace, cross-checks them against the recorded
+watchdog score, re-simulates the recorded schedule, and prints the
+simulated-vs-recorded SLO deltas.  Exit code 0 on a rendered bundle,
+1 when ``--replay``'s cross-check breached its tolerances, 2 on an
+unreadable (or unknown-schema) one.
 
 Stdlib-only by design: rendering a bundle must work on a machine with
 nothing but Python — no jax, no numpy, no serving stack.  (The ``-m``
 spelling imports the package root, which needs the full deps; on a
 bare box run the file directly: ``python path/to/serving/debug.py
-<bundle-dir>``.)
+<bundle-dir>``.)  ``--replay`` keeps that contract: the simulator is
+itself stdlib-only, and the bare-file spelling bootstraps it through a
+synthetic parent package so its relative imports resolve without
+installing anything.
 """
 
 from __future__ import annotations
@@ -148,6 +157,68 @@ def render_slo(slo: Dict[str, Any], out) -> None:
               f"uri={b.get('uri')}", file=out)
 
 
+def _load_sim_replay():
+    """Import ``serving.sim.replay`` in either spelling of this CLI.
+
+    Under ``python -m`` the package-relative import just works.  As a
+    bare file (``python path/to/debug.py``) there is no parent package,
+    so build a synthetic one whose ``__path__`` is this directory and
+    import the sim through it — the sim's ``from ..policy import ...``
+    then resolves to the sibling ``policy.py`` file, and the whole
+    chain stays stdlib-only (no numpy, no jax, nothing installed)."""
+    if __package__:
+        from .sim import replay  # type: ignore[no-redef]
+        return replay
+    import importlib
+    import types
+    name = "_azt_serving_bare"
+    pkg = sys.modules.get(name)
+    if pkg is None:
+        pkg = types.ModuleType(name)
+        pkg.__path__ = [os.path.dirname(os.path.abspath(__file__))]
+        sys.modules[name] = pkg
+    return importlib.import_module(f"{name}.sim.replay")
+
+
+def render_replay(path: str, out, seed: int = 0) -> int:
+    """Run the simulator's replay pipeline over a bundle and print the
+    simulated-vs-recorded SLO deltas.  Returns a process exit code (0
+    crosscheck ok, 1 tolerance breach, 2 unreadable/unknown schema)."""
+    replay = _load_sim_replay()
+    try:
+        report = replay.replay_bundle(path, seed=seed)
+    except (FileNotFoundError, ValueError) as e:
+        # SchemaVersionError subclasses ValueError
+        print(f"error: replay failed: {e}", file=sys.stderr)
+        return 2
+    print("replay (serving/sim, docs/simulation.md):", file=out)
+    rec_cls = report.get("recorded_slo") or {}
+    for cls, obs in (report["observed"].get("per_class") or {}).items():
+        rec = rec_cls.get(cls) or {}
+        sim = ((report.get("simulated") or {}).get("per_class")
+               or {}).get(cls) or {}
+        print(f"  {cls:<12} goodput recorded="
+              f"{rec.get('goodput', float('nan')):.3f} "
+              f"observed={obs['goodput']:.3f} "
+              f"simulated={sim.get('goodput', float('nan')):.3f}  "
+              f"ttft p99 observed={obs['ttft']['p99'] * 1e3:.1f}ms "
+              f"simulated="
+              f"{(sim.get('ttft') or {}).get('p99', 0.0) * 1e3:.1f}ms",
+              file=out)
+    for c in report["crosscheck"]["checks"]:
+        if c["verdict"] == "skipped_ring_truncated":
+            print(f"  crosscheck {c['class']}: skipped (trace ring "
+                  f"truncated: {c['observed_finished']} of "
+                  f"{c['recorded_finished']} visible)", file=out)
+        else:
+            print(f"  crosscheck {c['class']}: delta {c['delta']:+.3f} "
+                  f"(tolerance {c['tolerance']}) [{c['verdict']}]",
+                  file=out)
+    print(f"  crosscheck: "
+          f"{'OK' if report['ok'] else 'BREACH'}", file=out)
+    return 0 if report["ok"] else 1
+
+
 def render_bundle(path: str, *, ticks: int = 20, requests: int = 10,
                   uri: Optional[str] = None, logs: int = 5,
                   out=None) -> int:
@@ -236,10 +307,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="render only this request id's history")
     ap.add_argument("--logs", type=int, default=5,
                     help="log-tail length (default 5)")
+    ap.add_argument("--replay", action="store_true",
+                    help="re-simulate the bundle (serving/sim) and "
+                         "print simulated-vs-recorded SLO deltas")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="replay simulation seed (default 0)")
     args = ap.parse_args(argv)
-    return render_bundle(args.bundle, ticks=args.ticks,
-                         requests=args.requests, uri=args.uri,
-                         logs=args.logs)
+    rc = render_bundle(args.bundle, ticks=args.ticks,
+                       requests=args.requests, uri=args.uri,
+                       logs=args.logs)
+    if rc == 0 and args.replay:
+        rc = render_replay(args.bundle, sys.stdout, seed=args.seed)
+    return rc
 
 
 if __name__ == "__main__":

@@ -65,11 +65,21 @@ from analytics_zoo_tpu.serving.telemetry import (MetricsRegistry,
                                                  validate_chrome_trace)
 
 __all__ = [
+    "FLIGHT_SCHEMA_VERSION",
     "FlightRecorder", "SloPolicy", "SloWatchdog", "AnomalyMonitor",
     "dump_bundle", "prune_bundles", "JsonLogFormatter", "RingLogHandler",
     "install_flight_logging", "request_uri_context", "current_request_uri",
     "DEFAULT_SLO_TARGETS", "SLO_METRICS",
 ]
+
+#: Version of the tick-record + bundle-manifest schema.  Bump whenever
+#: a field changes meaning or disappears (pure additions are fine at
+#: the same version); the discrete-event simulator
+#: (``serving/sim/replay.py``) refuses bundles stamped with a version
+#: it does not know rather than silently misreading them, and
+#: docs/simulation.md pins the current number (guarded by
+#: tests/test_flight.py).
+FLIGHT_SCHEMA_VERSION = 1
 
 # ---------------------------------------------------------------------------
 # request-id correlation
@@ -214,6 +224,10 @@ class FlightRecorder:
         return self._seq
 
     def record(self, rec: Dict[str, Any]) -> None:
+        # every retained tick states which schema wrote it, so a ring
+        # snapshot (or the bundle built from one) is self-describing
+        # even when the producer predates the reader
+        rec.setdefault("schema_version", FLIGHT_SCHEMA_VERSION)
         self._ring.append(rec)
 
     def __len__(self) -> int:
@@ -515,6 +529,7 @@ def dump_bundle(root: str, *, reason: str, detail: Dict[str, Any],
                 config: Optional[Dict[str, Any]] = None,
                 logs: Optional[List[Dict[str, Any]]] = None,
                 slo: Optional[Dict[str, Any]] = None,
+                spec_acceptance: Optional[Dict[str, Any]] = None,
                 extra: Optional[Dict[str, Any]] = None) -> str:
     """Write one self-contained diagnostic bundle directory under
     ``root`` and return its path.
@@ -522,12 +537,17 @@ def dump_bundle(root: str, *, reason: str, detail: Dict[str, Any],
     Layout (every file optional except the manifest — a missing
     telemetry or flight ring writes an empty stub, never fails):
 
-    - ``manifest.json`` — reason, trigger detail, wall time, file list
+    - ``manifest.json`` — reason, trigger detail, wall time, file list,
+      ``schema_version`` (``FLIGHT_SCHEMA_VERSION``)
     - ``flight.json`` — the flight-recorder ring, oldest tick first
     - ``metrics.json`` — merged registry snapshots + Prometheus text
     - ``trace.json`` — Chrome trace-event JSON (Perfetto-loadable)
     - ``config.json`` — the resolved ServingConfig
     - ``logs.jsonl`` — recent structured log records, one per line
+    - ``spec_acceptance.json`` — recorded speculative-acceptance
+      distribution (``ContinuousEngine.spec_acceptance``), written only
+      when the engine runs a draft model; the simulator's calibration
+      source (docs/simulation.md)
 
     ``telemetries`` is any iterable of `Telemetry` facades (serving
     job + engine); their registries merge in order into metrics.json
@@ -548,7 +568,8 @@ def dump_bundle(root: str, *, reason: str, detail: Dict[str, Any],
 
     ticks = flight.snapshot() if flight is not None else []
     _write_json(os.path.join(path, "flight.json"),
-                {"capacity": flight.capacity if flight else 0,
+                {"schema_version": FLIGHT_SCHEMA_VERSION,
+                 "capacity": flight.capacity if flight else 0,
                  "n_ticks": len(ticks), "ticks": ticks})
     files.append("flight.json")
 
@@ -595,12 +616,17 @@ def dump_bundle(root: str, *, reason: str, detail: Dict[str, Any],
     if slo is not None:
         _write_json(os.path.join(path, "slo.json"), slo)
         files.append("slo.json")
+    if spec_acceptance is not None:
+        _write_json(os.path.join(path, "spec_acceptance.json"),
+                    spec_acceptance)
+        files.append("spec_acceptance.json")
     if extra:
         _write_json(os.path.join(path, "extra.json"), extra)
         files.append("extra.json")
 
     _write_json(os.path.join(path, "manifest.json"),
-                {"reason": reason, "detail": detail,
+                {"schema_version": FLIGHT_SCHEMA_VERSION,
+                 "reason": reason, "detail": detail,
                  "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
                  "unix_ts": round(time.time(), 3), "files": files,
                  "n_flight_ticks": len(ticks)})

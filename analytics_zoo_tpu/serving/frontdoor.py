@@ -8,7 +8,10 @@ Four pillars live here (docs/serving_qos.md):
   the three classes and their weights; ``WeightedWaitQueue`` is a
   drop-in replacement for the engine's plain waiting ``deque`` that
   pops in weighted stride-scheduling order over (priority class,
-  tenant) subqueues, with aging promoting starved batch work.
+  tenant) subqueues, with aging promoting starved batch work.  Both
+  now LIVE in ``serving/policy.py`` (the pure scheduler-policy module
+  the discrete-event simulator shares — docs/simulation.md) and are
+  re-exported here unchanged.
 * **Per-token streaming** — ``TokenEmitter`` is the bounded per-request
   emission queue between the engine's pump-thread ``on_token`` hook and
   the wire: the pump drains it once per ``step()`` and publishes every
@@ -26,8 +29,8 @@ Four pillars live here (docs/serving_qos.md):
   ``text/event-stream`` chunks.
 
 This module is imported by ``continuous.py`` (scheduler swap-in), so it
-must stay dependency-light: stdlib + numpy only, no jax, no imports
-from the rest of the serving package.
+must stay dependency-light: stdlib + numpy + ``serving/policy.py``
+only, no jax, no imports from the rest of the serving package.
 """
 
 from __future__ import annotations
@@ -35,176 +38,12 @@ from __future__ import annotations
 import collections
 import json
 import time
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
-#: Priority classes, best-first.  The wire encodes a priority as its
-#: index in this tuple (the input queue transports ints, not strings);
-#: aging promotes a waiting request one index at a time toward 0.
-PRIORITIES: Tuple[str, ...] = ("interactive", "standard", "batch")
-
-DEFAULT_WEIGHTS: Dict[str, float] = {
-    "interactive": 8.0, "standard": 4.0, "batch": 1.0}
-
-
-@dataclass(frozen=True)
-class QosPolicy:
-    """Admission policy knobs: per-class weights and the aging bound.
-
-    ``weights`` are stride-scheduling shares — a class with weight 8
-    gets ~8x the admission slots of weight 1 under contention, it does
-    NOT strictly preempt it.  ``aging_s`` is the starvation bound: a
-    request that has waited ``aging_s`` is treated as one class better
-    (both for its subqueue's stride and for prefill-grant ordering),
-    two intervals promotes two classes, so batch work can wait at most
-    ``2 * aging_s`` before it competes as interactive.  ``aging_s <= 0``
-    disables promotion (weights alone still prevent total starvation:
-    a never-popped subqueue's virtual pass stands still while every
-    other queue's advances, so it eventually holds the minimum)."""
-
-    weights: Dict[str, float] = field(
-        default_factory=lambda: dict(DEFAULT_WEIGHTS))
-    aging_s: float = 30.0
-
-    def __post_init__(self):
-        for cls in PRIORITIES:
-            w = self.weights.get(cls, DEFAULT_WEIGHTS[cls])
-            if w <= 0:
-                raise ValueError(f"qos weight for {cls!r} must be > 0, "
-                                 f"got {w}")
-            self.weights.setdefault(cls, DEFAULT_WEIGHTS[cls])
-
-    def class_rank(self, priority: str, waited_s: float) -> int:
-        """Aged class index (0 best).  Unknown priorities rank as
-        ``standard`` rather than raising — the pump must never die on a
-        stale wire value."""
-        try:
-            idx = PRIORITIES.index(priority)
-        except ValueError:
-            idx = PRIORITIES.index("standard")
-        if self.aging_s > 0 and waited_s > 0:
-            idx -= int(waited_s // self.aging_s)
-        return max(0, idx)
-
-    def effective_weight(self, priority: str, waited_s: float) -> float:
-        return self.weights[PRIORITIES[self.class_rank(priority,
-                                                       waited_s)]]
-
-
-class WeightedWaitQueue:
-    """Weighted deficit/stride scheduler over (priority class, tenant)
-    FIFO subqueues, exposing the exact ``collections.deque`` surface
-    the engine uses for ``self._waiting`` (``append`` / ``appendleft``
-    / ``popleft`` / ``remove`` / iteration / ``len``) so QoS admission
-    is a constructor-time swap, not a call-site rewrite.
-
-    Entries are the engine's ``_Req`` tuples; the scheduler reads only
-    their ``priority`` / ``tenant`` / ``enq_t`` attributes (absent
-    attributes degrade to standard/shared/now).  Each subqueue carries
-    a virtual ``pass``; ``popleft`` serves the minimum-pass nonempty
-    subqueue and advances its pass by ``1 / effective_weight`` — equal
-    passes per unit work means admission slots divide proportionally to
-    weight across classes and EQUALLY across tenants inside a class
-    (each (class, tenant) pair is its own subqueue at the class
-    weight).  Aging shrinks a promoted subqueue's stride, so a starved
-    batch tenant catches up instead of merely not falling further
-    behind.
-
-    ``appendleft`` is the engine's requeue path (preemption, blocked
-    admission): the entry returns to the FRONT of its own subqueue and
-    the pop's stride charge is refunded, so bouncing off a full pool
-    costs a tenant nothing.  All call sites run under the engine lock —
-    no internal locking.
-    """
-
-    def __init__(self, policy: QosPolicy):
-        self.policy = policy
-        self._queues: "collections.OrderedDict[Tuple[str, str], collections.deque]" = \
-            collections.OrderedDict()
-        self._pass: Dict[Tuple[str, str], float] = {}
-        self._clock = 0.0
-        self._charges: Dict[int, Tuple[Tuple[str, str], float]] = {}
-        self._n = 0
-
-    @staticmethod
-    def _key(req) -> Tuple[str, str]:
-        return (getattr(req, "priority", "standard"),
-                getattr(req, "tenant", ""))
-
-    def _subqueue(self, req) -> collections.deque:
-        key = self._key(req)
-        q = self._queues.get(key)
-        if q is None:
-            q = self._queues[key] = collections.deque()
-        if not q:
-            # (re)arming an idle subqueue: clamp its pass to the global
-            # virtual clock, or a long-idle tenant would bank credit
-            # and burst past everyone on return
-            self._pass[key] = max(self._pass.get(key, 0.0), self._clock)
-        return q
-
-    def append(self, req) -> None:
-        self._subqueue(req).append(req)
-        self._n += 1
-
-    def appendleft(self, req) -> None:
-        self._subqueue(req).appendleft(req)
-        self._n += 1
-        ent = self._charges.pop(id(req), None)
-        if ent is not None:
-            key, prior_pass = ent
-            if key == self._key(req):
-                self._pass[key] = prior_pass    # requeue is cost-neutral
-
-    def popleft(self):
-        if self._n == 0:
-            raise IndexError("pop from an empty WeightedWaitQueue")
-        now = time.monotonic()
-        best_key = None
-        best_rank: Optional[Tuple[float, float]] = None
-        for key, q in self._queues.items():
-            if not q:
-                continue
-            pv = self._pass[key]
-            rank = (pv, getattr(q[0], "enq_t", now))
-            if best_rank is None or rank < best_rank:
-                best_key, best_rank = key, rank
-        q = self._queues[best_key]
-        req = q.popleft()
-        self._n -= 1
-        pv = self._pass[best_key]
-        self._clock = max(self._clock, pv)
-        waited = now - getattr(req, "enq_t", now)
-        self._pass[best_key] = pv + 1.0 / self.policy.effective_weight(
-            best_key[0], waited)
-        if len(self._charges) > 4096:   # requeues long consumed
-            self._charges.clear()
-        self._charges[id(req)] = (best_key, pv)
-        return req
-
-    def remove(self, req) -> None:
-        key = self._key(req)
-        q = self._queues.get(key)
-        if q is None:
-            raise ValueError("WeightedWaitQueue.remove(x): x not in queue")
-        q.remove(req)       # raises ValueError like deque when absent
-        self._n -= 1
-
-    def __iter__(self):
-        for q in self._queues.values():
-            yield from q
-
-    def __len__(self) -> int:
-        return self._n
-
-    def __bool__(self) -> bool:
-        return self._n > 0
-
-    def depths(self) -> Dict[Tuple[str, str], int]:
-        """Per-(class, tenant) backlog snapshot (telemetry food)."""
-        return {k: len(q) for k, q in self._queues.items() if q}
+from analytics_zoo_tpu.serving.policy import (  # noqa: F401 (re-export)
+    DEFAULT_WEIGHTS, PRIORITIES, QosPolicy, WeightedWaitQueue)
 
 
 class TokenEmitter:

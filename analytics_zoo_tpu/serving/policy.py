@@ -1,0 +1,290 @@
+"""Pure scheduler policy for the serving engine — every admission /
+grant / preemption / budget-billing DECISION the continuous-batching
+engine makes, as side-effect-free functions over plain data.
+
+This module is the split the multi-replica router (ROADMAP) and the
+discrete-event simulator (``serving/sim/``, docs/simulation.md) both
+need: ``continuous.py`` executes these decisions against real device
+state, the simulator executes the SAME functions against modelled
+state, and the equivalence tests in ``tests/test_sim.py`` pin that the
+two produce identical decision sequences from the same request
+schedule.
+
+Decision points (each names the engine call site it was extracted
+from):
+
+* ``grant_rank`` — prefill-chunk grant ordering
+  (``ContinuousEngine._grant_rank``): FIFO by admission sequence
+  without QoS; aged priority class first, FIFO within a class, with it.
+* ``pick_victim`` — pool-dry preemption choice
+  (``ContinuousEngine._pick_victim``): PREFILLING rows first (they
+  lost no emitted tokens), latest admission among candidates (earliest
+  admissions keep strict forward progress, so preemption terminates).
+* ``plan_chunks`` — token-budget billing for a chunked tick
+  (``ContinuousEngine._chunked_tick`` / ``_spec_chunked_tick``): every
+  decode row is billed ``per_row_cost`` positions (1 plain, ``k+1``
+  speculative), the remainder grants prefill chunks in grant order,
+  each capped by the widest chunk bucket.
+* ``select_subqueue`` / ``stride_charge`` — the weighted
+  deficit/stride admission order (``WeightedWaitQueue.popleft``).
+
+Everything here is stdlib-only ON PURPOSE: the simulator (and the
+bare-box ``debug.py --replay`` path) import this file with no numpy,
+no jax, no serving stack.  Time is always an explicit parameter —
+``time.monotonic`` never appears in a decision function, which is what
+makes replay deterministic.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: Monotonically bumped whenever a decision function's observable
+#: behavior changes.  The simulator stamps it into every event log so
+#: a golden-trace mismatch distinguishes "policy changed" from "sim
+#: drifted".
+SCHEDULER_POLICY_VERSION = 1
+
+#: Priority classes, best-first.  The wire encodes a priority as its
+#: index in this tuple (the input queue transports ints, not strings);
+#: aging promotes a waiting request one index at a time toward 0.
+PRIORITIES: Tuple[str, ...] = ("interactive", "standard", "batch")
+
+DEFAULT_WEIGHTS: Dict[str, float] = {
+    "interactive": 8.0, "standard": 4.0, "batch": 1.0}
+
+
+@dataclass(frozen=True)
+class QosPolicy:
+    """Admission policy knobs: per-class weights and the aging bound.
+
+    ``weights`` are stride-scheduling shares — a class with weight 8
+    gets ~8x the admission slots of weight 1 under contention, it does
+    NOT strictly preempt it.  ``aging_s`` is the starvation bound: a
+    request that has waited ``aging_s`` is treated as one class better
+    (both for its subqueue's stride and for prefill-grant ordering),
+    two intervals promotes two classes, so batch work can wait at most
+    ``2 * aging_s`` before it competes as interactive.  ``aging_s <= 0``
+    disables promotion (weights alone still prevent total starvation:
+    a never-popped subqueue's virtual pass stands still while every
+    other queue's advances, so it eventually holds the minimum)."""
+
+    weights: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_WEIGHTS))
+    aging_s: float = 30.0
+
+    def __post_init__(self):
+        for cls in PRIORITIES:
+            w = self.weights.get(cls, DEFAULT_WEIGHTS[cls])
+            if w <= 0:
+                raise ValueError(f"qos weight for {cls!r} must be > 0, "
+                                 f"got {w}")
+            self.weights.setdefault(cls, DEFAULT_WEIGHTS[cls])
+
+    def class_rank(self, priority: str, waited_s: float) -> int:
+        """Aged class index (0 best).  Unknown priorities rank as
+        ``standard`` rather than raising — the pump must never die on a
+        stale wire value."""
+        try:
+            idx = PRIORITIES.index(priority)
+        except ValueError:
+            idx = PRIORITIES.index("standard")
+        if self.aging_s > 0 and waited_s > 0:
+            idx -= int(waited_s // self.aging_s)
+        return max(0, idx)
+
+    def effective_weight(self, priority: str, waited_s: float) -> float:
+        return self.weights[PRIORITIES[self.class_rank(priority,
+                                                       waited_s)]]
+
+
+# ---------------------------------------------------------------------------
+# decision functions (pure: plain data in, decision out)
+# ---------------------------------------------------------------------------
+
+def grant_rank(policy: Optional[QosPolicy], priority: Optional[str],
+               waited_s: float, admit_seq: int):
+    """Prefill-grant sort key for the chunked ticks.  QoS off: the
+    admission sequence number — bit-identical FIFO to the
+    pre-front-door engine (the parity guarantee).  QoS on: aged
+    priority class first, FIFO within a class, so an interactive
+    prompt's chunks land ahead of a batch prompt admitted earlier
+    while aging still bounds how long batch can be outranked."""
+    if policy is None:
+        return admit_seq
+    if priority is None:
+        return (policy.class_rank("standard", 0.0), admit_seq)
+    return (policy.class_rank(priority, waited_s), admit_seq)
+
+
+def pick_victim(rows: Iterable[Tuple[int, str, int]]) -> int:
+    """Pool-dry preemption choice over resident rows, each a
+    ``(slot, state, admit_seq)`` triple.  PREFILLING rows first: they
+    lost no emitted tokens and requeue cheaply; among candidates,
+    always the LATEST admission (earliest admissions keep strict
+    forward progress, so repeated preemption terminates)."""
+    rows = list(rows)
+    pre = [r for r in rows if r[1] == "PREFILLING"]
+    return max(pre or rows, key=lambda r: r[2])[0]
+
+
+def plan_chunks(budget: int, per_row_cost: int, n_decode: int,
+                prefill: Sequence[Tuple[int, int]],
+                chunk_cap: int) -> Tuple[List[Tuple[int, int]], bool]:
+    """Token-budget billing for one chunked tick.  Every decode row is
+    billed ``per_row_cost`` positions (1 plain, ``speculation_k + 1``
+    speculative); the remainder grants prefill chunks to ``prefill`` —
+    ``(slot, remaining_prompt_tokens)`` pairs ALREADY in grant order
+    (``grant_rank``) — each chunk capped at ``chunk_cap`` (the widest
+    chunk bucket).  Returns ``(chunks, stalled)`` where ``chunks`` is
+    ``[(slot, chunk_len), ...]`` and ``stalled`` flags a tick whose
+    budget was fully consumed by decode rows while prefill work
+    waited (the engine's ``prefill_stall_ticks`` counter)."""
+    remaining = budget - per_row_cost * n_decode
+    chunks: List[Tuple[int, int]] = []
+    for slot, rem in prefill:
+        if remaining <= 0:
+            break
+        clen = min(rem, remaining, chunk_cap)
+        if clen <= 0:
+            continue
+        chunks.append((slot, clen))
+        remaining -= clen
+    return chunks, bool(prefill) and not chunks
+
+
+def select_subqueue(entries: Iterable[Tuple[Tuple[str, str], float,
+                                            float]]):
+    """The weighted-stride pop decision: given ``(key, pass, head
+    enqueue time)`` for every NONEMPTY subqueue, return the key to
+    serve — minimum virtual pass, oldest head entry on ties (two idle
+    subqueues re-armed at the same clock must pop FIFO)."""
+    best_key = None
+    best_rank: Optional[Tuple[float, float]] = None
+    for key, pv, enq_t in entries:
+        rank = (pv, enq_t)
+        if best_rank is None or rank < best_rank:
+            best_key, best_rank = key, rank
+    return best_key
+
+
+def stride_charge(policy: QosPolicy, priority: str,
+                  waited_s: float) -> float:
+    """Virtual-pass advance for serving one entry: ``1 / effective
+    weight``.  Aging shrinks a promoted subqueue's stride, so a
+    starved batch tenant catches up instead of merely not falling
+    further behind."""
+    return 1.0 / policy.effective_weight(priority, waited_s)
+
+
+class WeightedWaitQueue:
+    """Weighted deficit/stride scheduler over (priority class, tenant)
+    FIFO subqueues, exposing the exact ``collections.deque`` surface
+    the engine uses for ``self._waiting`` (``append`` / ``appendleft``
+    / ``popleft`` / ``remove`` / iteration / ``len``) so QoS admission
+    is a constructor-time swap, not a call-site rewrite.
+
+    Entries are the engine's ``_Req`` tuples; the scheduler reads only
+    their ``priority`` / ``tenant`` / ``enq_t`` attributes (absent
+    attributes degrade to standard/shared/now).  Each subqueue carries
+    a virtual ``pass``; ``popleft`` serves the minimum-pass nonempty
+    subqueue (``select_subqueue``) and advances its pass by
+    ``stride_charge`` — equal passes per unit work means admission
+    slots divide proportionally to weight across classes and EQUALLY
+    across tenants inside a class (each (class, tenant) pair is its
+    own subqueue at the class weight).
+
+    ``appendleft`` is the engine's requeue path (preemption, blocked
+    admission): the entry returns to the FRONT of its own subqueue and
+    the pop's stride charge is refunded, so bouncing off a full pool
+    costs a tenant nothing.  All call sites run under the engine lock —
+    no internal locking.
+
+    ``clock`` injects the time source (default ``time.monotonic``):
+    the simulator drives the SAME scheduler on virtual time, which is
+    what makes its event logs reproducible byte-for-byte."""
+
+    def __init__(self, policy: QosPolicy, clock=time.monotonic):
+        self.policy = policy
+        self._now = clock
+        self._queues: "collections.OrderedDict[Tuple[str, str], collections.deque]" = \
+            collections.OrderedDict()
+        self._pass: Dict[Tuple[str, str], float] = {}
+        self._clock = 0.0
+        self._charges: Dict[int, Tuple[Tuple[str, str], float]] = {}
+        self._n = 0
+
+    @staticmethod
+    def _key(req) -> Tuple[str, str]:
+        return (getattr(req, "priority", "standard"),
+                getattr(req, "tenant", ""))
+
+    def _subqueue(self, req) -> collections.deque:
+        key = self._key(req)
+        q = self._queues.get(key)
+        if q is None:
+            q = self._queues[key] = collections.deque()
+        if not q:
+            # (re)arming an idle subqueue: clamp its pass to the global
+            # virtual clock, or a long-idle tenant would bank credit
+            # and burst past everyone on return
+            self._pass[key] = max(self._pass.get(key, 0.0), self._clock)
+        return q
+
+    def append(self, req) -> None:
+        self._subqueue(req).append(req)
+        self._n += 1
+
+    def appendleft(self, req) -> None:
+        self._subqueue(req).appendleft(req)
+        self._n += 1
+        ent = self._charges.pop(id(req), None)
+        if ent is not None:
+            key, prior_pass = ent
+            if key == self._key(req):
+                self._pass[key] = prior_pass    # requeue is cost-neutral
+
+    def popleft(self):
+        if self._n == 0:
+            raise IndexError("pop from an empty WeightedWaitQueue")
+        now = self._now()
+        best_key = select_subqueue(
+            (key, self._pass[key], getattr(q[0], "enq_t", now))
+            for key, q in self._queues.items() if q)
+        q = self._queues[best_key]
+        req = q.popleft()
+        self._n -= 1
+        pv = self._pass[best_key]
+        self._clock = max(self._clock, pv)
+        waited = now - getattr(req, "enq_t", now)
+        self._pass[best_key] = pv + stride_charge(
+            self.policy, best_key[0], waited)
+        if len(self._charges) > 4096:   # requeues long consumed
+            self._charges.clear()
+        self._charges[id(req)] = (best_key, pv)
+        return req
+
+    def remove(self, req) -> None:
+        key = self._key(req)
+        q = self._queues.get(key)
+        if q is None:
+            raise ValueError("WeightedWaitQueue.remove(x): x not in queue")
+        q.remove(req)       # raises ValueError like deque when absent
+        self._n -= 1
+
+    def __iter__(self):
+        for q in self._queues.values():
+            yield from q
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def depths(self) -> Dict[Tuple[str, str], int]:
+        """Per-(class, tenant) backlog snapshot (telemetry food)."""
+        return {k: len(q) for k, q in self._queues.items() if q}

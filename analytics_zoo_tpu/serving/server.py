@@ -870,12 +870,18 @@ class ClusterServing:
         """AnomalyMonitor's dump callback: one self-contained bundle
         directory under ``diag_dir`` (docs/debugging.md), then prune
         to ``diag_max_bundles``."""
+        engine = getattr(self, "engine", None)
+        spec_acceptance = (engine.spec_acceptance()
+                           if engine is not None
+                           and hasattr(engine, "spec_acceptance")
+                           else None)
         path = dump_bundle(
             self.config.diag_dir, reason=reason, detail=detail,
             flight=self.flight, telemetries=(self.telemetry,),
             config=dataclasses.asdict(self.config),
             logs=self.log_ring.snapshot(),
-            slo=self.watchdog.status())
+            slo=self.watchdog.status(),
+            spec_acceptance=spec_acceptance)
         prune_bundles(self.config.diag_dir,
                       max(1, self.config.diag_max_bundles))
         return path

@@ -1,0 +1,49 @@
+"""Discrete-event simulator for the continuous-batching serving engine.
+
+A jax-free, numpy-free model of the engine's SCHEDULING behavior —
+admission, block-pool accounting, token-budget chunked ticks, QoS
+deficit-round-robin queues, speculative acceptance as a stochastic
+process — that answers scheduler-policy questions (QoS weights, aging
+constants, ``tick_token_budget``, pool sizes) offline, in seconds, at
+million-request scale, with no device anywhere (docs/simulation.md).
+
+Two modes:
+
+* **Replay** (``sim.replay``): load a diagnostic bundle
+  (``serving/flight.py::dump_bundle``), re-derive per-request
+  TTFT/TPOT/queue-wait and per-class goodput from its trace, cross-check
+  them against the bundle's own recorded telemetry within documented
+  tolerances, and re-simulate the recorded request schedule to compare
+  modelled against measured behavior.
+* **Scenario** (``sim.model`` + ``sim.trace``): run a seeded synthetic
+  trace (Poisson or diurnal arrivals, mixed priority classes and
+  tenants) through the modelled engine and report p50/p99 latencies and
+  per-class goodput — the offline sweep surface, and the substrate of
+  the ``make sim-gate`` golden-trace regression envelope.
+
+The simulator makes scheduling decisions by calling the SAME pure
+functions the real engine calls (``serving/policy.py``: ``grant_rank``,
+``pick_victim``, ``plan_chunks``, ``WeightedWaitQueue``) — equivalence
+is pinned by tests/test_sim.py driving both from one request schedule.
+
+Import contract: stdlib + ``serving/policy.py`` only.  The package
+must load on a bare box with neither jax nor numpy installed —
+``serving/debug.py --replay`` bootstraps it file-by-file exactly that
+way.  Time never comes from the wall clock: the model runs on virtual
+seconds, which is what makes two runs of the same seed byte-identical.
+"""
+
+from ..policy import SCHEDULER_POLICY_VERSION  # noqa: F401
+from .model import (AcceptanceModel, EngineConfig, EngineModel,  # noqa: F401
+                    TimingModel, percentile, summarize)
+from .replay import (SUPPORTED_SCHEMA_VERSIONS,  # noqa: F401
+                     SchemaVersionError, load_bundle, replay_bundle)
+from .trace import Request, diurnal_trace, poisson_trace  # noqa: F401
+
+__all__ = [
+    "AcceptanceModel", "EngineConfig", "EngineModel", "TimingModel",
+    "Request", "poisson_trace", "diurnal_trace",
+    "SUPPORTED_SCHEMA_VERSIONS", "SchemaVersionError",
+    "load_bundle", "replay_bundle",
+    "percentile", "summarize", "SCHEDULER_POLICY_VERSION",
+]

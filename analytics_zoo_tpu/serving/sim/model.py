@@ -1,0 +1,914 @@
+"""Discrete-event model of the continuous-batching serving engine.
+
+``EngineModel`` mirrors ``ContinuousEngine._step_impl``'s scheduling
+skeleton — admission, the chunked/spec/decode tick dispatch, token-
+budget billing, paged block accounting with pool-dry preemption, QoS
+weighted admission — while replacing the device with a timing model and
+the LM with a completion-length oracle (``Request.gen_len``) plus a
+calibrated stochastic acceptance process for speculative rounds.
+
+Every scheduling DECISION is made by the same pure functions the real
+engine calls (``serving/policy.py``): ``grant_rank`` orders prefill
+grants, ``plan_chunks`` bills the token budget, ``pick_victim`` chooses
+preemptions, and ``WeightedWaitQueue`` (driven by the model's virtual
+clock) orders QoS admission.  ``tests/test_sim.py`` pins decision-
+sequence equivalence against the live engine.
+
+What is modelled exactly (same code path, same order):
+
+* chunked admission (``_admit_chunked``): pop-while-free-slots, paged
+  dry/blocked/error gates, front-requeue on blocked;
+* tick dispatch: spec_chunked / spec / chunked / plain decode, chosen
+  by the same predicate as ``_step_impl``;
+* budget billing and stall accounting (``plan_chunks``);
+* paged growth per tick (``_grow_chunk_blocks`` / ``_ensure_blocks``)
+  with latest-admission-prefilling-first preemption, lockstep draft
+  pool, front requeue, discard-partial-tokens semantics;
+* end-of-tick re-admission (freed slots recycle on the same iteration).
+
+What is approximated (documented in docs/simulation.md):
+
+* no prefix cache — every admission matches zero blocks;
+* non-chunked admission prefills monolithically at admission time and
+  emits the first token there (the engine's grouped-prefill batching
+  is a latency detail below the model's resolution);
+* all of a tick's token emissions are stamped at the tick's END (the
+  engine stamps them mid-tick, inside the device-call span);
+* tick duration comes from ``TimingModel`` (affine in billed tokens),
+  not a device.
+
+Virtual time only: ``EngineModel.now`` starts at 0 and advances by
+modelled tick durations.  No wall clock, no hash-order iteration, one
+``random.Random(seed)`` — two runs of the same (config, trace, seed)
+produce byte-identical event logs (``event_log_lines``).
+"""
+
+import json
+import math
+import random
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import policy as scheduler_policy
+from ..policy import PRIORITIES, QosPolicy, WeightedWaitQueue
+from .trace import Request
+
+__all__ = ["AcceptanceModel", "EngineConfig", "EngineModel",
+           "TimingModel", "percentile", "summarize",
+           "DEFAULT_SLO_TARGETS"]
+
+#: Mirror of serving/flight.py::DEFAULT_SLO_TARGETS (seconds).  The sim
+#: cannot import flight.py (numpy); tests/test_sim.py pins the two
+#: tables equal so they cannot drift apart silently.
+DEFAULT_SLO_TARGETS: Dict[str, Dict[str, float]] = {
+    "interactive": {"ttft": 1.0, "tpot": 0.25, "queue_wait": 0.5},
+    "standard": {"ttft": 2.5, "tpot": 0.5, "queue_wait": 2.0},
+    "batch": {"ttft": 10.0, "tpot": 2.0, "queue_wait": 30.0},
+}
+
+
+def _t(x: float) -> float:
+    """Stable float for event logs: fixed 9-dp rounding."""
+    return round(float(x), 9)
+
+
+# ---------------------------------------------------------------------------
+# calibrated sub-models
+# ---------------------------------------------------------------------------
+
+class TimingModel:
+    """Tick duration, affine in billed tokens:
+    ``dur_s = base_s + per_token_s * tokens``.
+
+    ``fit`` calibrates from a bundle's tick records (least squares of
+    ``dur_ms`` against billed tokens), so a replayed bundle runs on the
+    recorded machine's measured speed rather than a guess."""
+
+    def __init__(self, base_s: float = 0.002,
+                 per_token_s: float = 0.00005):
+        self.base_s = float(base_s)
+        self.per_token_s = float(per_token_s)
+
+    def tick_s(self, tokens: int) -> float:
+        return self.base_s + self.per_token_s * max(0, int(tokens))
+
+    def to_dict(self) -> Dict[str, float]:
+        return {"base_s": self.base_s, "per_token_s": self.per_token_s}
+
+    @classmethod
+    def fit(cls, samples: Sequence[Tuple[int, float]],
+            default: Optional["TimingModel"] = None) -> "TimingModel":
+        """Least-squares fit of ``(tokens, dur_s)`` samples; degenerate
+        inputs (no samples, constant x) fall back to the mean duration
+        as ``base_s`` (or ``default`` when there are no samples)."""
+        samples = [(int(n), float(d)) for n, d in samples if d >= 0]
+        if not samples:
+            return default or cls()
+        n = len(samples)
+        mx = sum(s[0] for s in samples) / n
+        my = sum(s[1] for s in samples) / n
+        sxx = sum((s[0] - mx) ** 2 for s in samples)
+        if sxx <= 0:
+            return cls(base_s=my, per_token_s=0.0)
+        slope = sum((s[0] - mx) * (s[1] - my) for s in samples) / sxx
+        base = my - slope * mx
+        if slope < 0 or base < 0:
+            # noisy small bundles can fit a negative slope/intercept;
+            # clamp to the physically meaningful constant model
+            return cls(base_s=max(my, 0.0), per_token_s=0.0)
+        return cls(base_s=base, per_token_s=slope)
+
+
+class AcceptanceModel:
+    """Speculative acceptance-length distribution: P(accept_len = a)
+    for ``a`` in ``0..k``, sampled per decode row per spec round.
+
+    ``from_counts`` calibrates from the engine's recorded exact counts
+    (the ``spec_acceptance`` bundle section / histogram satellite);
+    ``constant`` gives a degenerate distribution for what-if sweeps."""
+
+    def __init__(self, k: int, pmf: Sequence[float]):
+        if k < 0:
+            raise ValueError("k must be >= 0")
+        if len(pmf) != k + 1:
+            raise ValueError(f"pmf needs k+1={k + 1} entries, "
+                             f"got {len(pmf)}")
+        total = float(sum(pmf))
+        if total <= 0:
+            raise ValueError("pmf must have positive mass")
+        self.k = int(k)
+        self.pmf = [float(p) / total for p in pmf]
+        self._cdf = []
+        acc = 0.0
+        for p in self.pmf:
+            acc += p
+            self._cdf.append(acc)
+
+    @classmethod
+    def from_counts(cls, counts: Dict[Any, int], k: int) -> "AcceptanceModel":
+        pmf = [0.0] * (k + 1)
+        for key, v in counts.items():
+            a = int(key)
+            if 0 <= a <= k:
+                pmf[a] += int(v)
+        if sum(pmf) <= 0:
+            return cls.constant(k, k)
+        return cls(k, pmf)
+
+    @classmethod
+    def constant(cls, accept_len: int, k: int) -> "AcceptanceModel":
+        pmf = [0.0] * (k + 1)
+        pmf[max(0, min(int(accept_len), k))] = 1.0
+        return cls(k, pmf)
+
+    @property
+    def mean(self) -> float:
+        return sum(a * p for a, p in enumerate(self.pmf))
+
+    def sample(self, rng: random.Random) -> int:
+        x = rng.random()
+        for a, c in enumerate(self._cdf):
+            if x < c:
+                return a
+        return self.k
+
+
+# ---------------------------------------------------------------------------
+# engine configuration
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EngineConfig:
+    """The scheduling-relevant subset of ``ContinuousEngine``'s
+    constructor knobs.  Derived quantities (default token budget, chunk
+    buckets, paged caps) reproduce the engine's formulas exactly."""
+
+    slots: int = 8
+    max_new_tokens: int = 32
+    ticks_per_step: int = 1
+    prompt_buckets: Tuple[int, ...] = (16, 32, 64, 128)
+    chunked: bool = False
+    tick_token_budget: Optional[int] = None
+    paged: bool = False
+    block_size: int = 16
+    n_blocks: Optional[int] = None
+    draft_n_blocks: Optional[int] = None
+    spec_k: int = 0             # 0 = no draft model
+
+    def __post_init__(self):
+        self.prompt_buckets = tuple(sorted(int(b)
+                                           for b in self.prompt_buckets))
+        if self.slots < 1:
+            raise ValueError("slots must be >= 1")
+        if self.paged and self.n_blocks is None:
+            # engine default: enough blocks for every slot's full
+            # context is the caller's job; the sim wants an explicit
+            # number so pool pressure is a modelled choice
+            raise ValueError("paged=True needs n_blocks")
+        if self.spec_k > 0 and self.paged and self.draft_n_blocks is None:
+            self.draft_n_blocks = self.n_blocks
+        if self.chunked:
+            per_row = self.spec_k + 1 if self.spec_k > 0 else 1
+            if self.tick_token_budget is None:
+                # ContinuousEngine's default budget formula
+                budget = max(self.prompt_buckets[0] + per_row * self.slots,
+                             2 * per_row * self.slots)
+                if self.paged:
+                    budget = max(budget, self.block_size)
+                self.tick_token_budget = budget
+            if self.tick_token_budget < self.prompt_buckets[0]:
+                raise ValueError(
+                    f"tick_token_budget {self.tick_token_budget} below "
+                    f"the smallest prompt bucket "
+                    f"{self.prompt_buckets[0]}")
+            if self.paged and self.tick_token_budget < self.block_size:
+                raise ValueError(
+                    f"tick_token_budget {self.tick_token_budget} below "
+                    f"block_size {self.block_size}")
+
+    @property
+    def chunk_buckets(self) -> Tuple[int, ...]:
+        return tuple(b for b in self.prompt_buckets
+                     if b <= (self.tick_token_budget or 0))
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EngineConfig":
+        known = {f for f in cls.__dataclass_fields__}  # noqa: C401
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+class _Pool:
+    """Counter model of ``paged_cache.BlockPool``: block 0 is the sink,
+    ``n_blocks - 1`` usable blocks, no prefix cache (so ``allocatable``
+    is just the free count)."""
+
+    def __init__(self, n_blocks: int):
+        self.n_blocks = int(n_blocks)
+        self.free = self.n_blocks - 1
+        self.alloc_failures = 0
+
+    def allocatable(self) -> int:
+        return self.free
+
+    def allocate(self) -> bool:
+        if self.free <= 0:
+            self.alloc_failures += 1
+            return False
+        self.free -= 1
+        return True
+
+    def release(self, n: int) -> None:
+        self.free += int(n)
+
+
+class _Row:
+    """A resident slot: the sim's ``_Slot``."""
+
+    __slots__ = ("req", "state", "fill_pos", "emitted", "admit_seq",
+                 "blocks", "gen_len")
+
+    def __init__(self, req: "_SimReq", state: str, admit_seq: int):
+        self.req = req
+        self.state = state
+        self.fill_pos = 0
+        self.emitted = 0
+        self.admit_seq = admit_seq
+        self.blocks = 0         # both tenants grow in lockstep
+        self.gen_len = req.gen_len
+
+    @property
+    def pos(self) -> int:
+        """Next write position (engine ``_pos``).  The engine parks a
+        fresh decode row at ``prompt_len`` with its first token already
+        emitted — the token's K/V lands at ``prompt_len`` on the *next*
+        forward pass — so a decode row's next write is
+        ``prompt_len + emitted - 1``, not ``+ emitted``."""
+        return self.req.prompt_len + max(0, self.emitted - 1)
+
+
+class _SimReq:
+    """Queue entry: carries the attributes ``WeightedWaitQueue`` reads
+    (``priority`` / ``tenant`` / ``enq_t``) plus the request body.
+    Deliberately a plain mutable object — the queue keys refunds by
+    ``id()`` like the engine's ``_Req``."""
+
+    __slots__ = ("uri", "prompt_len", "gen_len", "priority", "tenant",
+                 "enq_t")
+
+    def __init__(self, r: Request, max_new_tokens: int):
+        self.uri = r.uri
+        self.prompt_len = int(r.prompt_len)
+        self.gen_len = max(1, min(int(r.gen_len), max_new_tokens))
+        self.priority = r.priority if r.priority in PRIORITIES \
+            else "standard"
+        self.tenant = r.tenant
+        self.enq_t = float(r.arrival_t)
+
+
+@dataclass
+class _Record:
+    """Per-request lifecycle record, mirroring what telemetry's trace
+    events expose: every admission epoch observes queue-wait from the
+    ORIGINAL arrival, every first token observes TTFT from the original
+    arrival (the engine re-stamps both after preemption)."""
+
+    uri: str
+    priority: str
+    tenant: str
+    arrival: float
+    admits: List[float] = field(default_factory=list)
+    queue_waits: List[float] = field(default_factory=list)
+    first_tokens: List[float] = field(default_factory=list)
+    preempts: int = 0
+    finish_t: Optional[float] = None
+    tokens: int = 0
+    dropped: Optional[str] = None
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_t is not None
+
+    @property
+    def ttfts(self) -> List[float]:
+        return [ft - self.arrival for ft in self.first_tokens]
+
+    @property
+    def tpot(self) -> Optional[float]:
+        if not self.finished or self.tokens < 2 or not self.first_tokens:
+            return None
+        return (self.finish_t - self.first_tokens[-1]) / (self.tokens - 1)
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+class EngineModel:
+    """Virtual-time discrete-event model of ``ContinuousEngine``.
+
+    ``run(trace)`` feeds a sorted request list through the modelled
+    engine and returns the per-request records; ``summarize`` turns
+    records into per-class latency/goodput tables.  ``record_events``
+    keeps the per-tick decision log (admissions, grants, preemptions) —
+    turn it off for million-request sweeps where only the summary
+    matters."""
+
+    def __init__(self, config: EngineConfig,
+                 qos: Optional[QosPolicy] = None,
+                 acceptance: Optional[AcceptanceModel] = None,
+                 timing: Optional[TimingModel] = None,
+                 seed: int = 0, record_events: bool = True):
+        self.config = config
+        self.qos = qos
+        self.timing = timing or TimingModel()
+        self.rng = random.Random(seed)
+        self.seed = int(seed)
+        self.record_events = bool(record_events)
+        if config.spec_k > 0:
+            self.acceptance = acceptance or AcceptanceModel.constant(
+                config.spec_k, config.spec_k)
+            if self.acceptance.k != config.spec_k:
+                raise ValueError(
+                    f"acceptance model k={self.acceptance.k} != "
+                    f"config spec_k={config.spec_k}")
+        else:
+            self.acceptance = None
+
+        self.now = 0.0
+        S = config.slots
+        self._slots: List[Optional[_Row]] = [None] * S
+        self._free: deque = deque(range(S))
+        self._admit_seq = 0
+        self._waiting = (WeightedWaitQueue(qos, clock=lambda: self.now)
+                         if qos is not None else deque())
+        self._pool = _Pool(config.n_blocks) if config.paged else None
+        self._dpool = (_Pool(config.draft_n_blocks)
+                       if config.paged and config.spec_k > 0 else None)
+
+        self.records: Dict[str, _Record] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.ticks = 0
+        self.preemptions = 0
+        self.prefill_preemptions = 0
+        self.prefill_stall_ticks = 0
+        self.budget_ticks = 0
+        self.budget_tokens_used = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        # scratch decision lists, reset per tick, flushed to the event
+        # log — lets the tick event carry what THIS tick decided
+        self._ev_admitted: List[str] = []
+        self._ev_preempted: List[str] = []
+        self._ev_chunks: List[Tuple[str, int]] = []
+        self._ev_dropped: List[str] = []
+        # (row, n) emissions decided during a tick, landed at its end
+        self._pending_emits: List[Tuple[_Row, int]] = []
+
+    # -- bookkeeping ----------------------------------------------------
+
+    @property
+    def n_active(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def _emit(self, kind: str, **kw) -> None:
+        if self.record_events:
+            ev = {"event": kind, "t": _t(self.now)}
+            ev.update(kw)
+            self.events.append(ev)
+
+    def event_log_lines(self) -> List[str]:
+        """Canonical event-log serialization: one sorted-key compact
+        JSON object per line.  Byte-identical across runs of the same
+        (config, trace, seed) — the determinism tests hash this."""
+        return [json.dumps(e, sort_keys=True, separators=(",", ":"))
+                for e in self.events]
+
+    # -- request lifecycle ----------------------------------------------
+
+    def submit(self, r: Request) -> None:
+        req = _SimReq(r, self.config.max_new_tokens)
+        self.records[req.uri] = _Record(
+            uri=req.uri, priority=req.priority, tenant=req.tenant,
+            arrival=req.enq_t)
+        self._waiting.append(req)
+
+    def _drop(self, req: "_SimReq", reason: str) -> None:
+        self.records[req.uri].dropped = reason
+        self._ev_dropped.append(req.uri)
+
+    def _record_admit(self, req: "_SimReq") -> None:
+        rec = self.records[req.uri]
+        rec.admits.append(self.now)
+        rec.queue_waits.append(self.now - rec.arrival)
+        self._ev_admitted.append(req.uri)
+
+    def _record_tokens(self, row: _Row, n: int, t: float) -> None:
+        """Land ``n`` generated tokens at time ``t``; finish + free the
+        slot when the completion length is reached (``_record_token``'s
+        finish path)."""
+        if n <= 0:
+            return
+        rec = self.records[row.req.uri]
+        if row.emitted == 0:
+            rec.first_tokens.append(t)
+        row.emitted += n
+        if row.emitted >= row.gen_len:
+            row.emitted = row.gen_len
+            rec.finish_t = t
+            rec.tokens = row.gen_len
+            i = self._slots.index(row)
+            self._slots[i] = None
+            self._free.append(i)
+            self._release_blocks(row)
+            self._emit("finish", uri=row.req.uri, tokens=row.gen_len)
+
+    def _release_blocks(self, row: _Row) -> None:
+        if self._pool is not None and row.blocks:
+            self._pool.release(row.blocks)
+            if self._dpool is not None:
+                self._dpool.release(row.blocks)
+            row.blocks = 0
+
+    # -- preemption (engine `_preempt` / `_grow_tenant`) ----------------
+
+    def _pick_victim(self) -> int:
+        return scheduler_policy.pick_victim(
+            (i, s.state, s.admit_seq)
+            for i, s in enumerate(self._slots) if s is not None)
+
+    def _preempt(self, slot: int) -> None:
+        row = self._slots[slot]
+        self._slots[slot] = None
+        self._free.append(slot)
+        self._release_blocks(row)
+        self.preemptions += 1
+        if row.state == "PREFILLING":
+            self.prefill_preemptions += 1
+        rec = self.records[row.req.uri]
+        rec.preempts += 1
+        # partial tokens are discarded; the epoch's first-token stamp
+        # stays in history (the engine's watchdog saw it too) and the
+        # NEXT epoch re-stamps TTFT from the original arrival
+        self._waiting.appendleft(row.req)
+        self._ev_preempted.append(row.req.uri)
+
+    def _grow_row(self, i: int, need: int) -> None:
+        while (self._slots[i] is not None
+               and self._slots[i].blocks < need):
+            ok = self._pool.allocate()
+            if ok and self._dpool is not None:
+                if not self._dpool.allocate():
+                    self._pool.release(1)
+                    ok = False
+            if not ok:
+                self._preempt(self._pick_victim())
+                continue
+            self._slots[i].blocks += 1
+
+    def _ensure_blocks(self, active: List[int]) -> List[int]:
+        bs = self.config.block_size
+        for i in list(active):
+            row = self._slots[i]
+            if row is None:
+                continue
+            if self.config.spec_k > 0:
+                last_write = row.pos + self.config.spec_k
+            else:
+                ticks = max(1, min(self.config.ticks_per_step,
+                                   row.gen_len - row.emitted))
+                last_write = row.pos + ticks - 1
+            self._grow_row(i, last_write // bs + 1)
+        return [i for i in active if self._slots[i] is not None]
+
+    def _grow_chunk_blocks(self, decode_rows, chunks) -> None:
+        bs = self.config.block_size
+        for i in decode_rows:
+            if self._slots[i] is None:
+                continue
+            last_write = self._slots[i].pos + (
+                self.config.spec_k if self.config.spec_k > 0 else 0)
+            self._grow_row(i, last_write // bs + 1)
+        for i, clen in chunks:
+            row = self._slots[i]
+            if row is None:
+                continue
+            self._grow_row(i, (row.fill_pos + clen - 1) // bs + 1)
+
+    # -- admission (engine `_admit` family) -----------------------------
+
+    def _pop_waiting(self) -> Optional["_SimReq"]:
+        return self._waiting.popleft() if self._waiting else None
+
+    def _requeue_front(self, req: "_SimReq") -> None:
+        self._waiting.appendleft(req)
+
+    def _admit(self) -> int:
+        if self.config.chunked:
+            return self._admit_chunked()
+        return self._admit_monolithic()
+
+    def _admit_chunked(self) -> int:
+        admitted = 0
+        while self._free:
+            req = self._pop_waiting()
+            if req is None:
+                break
+            res = (self._admit_one_chunked_paged(req) if self.config.paged
+                   else self._admit_one_chunked(req))
+            if res == "admitted":
+                admitted += 1
+            elif res == "blocked":
+                self._requeue_front(req)
+                break
+        return admitted
+
+    def _install_prefill(self, req: "_SimReq") -> None:
+        slot = self._free.popleft()
+        row = _Row(req, "PREFILLING", self._admit_seq)
+        self._admit_seq += 1
+        self._slots[slot] = row
+        self._record_admit(req)
+
+    def _admit_one_chunked(self, req: "_SimReq") -> str:
+        self._install_prefill(req)
+        return "admitted"
+
+    def _admit_one_chunked_paged(self, req: "_SimReq") -> str:
+        bs = self.config.block_size
+        plen = req.prompt_len
+        # no prefix cache in the model: matched == 0, need == total
+        need = -(-plen // bs)
+        cap = self._pool.n_blocks - 1
+        if self._dpool is not None:
+            cap = min(cap, self._dpool.n_blocks - 1)
+        if need + 1 > cap:
+            self._drop(req, "prompt_exceeds_pool")
+            return "error"
+        dry = self._pool.allocatable() < 2 or (
+            self._dpool is not None and self._dpool.allocatable() < 2)
+        if dry:
+            if self.n_active == 0:
+                self._drop(req, "pool_dry_no_residents")
+                return "error"
+            return "blocked"
+        self._install_prefill(req)
+        return "admitted"
+
+    def _admit_monolithic(self) -> int:
+        """Non-chunked admission, approximated: the whole prompt
+        prefills at admission time (first token stamped immediately);
+        paged admission gates on blocks for the full prompt plus one
+        decode block of headroom, requeueing at the front when the pool
+        cannot take it (``_admit_paged``'s plan gate)."""
+        admitted = 0
+        while self._free:
+            req = self._pop_waiting()
+            if req is None:
+                break
+            if self.config.paged:
+                bs = self.config.block_size
+                need = -(-req.prompt_len // bs) + 1
+                cap = self._pool.n_blocks - 1
+                if self._dpool is not None:
+                    cap = min(cap, self._dpool.n_blocks - 1)
+                if need > cap:
+                    self._drop(req, "prompt_exceeds_pool")
+                    continue
+                short = self._pool.allocatable() < need or (
+                    self._dpool is not None
+                    and self._dpool.allocatable() < need)
+                if short:
+                    if self.n_active == 0:
+                        self._drop(req, "pool_dry_no_residents")
+                        continue
+                    self._requeue_front(req)
+                    break
+            slot = self._free.popleft()
+            row = _Row(req, "DECODE", self._admit_seq)
+            self._admit_seq += 1
+            row.fill_pos = req.prompt_len
+            self._slots[slot] = row
+            if self.config.paged:
+                row.blocks = need
+                self._pool.free -= need
+                if self._dpool is not None:
+                    self._dpool.free -= need
+            self._record_admit(req)
+            # monolithic prefill picks the request's first token
+            self._record_tokens(row, 1, self.now)
+            admitted += 1
+        return admitted
+
+    # -- grant ordering --------------------------------------------------
+
+    def _grant_rank(self, i: int):
+        row = self._slots[i]
+        return scheduler_policy.grant_rank(
+            self.qos, row.req.priority, self.now - row.req.enq_t,
+            row.admit_seq)
+
+    # -- ticks (engine `_step_impl` dispatch) ----------------------------
+
+    def step(self) -> int:
+        """One engine iteration on virtual time.  Returns active slots
+        after the tick; 0 means idle (no tick happened)."""
+        if self.n_active == 0 and not self._waiting:
+            return 0
+        self._ev_admitted, self._ev_preempted = [], []
+        self._ev_chunks, self._ev_dropped = [], []
+        t0 = self.now
+        self._admit()
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            # every waiting request errored out during admission
+            self._tick_event("admit", t0, 0.0, 0)
+            return 0
+        spec = self.config.spec_k > 0
+        prefilling = any(self._slots[i].state == "PREFILLING"
+                         for i in active)
+        if spec and self.config.chunked and prefilling:
+            kind, work = self._chunked_tick(active,
+                                            self.config.spec_k + 1)
+            kind = "spec_chunked"
+        elif spec:
+            kind, work = self._spec_tick(active)
+        elif self.config.chunked and prefilling:
+            kind, work = self._chunked_tick(active, 1)
+        else:
+            kind, work = self._decode_tick(active)
+        dur = self.timing.tick_s(work)
+        self.now = t0 + dur
+        self._apply_emissions()
+        self._admit()       # freed slots recycle on the SAME iteration
+        self.ticks += 1
+        self._tick_event(kind, t0, dur, work)
+        return self.n_active
+
+    def _tick_event(self, kind: str, t0: float, dur: float,
+                    work: int) -> None:
+        if not self.record_events:
+            return
+        ev = {"event": "tick", "seq": self.ticks, "t": _t(t0),
+              "dur_s": _t(dur), "kind": kind, "work": int(work),
+              "active": self.n_active,
+              "queue_depth": len(self._waiting),
+              "admitted": list(self._ev_admitted),
+              "preempted": list(self._ev_preempted),
+              "chunks": [[u, int(c)] for u, c in self._ev_chunks]}
+        if self._ev_dropped:
+            ev["dropped"] = list(self._ev_dropped)
+        if self._pool is not None:
+            ev["free_blocks"] = self._pool.allocatable()
+            if self._dpool is not None:
+                ev["draft_free_blocks"] = self._dpool.allocatable()
+        self.events.append(ev)
+
+    # Emissions are decided during the tick but land at its END (see
+    # module docstring); the tick body queues (row, n) pairs here.
+    def _queue_emit(self, row: _Row, n: int) -> None:
+        self._pending_emits.append((row, n))
+
+    def _apply_emissions(self) -> None:
+        for row, n in self._pending_emits:
+            # a row preempted AFTER its emission was queued lost those
+            # tokens (the engine discards them too)
+            if row in self._slots:
+                self._record_tokens(row, n, self.now)
+        self._pending_emits = []
+
+    def _decode_tick(self, active: List[int]) -> Tuple[str, int]:
+        self._pending_emits = []
+        if self.config.paged:
+            active = self._ensure_blocks(active)
+            if not active:
+                return "decode", 0
+        n_eff = max(1, min(
+            self.config.ticks_per_step,
+            max(self._slots[i].gen_len - self._slots[i].emitted
+                for i in active)))
+        work = 0
+        for i in active:
+            row = self._slots[i]
+            n = min(n_eff, row.gen_len - row.emitted)
+            self._queue_emit(row, n)
+            work += n_eff
+        return "decode", work
+
+    def _spec_tick(self, active: List[int]) -> Tuple[str, int]:
+        self._pending_emits = []
+        if self.config.paged:
+            active = self._ensure_blocks(active)
+            if not active:
+                return "spec", 0
+        k = self.config.spec_k
+        work = 0
+        for i in active:
+            row = self._slots[i]
+            a = self.acceptance.sample(self.rng)
+            self.spec_proposed += k
+            self.spec_accepted += a
+            self._queue_emit(row, min(a + 1, row.gen_len - row.emitted))
+            work += k + 1
+        return "spec", work
+
+    def _chunked_tick(self, active: List[int],
+                      per_row: int) -> Tuple[str, int]:
+        self._pending_emits = []
+        decode_rows = [i for i in active
+                       if self._slots[i].state == "DECODE"]
+        prefill_rows = sorted(
+            (i for i in active
+             if self._slots[i].state == "PREFILLING"),
+            key=self._grant_rank)
+        chunks, stalled = scheduler_policy.plan_chunks(
+            self.config.tick_token_budget, per_row, len(decode_rows),
+            [(i, self._slots[i].req.prompt_len - self._slots[i].fill_pos)
+             for i in prefill_rows],
+            self.config.chunk_buckets[-1])
+        if stalled:
+            self.prefill_stall_ticks += 1
+        if self.config.paged:
+            self._grow_chunk_blocks(decode_rows, chunks)  # may preempt
+            decode_rows = [i for i in decode_rows
+                           if self._slots[i] is not None]
+            chunks = [(i, c) for i, c in chunks
+                      if self._slots[i] is not None]
+        if not decode_rows and not chunks:
+            return "chunked", 0
+        self.budget_ticks += 1
+        work = per_row * len(decode_rows) + sum(c for _, c in chunks)
+        self.budget_tokens_used += work
+        k = self.config.spec_k
+        for i in decode_rows:
+            row = self._slots[i]
+            if k > 0:
+                a = self.acceptance.sample(self.rng)
+                self.spec_proposed += k
+                self.spec_accepted += a
+                n = min(a + 1, row.gen_len - row.emitted)
+            else:
+                n = 1
+            self._queue_emit(row, n)
+        for i, clen in chunks:
+            row = self._slots[i]
+            row.fill_pos += clen
+            self._ev_chunks.append((row.req.uri, clen))
+            if row.fill_pos >= row.req.prompt_len:
+                row.state = "DECODE"
+                # the prompt's final chunk also picks its first token
+                self._queue_emit(row, 1)
+        return "chunked", work
+
+    # -- driving ---------------------------------------------------------
+
+    def run(self, trace: Sequence[Request],
+            max_ticks: Optional[int] = None) -> Dict[str, _Record]:
+        """Feed ``trace`` (sorted by arrival) through the model until
+        every request finishes or drops.  The clock jumps across idle
+        gaps to the next arrival, mirroring the serving pump's idle
+        wait."""
+        pending = sorted(trace, key=lambda r: (r.arrival_t, r.uri))
+        i = 0
+        guard = max_ticks if max_ticks is not None else \
+            20_000_000
+        while True:
+            while i < len(pending) and pending[i].arrival_t <= self.now:
+                self.submit(pending[i])
+                i += 1
+            if self.n_active == 0 and not self._waiting:
+                if i < len(pending):
+                    self.now = max(self.now, pending[i].arrival_t)
+                    continue
+                break
+            self.step()
+            if self.ticks >= guard:
+                raise RuntimeError(
+                    f"simulation exceeded {guard} ticks "
+                    f"(arrival rate beyond modelled capacity?)")
+        return self.records
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def percentile(xs: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation)."""
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    k = max(0, min(len(s) - 1, math.ceil(q / 100.0 * len(s)) - 1))
+    return s[k]
+
+
+def _dist(xs: List[float]) -> Dict[str, float]:
+    return {"n": len(xs),
+            "mean": _t(sum(xs) / len(xs)) if xs else 0.0,
+            "p50": _t(percentile(xs, 50)),
+            "p99": _t(percentile(xs, 99))}
+
+
+def summarize(records, targets: Optional[Dict[str, Dict[str, float]]]
+              = None) -> Dict[str, Any]:
+    """Per-class latency distributions + SLO goodput from request
+    records.  Judges requests exactly like ``SloWatchdog``: a request
+    is GOOD when no observation of any dimension breached its class
+    target (every admission epoch's queue wait and TTFT counts — a
+    preempted request that breached before preemption stays bad).
+    ``records`` accepts the model's ``_Record`` map or any iterable of
+    objects/dicts with the same fields."""
+    targets = targets or DEFAULT_SLO_TARGETS
+    if isinstance(records, dict):
+        records = list(records.values())
+    per_class: Dict[str, Dict[str, Any]] = {}
+    dropped = 0
+    total_tokens = 0
+    end_t = 0.0
+    for cls in PRIORITIES:
+        rows = [r for r in records if r.priority == cls]
+        if not rows:
+            continue
+        fin = [r for r in rows if r.finished]
+        tgt = targets.get(cls, {})
+        good = 0
+        for r in fin:
+            ok = True
+            for metric, obs in (("queue_wait", r.queue_waits),
+                                ("ttft", r.ttfts)):
+                lim = float(tgt.get(metric, 0.0))
+                if lim > 0 and any(v > lim for v in obs):
+                    ok = False
+            lim = float(tgt.get("tpot", 0.0))
+            if lim > 0 and r.tpot is not None and r.tpot > lim:
+                ok = False
+            if ok:
+                good += 1
+        dropped += sum(1 for r in rows if r.dropped)
+        total_tokens += sum(r.tokens for r in fin)
+        if fin:
+            end_t = max(end_t, max(r.finish_t for r in fin))
+        per_class[cls] = {
+            "submitted": len(rows),
+            "finished": len(fin),
+            "good": good,
+            "goodput": _t(good / len(fin)) if fin else 1.0,
+            "preemptions": sum(r.preempts for r in rows),
+            "ttft": _dist([r.ttfts[-1] for r in fin if r.ttfts]),
+            "tpot": _dist([r.tpot for r in fin
+                           if r.tpot is not None]),
+            "queue_wait": _dist([w for r in fin
+                                 for w in r.queue_waits]),
+        }
+    n_fin = sum(c["finished"] for c in per_class.values())
+    n_good = sum(c["good"] for c in per_class.values())
+    return {
+        "per_class": per_class,
+        "finished": n_fin,
+        "good": n_good,
+        "goodput": _t(n_good / n_fin) if n_fin else 1.0,
+        "dropped": dropped,
+        "tokens": total_tokens,
+        "duration_s": _t(end_t),
+        "tokens_per_s": _t(total_tokens / end_t) if end_t > 0 else 0.0,
+    }

@@ -1,0 +1,168 @@
+"""Synthetic request traces for the engine simulator.
+
+Generators are fully deterministic under a seed: the same
+``(seed, parameters)`` pair yields the same request list on any
+platform, any ``PYTHONHASHSEED``, any process.  All randomness flows
+through one ``random.Random(seed)`` instance and every iteration order
+is over explicit sequences (never over set/dict views of non-string
+keys), so there is no hash-order leakage.
+
+Arrival processes:
+
+* ``poisson_trace`` — homogeneous Poisson arrivals at ``rate_rps``
+  (exponential inter-arrival gaps).
+* ``diurnal_trace`` — nonhomogeneous Poisson with a sinusoidal rate
+  between ``base_rps`` and ``peak_rps`` over ``period_s``, sampled by
+  thinning against the peak rate.
+
+Stdlib only — this module is part of the bare-box import contract of
+``serving/sim`` (see the package docstring).
+"""
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Request", "poisson_trace", "diurnal_trace", "requests_from_dicts"]
+
+#: Default priority-class mix (must be a subset of policy.PRIORITIES).
+DEFAULT_CLASS_MIX: Tuple[Tuple[str, float], ...] = (
+    ("interactive", 0.5), ("standard", 0.3), ("batch", 0.2))
+
+
+@dataclass(frozen=True)
+class Request:
+    """One simulated request.
+
+    ``gen_len`` is the number of tokens the request will emit before
+    finishing — the simulator does not model EOS sampling, so the
+    completion length is part of the trace.  When re-simulating a
+    recorded bundle, ``gen_len`` is the realized token count from the
+    bundle's trace, which is exactly the "completion-length oracle"
+    trick the engine-vs-sim equivalence tests use.
+    """
+
+    uri: str
+    arrival_t: float
+    prompt_len: int
+    gen_len: int
+    priority: Optional[str] = "standard"
+    tenant: str = ""
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "uri": self.uri,
+            "arrival_t": round(float(self.arrival_t), 9),
+            "prompt_len": int(self.prompt_len),
+            "gen_len": int(self.gen_len),
+            "priority": self.priority,
+            "tenant": self.tenant,
+        }
+
+
+def requests_from_dicts(rows: Sequence[Dict[str, object]]) -> List[Request]:
+    """Parse an explicit request list (scenario files, golden fixtures)."""
+    out = []
+    for i, row in enumerate(rows):
+        out.append(Request(
+            uri=str(row.get("uri", "req-%06d" % i)),
+            arrival_t=float(row.get("arrival_t", 0.0)),
+            prompt_len=int(row["prompt_len"]),
+            gen_len=int(row.get("gen_len", row.get("max_new", 1))),
+            priority=row.get("priority", "standard"),  # type: ignore[arg-type]
+            tenant=str(row.get("tenant", "")),
+        ))
+    out.sort(key=lambda r: (r.arrival_t, r.uri))
+    return out
+
+
+def _normalize_mix(class_mix) -> List[Tuple[str, float]]:
+    if class_mix is None:
+        items = list(DEFAULT_CLASS_MIX)
+    elif isinstance(class_mix, dict):
+        # dicts preserve insertion order; scenario files are parsed in
+        # file order, so this is deterministic for a given file.
+        items = [(str(k), float(v)) for k, v in class_mix.items()]
+    else:
+        items = [(str(k), float(v)) for k, v in class_mix]
+    total = sum(w for _, w in items)
+    if total <= 0:
+        raise ValueError("class mix weights must sum to a positive value")
+    return [(k, w / total) for k, w in items]
+
+
+def _pick(rng: random.Random, items: List[Tuple[str, float]]) -> str:
+    x = rng.random()
+    acc = 0.0
+    for key, w in items:
+        acc += w
+        if x < acc:
+            return key
+    return items[-1][0]
+
+
+def _body(rng: random.Random, i: int, t: float, prompt_len, gen_len,
+          mix, tenants: Sequence[str]) -> Request:
+    plo, phi = int(prompt_len[0]), int(prompt_len[-1])
+    glo, ghi = int(gen_len[0]), int(gen_len[-1])
+    return Request(
+        uri="req-%06d" % i,
+        arrival_t=t,
+        prompt_len=rng.randint(plo, phi),
+        gen_len=rng.randint(glo, ghi),
+        priority=_pick(rng, mix),
+        tenant=rng.choice(list(tenants)) if tenants else "",
+    )
+
+
+def poisson_trace(*, n_requests: int, rate_rps: float, seed: int,
+                  prompt_len: Sequence[int] = (16, 256),
+                  gen_len: Sequence[int] = (8, 64),
+                  class_mix=None,
+                  tenants: Sequence[str] = ("",)) -> List[Request]:
+    """Homogeneous Poisson arrivals: exponential gaps at ``rate_rps``."""
+    if rate_rps <= 0:
+        raise ValueError("rate_rps must be positive")
+    rng = random.Random(seed)
+    mix = _normalize_mix(class_mix)
+    t = 0.0
+    out = []
+    for i in range(int(n_requests)):
+        t += rng.expovariate(rate_rps)
+        out.append(_body(rng, i, t, prompt_len, gen_len, mix, tenants))
+    return out
+
+
+def diurnal_trace(*, n_requests: int, base_rps: float, peak_rps: float,
+                  period_s: float, seed: int,
+                  prompt_len: Sequence[int] = (16, 256),
+                  gen_len: Sequence[int] = (8, 64),
+                  class_mix=None,
+                  tenants: Sequence[str] = ("",)) -> List[Request]:
+    """Sinusoidal-rate Poisson arrivals sampled by thinning.
+
+    Instantaneous rate at time ``t``::
+
+        rate(t) = base + (peak - base) * (1 - cos(2*pi*t/period)) / 2
+
+    which starts at ``base_rps``, peaks at ``peak_rps`` mid-period, and
+    returns to base — one "day" per ``period_s``.
+    """
+    if not (0 < base_rps <= peak_rps):
+        raise ValueError("need 0 < base_rps <= peak_rps")
+    if period_s <= 0:
+        raise ValueError("period_s must be positive")
+    rng = random.Random(seed)
+    mix = _normalize_mix(class_mix)
+    t = 0.0
+    out = []
+    i = 0
+    while i < int(n_requests):
+        t += rng.expovariate(peak_rps)
+        rate = base_rps + (peak_rps - base_rps) * (
+            1.0 - math.cos(2.0 * math.pi * t / period_s)) / 2.0
+        if rng.random() * peak_rps < rate:
+            out.append(_body(rng, i, t, prompt_len, gen_len, mix, tenants))
+            i += 1
+    return out

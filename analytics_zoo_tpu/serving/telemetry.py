@@ -520,6 +520,11 @@ class Telemetry:
             p + "spec_accept_len",
             "accepted draft tokens per row per verify round (0..k)",
             window=window)
+        # exact acceptance-length counts (NOT windowed): the
+        # simulator's calibration source — spec_acceptance() serializes
+        # it into diagnostic bundles (docs/simulation.md)
+        self._spec_accept_counts: Dict[int, int] = {}
+        self._spec_rounds = 0
 
     # -- request lifecycle (engine state transitions) ----------------
 
@@ -555,10 +560,13 @@ class Telemetry:
                                              uri)
         self.events.span("queue_wait", ck.arrival, now - ck.arrival,
                          EventLog.TID_QUEUE, {"uri": uri})
-        self.events.instant(
-            "admitted", now, slot,
-            {"uri": uri, "state": "PREFILLING" if prefilling
-             else "DECODE"})
+        args = {"uri": uri, "state": "PREFILLING" if prefilling
+                else "DECODE"}
+        if priority is not None:
+            # replay (serving/sim/) needs per-class attribution from
+            # the trace alone — the bundle's only per-request record
+            args["priority"] = priority
+        self.events.instant("admitted", now, slot, args)
 
     def req_token(self, uri: str, slot: int) -> None:
         now = time.monotonic()
@@ -707,11 +715,33 @@ class Telemetry:
         acceptance moves with the workload."""
         self.c_spec_proposed.inc(proposed)
         self.c_spec_accepted.inc(accepted)
-        for n in accept_lens:
-            self.h_spec_accept.record(float(n))
+        with self._lock:
+            self._spec_rounds += 1
+            for n in accept_lens:
+                self.h_spec_accept.record(float(n))
+                k = int(n)
+                self._spec_accept_counts[k] = \
+                    self._spec_accept_counts.get(k, 0) + 1
         self.events.instant("spec_round", None, EventLog.TID_ENGINE,
                             {"proposed": proposed,
                              "accepted": accepted})
+
+    def spec_acceptance(self) -> Dict[str, Any]:
+        """Serializable speculative-acceptance distribution: exact
+        counts of accepted draft tokens per row per verify round since
+        engine start (no window, no percentile loss).  ``counts`` keys
+        are strings so the section round-trips through JSON bundles
+        unchanged; the simulator calibrates its stochastic acceptance
+        process from this (serving/sim/, docs/simulation.md)."""
+        with self._lock:
+            counts = {str(k): v for k, v in
+                      sorted(self._spec_accept_counts.items())}
+            rounds = self._spec_rounds
+        total = sum(counts.values())
+        mean = (sum(int(k) * v for k, v in counts.items()) / total
+                if total else 0.0)
+        return {"rounds": rounds, "samples": total,
+                "mean_accept_len": round(mean, 6), "counts": counts}
 
     def jit_build(self, program: str, key: Any) -> None:
         """A jitted-program cache MISS (new (program, shape) variant):

@@ -23,9 +23,10 @@ import jax.numpy as jnp
 
 from analytics_zoo_tpu.models.lm import TransformerLM, generate
 from analytics_zoo_tpu.serving.flight import (
-    AnomalyMonitor, FlightRecorder, JsonLogFormatter, RingLogHandler,
-    SloPolicy, SloWatchdog, dump_bundle, install_flight_logging,
-    prune_bundles, request_uri_context)
+    FLIGHT_SCHEMA_VERSION, AnomalyMonitor, FlightRecorder,
+    JsonLogFormatter, RingLogHandler, SloPolicy, SloWatchdog,
+    dump_bundle, install_flight_logging, prune_bundles,
+    request_uri_context)
 from analytics_zoo_tpu.serving.frontdoor import normalize_request_id
 from analytics_zoo_tpu.serving.telemetry import (
     MetricsRegistry, render_prometheus)
@@ -380,6 +381,75 @@ class TestBundleAndCli:
         left = sorted(os.listdir(tmp_path))
         assert left == [paths[2].name, paths[3].name]
         assert prune_bundles(str(tmp_path / "missing"), keep=1) == 0
+
+
+# ---------------------------------------------------------------------------
+# schema versioning + spec-acceptance section (the simulator's contract)
+# ---------------------------------------------------------------------------
+
+class TestSchemaVersioning:
+    """Bundles are a versioned interchange format now that the offline
+    simulator (serving/sim) replays them: every tick record, flight.json
+    and manifest.json carry ``schema_version`` so a replayer can refuse
+    bundles written by a future engine instead of misreading them."""
+
+    def test_record_stamps_schema_version(self):
+        fr = FlightRecorder(capacity=2)
+        fr.record({"seq": fr.next_seq()})
+        assert fr.snapshot()[0]["schema_version"] == \
+            FLIGHT_SCHEMA_VERSION
+
+    def test_record_keeps_explicit_version(self):
+        # setdefault semantics: a caller replaying old ticks through a
+        # new recorder must not have their version silently upgraded
+        fr = FlightRecorder(capacity=2)
+        fr.record({"seq": fr.next_seq(), "schema_version": 0})
+        assert fr.snapshot()[0]["schema_version"] == 0
+
+    def test_bundle_files_carry_schema_version(self, tmp_path):
+        fr = FlightRecorder(capacity=2)
+        fr.record({"seq": fr.next_seq(), "kind": "decode"})
+        path = dump_bundle(str(tmp_path), reason="versioned",
+                           detail={}, flight=fr)
+        with open(os.path.join(path, "manifest.json")) as f:
+            assert json.load(f)["schema_version"] == \
+                FLIGHT_SCHEMA_VERSION
+        with open(os.path.join(path, "flight.json")) as f:
+            flight = json.load(f)
+        assert flight["schema_version"] == FLIGHT_SCHEMA_VERSION
+        assert flight["ticks"][0]["schema_version"] == \
+            FLIGHT_SCHEMA_VERSION
+
+    def test_spec_acceptance_round_trips(self, tmp_path):
+        acc = {"k": 2, "rounds": 5, "counts": [1, 1, 3],
+               "mean_accepted": 1.4}
+        path = dump_bundle(str(tmp_path), reason="spec", detail={},
+                           spec_acceptance=acc)
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert "spec_acceptance.json" in manifest["files"]
+        with open(os.path.join(path, "spec_acceptance.json")) as f:
+            assert json.load(f) == acc
+
+    def test_spec_acceptance_absent_when_not_given(self, tmp_path):
+        path = dump_bundle(str(tmp_path), reason="nospec", detail={})
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert "spec_acceptance.json" not in manifest["files"]
+        assert not os.path.exists(
+            os.path.join(path, "spec_acceptance.json"))
+
+    def test_simulation_doc_pins_current_version(self):
+        """Doc-drift guard (same spirit as test_doc_drift_guard below):
+        docs/simulation.md states the schema_version the code writes.
+        Bumping FLIGHT_SCHEMA_VERSION without re-documenting the
+        migration fails here."""
+        doc_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                                "docs", "simulation.md")
+        with open(doc_path) as f:
+            doc = f.read()
+        assert f"current schema_version: {FLIGHT_SCHEMA_VERSION}" \
+            in doc
 
 
 # ---------------------------------------------------------------------------

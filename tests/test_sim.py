@@ -1,0 +1,774 @@
+"""Discrete-event simulator (serving/sim/) + scheduler policy module:
+pure-policy unit semantics, trace-generator determinism, the modelled
+engine's budget/pool/spec behavior, byte-identical event logs across
+processes and hash seeds, bundle replay with schema gating and
+crosscheck verdicts, the pinned golden envelope gate, drift pins tying
+the jax-free sim to flight.py, and (slow) decision-sequence equivalence
+between the modelled engine and the live ContinuousEngine."""
+
+import copy
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from analytics_zoo_tpu.serving import policy as scheduler_policy
+from analytics_zoo_tpu.serving.policy import (
+    DEFAULT_WEIGHTS, PRIORITIES, QosPolicy, SCHEDULER_POLICY_VERSION,
+    WeightedWaitQueue, grant_rank, pick_victim, plan_chunks,
+    select_subqueue, stride_charge)
+from analytics_zoo_tpu.serving.sim import (
+    AcceptanceModel, EngineConfig, EngineModel, Request,
+    SUPPORTED_SCHEMA_VERSIONS, SchemaVersionError, TimingModel,
+    diurnal_trace, load_bundle, percentile, poisson_trace,
+    replay_bundle, summarize)
+from analytics_zoo_tpu.serving.sim.__main__ import (
+    check_envelopes, load_scenario, main as sim_main, run_scenario)
+from analytics_zoo_tpu.serving.sim.model import (
+    DEFAULT_SLO_TARGETS as SIM_SLO_TARGETS, _Record)
+from analytics_zoo_tpu.serving.sim.replay import DEFAULT_TOLERANCES
+from analytics_zoo_tpu.serving.sim.trace import requests_from_dicts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden", "sim_golden.json")
+SERVING_DIR = os.path.join(REPO, "analytics_zoo_tpu", "serving")
+
+
+# ---------------------------------------------------------------------------
+# pure policy functions
+# ---------------------------------------------------------------------------
+
+class _Entry:
+    """Minimal queue entry carrying the attributes the scheduler reads."""
+
+    def __init__(self, uri, priority="standard", tenant="", enq_t=0.0):
+        self.uri = uri
+        self.priority = priority
+        self.tenant = tenant
+        self.enq_t = enq_t
+
+
+class TestPolicyUnits:
+    def test_grant_rank_without_qos_is_the_admit_seq(self):
+        # the FIFO-parity guarantee: qos off returns the scalar
+        # admission sequence itself, not a tuple wrapping it
+        assert grant_rank(None, "interactive", 99.0, 7) == 7
+        assert grant_rank(None, None, 0.0, 3) == 3
+
+    def test_grant_rank_orders_by_aged_class_then_fifo(self):
+        pol = QosPolicy(aging_s=10.0)
+        assert grant_rank(pol, "interactive", 0.0, 5) \
+            < grant_rank(pol, "batch", 0.0, 1)
+        # aged two intervals: batch competes as interactive, FIFO wins
+        assert grant_rank(pol, "batch", 25.0, 1) \
+            < grant_rank(pol, "interactive", 0.0, 5)
+        # unknown/absent priority ranks as standard
+        assert grant_rank(pol, None, 0.0, 2) \
+            == grant_rank(pol, "standard", 0.0, 2)
+
+    def test_pick_victim_prefers_prefilling_then_latest_admission(self):
+        assert pick_victim([(0, "DECODE", 5), (1, "PREFILLING", 2),
+                            (2, "PREFILLING", 3)]) == 2
+        assert pick_victim([(0, "DECODE", 5), (1, "DECODE", 9)]) == 1
+
+    def test_plan_chunks_bills_decode_rows_first(self):
+        chunks, stalled = plan_chunks(16, 1, 4, [(0, 20), (1, 5)], 8)
+        assert chunks == [(0, 8), (1, 4)]
+        assert not stalled
+
+    def test_plan_chunks_speculative_per_row_cost(self):
+        # k=2: every decode row bills 3 positions
+        chunks, stalled = plan_chunks(16, 3, 5, [(0, 20)], 8)
+        assert chunks == [(0, 1)]
+        assert not stalled
+
+    def test_plan_chunks_stall_flag(self):
+        chunks, stalled = plan_chunks(4, 1, 4, [(0, 10)], 8)
+        assert chunks == [] and stalled
+        # no prefill waiting: a decode-only tick is not a stall
+        _, stalled = plan_chunks(4, 1, 4, [], 8)
+        assert not stalled
+
+    def test_select_subqueue_min_pass_then_oldest_head(self):
+        assert select_subqueue([(("a", ""), 1.0, 5.0),
+                                (("b", ""), 1.0, 2.0),
+                                (("c", ""), 0.5, 9.0)]) == ("c", "")
+        assert select_subqueue([(("a", ""), 1.0, 5.0),
+                                (("b", ""), 1.0, 2.0)]) == ("b", "")
+
+    def test_stride_charge_is_inverse_effective_weight(self):
+        pol = QosPolicy()
+        assert stride_charge(pol, "batch", 0.0) == 1.0
+        assert stride_charge(pol, "interactive", 0.0) == 1.0 / 8.0
+        # two aging intervals promote batch to interactive's weight
+        assert stride_charge(pol, "batch", 65.0) == 1.0 / 8.0
+
+    def test_qos_policy_rejects_nonpositive_weights(self):
+        with pytest.raises(ValueError):
+            QosPolicy(weights={"interactive": 0.0})
+
+    def test_weighted_queue_divides_slots_by_weight(self):
+        t = [0.0]
+        q = WeightedWaitQueue(QosPolicy(aging_s=0.0), clock=lambda: t[0])
+        for i in range(8):
+            q.append(_Entry(f"i{i}", "interactive", enq_t=0.001 * i))
+            q.append(_Entry(f"b{i}", "batch", enq_t=0.001 * i + 0.0005))
+        t[0] = 1.0
+        popped = [q.popleft().priority for _ in range(9)]
+        assert popped.count("interactive") >= 7
+
+    def test_weighted_queue_appendleft_refunds_the_pop(self):
+        t = [0.0]
+        q = WeightedWaitQueue(QosPolicy(), clock=lambda: t[0])
+        a = _Entry("a", "batch", enq_t=0.0)
+        b = _Entry("b", "batch", enq_t=0.1)
+        q.append(a)
+        q.append(b)
+        got = q.popleft()
+        assert got is a
+        q.appendleft(got)       # blocked admission: requeue at the front
+        assert q.popleft() is a     # still the head, charge refunded
+        assert q.popleft() is b
+
+    def test_weighted_queue_matches_deque_surface(self):
+        q = WeightedWaitQueue(QosPolicy())
+        assert not q and len(q) == 0
+        e = _Entry("x", "standard")
+        q.append(e)
+        assert q and list(q) == [e]
+        q.remove(e)
+        assert len(q) == 0
+        with pytest.raises((ValueError, IndexError)):
+            q.popleft()
+
+    def test_engine_and_frontdoor_share_this_policy_module(self):
+        # the extraction contract: the live engine executes the SAME
+        # module the simulator does, not a copy
+        from analytics_zoo_tpu.serving import continuous, frontdoor
+        assert continuous.scheduler_policy is scheduler_policy
+        assert frontdoor.QosPolicy is QosPolicy
+        assert isinstance(SCHEDULER_POLICY_VERSION, int)
+        assert SCHEDULER_POLICY_VERSION >= 1
+
+
+# ---------------------------------------------------------------------------
+# synthetic trace generators
+# ---------------------------------------------------------------------------
+
+class TestTraceGenerators:
+    def test_poisson_trace_is_seed_deterministic(self):
+        kw = dict(n_requests=64, rate_rps=20.0, prompt_len=(8, 32),
+                  gen_len=(2, 8), tenants=("a", "b"))
+        t1 = poisson_trace(seed=5, **kw)
+        t2 = poisson_trace(seed=5, **kw)
+        assert t1 == t2
+        assert poisson_trace(seed=6, **kw) != t1
+        assert all(x.arrival_t <= y.arrival_t for x, y in zip(t1, t1[1:]))
+        assert {r.priority for r in t1} <= set(PRIORITIES)
+
+    def test_diurnal_trace_is_seed_deterministic(self):
+        kw = dict(n_requests=64, base_rps=5.0, peak_rps=40.0,
+                  period_s=10.0)
+        t1 = diurnal_trace(seed=9, **kw)
+        assert t1 == diurnal_trace(seed=9, **kw)
+        assert all(x.arrival_t <= y.arrival_t for x, y in zip(t1, t1[1:]))
+
+    def test_requests_from_dicts_sorts_and_normalizes(self):
+        rows = [{"uri": "b", "arrival_t": 1.0, "prompt_len": 4,
+                 "max_new": 3},
+                {"uri": "a", "arrival_t": 0.0, "prompt_len": 8,
+                 "gen_len": 2, "priority": "interactive"}]
+        reqs = requests_from_dicts(rows)
+        assert [r.uri for r in reqs] == ["a", "b"]
+        assert reqs[1].gen_len == 3         # max_new accepted as alias
+        assert reqs[0].priority == "interactive"
+
+
+# ---------------------------------------------------------------------------
+# the modelled engine
+# ---------------------------------------------------------------------------
+
+def _reqs(specs):
+    return [Request(uri=f"r{i:02d}", arrival_t=0.0, prompt_len=p,
+                    gen_len=g, priority=pri)
+            for i, (p, g, pri) in enumerate(specs)]
+
+
+class TestEngineModel:
+    def test_chunked_budget_math_on_a_tiny_trace(self):
+        cfg = EngineConfig(slots=2, max_new_tokens=3, chunked=True,
+                           tick_token_budget=8, prompt_buckets=(4, 8))
+        m = EngineModel(cfg)
+        m.run(_reqs([(8, 3, "standard"), (8, 3, "standard")]))
+        # tick1: r00 prefills all 8 (budget exhausted); tick2: r00
+        # decodes (1) + r01 chunks 7; tick3: r00 decodes + r01's last
+        # token; then two plain decode ticks finish r01
+        assert m.ticks == 5
+        assert m.budget_ticks == 3
+        assert m.budget_tokens_used == 8 + 8 + 2
+        assert all(r.finished and r.tokens == 3
+                   for r in m.records.values())
+
+    def test_chunked_stall_counter(self):
+        cfg = EngineConfig(slots=5, max_new_tokens=30, chunked=True,
+                           tick_token_budget=4, prompt_buckets=(4,))
+        m = EngineModel(cfg)
+        m.run(_reqs([(4, 30, "standard")] * 5))
+        # once 4 rows decode they bill the whole budget while the 5th
+        # still has prompt to stream
+        assert m.prefill_stall_ticks > 0
+        assert all(r.finished for r in m.records.values())
+
+    def test_paged_pool_dry_preempts_and_everyone_finishes(self):
+        cfg = EngineConfig(slots=4, max_new_tokens=8, chunked=True,
+                           tick_token_budget=16, prompt_buckets=(8, 16),
+                           paged=True, block_size=4, n_blocks=9)
+        m = EngineModel(cfg)
+        m.run(_reqs([(16, 8, "standard")] * 6))
+        assert m.preemptions > 0
+        assert all(r.finished and not r.dropped
+                   for r in m.records.values())
+        assert m._pool.free == cfg.n_blocks - 1     # all blocks returned
+        preempted = [e for e in m.events
+                     if e["event"] == "tick" and e["preempted"]]
+        assert preempted            # the decision made it into the log
+
+    def test_prompt_beyond_pool_capacity_is_dropped(self):
+        cfg = EngineConfig(slots=2, max_new_tokens=4, chunked=True,
+                           tick_token_budget=16, prompt_buckets=(4, 16),
+                           paged=True, block_size=4, n_blocks=4)
+        m = EngineModel(cfg)
+        m.run(_reqs([(16, 4, "standard")]))
+        rec = m.records["r00"]
+        assert rec.dropped == "prompt_exceeds_pool"
+        assert not rec.finished
+
+    def test_spec_acceptance_shortens_decode(self):
+        def ticks_for(accept):
+            cfg = EngineConfig(slots=1, max_new_tokens=16, spec_k=4)
+            m = EngineModel(
+                cfg, acceptance=AcceptanceModel.constant(accept, 4))
+            m.run(_reqs([(8, 16, "standard")]))
+            return m
+        fast, slow = ticks_for(4), ticks_for(0)
+        assert fast.ticks < slow.ticks
+        assert fast.spec_accepted > 0 and slow.spec_accepted == 0
+        assert fast.records["r00"].tokens == 16
+
+    def test_monolithic_admission_stamps_first_token_at_admit(self):
+        cfg = EngineConfig(slots=2, max_new_tokens=4, chunked=False)
+        m = EngineModel(cfg)
+        m.run(_reqs([(8, 4, "interactive")]))
+        rec = m.records["r00"]
+        assert rec.first_tokens[0] == rec.admits[0]
+
+    def test_acceptance_model_validates_and_calibrates(self):
+        acc = AcceptanceModel.from_counts({"0": 1, "2": 3}, k=2)
+        assert abs(acc.mean - 1.5) < 1e-9
+        with pytest.raises(ValueError):
+            AcceptanceModel(2, [1.0])          # pmf length mismatch
+        with pytest.raises(ValueError):
+            EngineModel(EngineConfig(spec_k=2),
+                        acceptance=AcceptanceModel.constant(1, 3))
+
+    def test_timing_fit_recovers_affine_cost_and_clamps(self):
+        tm = TimingModel.fit([(n, 0.002 + 0.0001 * n)
+                              for n in (4, 8, 16, 32)])
+        assert abs(tm.base_s - 0.002) < 1e-9
+        assert abs(tm.per_token_s - 0.0001) < 1e-9
+        # constant-x / degenerate fits fall back to the mean duration
+        tm = TimingModel.fit([(8, 0.01), (8, 0.03)])
+        assert tm.per_token_s == 0.0 and abs(tm.base_s - 0.02) < 1e-9
+
+    def test_percentile_nearest_rank(self):
+        assert percentile([4.0, 1.0, 3.0, 2.0], 50) == 2.0
+        assert percentile([4.0, 1.0, 3.0, 2.0], 99) == 4.0
+        assert percentile([], 99) == 0.0
+
+    def test_summarize_judges_goodput_like_the_watchdog(self):
+        targets = {"standard": {"ttft": 1.0, "tpot": 0.5,
+                                "queue_wait": 1.0}}
+        good = _Record(uri="g", priority="standard", tenant="",
+                       arrival=0.0, admits=[0.1], queue_waits=[0.1],
+                       first_tokens=[0.2], finish_t=1.0, tokens=4)
+        # breached TTFT in a PRE-preemption epoch: stays bad even
+        # though the final epoch was fine (the watchdog saw it too)
+        bad = _Record(uri="b", priority="standard", tenant="",
+                      arrival=0.0, admits=[0.1, 2.0],
+                      queue_waits=[0.1, 2.0],
+                      first_tokens=[1.5, 2.1], preempts=1,
+                      finish_t=3.0, tokens=4)
+        out = summarize([good, bad], targets)
+        assert out["per_class"]["standard"]["finished"] == 2
+        assert out["per_class"]["standard"]["good"] == 1
+        assert out["goodput"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+_DETERMINISM_PROBE = r'''
+import hashlib, importlib, json, sys, types
+pkg = types.ModuleType("_sim_det_probe")
+pkg.__path__ = [sys.argv[1]]
+sys.modules["_sim_det_probe"] = pkg
+sim = importlib.import_module("_sim_det_probe.sim")
+pol = importlib.import_module("_sim_det_probe.policy")
+trace = sim.poisson_trace(n_requests=200, rate_rps=40.0, seed=3,
+                          prompt_len=(8, 64), gen_len=(4, 16),
+                          tenants=("a", "b"))
+cfg = sim.EngineConfig(slots=4, max_new_tokens=16, chunked=True,
+                       tick_token_budget=32, paged=True, block_size=8,
+                       n_blocks=48, prompt_buckets=(8, 16, 32, 64))
+m = sim.EngineModel(cfg, qos=pol.QosPolicy(), seed=11)
+m.run(trace)
+log = "\n".join(m.event_log_lines())
+print(hashlib.sha256(log.encode()).hexdigest())
+print(json.dumps(sim.summarize(m.records), sort_keys=True))
+'''
+
+
+class TestDeterminism:
+    def test_event_log_is_byte_identical_in_process(self):
+        trace = poisson_trace(n_requests=300, rate_rps=50.0, seed=2,
+                              prompt_len=(8, 64), gen_len=(2, 12),
+                              tenants=("a", "b"))
+        cfg = EngineConfig(slots=4, max_new_tokens=12, chunked=True,
+                           tick_token_budget=32, paged=True,
+                           block_size=8, n_blocks=64,
+                           prompt_buckets=(8, 16, 32, 64))
+
+        def one():
+            m = EngineModel(cfg, qos=QosPolicy(), seed=7)
+            m.run(trace)
+            return m
+        a, b = one(), one()
+        assert a.event_log_lines() == b.event_log_lines()
+        assert len(a.events) > 0
+        assert summarize(a.records) == summarize(b.records)
+
+    def test_event_log_survives_process_restart_and_hash_seeds(self):
+        # same model, two fresh interpreters with DIFFERENT
+        # PYTHONHASHSEED values: byte-identical logs prove no dict/set
+        # iteration order leaks into scheduling decisions.  The probe
+        # bootstraps serving/ as a bare package — no jax, no numpy.
+        outs = []
+        for seed in ("0", "4242"):
+            env = dict(os.environ, PYTHONHASHSEED=seed)
+            r = subprocess.run(
+                [sys.executable, "-c", _DETERMINISM_PROBE, SERVING_DIR],
+                capture_output=True, text=True, env=env, timeout=120)
+            assert r.returncode == 0, r.stderr
+            outs.append(r.stdout)
+        assert outs[0] == outs[1]
+        assert len(outs[0].splitlines()) == 2
+
+
+# ---------------------------------------------------------------------------
+# bundle replay
+# ---------------------------------------------------------------------------
+
+def _ev_i(name, ts, **args):
+    return {"ph": "i", "name": name, "ts": ts, "tid": 0, "args": args}
+
+
+def _ev_x(name, ts, dur, **args):
+    return {"ph": "X", "name": name, "ts": ts, "dur": dur, "tid": 0,
+            "args": args}
+
+
+def _write_synthetic_bundle(path, *, versioned=True,
+                            recorded_goodput=1.0,
+                            recorded_finished=1):
+    """A minimal coherent bundle: two finished requests (interactive +
+    batch), six chunked tick records (one compile-polluted), a resolved
+    config, and a watchdog score to cross-check against."""
+    os.makedirs(path, exist_ok=True)
+    events = [
+        _ev_i("enqueued", 0, uri="r-int"),
+        _ev_x("queue_wait", 0, 100_000, uri="r-int"),
+        _ev_i("admitted", 100_000, uri="r-int", state="PREFILLING",
+              priority="interactive"),
+        _ev_x("prefill_chunk", 100_000, 4_000, uri="r-int", tokens=8,
+              fill_pos=8),
+        _ev_i("first_token", 200_000, uri="r-int"),
+        _ev_x("request", 100_000, 500_000, uri="r-int", tokens=6),
+        _ev_i("enqueued", 0, uri="r-bat"),
+        _ev_x("queue_wait", 0, 150_000, uri="r-bat"),
+        _ev_i("admitted", 150_000, uri="r-bat", state="PREFILLING",
+              priority="batch"),
+        _ev_x("prefill_chunk", 150_000, 4_000, uri="r-bat", tokens=4,
+              fill_pos=4),
+        _ev_i("first_token", 300_000, uri="r-bat"),
+        _ev_x("request", 150_000, 1_000_000, uri="r-bat", tokens=4),
+    ]
+    ticks = [{"seq": i, "ts": 100.0 + 0.01 * i,
+              "dur_ms": 4.0 + 0.1 * (8 + i) if i else 1400.0,
+              "kind": "chunked", "active": 2, "budget_used": 8 + i,
+              "compiles": 1 if i == 0 else 0}
+             for i in range(6)]
+    if versioned:
+        for rec in ticks:
+            rec["schema_version"] = 1
+    flight = {"capacity": 16, "n_ticks": len(ticks), "ticks": ticks}
+    manifest = {"reason": "test", "detail": {}, "files": [],
+                "n_flight_ticks": len(ticks)}
+    if versioned:
+        flight["schema_version"] = 1
+        manifest["schema_version"] = 1
+    slo = {"targets": {c: dict(SIM_SLO_TARGETS[c]) for c in PRIORITIES},
+           "per_class": {
+               "interactive": {"finished": recorded_finished,
+                               "good": recorded_finished,
+                               "goodput": recorded_goodput,
+                               "breaches": {}},
+               "batch": {"finished": 1, "good": 1, "goodput": 1.0,
+                         "breaches": {}}},
+           "recent_breaches": []}
+    config = {"engine_slots": 2, "engine_ticks": 1,
+              "engine_chunked": True, "engine_tick_token_budget": 16,
+              "engine_paged": False}
+    for name, doc in (("manifest.json", manifest),
+                      ("flight.json", flight),
+                      ("trace.json", {"traceEvents": events,
+                                      "displayTimeUnit": "ms"}),
+                      ("config.json", config), ("slo.json", slo)):
+        with open(os.path.join(path, name), "w") as f:
+            json.dump(doc, f)
+    return path
+
+
+class TestReplay:
+    def test_load_bundle_accepts_preversioning_bundles(self, tmp_path):
+        p = _write_synthetic_bundle(str(tmp_path / "b"), versioned=False)
+        bundle = load_bundle(p)
+        assert bundle["manifest"].get("schema_version") is None
+        assert len(bundle["ticks"]) == 6
+
+    @pytest.mark.parametrize("where", ["manifest.json", "flight.json",
+                                       "tick"])
+    def test_unknown_schema_version_is_refused(self, tmp_path, where):
+        p = _write_synthetic_bundle(str(tmp_path / "b"))
+        target = "flight.json" if where == "tick" else where
+        fp = os.path.join(p, target)
+        with open(fp) as f:
+            doc = json.load(f)
+        if where == "tick":
+            doc["ticks"][3]["schema_version"] = 999
+        else:
+            doc["schema_version"] = 999
+        with open(fp, "w") as f:
+            json.dump(doc, f)
+        with pytest.raises(SchemaVersionError, match="999"):
+            load_bundle(p)
+
+    def test_missing_bundle_raises_filenotfound(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_bundle(str(tmp_path / "nope"))
+        os.makedirs(tmp_path / "empty")
+        with pytest.raises(FileNotFoundError):
+            load_bundle(str(tmp_path / "empty"))
+
+    def test_crosscheck_ok_on_a_coherent_bundle(self, tmp_path):
+        p = _write_synthetic_bundle(str(tmp_path / "b"))
+        report = replay_bundle(p, resim=False)
+        assert report["ok"] is True
+        assert report["schema_version"] == 1
+        obs = report["observed"]["per_class"]
+        assert obs["interactive"]["goodput"] == 1.0
+        assert obs["batch"]["finished"] == 1
+        verdicts = {c["class"]: c["verdict"]
+                    for c in report["crosscheck"]["checks"]}
+        assert verdicts == {"interactive": "ok", "batch": "ok"}
+
+    def test_crosscheck_flags_a_goodput_breach(self, tmp_path):
+        p = _write_synthetic_bundle(str(tmp_path / "b"),
+                                    recorded_goodput=0.2)
+        report = replay_bundle(p, resim=False)
+        assert report["ok"] is False
+        bad = [c for c in report["crosscheck"]["checks"]
+               if c["verdict"] == "breach"]
+        assert bad and bad[0]["class"] == "interactive"
+        assert bad[0]["delta"] > DEFAULT_TOLERANCES["goodput"]
+
+    def test_crosscheck_skips_when_trace_ring_truncated(self, tmp_path):
+        # watchdog counted 10x what the trace ring still shows: the
+        # goodput check must SKIP (with a verdict), not false-fail
+        p = _write_synthetic_bundle(str(tmp_path / "b"),
+                                    recorded_goodput=0.2,
+                                    recorded_finished=10)
+        report = replay_bundle(p, resim=False)
+        assert report["ok"] is True
+        skipped = [c for c in report["crosscheck"]["checks"]
+                   if c["verdict"] == "skipped_ring_truncated"]
+        assert skipped and skipped[0]["class"] == "interactive"
+
+    def test_resimulate_reruns_the_recorded_schedule(self, tmp_path):
+        p = _write_synthetic_bundle(str(tmp_path / "b"))
+        report = replay_bundle(p, seed=3)
+        sim = report["simulated"]
+        assert sim["finished"] == 2 and sim["n_requests"] == 2
+        assert sim["sim_ticks"] > 0
+        # timing was fitted from the compile-free ticks only: the
+        # 1.4s compile tick must not leak into the modelled speed
+        assert sim["duration_s"] < 10.0
+        assert set(report["sim_vs_observed"]) == {"interactive",
+                                                  "batch"}
+
+    def test_cli_replay_exit_codes(self, tmp_path, capsys):
+        ok = _write_synthetic_bundle(str(tmp_path / "ok"))
+        assert sim_main(["replay", ok]) == 0
+        breach = _write_synthetic_bundle(str(tmp_path / "breach"),
+                                         recorded_goodput=0.2)
+        assert sim_main(["replay", breach, "--no-resim"]) == 1
+        with open(os.path.join(ok, "manifest.json")) as f:
+            doc = json.load(f)
+        doc["schema_version"] = 999
+        with open(os.path.join(ok, "manifest.json"), "w") as f:
+            json.dump(doc, f)
+        assert sim_main(["replay", ok]) == 2
+        assert sim_main(["replay", str(tmp_path / "missing")]) == 2
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# golden envelope gate
+# ---------------------------------------------------------------------------
+
+class TestGoldenGate:
+    def test_golden_envelopes_hold_on_main(self):
+        doc = load_scenario(GOLDEN)
+        summary = run_scenario(doc)
+        violations = check_envelopes(summary, doc["envelopes"])
+        assert violations == [], violations
+
+    def test_golden_gate_fails_on_flattened_qos_weights(self):
+        # the acceptance criterion: perturbing the scheduler policy
+        # (interactive weight 8 -> 1) must trip the envelopes
+        doc = copy.deepcopy(load_scenario(GOLDEN))
+        doc["qos"]["weights"]["interactive"] = 1.0
+        summary = run_scenario(doc)
+        violations = check_envelopes(summary, doc["envelopes"])
+        assert violations
+        assert any(v["metric"].startswith("per_class.interactive")
+                   for v in violations)
+
+    def test_envelope_checker_reports_missing_metrics(self):
+        v = check_envelopes({"finished": 3},
+                            {"per_class.x.goodput": {"min": 1}})
+        assert v and v[0]["error"] == "metric missing from summary"
+
+    def test_sweep_expands_to_cartesian_product(self, tmp_path, capsys):
+        doc = {"seed": 1,
+               "engine": {"slots": 2, "max_new_tokens": 4,
+                          "chunked": True, "tick_token_budget": 8,
+                          "prompt_buckets": [4, 8]},
+               "qos": {"enabled": True},
+               "trace": {"kind": "poisson", "n_requests": 40,
+                         "rate_rps": 50.0, "prompt_len": [4, 8],
+                         "gen_len": [2, 4]},
+               "sweep": {"qos.weights.interactive": [1.0, 8.0],
+                         "engine.tick_token_budget": [8, 16]}}
+        p = tmp_path / "scen.json"
+        p.write_text(json.dumps(doc))
+        assert sim_main(["run", str(p), "--json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert len(rows) == 4
+        assert {r["label"] for r in rows} == {
+            "qos.weights.interactive=1.0 engine.tick_token_budget=8",
+            "qos.weights.interactive=1.0 engine.tick_token_budget=16",
+            "qos.weights.interactive=8.0 engine.tick_token_budget=8",
+            "qos.weights.interactive=8.0 engine.tick_token_budget=16"}
+
+    def test_gate_cli_passes_from_a_subprocess(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.sim",
+             "gate", GOLDEN],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "gate OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# drift pins: the jax-free sim vs the live stack's constants
+# ---------------------------------------------------------------------------
+
+class TestDriftPins:
+    def test_slo_targets_mirror_flight(self):
+        from analytics_zoo_tpu.serving.flight import (
+            DEFAULT_SLO_TARGETS as FLIGHT_SLO_TARGETS)
+        assert SIM_SLO_TARGETS == FLIGHT_SLO_TARGETS
+
+    def test_flight_schema_version_is_supported(self):
+        from analytics_zoo_tpu.serving.flight import FLIGHT_SCHEMA_VERSION
+        assert FLIGHT_SCHEMA_VERSION in SUPPORTED_SCHEMA_VERSIONS
+
+    def test_replay_tolerances_documented(self):
+        doc = open(os.path.join(REPO, "docs", "simulation.md")).read()
+        for key, val in DEFAULT_TOLERANCES.items():
+            assert key in doc, f"tolerance {key!r} not documented"
+            assert str(val) in doc, \
+                f"documented value for {key!r} drifted from {val}"
+
+    def test_docs_cross_link_simulation(self):
+        assert os.path.exists(os.path.join(REPO, "docs",
+                                           "simulation.md"))
+        for rel in ("docs/debugging.md", "docs/observability.md",
+                    "README.md"):
+            text = open(os.path.join(REPO, rel)).read()
+            assert "simulation.md" in text, f"{rel} lost the link"
+
+    def test_default_weights_match_golden_fixture(self):
+        doc = json.load(open(GOLDEN))
+        assert doc["qos"]["weights"] == DEFAULT_WEIGHTS
+
+
+# ---------------------------------------------------------------------------
+# (slow) live-engine equivalence + bundle round trip
+# ---------------------------------------------------------------------------
+
+def _tiny_lm():
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.models.lm import TransformerLM
+    return TransformerLM(vocab_size=32, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_position=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+    import numpy as np
+    model = _tiny_lm()
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+@pytest.mark.slow
+class TestEngineSimEquivalence:
+    """The policy-extraction contract: the live engine and the model,
+    fed the same request schedule under the same knobs, must make the
+    SAME decision sequences — admission order, prefill-chunk grants
+    (uri, length), and preemption victims."""
+
+    def _engine_decisions(self, lm, qos, spec):
+        import numpy as np
+        from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+        model, variables = lm
+        kw = dict(max_new_tokens=5, max_slots=3, prompt_buckets=(8, 16),
+                  chunked=True, tick_token_budget=16, paged=True,
+                  block_size=4, n_blocks=12, enable_prefix_cache=False,
+                  qos=qos)
+        if spec:
+            kw.update(draft_model=model, draft_variables=variables,
+                      speculation_k=2)
+        eng = ContinuousEngine(model, variables, **kw)
+        rng = np.random.default_rng(0)
+        reqs = []
+        for i in range(10):
+            plen = int(rng.integers(5, 17))
+            pri = PRIORITIES[i % 3]
+            prompt = rng.integers(1, 31, size=plen).astype(np.int32)
+            eng.submit(f"r{i:02d}", prompt, priority=pri)
+            reqs.append(Request(uri=f"r{i:02d}", arrival_t=i * 1e-6,
+                                prompt_len=plen, gen_len=5,
+                                priority=pri))
+        eng.drain()
+        evs = eng.telemetry.events.snapshot()
+        return reqs, {
+            "admits": [a["uri"] for ph, nm, ts, d, t, a in evs
+                       if nm == "admitted"],
+            "chunks": [(a["uri"], a["tokens"])
+                       for ph, nm, ts, d, t, a in evs
+                       if nm == "prefill_chunk"],
+            "preempts": [a["uri"] for ph, nm, ts, d, t, a in evs
+                         if nm == "preempted"],
+        }
+
+    def _sim_decisions(self, reqs, qos, spec):
+        cfg = EngineConfig(slots=3, max_new_tokens=5,
+                           prompt_buckets=(8, 16), chunked=True,
+                           tick_token_budget=16, paged=True,
+                           block_size=4, n_blocks=12,
+                           spec_k=2 if spec else 0)
+        # drafting with the TARGET model accepts every proposal, so the
+        # live run above realizes accept_len == k deterministically
+        acc = AcceptanceModel.constant(2, 2) if spec else None
+        m = EngineModel(cfg, qos=qos, acceptance=acc)
+        for r in reqs:
+            m.submit(r)
+        for _ in range(100_000):
+            if m.step() == 0 and not m._waiting:
+                break
+        ticks = [e for e in m.events if e["event"] == "tick"]
+        assert all(r.finished and r.tokens == 5
+                   for r in m.records.values())
+        return {
+            "admits": [u for e in ticks for u in e["admitted"]],
+            "chunks": [(u, c) for e in ticks for u, c in e["chunks"]],
+            "preempts": [u for e in ticks for u in e["preempted"]],
+        }
+
+    @pytest.mark.parametrize("variant", ["fifo", "qos", "spec"])
+    def test_decision_sequences_match(self, lm, variant):
+        # huge aging keeps wall-clock compile time out of the rank
+        # (virtual and real clocks then agree on every decision input)
+        qos = QosPolicy(aging_s=1e9) if variant == "qos" else None
+        spec = variant == "spec"
+        reqs, eng = self._engine_decisions(lm, qos, spec)
+        sim = self._sim_decisions(reqs, qos, spec)
+        assert sim["admits"] == eng["admits"]
+        assert sim["chunks"] == eng["chunks"]
+        assert sim["preempts"] == eng["preempts"]
+
+
+@pytest.mark.slow
+class TestLiveBundleRoundTrip:
+    def test_dump_then_replay_crosschecks_ok(self, lm, tmp_path):
+        import numpy as np
+        from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+        from analytics_zoo_tpu.serving.flight import (
+            SloWatchdog, dump_bundle)
+        model, variables = lm
+        qos = QosPolicy()
+        eng = ContinuousEngine(model, variables, max_new_tokens=5,
+                               max_slots=3, prompt_buckets=(8, 16),
+                               draft_model=model,
+                               draft_variables=variables,
+                               speculation_k=2, paged=True,
+                               block_size=4, chunked=True,
+                               tick_token_budget=16, qos=qos,
+                               flight_capacity=64)
+        wd = SloWatchdog(registry=eng.telemetry.metrics)
+        eng.telemetry.watchdog = wd
+        rng = np.random.default_rng(1)
+        for i in range(8):
+            prompt = rng.integers(1, 31,
+                                  size=int(rng.integers(5, 17)))
+            eng.submit(f"q{i}", prompt.astype(np.int32),
+                       priority=PRIORITIES[i % 3])
+        eng.drain()
+        config = {"engine_slots": 3, "engine_chunked": True,
+                  "engine_tick_token_budget": 16, "engine_paged": True,
+                  "engine_block_size": 4, "engine_speculation_k": 2,
+                  "qos_enabled": True, "qos_aging_s": 30.0}
+        path = dump_bundle(str(tmp_path), reason="test", detail={},
+                           flight=eng.flight,
+                           telemetries=[eng.telemetry],
+                           config=config, slo=wd.status(),
+                           spec_acceptance=eng.spec_acceptance())
+        report = replay_bundle(path, seed=0)
+        # recorded-vs-derived: same clock stamps, tight tolerance
+        assert report["ok"] is True, report["crosscheck"]
+        assert report["schema_version"] in SUPPORTED_SCHEMA_VERSIONS
+        sim = report["simulated"]
+        assert sim["finished"] == 8
+        # model-vs-reality on a compile-polluted micro-bundle: the
+        # documented LOOSE tolerance (docs/simulation.md)
+        for cls, d in report["sim_vs_observed"].items():
+            assert abs(d["goodput"]) <= 0.5, (cls, d)
