@@ -54,7 +54,7 @@ bench:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_cache.py \
 	    tests/test_chunked_prefill.py tests/test_telemetry.py \
-	    tests/test_frontdoor.py -q -m "not slow"
+	    tests/test_frontdoor.py tests/test_router.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_composed.py \
 	    tests/test_flight.py tests/test_paged_fused.py -q
 	# fresh-bundle -> replay round trip + engine/sim decision equivalence

@@ -189,11 +189,22 @@ def device_prefetch(batches: Iterator[Dict[str, np.ndarray]], mesh: Mesh, *,
     bounded queue (depth = HBM staging bound) while the main thread
     dispatches compute.  numpy gather + device_put release the GIL for the
     copy, so the threads genuinely overlap.
+
+    On the CPU backend the transfer is a host memcpy — there is nothing
+    to overlap — and a ``device_put`` issued from a second thread can
+    deadlock against a concurrently-executing jitted program in the XLA
+    CPU client (observed on forced multi-device hosts: worker pinned in
+    ``device_put``, consumer pinned in the jit step, indefinitely), so
+    stage inline on the consumer thread there.
     """
     import queue as _queue
     import threading
 
     sh = sharding or data_sharding(mesh)
+    if jax.default_backend() == "cpu":
+        for b in batches:
+            yield make_global_batch(mesh, b, sh, pack=pack)
+        return
     q: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
     _END = object()

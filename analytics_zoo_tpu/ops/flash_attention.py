@@ -36,6 +36,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from analytics_zoo_tpu.parallel.mesh import shard_map as _shard_map
+
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where NaN-free
 
 
@@ -391,7 +393,7 @@ def sharded_flash_attention(q, k, v, mesh, kv_mask=None, *,
 
     if kv_mask is None:
         kv_mask = jnp.ones(q.shape[:1] + k.shape[1:2], bool)
-    return jax.shard_map(
+    return _shard_map(
         local, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec, check_vma=False,

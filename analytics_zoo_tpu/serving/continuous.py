@@ -280,13 +280,6 @@ class ContinuousEngine:
                     f"vocab {model.vocab_size}")
             if draft_model.pp_stages > 0:
                 raise ValueError("draft must be pp_stages=0")
-            if mesh is not None:
-                raise ValueError(
-                    "speculative continuous batching is single-chip "
-                    "for now: mesh does not compose with draft_model "
-                    "(see the ROADMAP item 'Tensor-parallel + "
-                    "multi-replica paged serving'); drop mesh or drop "
-                    "draft_model")
             if self._spec_k < 1:
                 raise ValueError("speculation_k must be >= 1")
         # speculative verify writes k+1 entries past the pointer and
@@ -345,6 +338,14 @@ class ContinuousEngine:
                 f"kernel={kernel!r} / kv_dtype={kv_dtype!r} require "
                 f"paged=True: both select the paged-attention path "
                 f"(the arena engine has no block pool to apply them to)")
+        if kernel == "fused" and mesh is not None:
+            raise ValueError(
+                "kernel='fused' does not run under a mesh yet: the "
+                "Pallas paged-attention kernel reads one chip's pool "
+                "(the ROADMAP follow-on 'fused paged-attention under "
+                "tp' lifts this); tp-sharded paged serving reads the "
+                "pool through kernel='gather' — drop kernel='fused' "
+                "to serve paged on this mesh")
         self.kernel = kernel
         if kv_dtype == "bf16":
             # explicit storage request wins over cache_dtype/model dtype
@@ -352,6 +353,50 @@ class ContinuousEngine:
         self._kv_int8 = kv_dtype == "int8"
         self.kv_dtype = "int8" if self._kv_int8 else _kv_label(cdtype)
         self.mesh = mesh
+        # ---- mesh: weights shard FIRST, for EVERY engine mode ----------
+        # arena, paged, chunked, and speculative engines all ride the
+        # same Megatron-layout rules; the per-mode KV storage below only
+        # decides how the cache itself is laid out.  _kv_tp records
+        # whether the chosen rules actually put "tp" on the k/v
+        # projection outputs — the KV storage (arena OR block pool) must
+        # match what they emit, or every tick pays resharding
+        # collectives the layout never required.
+        tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+        self._tp = tp
+        self._kv_tp = self._dkv_tp = False
+        if tp > 1:
+            from analytics_zoo_tpu.models.lm import LM_PARTITION_RULES
+            from analytics_zoo_tpu.parallel.partition import state_sharding
+
+            if H % tp and partition_rules is None:
+                raise ValueError(
+                    f"kv_heads={H} must divide by tp={tp} to shard the "
+                    f"KV cache under the default LM_PARTITION_RULES; "
+                    f"narrow-KV (MQA/GQA) models pass partition_rules "
+                    f"with the key/value kernels replicated (P()) — the "
+                    f"KV storage then replicates too")
+            rules = partition_rules or LM_PARTITION_RULES
+            shardings = state_sharding(mesh, variables, rules)
+            # sharded-from-BIRTH: materialising full weights on one chip
+            # first would OOM exactly the beyond-one-chip models this
+            # path exists for
+            variables = jax.device_put(variables, shardings)
+            self._kv_tp = H % tp == 0 and self._kv_kernels_tp_sharded(
+                shardings)
+            if draft_model is not None:
+                # the draft shards under the SAME rules (same
+                # architecture, same regexes); a draft whose kv_heads
+                # don't divide tp replicates its k/v kernels per-dim
+                # (match_partition_rules' divisibility fallback) and its
+                # KV storage follows suit
+                dshardings = state_sharding(mesh, draft_variables, rules)
+                draft_variables = jax.device_put(draft_variables,
+                                                 dshardings)
+                self._draft_variables = draft_variables
+                dH = getattr(draft_model, "kv_heads",
+                             draft_model.num_heads)
+                self._dkv_tp = dH % tp == 0 and \
+                    self._kv_kernels_tp_sharded(dshardings)
         # ---- paged mode (block-pool cache, serving/paged_cache.py) -----
         self.paged = bool(paged)
         self._preemptions = 0
@@ -363,12 +408,6 @@ class ContinuousEngine:
         self._dpool: Optional[BlockPool] = None
         self._dpk = self._dpv = None
         if self.paged:
-            if mesh is not None:
-                raise ValueError(
-                    "paged mode is single-chip for now: mesh does not "
-                    "compose with paged=True (see the ROADMAP item "
-                    "'Tensor-parallel + multi-replica paged serving'); "
-                    "drop mesh")
             bs = int(block_size)
             if bs < 1:
                 raise ValueError(f"block_size must be >= 1, got {bs}")
@@ -441,14 +480,34 @@ class ContinuousEngine:
             # pytrees (int8 data + per-(block, position, head) bf16
             # scales) — every jitted program moves them like arrays.
             shape = (model.num_layers, n_blocks, H, bs, D)
+            # mesh: the pool shards over tp on the kv-heads dim exactly
+            # like the arena — [layers, N, KH/tp, bs, D] per chip,
+            # allocated sharded-from-birth.  Bookkeeping (BlockPool,
+            # block tables) stays host-side and replicated — allocation,
+            # prefix hashing, preemption, and pointer-rollback verify
+            # are all table rewrites, mesh-oblivious by construction —
+            # and the jitted decode/chunk/verify programs reach the
+            # pool through XLA's sharding propagation.
+            pool_sh = scale_sh = None
+            if tp > 1:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                hax = "tp" if self._kv_tp else None
+                pool_sh = NamedSharding(mesh,
+                                        P(None, None, hax, None, None))
+                scale_sh = NamedSharding(mesh, P(None, None, hax, None))
             if self._kv_int8:
-                self._pk = QuantKV(jnp.zeros(shape, jnp.int8),
-                                   jnp.ones(shape[:-1], KV_SCALE_DTYPE))
-                self._pv = QuantKV(jnp.zeros(shape, jnp.int8),
-                                   jnp.ones(shape[:-1], KV_SCALE_DTYPE))
+                self._pk = QuantKV(
+                    jnp.zeros(shape, jnp.int8, device=pool_sh),
+                    jnp.ones(shape[:-1], KV_SCALE_DTYPE,
+                             device=scale_sh))
+                self._pv = QuantKV(
+                    jnp.zeros(shape, jnp.int8, device=pool_sh),
+                    jnp.ones(shape[:-1], KV_SCALE_DTYPE,
+                             device=scale_sh))
             else:
-                self._pk = jnp.zeros(shape, cdtype)
-                self._pv = jnp.zeros_like(self._pk)
+                self._pk = jnp.zeros(shape, cdtype, device=pool_sh)
+                self._pv = jnp.zeros(shape, cdtype, device=pool_sh)
             # per-slot block tables; SINK everywhere a row holds no
             # block, so stray writes land in storage nothing attends
             self._tables = np.full((S, M), SINK_BLOCK, np.int32)
@@ -474,10 +533,20 @@ class ContinuousEngine:
                     event_cb=self.telemetry.pool_event, name="draft",
                     kv_dtype=_kv_label(cdtype),
                     bytes_per_block=draft_per_block)
+                dpool_sh = None
+                if tp > 1:
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+                    dpool_sh = NamedSharding(
+                        mesh, P(None, None,
+                                "tp" if self._dkv_tp else None,
+                                None, None))
                 self._dpk = jnp.zeros(
                     (draft_model.num_layers, dnb, DHp, bs, DDp),
-                    cdtype)
-                self._dpv = jnp.zeros_like(self._dpk)
+                    cdtype, device=dpool_sh)
+                self._dpv = jnp.zeros(
+                    (draft_model.num_layers, dnb, DHp, bs, DDp),
+                    cdtype, device=dpool_sh)
                 self._dtables = np.full((S, M), SINK_BLOCK, np.int32)
                 self._drow_blocks: List[List[int]] = [
                     [] for _ in range(S)]
@@ -509,12 +578,6 @@ class ContinuousEngine:
         self._budget_ticks = 0
         self.tick_token_budget: Optional[int] = None
         if self.chunked:
-            if mesh is not None:
-                raise ValueError(
-                    "chunked prefill is single-chip for now: mesh does "
-                    "not compose with chunked=True (see the ROADMAP "
-                    "item 'Tensor-parallel + multi-replica paged "
-                    "serving'); drop mesh")
             if tick_token_budget is None:
                 # default: roughly one decode-bucket of MXU work — all S
                 # decode rows plus at least one smallest-bucket chunk
@@ -557,38 +620,20 @@ class ContinuousEngine:
                 v *= 2
             rb.append(L)
             self._read_buckets = tuple(rb)
-        tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
         if self.paged:
             self._ck = self._cv = None  # pool replaces the slot arena
         elif tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from analytics_zoo_tpu.models.lm import LM_PARTITION_RULES
-            from analytics_zoo_tpu.parallel.partition import state_sharding
-
-            if H % tp and partition_rules is None:
-                raise ValueError(
-                    f"kv_heads={H} must divide by tp={tp} to shard the "
-                    f"KV arena under the default LM_PARTITION_RULES; "
-                    f"narrow-KV (MQA/GQA) models pass partition_rules "
-                    f"with the key/value kernels replicated (P()) — the "
-                    f"arena then replicates too")
-            rules = partition_rules or LM_PARTITION_RULES
-            shardings = state_sharding(mesh, variables, rules)
-            variables = jax.device_put(variables, shardings)
             # the arena must MATCH what the kv projections emit under
-            # the chosen rules — custom rules that replicate the k/v
-            # kernels (even on a divisible-heads model) need a
-            # replicated arena, or every decode step pays resharding
-            # collectives the layout never required
-            kv_tp = H % tp == 0 and self._kv_kernels_tp_sharded(
-                shardings)
+            # the chosen rules (weights sharded above) — custom rules
+            # that replicate the k/v kernels (even on a divisible-heads
+            # model) need a replicated arena, or every decode step pays
+            # resharding collectives the layout never required
             kv_sh = NamedSharding(
-                mesh, P(None, None, None, "tp", None) if kv_tp
+                mesh, P(None, None, None, "tp", None) if self._kv_tp
                 else P())
-            # allocate sharded-from-BIRTH: materialising the full arena
-            # on one chip first would OOM exactly the beyond-one-chip
-            # models this path exists for
+            # allocate sharded-from-BIRTH, like the weights above
             self._ck = jnp.zeros((model.num_layers, S, L, H, D), cdtype,
                                  device=kv_sh)
             self._cv = jnp.zeros((model.num_layers, S, L, H, D), cdtype,
@@ -1084,9 +1129,16 @@ class ContinuousEngine:
         else:
             DH = getattr(draft, "kv_heads", draft.num_heads)
             DD = draft.hidden_size // draft.num_heads
+            dkv_sh = None
+            if self._dkv_tp:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                dkv_sh = NamedSharding(self.mesh,
+                                       P(None, None, None, "tp", None))
             self._dck = jnp.zeros((draft.num_layers, S, L, DH, DD),
-                                  cdtype)
-            self._dcv = jnp.zeros_like(self._dck)
+                                  cdtype, device=dkv_sh)
+            self._dcv = jnp.zeros((draft.num_layers, S, L, DH, DD),
+                                  cdtype, device=dkv_sh)
 
             def spec_step(ck, cv, dck, dcv, tok, pos, dpos, done):
                 # draft: k proposals via k+1 greedy cached feeds (the
@@ -1238,8 +1290,14 @@ class ContinuousEngine:
                 # residency is pay-as-you-grow + shared prefixes
                 "arena_bytes": per_block * self._pool.n_blocks,
                 "arena_equivalent_bytes": arena_equiv,
-                "tp": 1,
-                "arena_bytes_per_chip": per_block * self._pool.n_blocks,
+                # per-chip pressure follows the pool's ACTUAL sharding:
+                # tp shards it over the kv-heads dim, a narrow-KV
+                # (MQA/GQA) override replicates it
+                "tp": (int(self.mesh.shape.get("tp", 1))
+                       if self.mesh is not None else 1),
+                "arena_bytes_per_chip":
+                    per_block * self._pool.n_blocks
+                    // (self._tp if self._kv_tp else 1),
                 # the draft tenant's pool (0 without a draft model);
                 # pinned prefixes live IN the pools for both tenants
                 "draft_arena_bytes": (

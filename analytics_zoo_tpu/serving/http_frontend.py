@@ -722,6 +722,13 @@ class HttpFrontend:
             if etm is not None and all(etm.metrics is not r
                                        for r in regs):
                 regs.append(etm.metrics)
+            # multi-replica: every replica's registry rides along; the
+            # exposition dedupes by name (first registration wins), so
+            # shared families keep replica 0's sample while the
+            # zoo_router_*_r{r} families are per-replica by NAME
+            for rtm in getattr(self.serving, "telemetries", ()) or ():
+                if all(rtm.metrics is not r for r in regs):
+                    regs.append(rtm.metrics)
         return regs
 
     def prometheus(self) -> str:

@@ -27,6 +27,10 @@ from):
   each capped by the widest chunk bucket.
 * ``select_subqueue`` / ``stride_charge`` — the weighted
   deficit/stride admission order (``WeightedWaitQueue.popleft``).
+* ``route_request`` — multi-replica placement (the ``ClusterServing``
+  router thread, ``n_replicas > 1``): pool pressure first, then
+  per-class SLO goodput, then least-loaded with a deterministic
+  round-robin cursor tie-break.
 
 Everything here is stdlib-only ON PURPOSE: the simulator (and the
 bare-box ``debug.py --replay`` path) import this file with no numpy,
@@ -104,6 +108,103 @@ class QosPolicy:
 # ---------------------------------------------------------------------------
 # decision functions (pure: plain data in, decision out)
 # ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# multi-replica routing (ClusterServing n_replicas > 1)
+# ---------------------------------------------------------------------------
+
+#: A replica whose per-class SLO goodput (SloWatchdog.status) falls
+#: below this fraction is avoided while any healthy peer exists.
+ROUTER_GOODPUT_FLOOR = 0.9
+
+#: A paged replica reporting fewer allocatable blocks than this is
+#: treated as pool-pressured (the alloc-fail streak catches sustained
+#: pressure; this floor catches it one tick earlier).
+ROUTER_MIN_ALLOCATABLE = 1
+
+
+@dataclass(frozen=True)
+class ReplicaSignals:
+    """One replica's live routing signals, as plain data — the server
+    snapshots these from each replica's engine/watchdog per routed
+    request, the simulator fabricates them, and ``route_request``
+    never sees anything richer.
+
+    ``queue_depth`` is the replica's total uncompleted load (routed-
+    but-unclaimed + engine-waiting + engine-resident).
+    ``allocatable_blocks`` is ``BlockPool.allocatable()`` (``None``
+    for an arena-mode replica: no pool, never pool-pressured).
+    ``goodput`` maps priority class -> SLO goodput fraction from the
+    replica's watchdog (``None``/missing class reads as healthy —
+    a replica that served nothing yet must not read as degraded)."""
+
+    replica: int
+    live: bool = True
+    queue_depth: int = 0
+    allocatable_blocks: Optional[int] = None
+    alloc_fail_streak: int = 0
+    goodput: Optional[Dict[str, float]] = None
+
+
+def replica_pressured(sig: ReplicaSignals,
+                      min_allocatable: int = ROUTER_MIN_ALLOCATABLE
+                      ) -> bool:
+    """Pool pressure: a live alloc-fail streak, or an allocatable-block
+    count below the floor.  Arena replicas are never pressured."""
+    if sig.alloc_fail_streak > 0:
+        return True
+    return (sig.allocatable_blocks is not None
+            and sig.allocatable_blocks < min_allocatable)
+
+
+def replica_degraded(sig: ReplicaSignals, priority: Optional[str],
+                     goodput_floor: float = ROUTER_GOODPUT_FLOOR
+                     ) -> bool:
+    """SLO degradation for THIS request's class: the replica's
+    watchdog goodput for the class sits below the floor."""
+    if not sig.goodput:
+        return False
+    cls = priority if priority in PRIORITIES else "standard"
+    return sig.goodput.get(cls, 1.0) < goodput_floor
+
+
+def route_request(replicas: Sequence[ReplicaSignals],
+                  priority: Optional[str] = None,
+                  rr_cursor: int = 0,
+                  *,
+                  goodput_floor: float = ROUTER_GOODPUT_FLOOR,
+                  min_allocatable: int = ROUTER_MIN_ALLOCATABLE
+                  ) -> Optional[int]:
+    """Place one request on a replica.  Returns the chosen replica id,
+    or ``None`` when no replica is live (the caller's requeue/error
+    path).
+
+    Rank order, best first:
+
+    1. not pool-pressured (``replica_pressured``) — a dry pool means
+       admission would preempt or stall, so pressure outranks depth;
+    2. not SLO-degraded FOR THIS CLASS (``replica_degraded``) — a
+       replica failing interactive targets still takes batch work;
+    3. least ``queue_depth`` (least-loaded);
+    4. round-robin distance from ``rr_cursor`` — the DETERMINISTIC
+       tie-break: equal replicas take turns as the caller advances the
+       cursor per routed request, never a coin flip.
+
+    Every signal equal (cold start) this degrades to exactly
+    least-loaded round-robin, the documented fallback."""
+    live = [r for r in replicas if r.live]
+    if not live:
+        return None
+    n = max(r.replica for r in live) + 1
+
+    def rank(r: ReplicaSignals):
+        return (replica_pressured(r, min_allocatable),
+                replica_degraded(r, priority, goodput_floor),
+                r.queue_depth,
+                (r.replica - rr_cursor) % n)
+
+    return min(live, key=rank).replica
+
 
 def grant_rank(policy: Optional[QosPolicy], priority: Optional[str],
                waited_s: float, admit_seq: int):

@@ -36,6 +36,8 @@ from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
                                                  TokenEmitter,
                                                  decode_priority,
                                                  decode_str_field)
+from analytics_zoo_tpu.serving.policy import (ReplicaSignals,
+                                                route_request)
 from analytics_zoo_tpu.serving.queues import (
     CANCEL_STREAM, IMG_MAGIC, INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX,
     TOKEN_PREFIX, OutputQueue, decode_ndarray, encode_ndarray)
@@ -82,6 +84,14 @@ class ServingConfig:
     # requests; eos_id frees a slot early when the model emits it.
     continuous_batching: bool = False
     engine_slots: int = 8
+    # engine replicas (continuous mode): N engines, each owning its own
+    # pump thread, telemetry registry and flight ring, behind ONE
+    # broker/front door — a router thread (serving/policy.py
+    # route_request) places each request on live per-replica signals
+    # (pool pressure, queue depth, per-class SLO goodput), falling back
+    # to least-loaded round-robin.  1 keeps the single-pump layout
+    # bit-identical to previous releases.
+    n_replicas: int = 1
     eos_id: Optional[int] = None
     # tokens decoded per device call: >1 trades admission-latency
     # granularity for fewer host round-trips (tunneled-device win)
@@ -195,6 +205,8 @@ class ServingConfig:
             cfg.continuous_batching = bool(params["continuous_batching"])
         if "engine_slots" in params:
             cfg.engine_slots = int(params["engine_slots"])
+        if "n_replicas" in params:
+            cfg.n_replicas = int(params["n_replicas"])
         if "eos_id" in params:
             cfg.eos_id = int(params["eos_id"])
         if "engine_ticks" in params:
@@ -331,6 +343,68 @@ class ClusterServing:
             breach_window_s=self.config.anomaly_breach_window_s,
             alloc_streak=self.config.anomaly_alloc_streak,
             steady_after_ticks=self.config.anomaly_steady_ticks)
+        # ---- replica set (continuous mode scale-out) -------------------
+        # replica 0 owns the job-level telemetry/watchdog/flight above
+        # (single-replica deployments stay bit-identical); each further
+        # replica gets its OWN registry, watchdog, flight ring and
+        # anomaly monitor, so one replica's incident never muddies a
+        # neighbour's trace and the router can read per-replica SLO
+        # goodput.  /metrics merges every registry (distinct engines
+        # share metric names, so multi-replica scrapes read replica 0's
+        # registry plus the zoo_router_* families for the fleet view;
+        # per-replica registries feed bundles and the router).
+        self.n_replicas = max(1, int(getattr(self.config,
+                                             "n_replicas", 1)))
+        if self.n_replicas > 1 and not self.config.continuous_batching:
+            raise ValueError(
+                "n_replicas > 1 needs continuous_batching: true — the "
+                "micro-batch path already scales with `workers` "
+                "consumers on the shared group; replicas exist to "
+                "multiply continuous engines")
+        self.engines: list = []
+        self.telemetries = [self.telemetry]
+        self.watchdogs = [self.watchdog]
+        self.flights = [self.flight]
+        self.anomaly_monitors = [self.anomalies]
+        for r in range(1, self.n_replicas):
+            tm = Telemetry()
+            wd = SloWatchdog(self.config.slo_policy(),
+                             registry=tm.metrics)
+            tm.watchdog = wd
+            fl = (FlightRecorder(self.config.flight_capacity)
+                  if self.config.flight_capacity > 0 else None)
+            mon = AnomalyMonitor(
+                (lambda reason, detail, _r=r:
+                 self._dump_bundle(reason, dict(detail, replica=_r))),
+                min_interval_s=self.config.diag_min_interval_s,
+                breach_burst=self.config.anomaly_breach_burst,
+                breach_window_s=self.config.anomaly_breach_window_s,
+                alloc_streak=self.config.anomaly_alloc_streak,
+                steady_after_ticks=self.config.anomaly_steady_ticks)
+            self.telemetries.append(tm)
+            self.watchdogs.append(wd)
+            self.flights.append(fl)
+            self.anomaly_monitors.append(mon)
+        # router state: per-replica routed-entry queues + cancel sets
+        # under ONE condition (the router appends, pumps pop, kills
+        # notify), round-robin cursor, uri->replica map for cancel
+        # fan-out, per-replica routed counters
+        self._rq_cond = threading.Condition()
+        self._rqueues: List[collections.deque] = [
+            collections.deque() for _ in range(self.n_replicas)]
+        self._rcancels: List[set] = [set()
+                                     for _ in range(self.n_replicas)]
+        self._pump_live = [False] * self.n_replicas
+        self._pump_stops = [threading.Event()
+                            for _ in range(self.n_replicas)]
+        self._rr_cursor = 0
+        self._uri_replica: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()
+        self._router_cancelled: set = set()
+        self._routed_counts = [0] * self.n_replicas
+        self._rerouted_count = 0
+        if self.n_replicas > 1:
+            self._register_router_gauges()
         self._img_resize = None
         from concurrent.futures import ThreadPoolExecutor
         import os as _os
@@ -376,6 +450,29 @@ class ClusterServing:
                   "streaming clients that disconnected mid-response")
         m.counter("zoo_serving_backpressure_rejections_total",
                   "admissions refused with 429 under a full backlog")
+
+    def _register_router_gauges(self) -> None:
+        """The ``zoo_router_*`` families (docs/observability.md): fleet
+        liveness plus per-replica placement counters and queue depths —
+        the serve-smoke 2-replica leg asserts traffic spread on these."""
+        m = self.telemetry.metrics
+        m.gauge("zoo_router_replicas", "configured engine replicas",
+                fn=lambda: self.n_replicas)
+        m.gauge("zoo_router_replicas_live",
+                "replicas with a live pump thread",
+                fn=lambda: sum(1 for v in self._pump_live if v))
+        m.gauge("zoo_router_rerouted_total",
+                "entries drained from a dead replica's queue and "
+                "re-placed on survivors",
+                fn=lambda: self._rerouted_count, kind="counter")
+        for r in range(self.n_replicas):
+            m.gauge(f"zoo_router_routed_total_r{r}",
+                    f"requests the router placed on replica {r}",
+                    fn=(lambda _r=r: self._routed_counts[_r]),
+                    kind="counter")
+            m.gauge(f"zoo_router_queue_depth_r{r}",
+                    f"replica {r} routed-but-unclaimed entries",
+                    fn=(lambda _r=r: len(self._rqueues[_r])))
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -453,9 +550,12 @@ class ClusterServing:
                 raise
         self._threads = []
         if self.config.continuous_batching:
-            # ONE pump thread owns the engine's device arena; horizontal
-            # scale for continuous mode is more engine slots (or more
-            # ClusterServing processes, each with its own arena)
+            # each pump thread owns ONE engine's device state; with
+            # n_replicas > 1 a router thread claims from the shared
+            # group and places requests on replicas (least-loaded /
+            # pressure/SLO-aware), so horizontal scale inside one
+            # process is more replicas — more ClusterServing PROCESSES
+            # on the same broker still compose on top
             qos = None
             if self.config.qos_enabled:
                 qos = QosPolicy(
@@ -466,7 +566,7 @@ class ClusterServing:
                             float(self.config.qos_weight_standard),
                         "batch": float(self.config.qos_weight_batch)},
                     aging_s=float(self.config.qos_aging_s))
-            self.engine = self.model.make_continuous_engine(
+            self.engines = [self.model.make_continuous_engine(
                 max_slots=self.config.engine_slots,
                 eos_id=self.config.eos_id,
                 ticks_per_step=self.config.engine_ticks,
@@ -483,15 +583,25 @@ class ClusterServing:
                 chunked=self.config.engine_chunked,
                 tick_token_budget=self.config.engine_tick_token_budget,
                 speculation_k=self.config.engine_speculation_k,
-                telemetry=self.telemetry,
+                telemetry=self.telemetries[r],
                 qos=qos,
-                flight=self.flight,
+                flight=self.flights[r],
                 flight_capacity=self.config.flight_capacity)
-            t = threading.Thread(target=self._loop_continuous,
-                                 args=("w0",), daemon=True,
-                                 name="zoo-serving-cb")
-            t.start()
-            self._threads.append(t)
+                for r in range(self.n_replicas)]
+            self.engine = self.engines[0]   # back-compat attribute
+            for r in range(self.n_replicas):
+                self._pump_live[r] = True
+                t = threading.Thread(target=self._loop_continuous,
+                                     args=(f"w{r}", r), daemon=True,
+                                     name=f"zoo-serving-cb-{r}")
+                t.start()
+                self._threads.append(t)
+            if self.n_replicas > 1:
+                rt = threading.Thread(target=self._loop_router,
+                                      daemon=True,
+                                      name="zoo-serving-router")
+                rt.start()
+                self._threads.append(rt)
         else:
             for w in range(max(1, self.config.workers)):
                 t = threading.Thread(target=self._loop, args=(f"w{w}",),
@@ -516,12 +626,20 @@ class ClusterServing:
             raise RuntimeError(
                 "register_prefix needs a RUNNING continuous engine: "
                 "enable continuous_batching and call start() first")
-        return self.engine.register_prefix(tokens)
+        # every replica prefills the prefix into ITS pool/arena; the
+        # id counters advance in lockstep (registrations are serialised
+        # here), so one id is valid fleet-wide
+        ids = [e.register_prefix(tokens) for e in self.engines]
+        if len(set(ids)) != 1:
+            raise RuntimeError(
+                f"prefix ids diverged across replicas: {ids}")
+        return ids[0]
 
     def unregister_prefix(self, pid: int) -> None:
         if self.engine is None:
             raise RuntimeError("no continuous engine running")
-        self.engine.unregister_prefix(pid)
+        for e in self.engines:
+            e.unregister_prefix(pid)
 
     def stop(self):
         self._stop.set()
@@ -658,19 +776,29 @@ class ClusterServing:
         finally:
             client.close()
 
-    def _loop_continuous(self, consumer: str):
+    def _loop_continuous(self, consumer: str, replica: int = 0):
         """Continuous-batching pump: requests stream into the slot-arena
         engine as they arrive (in-flight joining); each request publishes
         the moment IT finishes, so a 2-token request never convoys behind
-        a 32-token neighbour admitted earlier."""
+        a 32-token neighbour admitted earlier.
+
+        With one replica the pump claims straight from the broker's
+        consumer group (the historical path, bit-identical).  With
+        ``n_replicas > 1`` a router thread owns the claiming and this
+        pump pops its replica's routed queue; a ``kill_pump`` stops the
+        claiming but the pump keeps stepping until ITS engine drains,
+        so no admitted request is dropped by a graceful kill."""
         try:
             client = RespClient(self.config.redis_host,
                                 self.config.redis_port)
         except OSError:
             logger.exception("continuous serving pump could not connect "
                              "to the broker — not started")
+            self._pump_live[replica] = False
             return
-        engine = self.engine
+        engine = self.engines[replica]
+        routed = self.n_replicas > 1
+        stop_ev = self._pump_stops[replica]
         pcol = self.config.prompt_col or "prompt"
         # streaming state is PUMP-THREAD-ONLY (on_done/on_token fire
         # inside engine.step() on this thread): the emitter buffers
@@ -721,6 +849,7 @@ class ClusterServing:
                 self.stats["cache"] = cache
                 self._written.append((uri, time.monotonic()))
                 self._inflight.pop(uri, None)
+                self._uri_replica.pop(uri, None)
 
         # the continuous pump must prune too (the micro-batch path
         # prunes per publish): time-gated so the idle poll loop isn't
@@ -743,20 +872,31 @@ class ClusterServing:
         try:
             while not self._stop.is_set():
                 now = time.monotonic()
-                if now >= next_prune:
+                if replica == 0 and now >= next_prune:
                     next_prune = now + _prune_cadence()
                     self._prune_abandoned(client, now)
-                self._drain_cancels(client, emitter, streaming,
-                                    cancelled_pending)
+                if routed:
+                    self._drain_routed_cancels(client, replica, emitter,
+                                               streaming,
+                                               cancelled_pending)
+                else:
+                    self._drain_cancels(client, emitter, streaming,
+                                        cancelled_pending)
                 busy = engine.n_active > 0 or engine.n_waiting > 0
-                try:
-                    requests, ids = self._read_batch(
-                        client, consumer, 1 if busy else 200)
-                except (ConnectionError, OSError):
-                    if self._stop.is_set():
-                        break
-                    time.sleep(0.05)
-                    continue
+                if routed:
+                    requests, ids = self._pop_routed(
+                        replica, 0.001 if busy else 0.2)
+                    if stop_ev.is_set() and not requests and not busy:
+                        break       # killed + drained: graceful exit
+                else:
+                    try:
+                        requests, ids = self._read_batch(
+                            client, consumer, 1 if busy else 200)
+                    except (ConnectionError, OSError):
+                        if self._stop.is_set():
+                            break
+                        time.sleep(0.05)
+                        continue
                 for r, eid in zip(requests, ids):
                     t0 = time.perf_counter()
                     try:
@@ -843,28 +983,35 @@ class ClusterServing:
                     # to timeout with no log.  Log, breathe, keep
                     # serving (admission of new work may still succeed;
                     # a persistent fault keeps logging loudly).
-                    logger.exception("continuous engine step failed")
+                    logger.exception("continuous engine step failed "
+                                     "(replica %d)", replica)
                     # the flight ring holds the ticks leading here —
                     # exactly what a post-mortem needs; dump now (rate-
                     # limited, failure-isolated) while the state is hot
-                    self.anomalies.crash(traceback.format_exc())
+                    self.anomaly_monitors[replica].crash(
+                        traceback.format_exc())
                     time.sleep(0.2)
                 else:
-                    self._diag_poll(engine)
+                    self._diag_poll(engine, replica)
                 self._flush_emitter(client, emitter)
         finally:
+            self._pump_live[replica] = False
+            with self._rq_cond:
+                self._rq_cond.notify_all()   # wake the router's sweep
             client.close()
 
-    def _diag_poll(self, engine) -> None:
+    def _diag_poll(self, engine, replica: int = 0) -> None:
         """One cheap anomaly check per pump iteration: three counter
         reads and a deque scan — the monitor only gets expensive when
-        it actually triggers a bundle."""
-        self.anomalies.poll(
+        it actually triggers a bundle.  Each replica polls ITS monitor
+        against ITS telemetry/watchdog, so one replica's pathology
+        never hides behind a healthy fleet average."""
+        tm = self.telemetries[replica]
+        self.anomaly_monitors[replica].poll(
             alloc_fail_streak=engine.alloc_fail_streak,
-            ticks=self.telemetry.c_ticks.value,
-            compiles=(self.telemetry.c_jit_builds.value
-                      + self.telemetry.c_retraces.value),
-            watchdog=self.watchdog)
+            ticks=tm.c_ticks.value,
+            compiles=(tm.c_jit_builds.value + tm.c_retraces.value),
+            watchdog=self.watchdogs[replica])
 
     def _dump_bundle(self, reason: str, detail: dict) -> str:
         """AnomalyMonitor's dump callback: one self-contained bundle
@@ -942,10 +1089,11 @@ class ClusterServing:
 
     def _cancel_one(self, client: RespClient, uri: str,
                     emitter: TokenEmitter, streaming: set,
-                    cancelled_pending: set) -> None:
+                    cancelled_pending: set, engine=None) -> None:
+        engine = engine if engine is not None else self.engine
         with self._stats_lock:
             info = self._inflight.pop(uri, None)
-        aborted = self.engine.abort(uri)
+        aborted = engine.abort(uri)
         if not aborted and info is None:
             # not in the engine and not tracked: either it already
             # published (don't clobber the result) or it is still in
@@ -963,6 +1111,220 @@ class ClusterServing:
         self._publish_error({"uri": uri.encode()}, "cancelled")
         if info is not None:
             self._finish_entries(client, [info[1]])
+
+    # ---- multi-replica router (serving/policy.py route_request) -------
+
+    def _pop_routed(self, replica: int, wait_s: float):
+        """A pump's claim path in multi-replica mode: pop up to
+        batch_size routed entries from THIS replica's queue.  A killed
+        pump claims nothing more — its unclaimed queue becomes the
+        router's to re-place (``_reroute_dead``)."""
+        cap = self.config.batch_size
+        out = []
+        with self._rq_cond:
+            if self._pump_stops[replica].is_set():
+                return [], []
+            q = self._rqueues[replica]
+            if not q:
+                self._rq_cond.wait(wait_s)
+                if self._pump_stops[replica].is_set():
+                    return [], []
+            while q and len(out) < cap:
+                out.append(q.popleft())
+        if not out:
+            return [], []
+        return [f for f, _ in out], [e for _, e in out]
+
+    def _drain_routed_cancels(self, client: RespClient, replica: int,
+                              emitter: TokenEmitter, streaming: set,
+                              cancelled_pending: set) -> int:
+        """Multi-replica cancel leg: the router already fanned the
+        cancel stream out to owning replicas (``_route_cancels``); each
+        pump serves its own share against ITS engine."""
+        with self._rq_cond:
+            if not self._rcancels[replica]:
+                return 0
+            uris = list(self._rcancels[replica])
+            self._rcancels[replica].clear()
+        for uri in uris:
+            self._cancel_one(client, uri, emitter, streaming,
+                             cancelled_pending,
+                             engine=self.engines[replica])
+        return len(uris)
+
+    def replica_signals(self, replica: int) -> ReplicaSignals:
+        """Snapshot one replica's live routing signals: effective load
+        (routed-but-unclaimed + queued-in-engine + resident), pool
+        pressure (paged engines only — arena replicas report no block
+        counts and are never 'pressured' on that leg), and per-class
+        SLO goodput from the replica's own watchdog."""
+        eng = self.engines[replica]
+        pool = getattr(eng, "_pool", None)
+        per_class = self.watchdogs[replica].status()["per_class"]
+        return ReplicaSignals(
+            replica=replica,
+            live=self._pump_live[replica],
+            queue_depth=(len(self._rqueues[replica])
+                         + eng.n_waiting + eng.n_active),
+            allocatable_blocks=(pool.allocatable()
+                                if pool is not None else None),
+            alloc_fail_streak=eng.alloc_fail_streak,
+            goodput={c: d["goodput"] for c, d in per_class.items()})
+
+    def router_status(self) -> dict:
+        """Live routing view — the observability surface behind the
+        ``zoo_router_*`` families and the serve-smoke 2-replica leg's
+        assertions."""
+        status = {
+            "n_replicas": self.n_replicas,
+            "live": list(self._pump_live),
+            "routed": list(self._routed_counts),
+            "rerouted": self._rerouted_count,
+            "queue_depths": [len(q) for q in self._rqueues],
+        }
+        if self.engines:
+            status["signals"] = [
+                dataclasses.asdict(self.replica_signals(r))
+                for r in range(self.n_replicas)]
+        return status
+
+    def kill_pump(self, replica: int) -> None:
+        """Gracefully retire one replica: the router stops placing new
+        work there at once, the pump claims nothing more but keeps
+        stepping until every request already admitted to its engine
+        has published, then exits; the replica's routed-but-unclaimed
+        entries are swept onto survivors by the router.  The drain
+        test and the serve-smoke 2-replica leg drive this path."""
+        if not 0 <= replica < self.n_replicas:
+            raise ValueError(f"no replica {replica} "
+                             f"(n_replicas={self.n_replicas})")
+        if self.n_replicas == 1:
+            raise ValueError(
+                "kill_pump on the sole pump would stop serving "
+                "entirely — that is stop()")
+        self._pump_live[replica] = False
+        self._pump_stops[replica].set()
+        with self._rq_cond:
+            self._rq_cond.notify_all()
+
+    def _route_one(self, client: RespClient, fields: Dict[str, bytes],
+                   eid) -> None:
+        """Place ONE claimed entry: cancel-raced entries die here
+        without touching any engine; otherwise route_request ranks the
+        live replicas on (pressure, SLO degradation, depth, round-
+        robin distance) and the entry lands in the winner's queue."""
+        try:
+            uri = fields["uri"].decode()
+        except Exception:
+            uri = ""
+        if uri and uri in self._router_cancelled:
+            self._router_cancelled.discard(uri)
+            self._publish_error({"uri": fields["uri"]}, "cancelled")
+            self._finish_entries(client, [eid])
+            return
+        priority = None
+        if "priority" in fields:
+            try:
+                priority = decode_priority(
+                    self._decode_value(fields["priority"]))
+            except Exception:
+                priority = None
+        sigs = [self.replica_signals(r)
+                for r in range(self.n_replicas)]
+        r = route_request(sigs, priority, self._rr_cursor)
+        if r is None:
+            # no live pump anywhere: fail fast rather than letting
+            # every client ride out its timeout against dead queues
+            self._publish_error({"uri": fields.get("uri", b"")},
+                                "no live replicas")
+            self._finish_entries(client, [eid])
+            return
+        with self._rq_cond:
+            self._rqueues[r].append((fields, eid))
+            if uri:
+                self._uri_replica[uri] = r
+                while len(self._uri_replica) > 65536:
+                    self._uri_replica.popitem(last=False)
+            self._routed_counts[r] += 1
+            self._rr_cursor = (r + 1) % self.n_replicas
+            self._rq_cond.notify_all()
+
+    def _route_cancels(self, client: RespClient) -> int:
+        """Router-side cancel fan-out: owning replicas get the uri in
+        their cancel set; uris the router never placed park in
+        ``_router_cancelled`` so a late-claimed entry dies at routing
+        time (the single-pump path's ``cancelled_pending``, lifted to
+        the router)."""
+        try:
+            entries = client.execute("XRANGE", CANCEL_STREAM, "-", "+")
+        except Exception:
+            return 0
+        if not entries:
+            return 0
+        ids = []
+        with self._rq_cond:
+            for eid, flat in entries:
+                ids.append(eid)
+                f = {flat[i].decode(): flat[i + 1]
+                     for i in range(0, len(flat), 2)}
+                uri = f.get("uri", b"").decode()
+                if not uri:
+                    continue
+                r = self._uri_replica.get(uri)
+                if r is not None:
+                    self._rcancels[r].add(uri)
+                elif len(self._router_cancelled) < 4096:
+                    self._router_cancelled.add(uri)
+            self._rq_cond.notify_all()
+        try:
+            client.execute("XDEL", CANCEL_STREAM, *ids)
+        except Exception:
+            logger.exception("cancel-stream trim failed")
+        return len(ids)
+
+    def _reroute_dead(self, client: RespClient) -> None:
+        """Sweep dead replicas' unclaimed queues onto survivors — the
+        other half of the graceful-kill contract: admitted work drains
+        in place, unclaimed work moves."""
+        moved = []
+        with self._rq_cond:
+            for r in range(self.n_replicas):
+                if self._pump_live[r] or not self._rqueues[r]:
+                    continue
+                while self._rqueues[r]:
+                    moved.append(self._rqueues[r].popleft())
+        for fields, eid in moved:
+            self._rerouted_count += 1
+            self._route_one(client, fields, eid)
+
+    def _loop_router(self) -> None:
+        """Router thread (``n_replicas > 1``): the SOLE claimer of the
+        broker's consumer group — XREADGROUP as consumer "router" —
+        placing each entry via ``_route_one``.  Short claim blocks keep
+        the cancel fan-out and the dead-replica sweep responsive."""
+        try:
+            client = RespClient(self.config.redis_host,
+                                self.config.redis_port)
+        except OSError:
+            logger.exception("router could not connect to the broker "
+                             "— multi-replica serving not started")
+            return
+        try:
+            while not self._stop.is_set():
+                self._route_cancels(client)
+                self._reroute_dead(client)
+                try:
+                    requests, ids = self._read_batch(client, "router",
+                                                     20)
+                except (ConnectionError, OSError):
+                    if self._stop.is_set():
+                        break
+                    time.sleep(0.05)
+                    continue
+                for fields, eid in zip(requests, ids):
+                    self._route_one(client, fields, eid)
+        finally:
+            client.close()
 
     def _finish_entries(self, client: RespClient, ids):
         """Ack + delete consumed stream entries (after their results —
@@ -1197,8 +1559,8 @@ class ClusterServing:
         a speculative row — and its stream entry is acked so the group
         never redelivers dead work."""
         ttl = self.config.result_ttl_s
-        engine = getattr(self, "engine", None)
-        if engine is not None:
+        engines = list(getattr(self, "engines", ()))
+        if engines:
             with self._stats_lock:
                 stale = [(u, te) for u, te in self._inflight.items()
                          if now - te[0] > ttl]
@@ -1206,8 +1568,9 @@ class ClusterServing:
                     del self._inflight[u]
             for u, (t_sub, eid) in stale:
                 # False = the row completed in the race window; its
-                # publish already handled the entry
-                if engine.abort(u):
+                # publish already handled the entry.  A uri lives in at
+                # most ONE replica's engine, so any() stops there.
+                if any(e.abort(u) for e in engines):
                     self.telemetry.req_abandoned(u, now - t_sub)
                     self._finish_entries(client, [eid])
                     # a streaming abandoner's token stream dies with it

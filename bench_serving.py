@@ -1201,11 +1201,196 @@ PLAN = [("resnet18", 64, 10, 64),
         # bounded backlog with 429 + Retry-After, mid-stream aborts
         # freeing blocks live; clients = engine slots, rpc = offered
         ("lm-qos", 4, 80, 8),
+        # multi-replica scale-out at fixed TOTAL KV HBM: aggregate
+        # req/s + per-class p99 TTFT vs n_replicas in {1,2,4} behind
+        # one broker/router, plus the tp=2 paged-vs-arena bitwise
+        # parity row; clients = engine slots per replica, rpc = burst
+        ("lm-scale", 4, 96, 8),
         ("lm", 16, 10, 32), ("lm-spec", 16, 10, 32),
         ("lm", 64, 5, 32), ("lm", 1, 20, 32),
         ("mlp", 256, 50, 128), ("mlp", 64, 50, 128),
         ("mlp", 1, 100, 128),
         ("resnet18", 16, 20, 64), ("resnet18", 1, 50, 64)]
+
+
+
+def run_scale_scenario(slots: int = 4, n_requests: int = 96) -> dict:
+    """Multi-replica scale-out at FIXED total KV HBM: one saturating
+    interactive/batch burst served by ``n_replicas`` in {1, 2, 4},
+    every fleet splitting the SAME block budget across its replicas —
+    so the delta is router + pump parallelism, never extra memory.
+
+    Reported per fleet size: aggregate req/s, per-class p99 TTFT
+    (merged from every replica's request stamps), and the router's
+    placement counters (multi-replica fleets must show traffic on
+    EVERY replica).  A final row serves the same prompts through a
+    tp=2 mesh engine paged AND arena and asserts bitwise parity —
+    the tensor-parallel paged pool must be a memory layout, never a
+    numerics change.  NOTE: on a CPU host the engines share cores, so
+    the req/s column is flat-to-down with R; the scale-out claim is
+    for real fleets where each replica owns devices — judge the
+    ROUTING (spread, per-class p99) here and the throughput on TPU."""
+    import queue as _q
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, InputQueue, OutputQueue, ServingConfig)
+    from analytics_zoo_tpu.serving.frontdoor import encode_priority
+
+    total_blocks = 96
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(1, 8192, int(rng.integers(6, 14))).astype(
+        np.int32) for _ in range(16)]
+
+    def pct(cls, vals, q):
+        a = np.asarray(vals.get(cls, []))
+        return round(float(np.percentile(a, q)) * 1e3, 2) if a.size \
+            else None
+
+    def serve_fleet(n_replicas: int) -> dict:
+        im = InferenceModel(batch_buckets=(1, slots))
+        im.load_flax_generator(model, variables, max_new_tokens=16,
+                               prompt_buckets=(16,))
+        cfg = ServingConfig(
+            prompt_col="tokens", continuous_batching=True,
+            engine_slots=slots, engine_ticks=2, engine_paged=True,
+            engine_block_size=8,
+            engine_blocks=max(slots * 4, total_blocks // n_replicas),
+            n_replicas=n_replicas)
+        serving = ClusterServing(im, cfg, embedded_broker=True).start()
+        inq = InputQueue(port=serving.port)
+        wq = OutputQueue(port=serving.port)
+        # warm every replica (round-robin spreads equal-depth warmups)
+        for r in range(n_replicas):
+            inq.enqueue(f"warm{r}", tokens=prompts[0])
+        for r in range(n_replicas):
+            assert wq.query(f"warm{r}", timeout=600) is not None
+        for e in serving.engines:
+            e.telemetry.reset_windows()
+            e.record_timings = True
+
+        served: set = set()
+        lock = threading.Lock()
+        uris_q: "_q.Queue" = _q.Queue()
+
+        def waiter():
+            outq = OutputQueue(port=serving.port)
+            try:
+                while True:
+                    u = uris_q.get()
+                    if u is None:
+                        return
+                    r = outq.query(u, timeout=600, poll_interval=0.001)
+                    if r is not None:
+                        with lock:
+                            served.add(u)
+            finally:
+                outq.close()
+
+        waiters = [threading.Thread(target=waiter) for _ in range(12)]
+        for w in waiters:
+            w.start()
+        t_start = time.perf_counter()
+        for i in range(n_requests):
+            cls = "interactive" if i % 4 == 0 else "batch"
+            uri = f"{cls[0]}{i}"
+            inq.enqueue(uri, tokens=prompts[int(rng.integers(16))],
+                        priority=encode_priority(cls))
+            uris_q.put(uri)
+        for _ in waiters:
+            uris_q.put(None)
+        for w in waiters:
+            w.join()
+        wall = time.perf_counter() - t_start
+        timings = {}
+        for e in serving.engines:
+            timings.update(e.pop_request_timings())
+        router = (serving.router_status() if n_replicas > 1 else None)
+        serving.stop()
+        inq.close()
+        wq.close()
+        ttft: dict = {"i": [], "b": []}
+        for u, t in timings.items():
+            if u[0] in ttft and t["token_times"]:
+                ttft[u[0]].append(t["token_times"][0] - t["arrival"])
+        row = {
+            "n_replicas": n_replicas,
+            "blocks_per_replica": cfg.engine_blocks,
+            "served": len(served),
+            "req_per_sec": round(len(served) / wall, 1),
+            "ttft_p99_interactive_ms": pct("i", ttft, 99),
+            "ttft_p99_batch_ms": pct("b", ttft, 99),
+        }
+        if router is not None:
+            row["routed"] = router["routed"]
+            row["rerouted"] = router["rerouted"]
+            assert all(c > 0 for c in router["routed"]), \
+                f"replica starved by the router: {router}"
+        assert len(served) == n_requests, \
+            f"lost requests: {n_requests - len(served)}"
+        return row
+
+    fleets = [serve_fleet(r) for r in (1, 2, 4)]
+
+    # ---- tp=2 parity row (the tentpole claim): for BOTH allocators
+    # the mesh is a memory layout, never a numerics change — paged and
+    # arena alike must emit bitwise the single-chip engine's tokens.
+    # Judged at f32 compute (same weights), like every bitwise bar in
+    # tests/: under bf16 a tp-split matmul's different reduction order
+    # can legitimately flip a near-tied argmax, which would make the
+    # row flaky without saying anything about the layout.
+    def tp_parity_row() -> dict:
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.parallel.mesh import make_mesh
+        from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+
+        if len(jax.devices()) < 2:
+            return {"skipped": "tp=2 needs >= 2 devices"}
+        mesh = make_mesh(axes={"dp": -1, "tp": 2})
+        f32_model = model.clone(dtype=jnp.float32)
+        row = {"tp": 2}
+        for mode in ("arena", "paged"):
+            kw = dict(paged=True, block_size=8) if mode == "paged" \
+                else {}
+            outs, walls = {}, {}
+            for name, m in (("tp1", None), ("tp2", mesh)):
+                eng = ContinuousEngine(f32_model, variables, mesh=m,
+                                       max_new_tokens=8,
+                                       max_slots=slots,
+                                       prompt_buckets=(16,), **kw)
+                got = {}
+                t0 = time.perf_counter()
+                for i in range(8):
+                    eng.submit(f"u{i}", prompts[i % len(prompts)],
+                               on_done=lambda u, t:
+                               got.__setitem__(u, t))
+                eng.drain()
+                walls[name] = time.perf_counter() - t0
+                outs[name] = got
+            match = all(np.array_equal(outs["tp1"][u], outs["tp2"][u])
+                        for u in outs["tp1"])
+            assert match, f"tp=2 {mode} diverged from single-chip"
+            row[f"{mode}_matches_tp1"] = match
+            row[f"{mode}_tp2_wall_s"] = round(walls["tp2"], 2)
+        return row
+
+    return {
+        "model": "lm-scale",
+        "mode": "continuous-paged-replicas",
+        "slots": slots,
+        "total_blocks": total_blocks,
+        "offered": n_requests,
+        "fleets": fleets,
+        "tp2_parity": tp_parity_row(),
+    }
 
 
 def _probe_main():
@@ -1366,6 +1551,8 @@ def _one():
         r = run_spec_scenario(chunked=True, slots=clients)
     elif kind == "lm-qos":
         r = run_qos_scenario(slots=clients, n_requests=rpc)
+    elif kind == "lm-scale":
+        r = run_scale_scenario(slots=clients, n_requests=rpc)
     elif kind == "lm-poisson-pg":
         r = run_poisson_scenario(True, rate_per_s=clients,
                                  n_requests=rpc, slots=bs, paged=True)
@@ -1745,6 +1932,88 @@ def _smoke_anomaly():
     print("ANOMALY_OK")
 
 
+
+def _smoke_replicas():
+    """serve-smoke scale-out leg (docs/serving_memory.md "Scale-out"):
+    a 2-replica fleet behind ONE embedded broker + HTTP frontend.  A
+    burst must spread over BOTH replicas — asserted on the
+    ``zoo_router_routed_total_r{r}`` counters through a real /metrics
+    scrape, not internals — then one pump is killed gracefully and the
+    survivor finishes the whole backlog without losing a request."""
+    import urllib.request
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, HttpFrontend, InputQueue, OutputQueue,
+        ServingConfig)
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    im = InferenceModel(batch_buckets=(1, 2))
+    im.load_flax_generator(model, variables, max_new_tokens=12,
+                           prompt_buckets=(16,))
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=2, engine_paged=True,
+                        engine_block_size=8, n_replicas=2)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = HttpFrontend(redis_port=serving.port, timeout=600,
+                      serving=serving).start()
+    inq = InputQueue(port=serving.port)
+    outq = OutputQueue(port=serving.port)
+    try:
+        rng = np.random.default_rng(17)
+        n = 12
+        for i in range(n):
+            inq.enqueue(f"s{i}", tokens=rng.integers(
+                1, 8192, int(rng.integers(6, 14))).astype(np.int32))
+        # both replicas must take traffic before the kill lands
+        deadline = time.time() + 300
+        while True:
+            routed = serving.router_status()["routed"]
+            if all(c > 0 for c in routed):
+                break
+            assert time.time() < deadline, \
+                f"burst never spread over both replicas: {routed}"
+            time.sleep(0.02)
+        # the spread is visible on the SCRAPE surface, per-replica
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{fe.port}/metrics", timeout=30
+        ).read().decode()
+        scraped = {}
+        for line in body.splitlines():
+            if line.startswith("zoo_router_routed_total_r"):
+                name, val = line.split()
+                scraped[name] = float(val)
+        assert scraped.get("zoo_router_routed_total_r0", 0) > 0, scraped
+        assert scraped.get("zoo_router_routed_total_r1", 0) > 0, scraped
+        assert "zoo_router_replicas_live 2" in body, "liveness gauge"
+        # graceful kill mid-backlog: replica 1 finishes what it
+        # admitted, its unclaimed queue moves, nothing is lost
+        serving.kill_pump(1)
+        for i in range(n):
+            r = outq.query(f"s{i}", timeout=600)
+            assert r is not None, f"s{i} lost in the kill"
+        status = serving.router_status()
+        assert status["live"] == [True, False], status
+        e1 = serving.engines[1]
+        assert e1.n_active == 0 and e1.n_waiting == 0, \
+            "killed replica exited with admitted work resident"
+        print(json.dumps({"leg": "replicas", "served": n,
+                          "routed": status["routed"],
+                          "rerouted": status["rerouted"]}))
+    finally:
+        fe.stop()
+        serving.stop()
+        inq.close()
+        outq.close()
+    print("REPLICAS_OK")
+
+
 def _smoke():
     """``python bench_serving.py --smoke``: the `make serve-smoke` e2e
     leg — 20 requests through the full wire protocol on the PAGED
@@ -1756,8 +2025,9 @@ def _smoke():
     surfaces (/healthz, Prometheus /metrics, /trace) on a live stack
     via ``_smoke_scrape``, the front-door wire contracts via
     ``_smoke_frontdoor``, the flight-recorder overhead bound via
-    ``_smoke_flight``, and the anomaly-to-bundle-to-CLI path via
-    ``_smoke_anomaly``."""
+    ``_smoke_flight``, the anomaly-to-bundle-to-CLI path via
+    ``_smoke_anomaly``, and the 2-replica router spread + graceful
+    pump-kill drain via ``_smoke_replicas``."""
     r = run_poisson_scenario(True, rate_per_s=20.0, n_requests=20,
                              slots=4, prefix_mode="full", paged=True,
                              chunked=True)
@@ -1772,6 +2042,7 @@ def _smoke():
     _smoke_frontdoor()
     _smoke_flight()
     _smoke_anomaly()
+    _smoke_replicas()
     print("SMOKE_OK")
 
 

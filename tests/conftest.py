@@ -11,8 +11,14 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags += " --xla_force_host_platform_device_count=8"
+# The suite is compile-dominated on the single-core CI box and -O0 cuts
+# XLA compile wall time ~40%.  Parity tests are unaffected: both sides of
+# every comparison compile under the same flags, so bitwise checks hold.
+# Preset the flag in XLA_FLAGS to opt out.
+if "xla_backend_optimization_level" not in flags:
+    flags += " --xla_backend_optimization_level=0"
+os.environ["XLA_FLAGS"] = flags.strip()
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
@@ -35,3 +41,91 @@ def ctx8():
     ctx = init_orca_context("local", mesh_axes={"dp": -1})
     yield ctx
     stop_orca_context()
+
+# Tests measured >= ~10s apiece on the 1-core CI box (full-suite census with
+# --durations=0).  They stay in `make test` (no marker filter) but move to
+# the slow lane for the budgeted `-m 'not slow'` tier-1 run, which must fit
+# a fixed wall-clock window; without this the window truncates the suite
+# mid-file and later test files never report at all.  Deliberately a literal
+# nodeid list, not a runtime timer: collection must be deterministic across
+# boxes.  The heaviest composition checks keep one representative in the
+# fast lane (the fullest tp=2 mesh combo, the 2-replica router kill test).
+_HEAVY_NODEIDS = frozenset((
+    "tests/test_checkpoint_reshape.py::test_restore_dp_checkpoint_onto_tp_sp_mesh",
+    "tests/test_checkpoint_reshape.py::test_restore_tp_checkpoint_onto_dp_mesh",
+    "tests/test_chunked_prefill.py::test_chunked_greedy_bitwise_equals_monolithic[arena]",
+    "tests/test_chunked_prefill.py::test_chunked_greedy_bitwise_equals_monolithic[paged]",
+    "tests/test_chunked_prefill.py::test_chunked_sampled_bitwise_equals_monolithic[arena]",
+    "tests/test_chunked_prefill.py::test_chunked_sampled_bitwise_equals_monolithic[paged]",
+    "tests/test_chunked_prefill.py::test_pool_dry_mid_prefill_requeues_and_completes",
+    "tests/test_chunked_prefill.py::test_precompile_covers_fused_grid[arena]",
+    "tests/test_chunked_prefill.py::test_precompile_covers_fused_grid[paged]",
+    "tests/test_composition.py::test_moe_accum_pack_checkpoint_serve_chain",
+    "tests/test_composition.py::test_rope_gqa_moe_lm_train_checkpoint_continuous_serve_chain",
+    "tests/test_continuous.py::test_cluster_serving_continuous_round_trip",
+    "tests/test_continuous.py::test_cluster_serving_prefix_round_trip",
+    "tests/test_continuous.py::test_engine_matches_solo_generation",
+    "tests/test_continuous.py::test_engine_multi_tick_sampling_reproducible",
+    "tests/test_continuous.py::test_prefix_requests_match_concatenated_solo[False]",
+    "tests/test_continuous.py::test_prefix_requests_match_concatenated_solo[True]",
+    "tests/test_continuous.py::test_spec_engine_matches_solo_generation[False]",
+    "tests/test_continuous.py::test_spec_engine_matches_solo_generation[True]",
+    "tests/test_detection.py::test_ssd_detector_learns_synthetic_boxes",
+    "tests/test_distill.py::test_distillation_raises_speculative_acceptance",
+    "tests/test_distill.py::test_target_stays_frozen",
+    "tests/test_lm.py::test_beam_search_scores_sorted_and_contains_greedy_on_peaked_model",
+    "tests/test_lm.py::test_fused_loss_trains_in_estimator",
+    "tests/test_lm.py::test_generate_eos_freezes_tail",
+    "tests/test_lm.py::test_generate_learned_repetition",
+    "tests/test_lm.py::test_moe_lm_trains_and_generates",
+    "tests/test_lm.py::test_pp_lm_1f1b_schedule_matches_gpipe",
+    "tests/test_lm.py::test_pp_lm_interleaved_schedule_matches_sequential",
+    "tests/test_lm.py::test_pp_trunk_trains_on_pipeline_mesh",
+    "tests/test_lm.py::test_remat_matches_non_remat",
+    "tests/test_lm.py::test_rope_lm_trains_and_generates",
+    "tests/test_lm.py::test_sampling_generation",
+    "tests/test_lm.py::test_top_p_sampling",
+    "tests/test_lm_serving.py::test_inference_model_generator_pads_and_infers_lengths",
+    "tests/test_lm_serving.py::test_int8_quantized_generator",
+    "tests/test_lora.py::test_base_frozen_adapters_train",
+    "tests/test_lora.py::test_checkpoint_roundtrip_with_lora",
+    "tests/test_lora.py::test_lora_on_tp_mesh",
+    "tests/test_lora.py::test_lora_with_gradient_accumulation",
+    "tests/test_lora.py::test_merged_params_serve_identically",
+    "tests/test_lora.py::test_optimizer_state_only_for_adapters",
+    "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[chunked]",
+    "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[paged-chunked]",
+    "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[spec-chunked]",
+    "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[spec-paged]",
+    "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[spec]",
+    "tests/test_model_zoo.py::test_dien_learns_history_membership",
+    "tests/test_model_zoo.py::test_forecast_nets",
+    "tests/test_moe.py::test_moe_bert_trains_ep_sharded",
+    "tests/test_moe.py::test_moe_classifier_trains_ep_sharded",
+    "tests/test_moe.py::test_moe_decode_capacity_agreement_bound",
+    "tests/test_observability.py::test_profiler_not_leaked_on_fault",
+    "tests/test_paged_cache.py::test_cluster_serving_paged_round_trip",
+    "tests/test_paged_cache.py::test_paged_matches_arena_and_solo",
+    "tests/test_paged_cache.py::test_paged_prefix_sharing_hits",
+    "tests/test_paged_cache.py::test_pool_dry_preempts_to_queue_not_oom",
+    "tests/test_paged_cache.py::test_recycled_block_never_leaks_predecessor_kv",
+    "tests/test_paged_fused.py::test_fused_gather_token_parity[paged]",
+    "tests/test_paged_fused.py::test_int8_fused_gather_token_parity[paged]",
+    "tests/test_pipeline.py::test_1f1b_custom_vjp_grads_match_gpipe_autodiff[mesh_axes0-4]",
+    "tests/test_pipeline.py::test_1f1b_custom_vjp_grads_match_gpipe_autodiff[mesh_axes1-8]",
+    "tests/test_pipeline.py::test_interleaved_1f1b_matches_sequential[mesh_axes2-8-2]",
+    "tests/test_quantize.py::test_int8_mxu_conv_resnet_through_inference_model",
+    "tests/test_ring_attention.py::test_ring_grads_flow",
+    "tests/test_speculative.py::test_greedy_equality_random_draft",
+    "tests/test_speculative.py::test_serving_path_speculative_equals_plain",
+    "tests/test_speculative.py::test_verify_step_equals_sequential_decode",
+    "tests/test_tfpark_text.py::test_bert_classifier_builds_and_steps",
+    "tests/test_tfpark_text.py::test_text_classification_lstm_encoder",
+    "tests/test_transformer.py::test_bert_classifier_trains",
+))
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.nodeid in _HEAVY_NODEIDS:
+            item.add_marker(pytest.mark.slow)

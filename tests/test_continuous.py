@@ -656,12 +656,16 @@ def test_spec_engine_validation(lm):
         bv = bad.init(jax.random.key(2), np.zeros((1, 8), np.int32))
         ContinuousEngine(model, variables, max_new_tokens=4,
                          draft_model=bad, draft_variables=bv)
-    with pytest.raises(ValueError, match="single-chip"):
-        from analytics_zoo_tpu.parallel.mesh import make_mesh
+    # mesh + draft_model COMPOSES now (tp-sharded speculative serving;
+    # parity coverage lives in test_mesh_paged.py) — construction must
+    # succeed where it used to raise "single-chip for now"
+    from analytics_zoo_tpu.parallel.mesh import make_mesh
 
-        ContinuousEngine(model, variables, max_new_tokens=4,
-                         mesh=make_mesh(axes={"dp": -1, "tp": 2}),
-                         draft_model=dm, draft_variables=dvv)
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8,),
+                           mesh=make_mesh(axes={"dp": -1, "tp": 2}),
+                           draft_model=dm, draft_variables=dvv)
+    assert eng.draft_model is dm
 
 
 def test_inference_model_builds_spec_engine(lm):

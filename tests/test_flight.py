@@ -737,7 +737,7 @@ class TestLiveStack:
                                   for a in alts.split(","))
 
         prefixes = ("zoo_engine_", "zoo_serving_", "zoo_http_",
-                    "zoo_slo_")
+                    "zoo_slo_", "zoo_router_")
         undocumented = [f for f in families
                         if f not in doc
                         and not any(f.startswith(p)

@@ -353,7 +353,8 @@ def test_paged_double_coresidency_for_equal_hbm(lm):
 
 def test_paged_validation_and_cache_dtype_errors(lm):
     """Eager, serving-level errors: bad cache_dtype (any mode), integer
-    cache_dtype, undersized pool, paged+mesh / paged+draft refusals."""
+    cache_dtype, undersized pool, draft-pool sizing, and the one
+    composition still excluded — the fused kernel under a mesh."""
     model, variables = lm
     with pytest.raises(ValueError, match="cache_dtype"):
         ContinuousEngine(model, variables, max_new_tokens=4,
@@ -372,10 +373,13 @@ def test_paged_validation_and_cache_dtype_errors(lm):
         ContinuousEngine(model, variables, max_new_tokens=4, paged=True,
                          block_size=4, draft_model=draft,
                          draft_variables=dvars, draft_n_blocks=2)
+    # paged + mesh composes now (tests/test_mesh_paged.py pins parity);
+    # the one exclusion left is the fused Pallas kernel, which reads a
+    # single chip's pool
     mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("dp",))
-    with pytest.raises(ValueError, match="paged"):
+    with pytest.raises(ValueError, match="fused"):
         ContinuousEngine(model, variables, max_new_tokens=4, paged=True,
-                         mesh=mesh)
+                         kernel="fused", mesh=mesh)
 
 
 def test_paged_gqa_cache_dtype_parity():

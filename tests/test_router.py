@@ -1,0 +1,230 @@
+"""Multi-replica routing: the pure ``route_request`` policy
+(serving/policy.py — plain signals in, replica id out, sim-testable
+with no engine anywhere near it) and the live ``ClusterServing``
+replica set behind one embedded broker — placement spread, cancel
+fan-out, and the graceful ``kill_pump`` drain contract."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.learn.inference_model import InferenceModel
+from analytics_zoo_tpu.models import TransformerLM
+from analytics_zoo_tpu.serving import (ClusterServing, InputQueue,
+                                       OutputQueue, ServingConfig)
+from analytics_zoo_tpu.serving.policy import (ReplicaSignals,
+                                              replica_degraded,
+                                              replica_pressured,
+                                              route_request)
+
+# ---------------------------------------------------------------------------
+# pure policy
+# ---------------------------------------------------------------------------
+
+
+def _sig(r, **kw):
+    return ReplicaSignals(replica=r, **kw)
+
+
+def test_route_least_loaded_round_robin_fallback():
+    """All signals equal (cold start) the router IS least-loaded
+    round-robin: ties break on distance from the cursor, so equal
+    replicas take turns as the caller advances it."""
+    sigs = [_sig(0), _sig(1), _sig(2)]
+    picks = []
+    cur = 0
+    for _ in range(6):
+        r = route_request(sigs, rr_cursor=cur)
+        picks.append(r)
+        cur = (r + 1) % 3
+    assert picks == [0, 1, 2, 0, 1, 2]
+    # depth dominates the cursor once load skews
+    sigs = [_sig(0, queue_depth=5), _sig(1, queue_depth=1),
+            _sig(2, queue_depth=5)]
+    assert route_request(sigs, rr_cursor=2) == 1
+
+
+def test_route_avoids_pool_pressure():
+    """A pressured pool (alloc-fail streak, or allocatable below the
+    floor) outranks queue depth: admission there would preempt or
+    stall, so the emptier-but-dry replica loses."""
+    assert replica_pressured(_sig(0, alloc_fail_streak=3))
+    assert replica_pressured(_sig(0, allocatable_blocks=0))
+    assert not replica_pressured(_sig(0, allocatable_blocks=8))
+    # arena replicas carry no block counts and are never pool-pressured
+    assert not replica_pressured(_sig(0, allocatable_blocks=None))
+    sigs = [_sig(0, queue_depth=0, allocatable_blocks=0),
+            _sig(1, queue_depth=7, allocatable_blocks=64)]
+    assert route_request(sigs) == 1
+    # every replica pressured: still places (least-loaded among them)
+    sigs = [_sig(0, queue_depth=4, alloc_fail_streak=2),
+            _sig(1, queue_depth=2, alloc_fail_streak=2)]
+    assert route_request(sigs) == 1
+
+
+def test_route_slo_degradation_is_per_class():
+    """Degradation is judged for THIS request's class: a replica
+    missing interactive targets still takes batch work ahead of a
+    deeper healthy peer's queue; empty goodput (nothing finished yet)
+    reads healthy."""
+    degraded_int = {"interactive": 0.5, "batch": 1.0}
+    assert replica_degraded(_sig(0, goodput=degraded_int),
+                            "interactive")
+    assert not replica_degraded(_sig(0, goodput=degraded_int), "batch")
+    assert not replica_degraded(_sig(0, goodput=None), "interactive")
+    assert not replica_degraded(_sig(0, goodput={}), "interactive")
+    # unknown wire priority judges as "standard", never raises
+    assert replica_degraded(_sig(0, goodput={"standard": 0.2}),
+                            "no-such-class")
+    sigs = [_sig(0, queue_depth=1, goodput=degraded_int),
+            _sig(1, queue_depth=6)]
+    assert route_request(sigs, "interactive") == 1
+    assert route_request(sigs, "batch") == 0
+
+
+def test_route_dead_replicas():
+    """Dead replicas are never placed on; an all-dead fleet returns
+    None (the caller's fail-fast path, not an exception)."""
+    sigs = [_sig(0, live=False), _sig(1), _sig(2, live=False)]
+    for cur in range(3):
+        assert route_request(sigs, rr_cursor=cur) == 1
+    assert route_request([_sig(0, live=False)]) is None
+    assert route_request([]) is None
+
+
+# ---------------------------------------------------------------------------
+# live replica set (embedded broker, tiny LM)
+# ---------------------------------------------------------------------------
+
+
+def _generator_im():
+    model = TransformerLM(vocab_size=32, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=64, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, 8), np.int32))
+    return InferenceModel().load_flax_generator(
+        model, variables, max_new_tokens=4, prompt_buckets=(8,))
+
+
+def test_n_replicas_requires_continuous():
+    with pytest.raises(ValueError, match="continuous_batching"):
+        ClusterServing(_generator_im(),
+                       ServingConfig(prompt_col="tokens", n_replicas=2))
+
+
+def test_two_replicas_spread_and_graceful_kill():
+    """The full scale-out story on one broker: a burst lands on BOTH
+    replicas (router counters), results match the single-replica
+    output bitwise, then ``kill_pump(1)`` drains gracefully — every
+    request already placed still publishes, the router marks the
+    replica dead, and the survivor takes all subsequent traffic."""
+    im = _generator_im()
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=2, n_replicas=2)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        assert len(srv.engines) == 2
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        rng = np.random.default_rng(3)
+        prompts = {f"r{i}": rng.integers(1, 32, 3 + i % 4)
+                   .astype(np.int32) for i in range(8)}
+        for u, p in prompts.items():
+            iq.enqueue(u, tokens=p)
+        outs = {u: np.asarray(oq.query(u, timeout=120))
+                for u in prompts}
+        status = srv.router_status()
+        assert sum(status["routed"]) == 8
+        assert all(c > 0 for c in status["routed"]), status
+        # replica placement must not change results: compare against
+        # the model's own single-row generation
+        from analytics_zoo_tpu.models import generate
+        for u, p in prompts.items():
+            ref = np.asarray(generate(im.model, im._variables,
+                                      jnp.asarray(p[None]), 4))[0]
+            np.testing.assert_array_equal(outs[u], ref, err_msg=u)
+
+        # ---- graceful kill: replica 1 exits only after draining ----
+        srv.kill_pump(1)
+        t1 = next(t for t in srv._threads
+                  if t.name == "zoo-serving-cb-1")
+        t1.join(timeout=60)
+        assert not t1.is_alive(), "pump 1 never exited"
+        e1 = srv.engines[1]
+        assert e1.n_active == 0 and e1.n_waiting == 0
+        routed_before = srv.router_status()["routed"]
+        for i in range(4):
+            iq.enqueue(f"post{i}",
+                       tokens=rng.integers(1, 32, 4).astype(np.int32))
+        for i in range(4):
+            assert np.asarray(
+                oq.query(f"post{i}", timeout=120)).shape == (4,)
+        after = srv.router_status()
+        assert after["live"] == [True, False]
+        assert after["routed"][1] == routed_before[1], \
+            "router placed work on a dead replica"
+        assert after["routed"][0] == routed_before[0] + 4
+        with pytest.raises(ValueError, match="replica"):
+            srv.kill_pump(7)
+    finally:
+        srv.stop()
+
+
+def test_kill_pump_drains_admitted_backlog():
+    """Kill the pump while its engine still holds admitted work: the
+    stop must not drop a single request — everything admitted to the
+    killed replica publishes, unclaimed queue entries move to the
+    survivor (``zoo_router_rerouted_total``)."""
+    im = _generator_im()
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=1, n_replicas=2)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        rng = np.random.default_rng(5)
+        # slots=1 per replica: a 10-burst leaves backlog both routed-
+        # unclaimed and engine-queued when the kill lands
+        for i in range(10):
+            iq.enqueue(f"b{i}",
+                       tokens=rng.integers(1, 32, 4).astype(np.int32))
+        deadline = time.monotonic() + 60
+        while srv.router_status()["routed"][1] == 0:
+            assert time.monotonic() < deadline, \
+                "replica 1 never saw traffic"
+            time.sleep(0.01)
+        srv.kill_pump(1)
+        for i in range(10):
+            out = np.asarray(oq.query(f"b{i}", timeout=120))
+            assert out.shape == (4,), f"b{i} lost in the kill"
+        assert srv.router_status()["live"] == [True, False]
+    finally:
+        srv.stop()
+
+
+def test_single_replica_layout_unchanged():
+    """n_replicas=1 keeps the historical single-pump layout: no router
+    thread, kill_pump refuses (that is stop()), and the back-compat
+    ``engine`` attribute is the sole engine."""
+    im = _generator_im()
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=2)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        assert srv.n_replicas == 1
+        assert srv.engines == [srv.engine]
+        assert not any(t.name == "zoo-serving-router"
+                       for t in srv._threads)
+        with pytest.raises(ValueError, match="stop"):
+            srv.kill_pump(0)
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        iq.enqueue("solo", tokens=np.asarray([3, 5, 9], np.int32))
+        assert np.asarray(oq.query("solo", timeout=60)).shape == (4,)
+    finally:
+        srv.stop()
