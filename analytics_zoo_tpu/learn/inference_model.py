@@ -339,6 +339,7 @@ class InferenceModel:
                                chunked: bool = False,
                                tick_token_budget: Optional[int] = None,
                                speculation_k: Optional[int] = None,
+                               elastic_pool: bool = False,
                                record_timings: bool = False,
                                telemetry=None, qos=None,
                                flight=None, flight_capacity: int = 2048):
@@ -377,6 +378,12 @@ class InferenceModel:
         prefill-grant order into a weighted fair share over (priority
         class, tenant) — the serving front door's scheduler
         (docs/serving_qos.md).  ``None`` keeps plain FIFO.
+
+        ``elastic_pool=True`` (paged only) arms the elastic block
+        pool: the engine probes free HBM for a grow ceiling at build
+        and ``maybe_autoresize``/``resize_pool`` then move ``n_blocks``
+        in block-granular steps at the eviction boundary
+        (docs/serving_memory.md 'Disaggregation & elastic pools').
 
         ``flight`` / ``flight_capacity`` configure the engine's
         always-on per-tick flight recorder (serving/flight.py;
@@ -419,6 +426,7 @@ class InferenceModel:
             hbm_fraction=hbm_fraction,
             enable_prefix_cache=enable_prefix_cache,
             chunked=chunked, tick_token_budget=tick_token_budget,
+            elastic_pool=elastic_pool,
             record_timings=record_timings, telemetry=telemetry,
             qos=qos, flight=flight, flight_capacity=flight_capacity,
             **spec)

@@ -96,6 +96,13 @@ class _Req(NamedTuple):
     priority: str = "standard"
     tenant: str = ""
     enq_t: float = 0.0
+    # prefill/decode disaggregation (docs/serving_memory.md): on the
+    # SOURCE engine, ``handoff_cb(state)`` fires once the prefill's
+    # first token lands — the row exports instead of decoding here.  On
+    # the DESTINATION engine, ``handoff_state`` carries the exported
+    # chain (submit_handoff); admission adopts it instead of prefilling.
+    handoff_cb: Optional[Callable] = None
+    handoff_state: Optional[dict] = None
 
 
 @dataclass
@@ -195,6 +202,7 @@ class ContinuousEngine:
                  draft_n_blocks: Optional[int] = None,
                  hbm_fraction: Optional[float] = None,
                  enable_prefix_cache: bool = True,
+                 elastic_pool: bool = False,
                  chunked: bool = False,
                  tick_token_budget: Optional[int] = None,
                  record_timings: bool = False,
@@ -261,7 +269,8 @@ class ContinuousEngine:
         self._flight_last = {"preempt": 0, "compiles": 0, "chunks": 0,
                              "budget_tokens": 0, "alloc_fail": 0,
                              "draft_alloc_fail": 0, "spec_proposed": 0,
-                             "spec_accepted": 0}
+                             "spec_accepted": 0, "pool_resizes": 0,
+                             "handoffs_out": 0, "handoffs_in": 0}
         # ---- speculative mode (draft arena) ----------------------------
         # the slot arena is ALREADY per-row-positioned, which is exactly
         # what per-slot acceptance rates need: each verify round advances
@@ -338,6 +347,10 @@ class ContinuousEngine:
                 f"kernel={kernel!r} / kv_dtype={kv_dtype!r} require "
                 f"paged=True: both select the paged-attention path "
                 f"(the arena engine has no block pool to apply them to)")
+        if elastic_pool and not paged:
+            raise ValueError(
+                "elastic_pool=True requires paged=True: the arena "
+                "engine has no block pool to grow or shrink")
         if kernel == "fused" and mesh is not None:
             raise ValueError(
                 "kernel='fused' does not run under a mesh yet: the "
@@ -550,6 +563,46 @@ class ContinuousEngine:
                 self._dtables = np.full((S, M), SINK_BLOCK, np.int32)
                 self._drow_blocks: List[List[int]] = [
                     [] for _ in range(S)]
+        # ---- elastic pool (opt-in; docs/serving_memory.md) -------------
+        # probe free HBM AFTER weights + initial pool allocation to set
+        # the grow ceiling; grow/shrink execute in resize_pool() on the
+        # pump thread, block-granular, at the eviction boundary
+        # (BlockPool.shrink never evicts a referenced block).
+        self.elastic_pool = bool(elastic_pool)
+        self._pool_resizes = 0
+        self._pool_resize_clamps = 0
+        # prefill/decode disaggregation traffic (paged only): rows this
+        # engine exported at first-token time / adopted from a donor
+        self._handoffs_out = 0
+        self._handoffs_in = 0
+        self._autoresize_last_fails = 0
+        self._pool_floor = (self._M + 1) if self.paged else 0
+        self._pool_ceiling = 0
+        self._resize_step = 0
+        if self.elastic_pool:
+            # resize steps snap to a coarse granularity so the jitted
+            # programs see FEW distinct pool shapes (each new shape
+            # compiles once, then caches)
+            self._resize_step = max(self._bs, n_blocks // 8)
+            ceiling = n_blocks
+            try:
+                stats = jax.devices()[0].memory_stats() or {}
+                lim = int(stats.get("bytes_limit", 0))
+                used = int(stats.get("bytes_in_use", 0))
+            except Exception:
+                lim = used = 0
+            per = self._per_block_bytes + self._draft_per_block_bytes
+            if lim > used and per > 0:
+                # leave 20% of the probed headroom for activations /
+                # compile scratch — the elastic pool must never be the
+                # reason a forward OOMs
+                ceiling = max(ceiling, n_blocks
+                              + (int((lim - used) * 0.8) // per))
+            else:
+                # no memory_stats (CPU backend): cap at arena-equivalent
+                # capacity — every slot can run to full length
+                ceiling = max(ceiling, S * self._M + 1)
+            self._pool_ceiling = int(ceiling)
         # kv-bytes-per-token: all-layer, both-tenant HBM cost of ONE
         # cached token position — the gauge/flight-record figure that
         # makes bf16 and int8 runs comparable at a glance.
@@ -1031,6 +1084,27 @@ class ContinuousEngine:
                      "zoo_engine_pool_alloc_failures_total", "counter",
                      "allocate() calls the pool could not serve")):
                 m.gauge(name, hlp, fn=_pool_read(key), kind=kind)
+            # elastic pool + disaggregation surface: registered for
+            # EVERY paged engine (zero until the features engage) so
+            # dashboards and the doc-drift guard see stable names
+            m.gauge("zoo_engine_pool_n_blocks",
+                    "current per-tenant pool size in blocks (moves "
+                    "only under elastic_pool)",
+                    fn=lambda: self._pool.n_blocks)
+            m.gauge("zoo_engine_pool_resize_total",
+                    "applied elastic pool resizes (grow + shrink)",
+                    fn=lambda: self._pool_resizes, kind="counter")
+            m.gauge("zoo_engine_pool_resize_clamped_total",
+                    "resize requests clamped at the eviction boundary "
+                    "or the floor/ceiling",
+                    fn=lambda: self._pool_resize_clamps,
+                    kind="counter")
+            m.gauge("zoo_engine_handoffs_out_total",
+                    "prefilled rows exported to a decode replica",
+                    fn=lambda: self._handoffs_out, kind="counter")
+            m.gauge("zoo_engine_handoffs_in_total",
+                    "prefilled rows adopted from a prefill replica",
+                    fn=lambda: self._handoffs_in, kind="counter")
             if self._dpool is not None:
                 def _dpool_read(key):
                     def read():
@@ -1456,7 +1530,8 @@ class ContinuousEngine:
                top_p: float = 0.0,
                on_token: Optional[Callable] = None,
                priority: str = "standard",
-               tenant: str = "") -> None:
+               tenant: str = "",
+               handoff_cb: Optional[Callable] = None) -> None:
         """Queue one request.  ``prompt``: 1-D int32 token array.
         ``on_done(uri, tokens)`` fires from the pump thread when the
         request finishes (tokens: ``[max_new]`` int32, eos-padded frozen
@@ -1473,7 +1548,16 @@ class ContinuousEngine:
         thread (the index dedups re-emissions after preemption);
         ``priority`` / ``tenant`` feed the QoS scheduler when the
         engine was built with a ``qos`` policy (recorded but inert
-        otherwise)."""
+        otherwise).
+
+        ``handoff_cb(state)`` marks THIS engine as the request's
+        prefill side of a disaggregated fleet: the tick the prompt's
+        first token lands, the row's KV block chain is exported
+        (host table snapshot + materialized device pool slices), the
+        row is freed here, and the callback receives the
+        self-contained state dict to route to a decode replica's
+        ``submit_handoff``.  Paged + greedy only (docs/serving_memory.md
+        'Disaggregation & elastic pools')."""
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1:
             raise ValueError(f"prompt must be 1-D, got {prompt.shape}")
@@ -1516,6 +1600,26 @@ class ContinuousEngine:
         if priority not in PRIORITIES:
             raise ValueError(
                 f"priority must be one of {PRIORITIES}, got {priority!r}")
+        if handoff_cb is not None:
+            if not self.paged:
+                raise ValueError(
+                    "handoff_cb requires paged=True: a prefill/decode "
+                    "handoff exports a KV BLOCK chain; the arena engine "
+                    "has no block tables to rewrite")
+            if temperature > 0.0:
+                raise ValueError(
+                    "prefill/decode handoff is greedy-only: a sampled "
+                    "row's RNG stream cannot be split across replicas "
+                    "bitwise; submit with temperature=0 or without "
+                    "handoff_cb")
+            if self.draft_model is not None:
+                raise ValueError(
+                    "prefill/decode handoff does not compose with "
+                    "speculative decoding yet: the draft tenant's block "
+                    "chain would have to ship alongside the target's "
+                    "(the ROADMAP follow-on 'spec-aware KV handoff' "
+                    "lifts this); serve the disaggregated fleet without "
+                    "a draft model")
         # stamp AFTER validation: a rejected submit never existed as
         # far as queue-wait/TTFT accounting is concerned
         self.telemetry.req_enqueued(uri)
@@ -1523,7 +1627,56 @@ class ContinuousEngine:
             self._waiting.append(_Req(
                 uri, prompt, on_done, on_error, float(temperature),
                 rng_seed, mn, prefix, float(top_p), on_token,
-                priority, str(tenant), time.monotonic()))
+                priority, str(tenant), time.monotonic(), handoff_cb))
+
+    def submit_handoff(self, state: dict) -> None:
+        """Adopt a prefilled request exported by another engine's
+        ``handoff_cb``: queue it for admission as a DECODE row whose KV
+        block chain is copied from the shipped pool slices instead of
+        recomputed.  ``state`` is the self-contained dict
+        ``_handoff_slot`` built on the source (prompt, emitted tokens,
+        chain hashes, materialized K/V slices, completion callbacks).
+        Thread-safe like ``submit`` — the source pump may call straight
+        into the destination engine; all device writes happen later on
+        THIS engine's pump thread at admission."""
+        if not self.paged:
+            raise ValueError(
+                "submit_handoff requires a paged engine: the handoff "
+                "wire format is a KV block chain")
+        if self.draft_model is not None:
+            raise ValueError(
+                "prefill/decode handoff does not compose with "
+                "speculative decoding yet (ROADMAP follow-on "
+                "'spec-aware KV handoff'); the decode replica must "
+                "serve without a draft model")
+        chain = state["chain"]
+        if int(chain["block_size"]) != self._bs:
+            raise ValueError(
+                f"handoff block_size {chain['block_size']} != this "
+                f"engine's block_size {self._bs}")
+        if chain["kv_dtype"] != self.kv_dtype:
+            raise ValueError(
+                f"handoff kv_dtype {chain['kv_dtype']!r} != this "
+                f"engine's kv_dtype {self.kv_dtype!r}")
+        plen = int(state["plen"])
+        mn = int(state["max_new"])
+        if plen > self.max_prompt_width:
+            raise ValueError(
+                f"handoff prompt length {plen} exceeds max prompt "
+                f"width {self.max_prompt_width}")
+        if mn > self.max_new_tokens:
+            raise ValueError(
+                f"handoff max_new {mn} exceeds engine budget "
+                f"{self.max_new_tokens}")
+        self.telemetry.req_enqueued(state["uri"])
+        with self._lock:
+            self._waiting.append(_Req(
+                state["uri"], np.asarray(state["prompt"], np.int32),
+                state.get("on_done"), state.get("on_error"),
+                0.0, None, mn, None, 0.0, state.get("on_token"),
+                state.get("priority", "standard"),
+                state.get("tenant", ""), time.monotonic(),
+                None, state))
 
     # ---- pump ---------------------------------------------------------
 
@@ -1762,6 +1915,8 @@ class ContinuousEngine:
         unmatched tail are allocated PER CHUNK by the tick scheduler —
         a mid-prompt dry pool preempts this prefilling row back to the
         queue, never a decoder."""
+        if req.handoff_state is not None:
+            return self._admit_handoff(req)
         try:
             full = self._full_prompt(req)
         except Exception as e:
@@ -1940,6 +2095,72 @@ class ContinuousEngine:
             self._paged_prefixes[pid] = (tokens, blocks, dblocks)
         return pid
 
+    def _admit_handoff(self, req: _Req) -> str:
+        """Adopt a prefill exported by another engine (the decode half
+        of a prefill/decode handoff): allocate a same-length block
+        chain via ``adopt_chain`` (carried prefix hashes republished,
+        first writer wins, so the decode side keeps sharing the
+        prefix), SCATTER the shipped pool slices into this engine's
+        arena at the new block ids, and install the slot directly in
+        DECODE at the donor's position — no prefill forward runs here.
+        A pool that can't hold the chain yet blocks (requeue at the
+        front), and a preemption later requeues the same request with
+        its immutable ``handoff_state``, so re-adoption regenerates
+        the identical row."""
+        state = req.handoff_state
+        chain = state["chain"]
+        n = int(chain["n"])
+        with self._pool_lock:
+            # +1 headroom mirrors monolithic admission: the first
+            # decode tokens must not instantly preempt the adoption
+            cap = self._pool.n_blocks - 1
+            if n + 1 > cap:
+                self._req_error(req.uri, req.on_error, ValueError(
+                    f"handoff chain needs {n} blocks + headroom but "
+                    f"the pool holds {cap}"))
+                return "error"
+            if self._pool.allocatable() < n + 1:
+                if self.n_active == 0:
+                    self._req_error(req.uri, req.on_error, RuntimeError(
+                        f"pool dry with no residents: "
+                        f"{self._pool.num_referenced()} of "
+                        f"{self._pool.n_blocks} blocks are pinned "
+                        f"(unregister a prefix or raise n_blocks)"))
+                    return "error"
+                return "blocked"
+            blocks = self._pool.adopt_chain(chain)
+            if blocks is None:
+                return "blocked"
+        idx = jnp.asarray(blocks, jnp.int32)
+
+        def scatter(d, s):
+            out = d.at[:, idx].set(jnp.asarray(s, d.dtype))
+            return jax.device_put(out, d.sharding)
+
+        self._pk = jax.tree_util.tree_map(scatter, self._pk,
+                                          state["k"])
+        self._pv = jax.tree_util.tree_map(scatter, self._pv,
+                                          state["v"])
+        slot = self._free.popleft()
+        self._row_blocks[slot] = list(blocks)
+        self._tables[slot, :] = SINK_BLOCK
+        self._tables[slot, :len(blocks)] = blocks
+        self._slots[slot] = _Slot(
+            uri=req.uri, plen=int(state["plen"]), max_new=req.max_new,
+            tokens=list(state["tokens"]), on_done=req.on_done,
+            on_error=req.on_error, temperature=0.0, rng_seed=None,
+            top_p=0.0, on_token=req.on_token, req=req,
+            admit_seq=self._admit_seq)
+        self._admit_seq += 1
+        # the donor already emitted token[0]; decode resumes from it
+        self._tok[slot] = int(state["last_token"])
+        self._pos[slot] = int(state["pos"])
+        self._done[slot] = False
+        self._handoffs_in += 1
+        self.telemetry.req_admitted(req.uri, slot,
+                                    priority=req.priority)
+        return "admitted"
+
     def _admit_paged(self) -> int:
         """Paged admission: per request, match leading FULL prompt
         blocks in the chain-hash index (copy-free sharing), allocate
@@ -1962,6 +2183,14 @@ class ContinuousEngine:
             for req in batch:
                 if blocked:         # keep queue order behind the block
                     blocked.append(req)
+                    continue
+                if req.handoff_state is not None:
+                    # adopted chains never prefill — no plan, no group
+                    res = self._admit_handoff(req)
+                    if res == "admitted":
+                        admitted += 1
+                    elif res == "blocked":
+                        blocked.append(req)
                     continue
                 try:
                     full = self._full_prompt(req)
@@ -2283,6 +2512,98 @@ class ContinuousEngine:
             for b in dblocks:
                 self._dpool.release(b)
 
+    def resize_pool(self, target: int) -> int:
+        """Grow or shrink BOTH tenants' block pools toward ``target``
+        blocks (clamped to [floor, ceiling]) and pad/slice the device
+        arenas to match.  Shrink only sheds the contiguous
+        unreferenced TAIL of the id space — the arena is dense in
+        block id, so the eviction boundary (``BlockPool.shrink``)
+        stops at the first referenced block: cached tail blocks are
+        evicted, a referenced block NEVER is, and a deeper request is
+        clamped and counted rather than raised.  Both tenants move in
+        lockstep (the min of their shrinkable tails) so the mirror-
+        image invariant the speculative path relies on survives.
+        Pump thread only: the arenas are donated through the step
+        programs, so no device call may be in flight.  Returns the
+        signed block delta actually applied."""
+        if not self.paged:
+            raise ValueError("resize_pool requires paged=True")
+        want = int(target)
+        target = max(self._pool_floor,
+                     min(want, self._pool_ceiling or want))
+        clamped = target != want
+        with self._pool_lock:
+            n = self._pool.n_blocks
+            if target > n:
+                applied = self._pool.grow(target - n)
+                if self._dpool is not None:
+                    self._dpool.grow(target - n)
+            elif target < n:
+                m = min(n - target, self._pool.shrinkable())
+                if self._dpool is not None:
+                    m = min(m, self._dpool.shrinkable())
+                if m < n - target:
+                    clamped = True
+                applied = -self._pool.shrink(m) if m else 0
+                if m and self._dpool is not None:
+                    self._dpool.shrink(m)
+            else:
+                applied = 0
+        if clamped:
+            self._pool_resize_clamps += 1
+        if applied == 0:
+            return 0
+        new_n = n + applied
+
+        def fit(x):
+            if applied > 0:
+                pad = [(0, 0)] * x.ndim
+                pad[1] = (0, applied)
+                out = jnp.pad(x, pad)
+            else:
+                out = x[:, :new_n]
+            # keep the mesh layout: a resized pool must land exactly
+            # where the step programs expect their donated operands
+            return jax.device_put(out, x.sharding)
+
+        self._pk = jax.tree_util.tree_map(fit, self._pk)
+        self._pv = jax.tree_util.tree_map(fit, self._pv)
+        if self._dpool is not None:
+            self._dpk = jax.tree_util.tree_map(fit, self._dpk)
+            self._dpv = jax.tree_util.tree_map(fit, self._dpv)
+        self._pool_resizes += 1
+        logger.info("elastic pool resized %d -> %d blocks (%+d)",
+                    n, new_n, applied)
+        return applied
+
+    def maybe_autoresize(self,
+                         goodput: Optional[Dict[str, float]] = None
+                         ) -> int:
+        """One elastic-pool control step (pump thread): feed the
+        current pool pressure — allocatable blocks and fresh
+        allocation failures since the last call — plus the caller's
+        per-class goodput map into the pure ``plan_pool_resize``
+        policy, and execute any non-zero delta via ``resize_pool``.
+        No-op (returns 0) unless built with ``elastic_pool=True``."""
+        if not (self.paged and self.elastic_pool):
+            return 0
+        with self._pool_lock:
+            n = self._pool.n_blocks
+            alloc = self._pool.allocatable()
+            fails = self._pool.alloc_failures
+            if self._dpool is not None:
+                alloc = min(alloc, self._dpool.allocatable())
+                fails += self._dpool.alloc_failures
+        streak = fails - self._autoresize_last_fails
+        self._autoresize_last_fails = fails
+        delta = scheduler_policy.plan_pool_resize(
+            n_blocks=n, allocatable=alloc, alloc_fail_streak=streak,
+            step=self._resize_step, floor=self._pool_floor,
+            ceiling=self._pool_ceiling, goodput=goodput)
+        if delta == 0:
+            return 0
+        return self.resize_pool(n + delta)
+
     def cache_metrics(self) -> dict:
         """Serving-visible cache counters (bench_serving.py columns).
 
@@ -2355,6 +2676,14 @@ class ContinuousEngine:
                     # pools' pressure side by side
                     out.update({"draft_" + kk: vv for kk, vv in
                                 self._dpool.metrics().items()})
+            out.update({
+                "pool_resizes": self._pool_resizes,
+                "pool_resize_clamps": self._pool_resize_clamps,
+                "pool_floor": self._pool_floor,
+                "pool_ceiling": self._pool_ceiling,
+                "handoffs_out": self._handoffs_out,
+                "handoffs_in": self._handoffs_in,
+            })
         return out
 
     @property
@@ -2446,6 +2775,55 @@ class ContinuousEngine:
         # admission only (baselined).
         return int(jax.random.categorical(key, scaled))
 
+    def _handoff_slot(self, slot: int, st: _Slot) -> None:
+        """Export a just-prefilled row for adoption by another engine
+        (the prefill half of a prefill/decode handoff).  Runs on the
+        pump thread at first-token time: snapshot the block chain +
+        published hashes (``export_chain``), GATHER the row's pool
+        slices into fresh device buffers (the live pool is DONATED
+        through later step programs, so the copy must materialize
+        now), then free the slot exactly like a completion.  The
+        state dict is self-contained — the destination engine needs
+        nothing further from this one."""
+        blocks = list(self._row_blocks[slot])
+        with self._pool_lock:
+            chain = self._pool.export_chain(blocks)
+        idx = jnp.asarray(blocks, jnp.int32)
+
+        def gather(x):
+            return jnp.take(x, idx, axis=1)
+
+        state = {
+            "uri": st.uri,
+            "prompt": np.asarray(self._full_prompt(st.req), np.int32),
+            "plen": st.plen,
+            "pos": int(self._pos[slot]),
+            "tokens": list(st.tokens),
+            "last_token": int(st.tokens[-1]),
+            "max_new": st.max_new,
+            "priority": st.req.priority,
+            "tenant": st.req.tenant,
+            "chain": chain,
+            "k": jax.tree_util.tree_map(gather, self._pk),
+            "v": jax.tree_util.tree_map(gather, self._pv),
+            "on_done": st.on_done,
+            "on_error": st.on_error,
+            "on_token": st.on_token,
+        }
+        self._slots[slot] = None
+        self._done[slot] = True
+        self._free.append(slot)
+        self._release_slot_blocks(slot)
+        self._handoffs_out += 1
+        # this engine's part of the request is over — the destination
+        # runs its own full enqueue->admit->finish telemetry lifecycle
+        self.telemetry.req_finished(st.uri, slot, len(st.tokens))
+        try:
+            st.req.handoff_cb(state)
+        except Exception as e:
+            logger.exception("handoff callback failed for %r", st.uri)
+            self._req_error(st.uri, st.on_error, e)
+
     def _record_token(self, slot: int, token: int):
         """Append one generated token; finish + free the slot when done."""
         st = self._slots[slot]
@@ -2462,6 +2840,11 @@ class ContinuousEngine:
         done = len(st.tokens) >= st.max_new or \
             (self.eos_id is not None and token == self.eos_id)
         if not done:
+            if (len(st.tokens) == 1 and st.req is not None
+                    and st.req.handoff_cb is not None):
+                # prefill role: the first token is this engine's LAST —
+                # export the row instead of decoding it here
+                self._handoff_slot(slot, st)
             return
         out = np.full(st.max_new,
                       self.eos_id if self.eos_id is not None else 0,
@@ -2577,11 +2960,21 @@ class ContinuousEngine:
             with self._pool_lock:
                 af = self._pool.alloc_failures
                 rec["used_blocks"] = self._pool.num_referenced()
+                # schema v2: per-tenant pool SIZE per tick, so elastic
+                # resizes are visible on the flight timeline
+                rec["n_blocks"] = self._pool.n_blocks
                 daf = (self._dpool.alloc_failures
                        if self._dpool is not None else 0)
                 if self._dpool is not None:
                     rec["draft_used_blocks"] = \
                         self._dpool.num_referenced()
+                    rec["draft_n_blocks"] = self._dpool.n_blocks
+            rec["pool_resizes"] = delta("pool_resizes",
+                                        self._pool_resizes)
+            rec["handoffs_out"] = delta("handoffs_out",
+                                        self._handoffs_out)
+            rec["handoffs_in"] = delta("handoffs_in",
+                                       self._handoffs_in)
             fails = delta("alloc_fail", af) \
                 + delta("draft_alloc_fail", daf)
             rec["alloc_failures"] = fails

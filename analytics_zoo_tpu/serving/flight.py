@@ -79,7 +79,14 @@ __all__ = [
 #: it does not know rather than silently misreading them, and
 #: docs/simulation.md pins the current number (guarded by
 #: tests/test_flight.py).
-FLIGHT_SCHEMA_VERSION = 1
+#:
+#: v2: every paged tick additionally records the per-tenant pool SIZE
+#: (``n_blocks``, ``draft_n_blocks``) plus per-tick ``pool_resizes`` /
+#: ``handoffs_out`` / ``handoffs_in`` deltas, so elastic-pool resizes
+#: and prefill/decode handoffs are visible on the flight timeline.
+#: The reader backfills ``n_blocks`` for v1 bundles (static pools:
+#: free + used + sink), so v1 replays unchanged.
+FLIGHT_SCHEMA_VERSION = 2
 
 # ---------------------------------------------------------------------------
 # request-id correlation

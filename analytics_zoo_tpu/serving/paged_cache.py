@@ -184,6 +184,10 @@ class BlockPool:
         self.prefix_hits = 0       # blocks answered from the index
         self.evictions = 0
         self.alloc_failures = 0    # allocate() returned None
+        self.resizes = 0           # grow()/shrink() calls that moved
+        self.resize_clamps = 0     # shrink clamped by referenced tail
+        self.chains_exported = 0   # export_chain() calls
+        self.chains_adopted = 0    # successful adopt_chain() calls
 
     # -- hashing / lookup --------------------------------------------
 
@@ -282,6 +286,120 @@ class BlockPool:
         self._index[hash_] = block
         self._hash_of[block] = hash_
 
+    # -- prefill/decode handoff (docs/serving_memory.md) ---------------
+
+    def export_chain(self, blocks: Sequence[int]) -> Dict[str, object]:
+        """Host-side half of a prefill→decode handoff: snapshot a
+        request's block chain so ANOTHER pool can adopt an equivalent
+        chain.  Returns the wire-format dict (``block_size`` /
+        ``kv_dtype`` / per-block published hashes, ``None`` for a
+        private block) — plain Python data, no device state; the
+        engine ships the device pool slices alongside.  Read-only:
+        the source pool's refcounts are untouched (the engine releases
+        the source chain through the normal completion path once the
+        export is materialized)."""
+        hashes: List[Optional[int]] = []
+        for b in blocks:
+            if b == SINK_BLOCK or self._ref.get(b, 0) < 1:
+                raise ValueError(
+                    f"export_chain needs referenced non-sink blocks, "
+                    f"got {b} (ref={self._ref.get(b, 0)})")
+            hashes.append(self._hash_of.get(b))
+        self.chains_exported += 1
+        return {"block_size": self.block_size,
+                "kv_dtype": self.kv_dtype,
+                "n": len(hashes), "hashes": hashes}
+
+    def adopt_chain(self, chain: Dict[str, object]) -> Optional[List[int]]:
+        """Allocate a same-length chain in THIS pool (ref=1 each) and
+        republish the carried prefix hashes so the decode side keeps
+        sharing/serving the prefix — first writer wins exactly like
+        :meth:`insert`.  Returns the new block ids in chain order, or
+        ``None`` when the pool cannot take the whole chain right now
+        (any partial allocation is rolled back — the caller's
+        requeue/blocked path)."""
+        if int(chain["block_size"]) != self.block_size:
+            raise ValueError(
+                f"adopt_chain block_size {chain['block_size']} != "
+                f"pool block_size {self.block_size}")
+        if chain["kv_dtype"] != self.kv_dtype:
+            raise ValueError(
+                f"adopt_chain kv_dtype {chain['kv_dtype']!r} != pool "
+                f"kv_dtype {self.kv_dtype!r}")
+        out: List[int] = []
+        for _ in range(int(chain["n"])):
+            blk = self.allocate()
+            if blk is None:
+                for b in out:
+                    self.release(b)
+                return None
+            out.append(blk)
+        for h, b in zip(chain["hashes"], out):
+            if h is not None:
+                self.insert(h, b)
+        self.chains_adopted += 1
+        return out
+
+    # -- elastic resize (block-granular, at the eviction boundary) -----
+
+    def grow(self, n: int) -> int:
+        """Append ``n`` fresh FREE blocks at the top of the id range
+        (ids ``n_blocks .. n_blocks+n-1``).  The caller must have
+        already extended the device arena to match — block ids are
+        indices into it.  Returns ``n``."""
+        if n < 0:
+            raise ValueError(f"grow needs n >= 0, got {n}")
+        if n == 0:
+            return 0
+        start = self.n_blocks
+        self.n_blocks += int(n)
+        self._free.extend(range(start, self.n_blocks))
+        self.resizes += 1
+        return int(n)
+
+    def shrinkable(self) -> int:
+        """Length of the contiguous UNREFERENCED tail of the id range —
+        the most :meth:`shrink` can remove right now.  Only a tail can
+        go: the device arena is dense in block id, so dropping a middle
+        block would renumber live tables.  Bounded so ``n_blocks``
+        never drops below 2 (sink + one usable block)."""
+        n = 0
+        b = self.n_blocks - 1
+        while b >= 2 and b not in self._ref:
+            n += 1
+            b -= 1
+        return n
+
+    def shrink(self, n: int) -> int:
+        """Remove up to ``n`` blocks from the top of the id range,
+        stopping at the first referenced block (the eviction boundary —
+        a live request's storage is NEVER evicted).  Cached tail blocks
+        are evicted (hash unpublished, counted like an LRU eviction);
+        free tail blocks just leave the free list.  Returns the count
+        actually removed; a clamped request (achieved < asked) bumps
+        ``resize_clamps`` instead of raising.  The caller slices the
+        device arena to the new ``n_blocks`` afterwards."""
+        if n < 0:
+            raise ValueError(f"shrink needs n >= 0, got {n}")
+        m = min(int(n), self.shrinkable())
+        if m < n:
+            self.resize_clamps += 1
+        if m == 0:
+            return 0
+        for b in range(self.n_blocks - 1, self.n_blocks - m - 1, -1):
+            if b in self._lru:
+                del self._lru[b]
+                h = self._hash_of.pop(b)
+                del self._index[h]
+                self.evictions += 1
+                if self.event_cb is not None:
+                    self.event_cb("eviction", block=b, tenant=self.name)
+            else:
+                self._free.remove(b)
+        self.n_blocks -= m
+        self.resizes += 1
+        return m
+
     # -- introspection -----------------------------------------------
 
     def allocatable(self) -> int:
@@ -319,6 +437,10 @@ class BlockPool:
             "prefix_hit_rate": self.hit_rate(),
             "evictions": self.evictions,
             "alloc_failures": self.alloc_failures,
+            "resizes": self.resizes,
+            "resize_clamps": self.resize_clamps,
+            "chains_exported": self.chains_exported,
+            "chains_adopted": self.chains_adopted,
         }
 
     def check(self) -> None:

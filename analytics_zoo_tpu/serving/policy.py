@@ -28,9 +28,13 @@ from):
 * ``select_subqueue`` / ``stride_charge`` — the weighted
   deficit/stride admission order (``WeightedWaitQueue.popleft``).
 * ``route_request`` — multi-replica placement (the ``ClusterServing``
-  router thread, ``n_replicas > 1``): pool pressure first, then
-  per-class SLO goodput, then least-loaded with a deterministic
-  round-robin cursor tie-break.
+  router thread, ``n_replicas > 1``): role match first (prefill/decode
+  disaggregation, constant when no replica carries a role), then pool
+  pressure, then per-class SLO goodput, then least-loaded with a
+  deterministic round-robin cursor tie-break.
+* ``plan_pool_resize`` — the elastic-pool step
+  (``ContinuousEngine.maybe_autoresize``): grow under pool pressure,
+  hold while SLO-degraded, hand blocks back when the pool runs slack.
 
 Everything here is stdlib-only ON PURPOSE: the simulator (and the
 bare-box ``debug.py --replay`` path) import this file with no numpy,
@@ -50,7 +54,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 #: behavior changes.  The simulator stamps it into every event log so
 #: a golden-trace mismatch distinguishes "policy changed" from "sim
 #: drifted".
-SCHEDULER_POLICY_VERSION = 1
+SCHEDULER_POLICY_VERSION = 2
 
 #: Priority classes, best-first.  The wire encodes a priority as its
 #: index in this tuple (the input queue transports ints, not strings);
@@ -122,6 +126,12 @@ ROUTER_GOODPUT_FLOOR = 0.9
 #: pressure; this floor catches it one tick earlier).
 ROUTER_MIN_ALLOCATABLE = 1
 
+#: Replica specializations under prefill/decode disaggregation
+#: (``ServingConfig.replica_roles``).  ``None`` means symmetric — the
+#: replica takes either phase, which is also every replica's role when
+#: disaggregation is off (PR 14 behavior, bit-identical ranks).
+REPLICA_ROLES: Tuple[str, ...] = ("prefill", "decode")
+
 
 @dataclass(frozen=True)
 class ReplicaSignals:
@@ -136,7 +146,10 @@ class ReplicaSignals:
     for an arena-mode replica: no pool, never pool-pressured).
     ``goodput`` maps priority class -> SLO goodput fraction from the
     replica's watchdog (``None``/missing class reads as healthy —
-    a replica that served nothing yet must not read as degraded)."""
+    a replica that served nothing yet must not read as degraded).
+    ``role`` is the replica's disaggregation specialization
+    (``"prefill"`` / ``"decode"`` / ``None`` = symmetric, takes
+    either phase)."""
 
     replica: int
     live: bool = True
@@ -144,6 +157,7 @@ class ReplicaSignals:
     allocatable_blocks: Optional[int] = None
     alloc_fail_streak: int = 0
     goodput: Optional[Dict[str, float]] = None
+    role: Optional[str] = None
 
 
 def replica_pressured(sig: ReplicaSignals,
@@ -172,6 +186,7 @@ def route_request(replicas: Sequence[ReplicaSignals],
                   priority: Optional[str] = None,
                   rr_cursor: int = 0,
                   *,
+                  phase: Optional[str] = None,
                   goodput_floor: float = ROUTER_GOODPUT_FLOOR,
                   min_allocatable: int = ROUTER_MIN_ALLOCATABLE
                   ) -> Optional[int]:
@@ -181,6 +196,15 @@ def route_request(replicas: Sequence[ReplicaSignals],
 
     Rank order, best first:
 
+    0. role match FIRST, when ``phase`` is given ("prefill"/"decode"
+       — the disaggregated router passes the request's current phase):
+       a replica whose ``role`` is ``None`` or equals the phase
+       outranks a role-mismatched one.  The term is a preference, not
+       a partition — with every same-role replica dead (mid
+       ``kill_pump`` drain) traffic falls through to the other role
+       rather than failing, and with no roles configured anywhere the
+       term is constant, leaving ranks bit-identical to the symmetric
+       router;
     1. not pool-pressured (``replica_pressured``) — a dry pool means
        admission would preempt or stall, so pressure outranks depth;
     2. not SLO-degraded FOR THIS CLASS (``replica_degraded``) — a
@@ -198,12 +222,64 @@ def route_request(replicas: Sequence[ReplicaSignals],
     n = max(r.replica for r in live) + 1
 
     def rank(r: ReplicaSignals):
-        return (replica_pressured(r, min_allocatable),
+        mismatch = (phase is not None and r.role is not None
+                    and r.role != phase)
+        return (mismatch,
+                replica_pressured(r, min_allocatable),
                 replica_degraded(r, priority, goodput_floor),
                 r.queue_depth,
                 (r.replica - rr_cursor) % n)
 
     return min(live, key=rank).replica
+
+
+# ---------------------------------------------------------------------------
+# elastic per-replica pool sizing (ContinuousEngine.maybe_autoresize)
+# ---------------------------------------------------------------------------
+
+#: Allocatable fraction below which the elastic planner grows the pool
+#: (the one-tick-early analog of the alloc-fail streak).
+POOL_GROW_FRAC = 0.125
+
+#: Allocatable fraction above which the planner hands blocks back —
+#: conservatively high so the pool breathes, not oscillates.
+POOL_SHRINK_FRAC = 0.5
+
+
+def plan_pool_resize(*, n_blocks: int, allocatable: int,
+                     alloc_fail_streak: int, step: int, floor: int,
+                     ceiling: int,
+                     goodput: Optional[Dict[str, float]] = None,
+                     goodput_floor: float = ROUTER_GOODPUT_FLOOR,
+                     low_frac: float = POOL_GROW_FRAC,
+                     high_frac: float = POOL_SHRINK_FRAC) -> int:
+    """One elastic-pool step for a paged replica, as a signed block
+    delta (positive = grow, negative = shrink, 0 = hold).  Pure policy:
+    the engine executes the delta at the eviction boundary
+    (``BlockPool.shrink`` stops at the first referenced block, so the
+    delta here is a TARGET the executor may clamp).
+
+    Decision order:
+
+    1. grow ``step`` (clamped to ``ceiling``) under pool pressure — a
+       live alloc-fail streak, or allocatable at/below
+       ``low_frac * n_blocks``;
+    2. hold while any priority class's goodput sits below
+       ``goodput_floor`` — shrinking a replica that is already missing
+       SLOs can only make it worse;
+    3. shrink ``step`` when allocatable sits at/above
+       ``high_frac * n_blocks`` and the result stays at/above
+       ``floor`` (the engine's minimum working set);
+    4. otherwise hold."""
+    if step <= 0:
+        return 0
+    if alloc_fail_streak > 0 or allocatable <= low_frac * n_blocks:
+        return min(step, max(0, ceiling - n_blocks))
+    if goodput and any(g < goodput_floor for g in goodput.values()):
+        return 0
+    if allocatable >= high_frac * n_blocks and n_blocks - step >= floor:
+        return -step
+    return 0
 
 
 def grant_rank(policy: Optional[QosPolicy], priority: Optional[str],

@@ -36,7 +36,8 @@ from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
                                                  TokenEmitter,
                                                  decode_priority,
                                                  decode_str_field)
-from analytics_zoo_tpu.serving.policy import (ReplicaSignals,
+from analytics_zoo_tpu.serving.policy import (REPLICA_ROLES,
+                                                ReplicaSignals,
                                                 route_request)
 from analytics_zoo_tpu.serving.queues import (
     CANCEL_STREAM, IMG_MAGIC, INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX,
@@ -92,6 +93,22 @@ class ServingConfig:
     # to least-loaded round-robin.  1 keeps the single-pump layout
     # bit-identical to previous releases.
     n_replicas: int = 1
+    # Prefill/decode disaggregation (docs/serving_memory.md
+    # "Disaggregation & elastic pools"): one role string per replica,
+    # "prefill" or "decode".  Prefill-heavy replicas run prompts to
+    # their first token, export the KV block chain, and a decode-heavy
+    # replica adopts it (route_request ranks role match FIRST, so
+    # either side still absorbs the other's overflow).  Requires
+    # n_replicas > 1, continuous_batching, engine_paged, and no draft
+    # model.  None keeps every replica symmetric — bit-identical to
+    # role-less routing.
+    replica_roles: Optional[List[str]] = None
+    # Elastic per-replica block pools: after weights load, each paged
+    # engine probes free HBM for a grow ceiling and resizes n_blocks
+    # in block-granular steps at the eviction boundary, driven by pool
+    # pressure and per-class goodput (policy.plan_pool_resize).  Off =
+    # static pools, bit-identical to previous releases.
+    engine_elastic_pool: bool = False
     eos_id: Optional[int] = None
     # tokens decoded per device call: >1 trades admission-latency
     # granularity for fewer host round-trips (tunneled-device win)
@@ -207,6 +224,13 @@ class ServingConfig:
             cfg.engine_slots = int(params["engine_slots"])
         if "n_replicas" in params:
             cfg.n_replicas = int(params["n_replicas"])
+        if "replica_roles" in params:
+            v = params["replica_roles"]
+            cfg.replica_roles = (None if v is None
+                                 else [str(x) for x in v])
+        if "engine_elastic_pool" in params:
+            cfg.engine_elastic_pool = bool(
+                params["engine_elastic_pool"])
         if "eos_id" in params:
             cfg.eos_id = int(params["eos_id"])
         if "engine_ticks" in params:
@@ -361,6 +385,43 @@ class ClusterServing:
                 "micro-batch path already scales with `workers` "
                 "consumers on the shared group; replicas exist to "
                 "multiply continuous engines")
+        # replica roles (prefill/decode disaggregation): validated
+        # eagerly so a bad fleet layout fails at assembly, not from a
+        # pump thread mid-request
+        roles = getattr(self.config, "replica_roles", None)
+        self.replica_roles: Optional[List[str]] = None
+        if roles is not None:
+            roles = [str(x) for x in roles]
+            if len(roles) != self.n_replicas:
+                raise ValueError(
+                    f"replica_roles needs one role per replica: got "
+                    f"{len(roles)} roles for n_replicas="
+                    f"{self.n_replicas}")
+            bad = [x for x in roles if x not in REPLICA_ROLES]
+            if bad:
+                raise ValueError(
+                    f"replica_roles entries must be one of "
+                    f"{REPLICA_ROLES}, got {bad}")
+            if self.n_replicas < 2:
+                raise ValueError(
+                    "replica_roles needs n_replicas > 1: a sole "
+                    "replica must both prefill and decode")
+            if not self.config.engine_paged:
+                raise ValueError(
+                    "replica_roles requires engine_paged: true — the "
+                    "handoff wire format is a KV block chain")
+            self.replica_roles = roles
+        if self.config.engine_elastic_pool and \
+                not self.config.engine_paged:
+            raise ValueError(
+                "engine_elastic_pool requires engine_paged: true — "
+                "the arena has no block pool to resize")
+        # disaggregation counters (under _rq_cond like the router's
+        # other placement state)
+        self._role_handoffs = 0
+        self._role_prefill_routed = 0
+        self._role_decode_routed = 0
+        self._h_handoff = None      # set by _register_router_gauges
         self.engines: list = []
         self.telemetries = [self.telemetry]
         self.watchdogs = [self.watchdog]
@@ -473,6 +534,22 @@ class ClusterServing:
             m.gauge(f"zoo_router_queue_depth_r{r}",
                     f"replica {r} routed-but-unclaimed entries",
                     fn=(lambda _r=r: len(self._rqueues[_r])))
+        # disaggregation families: registered for every multi-replica
+        # fleet (zero without replica_roles) so dashboards see stable
+        # names whether or not roles are configured
+        m.gauge("zoo_router_role_handoffs_total",
+                "prefill->decode KV chain handoffs completed",
+                fn=lambda: self._role_handoffs, kind="counter")
+        m.gauge("zoo_router_role_prefill_routed_total",
+                "new requests placed on a prefill-role replica",
+                fn=lambda: self._role_prefill_routed, kind="counter")
+        m.gauge("zoo_router_role_decode_routed_total",
+                "exported prefills placed on a decode-role replica",
+                fn=lambda: self._role_decode_routed, kind="counter")
+        self._h_handoff = m.histogram(
+            "zoo_router_handoff_seconds",
+            "wall seconds from prefill export to decode-side "
+            "adoption enqueue (route + chain ship)")
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -583,6 +660,7 @@ class ClusterServing:
                 chunked=self.config.engine_chunked,
                 tick_token_budget=self.config.engine_tick_token_budget,
                 speculation_k=self.config.engine_speculation_k,
+                elastic_pool=self.config.engine_elastic_pool,
                 telemetry=self.telemetries[r],
                 qos=qos,
                 flight=self.flights[r],
@@ -800,6 +878,10 @@ class ClusterServing:
         routed = self.n_replicas > 1
         stop_ev = self._pump_stops[replica]
         pcol = self.config.prompt_col or "prompt"
+        role = (self.replica_roles[replica]
+                if self.replica_roles is not None else None)
+        elastic = bool(self.config.engine_elastic_pool)
+        next_resize = time.monotonic() + 0.25
         # streaming state is PUMP-THREAD-ONLY (on_done/on_token fire
         # inside engine.step() on this thread): the emitter buffers
         # per-token events between steps; one pipeline per step ships
@@ -954,6 +1036,16 @@ class ClusterServing:
                         # a needless second copy for the generation's
                         # lifetime)
                         ureq = {"uri": r["uri"]}
+                        if role == "prefill" and not stream and \
+                                kw.get("temperature", 0.0) <= 0.0:
+                            # prefill replica: export at first token
+                            # and ship to a decode replica.  Streaming
+                            # and sampled rows decode HERE — the
+                            # emitter is pump-local and the handoff
+                            # contract is greedy-only.
+                            kw["handoff_cb"] = (
+                                lambda state, _rep=replica:
+                                self._handoff_request(_rep, state))
                         engine.submit(
                             uri, prompt,
                             on_done=(lambda u, toks, _eid=eid, _t0=t0,
@@ -993,6 +1085,22 @@ class ClusterServing:
                     time.sleep(0.2)
                 else:
                     self._diag_poll(engine, replica)
+                    if elastic and time.monotonic() >= next_resize:
+                        # throttled elastic-pool control step (pump
+                        # thread — the arenas are donated through the
+                        # step programs, so resizes interleave with
+                        # ticks, never race them)
+                        next_resize = time.monotonic() + 0.25
+                        try:
+                            per_class = self.watchdogs[replica].status(
+                            )["per_class"]
+                            engine.maybe_autoresize(
+                                {c: d["goodput"]
+                                 for c, d in per_class.items()})
+                        except Exception:
+                            logger.exception(
+                                "elastic pool autoresize failed "
+                                "(replica %d)", replica)
                 self._flush_emitter(client, emitter)
         finally:
             self._pump_live[replica] = False
@@ -1169,7 +1277,9 @@ class ClusterServing:
             allocatable_blocks=(pool.allocatable()
                                 if pool is not None else None),
             alloc_fail_streak=eng.alloc_fail_streak,
-            goodput={c: d["goodput"] for c, d in per_class.items()})
+            goodput={c: d["goodput"] for c, d in per_class.items()},
+            role=(self.replica_roles[replica]
+                  if self.replica_roles is not None else None))
 
     def router_status(self) -> dict:
         """Live routing view — the observability surface behind the
@@ -1181,6 +1291,9 @@ class ClusterServing:
             "routed": list(self._routed_counts),
             "rerouted": self._rerouted_count,
             "queue_depths": [len(q) for q in self._rqueues],
+            "roles": (list(self.replica_roles)
+                      if self.replica_roles is not None else None),
+            "handoffs": self._role_handoffs,
         }
         if self.engines:
             status["signals"] = [
@@ -1231,7 +1344,12 @@ class ClusterServing:
                 priority = None
         sigs = [self.replica_signals(r)
                 for r in range(self.n_replicas)]
-        r = route_request(sigs, priority, self._rr_cursor)
+        # a NEW request always enters at its prefill phase; without
+        # replica_roles every signal's role is None and the rank is
+        # bit-identical to role-less routing
+        r = route_request(sigs, priority, self._rr_cursor,
+                          phase=("prefill" if self.replica_roles
+                                 else None))
         if r is None:
             # no live pump anywhere: fail fast rather than letting
             # every client ride out its timeout against dead queues
@@ -1246,8 +1364,58 @@ class ClusterServing:
                 while len(self._uri_replica) > 65536:
                     self._uri_replica.popitem(last=False)
             self._routed_counts[r] += 1
+            if self.replica_roles is not None and \
+                    self.replica_roles[r] == "prefill":
+                self._role_prefill_routed += 1
             self._rr_cursor = (r + 1) % self.n_replicas
             self._rq_cond.notify_all()
+
+    def _handoff_request(self, src: int, state: dict) -> None:
+        """Place one exported prefill on a decode-heavy replica — runs
+        on the SOURCE pump thread, inside the engine's ``handoff_cb``,
+        the tick the prompt's first token lands.  ``route_request``
+        ranks the fleet with ``phase="decode"``: decode-role replicas
+        win, a pressured/degraded decode tier falls back across roles,
+        and the source itself is the last resort (self-adoption — the
+        request decodes where it prefilled; a beat slower, never
+        wrong).  ``submit_handoff`` only enqueues host state under the
+        destination's engine lock, so calling straight into another
+        replica's engine from this thread is safe; all device writes
+        happen later on the destination pump at admission.  The
+        ``kill_pump`` drain contract holds unchanged: an exported
+        request counts as admitted work on its DESTINATION, whose pump
+        keeps stepping until its engine drains."""
+        t0 = time.monotonic()
+        uri = state.get("uri", "")
+        sigs = [self.replica_signals(r)
+                for r in range(self.n_replicas)]
+        r = route_request(sigs, state.get("priority"),
+                          self._rr_cursor, phase="decode")
+        if r is None:
+            r = src
+        try:
+            self.engines[r].submit_handoff(state)
+        except Exception:
+            if r == src:
+                # _handoff_slot catches this and error-publishes the
+                # request through its on_error
+                raise
+            logger.exception(
+                "handoff of %r to replica %d failed; self-adopting on "
+                "replica %d", uri, r, src)
+            r = src
+            self.engines[r].submit_handoff(state)
+        with self._rq_cond:
+            self._role_handoffs += 1
+            if self.replica_roles is not None and \
+                    self.replica_roles[r] == "decode":
+                self._role_decode_routed += 1
+            if uri:
+                # cancels/abandonment now belong to the decode side
+                self._uri_replica[uri] = r
+            self._rq_cond.notify_all()   # wake an idle decode pump
+        if self._h_handoff is not None:
+            self._h_handoff.record(time.monotonic() - t0)
 
     def _route_cancels(self, client: RespClient) -> int:
         """Router-side cancel fan-out: owning replicas get the uri in

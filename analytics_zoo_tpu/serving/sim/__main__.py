@@ -27,6 +27,7 @@ import sys
 from typing import Any, Dict, List, Optional
 
 from ..policy import QosPolicy
+from .fleet import FleetModel
 from .model import (DEFAULT_SLO_TARGETS, AcceptanceModel, EngineConfig,
                     EngineModel, TimingModel, summarize)
 from .replay import SchemaVersionError, replay_bundle
@@ -104,10 +105,32 @@ def run_scenario(doc: Dict[str, Any],
     timing = TimingModel(**(doc.get("timing")
                             or {"base_s": 0.002,
                                 "per_token_s": 0.00005}))
+    targets = doc.get("slo") or DEFAULT_SLO_TARGETS
+    fleet_doc = doc.get("fleet")
+    if fleet_doc:
+        # disaggregated fleet scenario (docs/simulation.md): N modelled
+        # replicas behind the real router, optional prefill/decode
+        # roles with modelled KV handoff
+        roles = fleet_doc.get("roles")
+        n = int(fleet_doc.get("n_replicas",
+                              len(roles) if roles else 1))
+        fleet = FleetModel(
+            [EngineConfig.from_dict(doc.get("engine") or {})
+             for _ in range(n)],
+            roles=roles, qos=qos, acceptance=acc, timing=timing,
+            seed=seed, record_events=record_events,
+            handoff_s=float(fleet_doc.get("handoff_s", 0.0)))
+        fleet.run(_build_trace(doc["trace"], seed))
+        out = fleet.summary(targets)
+        out["seed"] = seed
+        if record_events:
+            out["event_log_lines"] = [
+                line for e in fleet.engines
+                for line in e.event_log_lines()]
+        return out
     model = EngineModel(econf, qos=qos, acceptance=acc, timing=timing,
                         seed=seed, record_events=record_events)
     model.run(_build_trace(doc["trace"], seed))
-    targets = doc.get("slo") or DEFAULT_SLO_TARGETS
     out = summarize(model.records, targets)
     out["seed"] = seed
     out["ticks"] = model.ticks
@@ -163,12 +186,14 @@ def _print_summary(out: Dict[str, Any], label: str = "",
               f"{_fmt_ms(c['ttft']['p99']):>9} "
               f"{_fmt_ms(c['tpot']['p99']):>9} "
               f"{_fmt_ms(c['queue_wait']['p99']):>10}", file=f)
+    extra = (f", {out['handoffs']} handoffs"
+             if "handoffs" in out else "")
     print(f"total: {out['finished']} finished, {out['dropped']} "
           f"dropped, goodput {out['goodput']:.3f}, "
           f"{out['tokens_per_s']:.0f} tok/s over "
           f"{out['duration_s']:.2f}s simulated "
           f"({out.get('ticks', out.get('sim_ticks', 0))} ticks, "
-          f"{out.get('preemptions', 0)} preemptions)", file=f)
+          f"{out.get('preemptions', 0)} preemptions{extra})", file=f)
 
 
 def check_envelopes(summary: Dict[str, Any],
@@ -269,26 +294,46 @@ def _cmd_gate(args) -> int:
         print(f"error: {args.golden} has no 'envelopes' section — "
               f"nothing to gate on", file=sys.stderr)
         return 2
-    summary = run_scenario(doc, seed=args.seed)
-    violations = check_envelopes(summary, envelopes)
+    # the pinned primary scenario plus any embedded extra_scenarios
+    # (each a complete scenario doc with its own envelopes — e.g. the
+    # disaggregated-fleet fixture); ALL must hold for exit 0
+    gates = [(doc.get("name", args.golden), doc, envelopes)]
+    for sub in doc.get("extra_scenarios") or []:
+        sub_env = sub.get("envelopes")
+        if not sub_env:
+            print(f"error: extra scenario "
+                  f"{sub.get('name', '?')!r} has no 'envelopes' "
+                  f"section — nothing to gate on", file=sys.stderr)
+            return 2
+        gates.append((sub.get("name", "extra"), sub, sub_env))
+    results = []
+    all_violations = []
+    for name, d, env in gates:
+        summary = run_scenario(d, seed=args.seed)
+        violations = check_envelopes(summary, env)
+        results.append((name, summary, env, violations))
+        all_violations.extend(
+            dict(v, scenario=name) for v in violations)
     if args.json:
-        json.dump({"summary": summary, "violations": violations},
+        json.dump({"summary": results[0][1],
+                   "violations": all_violations},
                   sys.stdout, indent=2, sort_keys=True)
         print()
-        return 1 if violations else 0
-    _print_summary(summary, doc.get("name", args.golden))
-    if violations:
-        print("ENVELOPE VIOLATIONS (see docs/simulation.md for how to "
-              "read and, when intended, re-pin these):")
-        for v in violations:
-            bound = (f">= {v['min']}" if "min" in v
-                     else f"<= {v['max']}" if "max" in v
-                     else v.get("error", "?"))
-            print(f"  {v['metric']}: value {v['value']} violates "
-                  f"{bound}")
-        return 1
-    print(f"gate OK: {len(envelopes)} envelope(s) hold")
-    return 0
+        return 1 if all_violations else 0
+    for name, summary, env, violations in results:
+        _print_summary(summary, name)
+        if violations:
+            print("ENVELOPE VIOLATIONS (see docs/simulation.md for "
+                  "how to read and, when intended, re-pin these):")
+            for v in violations:
+                bound = (f">= {v['min']}" if "min" in v
+                         else f"<= {v['max']}" if "max" in v
+                         else v.get("error", "?"))
+                print(f"  {v['metric']}: value {v['value']} violates "
+                      f"{bound}")
+        else:
+            print(f"gate OK: {len(env)} envelope(s) hold")
+    return 1 if all_violations else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:

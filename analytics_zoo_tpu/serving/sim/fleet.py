@@ -1,0 +1,214 @@
+"""Discrete-event model of a disaggregated serving FLEET.
+
+``FleetModel`` wraps N ``EngineModel`` replicas behind the REAL router
+policy — every placement decision is ``policy.route_request`` on
+fabricated ``ReplicaSignals``, the same pure function and the same
+rank tuple the live ``ClusterServing`` router evaluates — and models
+the prefill/decode KV-handoff path (docs/serving_memory.md):
+
+* arrivals route with ``phase="prefill"`` (when roles are configured),
+  so prefill-heavy replicas take new prompts first;
+* a prefill replica exports a row at its FIRST token
+  (``EngineModel.handoff_cb`` — the sim's
+  ``ContinuousEngine._handoff_slot``), the fleet routes the handoff
+  with ``phase="decode"`` and delivers it ``handoff_s`` later (the
+  modelled chain-snapshot + KV-slice copy cost);
+* the decode replica adopts via ``EngineModel.submit_prefilled`` —
+  straight into DECODE, first token not re-emitted, lifecycle record
+  continued (TTFT observed from the ORIGINAL arrival, exactly like
+  the live telemetry).
+
+Clocks: each replica keeps its own virtual ``now`` (they tick
+independently, like real pump threads); the fleet driver always steps
+the busiest-lagging replica (minimum ``now`` among those with work)
+and fast-forwards an IDLE replica to its next delivery, mirroring the
+serving pump's idle wait.  No wall clock, index-ordered iteration,
+one seeded RNG per replica — byte-identical runs for the same
+(configs, trace, seed), which is what lets ``make sim-gate`` pin a
+disaggregated scenario's envelopes.
+"""
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import policy as scheduler_policy
+from ..policy import REPLICA_ROLES, QosPolicy, ReplicaSignals
+from .model import (AcceptanceModel, EngineConfig, EngineModel,
+                    TimingModel, summarize)
+from .trace import Request
+
+__all__ = ["FleetModel"]
+
+
+class FleetModel:
+    """N modelled replicas + the real routing policy + KV handoff."""
+
+    def __init__(self, configs: Sequence[EngineConfig],
+                 roles: Optional[Sequence[Optional[str]]] = None,
+                 qos: Optional[QosPolicy] = None,
+                 acceptance: Optional[AcceptanceModel] = None,
+                 timing: Optional[TimingModel] = None,
+                 seed: int = 0, record_events: bool = True,
+                 handoff_s: float = 0.0):
+        if not configs:
+            raise ValueError("FleetModel needs at least one replica")
+        if roles is not None:
+            if len(roles) != len(configs):
+                raise ValueError(
+                    f"roles has {len(roles)} entries for "
+                    f"{len(configs)} replicas")
+            bad = [r for r in roles
+                   if r is not None and r not in REPLICA_ROLES]
+            if bad:
+                raise ValueError(f"unknown replica roles {bad!r} "
+                                 f"(choose from {REPLICA_ROLES})")
+        self.engines = [
+            EngineModel(c, qos=qos, acceptance=acceptance, timing=timing,
+                        seed=seed + i, record_events=record_events)
+            for i, c in enumerate(configs)]
+        self.roles = list(roles) if roles is not None else None
+        self.handoff_s = float(handoff_s)
+        self.handoffs = 0
+        self.routed = [0] * len(configs)
+        self._rr = 0
+        self._seq = 0               # stable tiebreak for inbox ordering
+        # per-replica pending deliveries: (available_t, seq, req, record)
+        self._inbox: List[List[Tuple[float, int, Any, Any]]] = [
+            [] for _ in configs]
+        if self.roles is not None:
+            for i, e in enumerate(self.engines):
+                if self.roles[i] == "prefill":
+                    e.handoff_cb = (lambda row, t, _i=i:
+                                    self._handoff(_i, row, t))
+
+    # -- routing --------------------------------------------------------
+
+    def _signals(self) -> List[ReplicaSignals]:
+        sigs = []
+        for i, e in enumerate(self.engines):
+            sigs.append(ReplicaSignals(
+                replica=i, live=True,
+                queue_depth=len(e._waiting) + e.n_active
+                + len(self._inbox[i]),
+                allocatable_blocks=(e._pool.allocatable()
+                                    if e._pool is not None else None),
+                role=(self.roles[i] if self.roles is not None
+                      else None)))
+        return sigs
+
+    def _route(self, priority: Optional[str],
+               phase: Optional[str]) -> int:
+        r = scheduler_policy.route_request(
+            self._signals(), priority=priority, rr_cursor=self._rr,
+            phase=phase if self.roles is not None else None)
+        self._rr = (self._rr + 1) % len(self.engines)
+        return r
+
+    def _deliver(self, dst: int, available_t: float, req, record) -> None:
+        self._seq += 1
+        self._inbox[dst].append((available_t, self._seq, req, record))
+        self._inbox[dst].sort(key=lambda e: (e[0], e[1]))
+
+    def _handoff(self, src: int, row, t: float) -> None:
+        """A prefill replica exported ``row`` at time ``t``: route the
+        decode phase and deliver the adopted request ``handoff_s``
+        later.  The router may pick the source itself (every decode
+        replica saturated) — self-adoption, same as the live broker's
+        fallback."""
+        req = row.req
+        req.handoff = int(row.emitted)
+        dst = self._route(req.priority, "decode")
+        self.handoffs += 1
+        self._deliver(dst, t + self.handoff_s, req,
+                      self.engines[src].records[req.uri])
+
+    # -- driving --------------------------------------------------------
+
+    def _drain_inbox(self, i: int) -> None:
+        """Hand every delivery whose time has come to replica ``i``'s
+        waiting queue.  An ACTIVE replica only sees deliveries at/behind
+        its own clock (a future handoff cannot jump the queue); an idle
+        one fast-forwards in ``run``."""
+        e = self.engines[i]
+        box = self._inbox[i]
+        while box and box[0][0] <= e.now:
+            _, _, req, record = box.pop(0)
+            if record is None:
+                e.submit(req)
+            else:
+                e.submit_prefilled(req, record)
+
+    def _has_work(self, i: int) -> bool:
+        e = self.engines[i]
+        return e.n_active > 0 or len(e._waiting) > 0
+
+    def run(self, trace: Sequence[Request],
+            max_ticks: Optional[int] = None) -> Dict[str, Any]:
+        """Feed ``trace`` through the routed fleet until every request
+        finishes or drops; returns the merged per-request records."""
+        pending = sorted(trace, key=lambda r: (r.arrival_t, r.uri))
+        guard = max_ticks if max_ticks is not None else 20_000_000
+        p = 0
+        n = len(self.engines)
+        while True:
+            # 1. route arrivals due at/before the busiest frontier (or
+            #    all remaining ones once the fleet has gone idle)
+            busy_now = [self.engines[i].now for i in range(n)
+                        if self._has_work(i)]
+            frontier = min(busy_now) if busy_now else None
+            while p < len(pending) and (
+                    frontier is None
+                    or pending[p].arrival_t <= frontier):
+                r = pending[p]
+                dst = self._route(r.priority, "prefill")
+                self.routed[dst] += 1
+                self._deliver(dst, r.arrival_t, r, None)
+                p += 1
+                if frontier is None:
+                    break       # idle fleet: one arrival re-busies it
+            # 2. deliver matured inbox entries; fast-forward idle
+            #    replicas to their next delivery
+            for i in range(n):
+                e = self.engines[i]
+                if (not self._has_work(i)) and self._inbox[i]:
+                    e.now = max(e.now, self._inbox[i][0][0])
+                self._drain_inbox(i)
+            # 3. step the lagging busy replica
+            work = [i for i in range(n) if self._has_work(i)]
+            if not work:
+                if p < len(pending) or any(self._inbox[i]
+                                           for i in range(n)):
+                    continue    # future arrivals/deliveries remain
+                break
+            i = min(work, key=lambda j: (self.engines[j].now, j))
+            self.engines[i].step()
+            if sum(e.ticks for e in self.engines) >= guard:
+                raise RuntimeError(
+                    f"fleet simulation exceeded {guard} ticks "
+                    f"(arrival rate beyond modelled capacity?)")
+        return self.records
+
+    # -- results --------------------------------------------------------
+
+    @property
+    def records(self) -> Dict[str, Any]:
+        """Merged per-request records.  A handed-off request's record
+        OBJECT is shared between source and destination replicas, so
+        the union has exactly one entry per uri."""
+        out: Dict[str, Any] = {}
+        for e in self.engines:
+            out.update(e.records)
+        return out
+
+    def summary(self, targets: Optional[Dict[str, Dict[str, float]]]
+                = None) -> Dict[str, Any]:
+        out = summarize(self.records, targets)
+        out["ticks"] = sum(e.ticks for e in self.engines)
+        out["preemptions"] = sum(e.preemptions for e in self.engines)
+        out["prefill_stall_ticks"] = sum(e.prefill_stall_ticks
+                                         for e in self.engines)
+        out["handoffs"] = self.handoffs
+        out["handoffs_adopted"] = sum(e.handoffs_in
+                                      for e in self.engines)
+        out["routed"] = list(self.routed)
+        out["per_replica_ticks"] = [e.ticks for e in self.engines]
+        return out

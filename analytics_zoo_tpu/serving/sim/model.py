@@ -294,7 +294,7 @@ class _SimReq:
     ``id()`` like the engine's ``_Req``."""
 
     __slots__ = ("uri", "prompt_len", "gen_len", "priority", "tenant",
-                 "enq_t")
+                 "enq_t", "handoff")
 
     def __init__(self, r: Request, max_new_tokens: int):
         self.uri = r.uri
@@ -304,6 +304,12 @@ class _SimReq:
             else "standard"
         self.tenant = r.tenant
         self.enq_t = float(r.arrival_t)
+        # tokens already emitted on a prefill replica; None for a plain
+        # request.  Set by FleetModel's handoff path — an adopted
+        # request admits straight into DECODE (``_admit_adopted``), and
+        # a preempted adopted row re-adopts from this same immutable
+        # state, exactly like the engine's requeued handoff ``_Req``.
+        self.handoff: Optional[int] = None
 
 
 @dataclass
@@ -389,6 +395,14 @@ class EngineModel:
         self.records: Dict[str, _Record] = {}
         self.events: List[Dict[str, Any]] = []
         self.ticks = 0
+        # prefill/decode disaggregation (sim/fleet.py): a fleet sets
+        # ``handoff_cb`` on its prefill replicas; a row then exports at
+        # its first token instead of decoding here.  ``None`` (the
+        # default) leaves every code path bit-identical to the
+        # single-engine model the determinism tests pin.
+        self.handoff_cb = None
+        self.handoffs_out = 0
+        self.handoffs_in = 0
         self.preemptions = 0
         self.prefill_preemptions = 0
         self.prefill_stall_ticks = 0
@@ -433,6 +447,17 @@ class EngineModel:
             arrival=req.enq_t)
         self._waiting.append(req)
 
+    def submit_prefilled(self, req: "_SimReq", record: _Record) -> None:
+        """Adopt a handed-off request from a prefill replica
+        (``ContinuousEngine.submit_handoff``): the lifecycle record
+        continues — same arrival, first token already stamped on the
+        source replica — and admission skips prefill entirely
+        (``_admit_adopted``)."""
+        if req.handoff is None:
+            raise ValueError("submit_prefilled needs req.handoff set")
+        self.records[req.uri] = record
+        self._waiting.append(req)
+
     def _drop(self, req: "_SimReq", reason: str) -> None:
         self.records[req.uri].dropped = reason
         self._ev_dropped.append(req.uri)
@@ -452,6 +477,22 @@ class EngineModel:
         rec = self.records[row.req.uri]
         if row.emitted == 0:
             rec.first_tokens.append(t)
+            if self.handoff_cb is not None and n < row.gen_len:
+                # disaggregated prefill replica: export at the first
+                # token (ContinuousEngine._handoff_slot) — free the
+                # slot like a completion and ship the row; the decode
+                # replica finishes it.  A request done at its first
+                # token (n >= gen_len) never hands off, matching the
+                # engine's not-done condition.
+                row.emitted = n
+                i = self._slots.index(row)
+                self._slots[i] = None
+                self._free.append(i)
+                self._release_blocks(row)
+                self.handoffs_out += 1
+                self._emit("handoff_out", uri=row.req.uri)
+                self.handoff_cb(row, t)
+                return
         row.emitted += n
         if row.emitted >= row.gen_len:
             row.emitted = row.gen_len
@@ -554,8 +595,12 @@ class EngineModel:
             req = self._pop_waiting()
             if req is None:
                 break
-            res = (self._admit_one_chunked_paged(req) if self.config.paged
-                   else self._admit_one_chunked(req))
+            if req.handoff is not None:
+                res = self._admit_adopted(req)
+            else:
+                res = (self._admit_one_chunked_paged(req)
+                       if self.config.paged
+                       else self._admit_one_chunked(req))
             if res == "admitted":
                 admitted += 1
             elif res == "blocked":
@@ -595,6 +640,45 @@ class EngineModel:
         self._install_prefill(req)
         return "admitted"
 
+    def _admit_adopted(self, req: "_SimReq") -> str:
+        """Admit a handed-off row straight into DECODE
+        (``ContinuousEngine._admit_handoff``): blocks for the prompt's
+        KV chain plus one decode block of headroom, no prefill phase,
+        first token NOT re-emitted (the source replica stamped it)."""
+        if self.config.paged:
+            bs = self.config.block_size
+            need = -(-req.prompt_len // bs)
+            cap = self._pool.n_blocks - 1
+            if self._dpool is not None:
+                cap = min(cap, self._dpool.n_blocks - 1)
+            if need + 1 > cap:
+                self._drop(req, "prompt_exceeds_pool")
+                return "error"
+            short = self._pool.allocatable() < need + 1 or (
+                self._dpool is not None
+                and self._dpool.allocatable() < need + 1)
+            if short:
+                if self.n_active == 0:
+                    self._drop(req, "pool_dry_no_residents")
+                    return "error"
+                return "blocked"
+        slot = self._free.popleft()
+        row = _Row(req, "DECODE", self._admit_seq)
+        self._admit_seq += 1
+        row.fill_pos = req.prompt_len
+        row.emitted = int(req.handoff)
+        self._slots[slot] = row
+        if self.config.paged:
+            need = -(-req.prompt_len // self.config.block_size)
+            row.blocks = need
+            self._pool.free -= need
+            if self._dpool is not None:
+                self._dpool.free -= need
+        self.handoffs_in += 1
+        self._record_admit(req)
+        self._emit("handoff_in", uri=req.uri)
+        return "admitted"
+
     def _admit_monolithic(self) -> int:
         """Non-chunked admission, approximated: the whole prompt
         prefills at admission time (first token stamped immediately);
@@ -606,6 +690,14 @@ class EngineModel:
             req = self._pop_waiting()
             if req is None:
                 break
+            if req.handoff is not None:
+                res = self._admit_adopted(req)
+                if res == "admitted":
+                    admitted += 1
+                elif res == "blocked":
+                    self._requeue_front(req)
+                    break
+                continue
             if self.config.paged:
                 bs = self.config.block_size
                 need = -(-req.prompt_len // bs) + 1

@@ -44,7 +44,7 @@ __all__ = ["SUPPORTED_SCHEMA_VERSIONS", "SchemaVersionError",
 #: Flight/bundle schema versions this simulator understands.  Must
 #: track ``serving/flight.py::FLIGHT_SCHEMA_VERSION`` — pinned against
 #: it by tests/test_sim.py (this module cannot import flight.py: numpy).
-SUPPORTED_SCHEMA_VERSIONS: Tuple[int, ...] = (1,)
+SUPPORTED_SCHEMA_VERSIONS: Tuple[int, ...] = (1, 2)
 
 #: Replay cross-check tolerances (documented in docs/simulation.md).
 #: ``goodput``: absolute per-class delta between trace-derived and
@@ -101,6 +101,11 @@ def load_bundle(path: str) -> Dict[str, Any]:
     for rec in ticks:
         _check_version(rec.get("schema_version"),
                        f"flight tick seq={rec.get('seq')}")
+        # v1 producers predate elastic pools: the pool size was static,
+        # so every tick's n_blocks is free + used + the sink block
+        if "free_blocks" in rec:
+            rec.setdefault("n_blocks", int(rec["free_blocks"])
+                           + int(rec.get("used_blocks", 0)) + 1)
     trace = _read_json(os.path.join(path, "trace.json")) or {}
     return {
         "path": path,
@@ -259,15 +264,21 @@ def engine_config_from_bundle(bundle: Dict[str, Any]) -> EngineConfig:
     spec_k = int(spec.get("k") or cfg.get("engine_speculation_k") or 0)
     paged = bool(cfg.get("engine_paged", False))
     n_blocks = cfg.get("engine_blocks")
-    if paged and n_blocks is None:
-        # pool sized by HBM fraction / arena parity: reconstruct from
-        # the tick samples (used + free + sink)
+    if paged:
+        # v2 ticks carry the pool size directly (elastic pools move it
+        # mid-run — size to the high-water mark); v1 falls back to the
+        # static reconstruction used + free + sink
+        peak = 0
         for rec in ticks:
-            if "free_blocks" in rec:
-                n_blocks = max(int(n_blocks or 0),
-                               int(rec.get("free_blocks", 0))
-                               + int(rec.get("used_blocks", 0)) + 1)
-        n_blocks = n_blocks or 256
+            if "n_blocks" in rec:
+                peak = max(peak, int(rec["n_blocks"]))
+            elif "free_blocks" in rec:
+                peak = max(peak, int(rec.get("free_blocks", 0))
+                           + int(rec.get("used_blocks", 0)) + 1)
+        if peak:
+            n_blocks = max(int(n_blocks or 0), peak)
+        if n_blocks is None:
+            n_blocks = 256
     max_new = 0
     for ev in bundle.get("trace_events") or []:
         if ev.get("name") == "request":

@@ -105,6 +105,7 @@ _HEAVY_NODEIDS = frozenset((
     "tests/test_moe.py::test_moe_decode_capacity_agreement_bound",
     "tests/test_observability.py::test_profiler_not_leaked_on_fault",
     "tests/test_paged_cache.py::test_cluster_serving_paged_round_trip",
+    "tests/test_paged_cache.py::test_engine_handoff_parity",
     "tests/test_paged_cache.py::test_paged_matches_arena_and_solo",
     "tests/test_paged_cache.py::test_paged_prefix_sharing_hits",
     "tests/test_paged_cache.py::test_pool_dry_preempts_to_queue_not_oom",
@@ -116,6 +117,7 @@ _HEAVY_NODEIDS = frozenset((
     "tests/test_pipeline.py::test_interleaved_1f1b_matches_sequential[mesh_axes2-8-2]",
     "tests/test_quantize.py::test_int8_mxu_conv_resnet_through_inference_model",
     "tests/test_ring_attention.py::test_ring_grads_flow",
+    "tests/test_router.py::test_disaggregated_fleet_handoff_round_trip",
     "tests/test_speculative.py::test_greedy_equality_random_draft",
     "tests/test_speculative.py::test_serving_path_speculative_equals_plain",
     "tests/test_speculative.py::test_verify_step_equals_sequential_decode",

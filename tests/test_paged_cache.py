@@ -461,3 +461,236 @@ def test_cluster_serving_paged_round_trip(lm):
         assert "prefix_hit_rate" in cache and "occupancy" in cache
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# disaggregation: chain export/adopt + elastic pool resize
+# ---------------------------------------------------------------------------
+
+def test_block_pool_export_adopt_chain_preserves_hashes():
+    """The handoff wire format round-trips: an exported chain carries
+    the source's full-block prefix hashes, export is read-only on the
+    source, and adoption re-publishes the hashes so the destination's
+    prefix index matches them again."""
+    src = BlockPool(8, 4)
+    hs = src.block_hashes(list(range(10)))      # 2 full blocks + partial
+    assert len(hs) == 2
+    blocks = [src.allocate() for _ in range(3)]
+    for h, blk in zip(hs, blocks):
+        src.insert(h, blk)
+    chain = src.export_chain(blocks)
+    assert chain["n"] == 3 and chain["block_size"] == 4
+    assert chain["hashes"][:2] == hs and chain["hashes"][2] is None
+    assert src.metrics()["chains_exported"] == 1
+    assert src.num_referenced() == 3            # export took no refs
+    src.check()
+
+    dst = BlockPool(8, 4)
+    got = dst.adopt_chain(chain)
+    assert got is not None and len(got) == 3 and SINK_BLOCK not in got
+    assert dst.num_referenced() == 3
+    assert dst.lookup(hs) == got[:2]            # prefix index restored
+    assert dst.metrics()["chains_adopted"] == 1
+    dst.check()
+
+
+def test_block_pool_export_chain_refuses_sink_and_unreferenced():
+    pool = BlockPool(8, 4)
+    b = pool.allocate()
+    with pytest.raises(ValueError):
+        pool.export_chain([SINK_BLOCK, b])
+    h = pool.block_hashes([1, 2, 3, 4])
+    b2 = pool.allocate()
+    pool.insert(h[0], b2)
+    pool.release(b2)                            # cached, ref == 0
+    with pytest.raises(ValueError):
+        pool.export_chain([b2])
+    pool.release(b)                             # free, ref == 0
+    with pytest.raises(ValueError):
+        pool.export_chain([b])
+    pool.check()
+
+
+def test_block_pool_adopt_chain_validates_and_rolls_back():
+    """Geometry/dtype mismatches are loud; an adoption the pool cannot
+    fully satisfy rolls back EVERY partial allocation and returns None
+    (the engine then requeues the handoff, it must not leak blocks)."""
+    src = BlockPool(8, 4)
+    blocks = [src.allocate() for _ in range(3)]
+    chain = src.export_chain(blocks)
+    with pytest.raises(ValueError):
+        BlockPool(8, 8).adopt_chain(chain)      # block_size mismatch
+    with pytest.raises(ValueError):
+        BlockPool(8, 4, kv_dtype="int8").adopt_chain(chain)
+    tiny = BlockPool(3, 4)                      # 2 usable < chain n=3
+    before = tiny.allocatable()
+    assert tiny.adopt_chain(chain) is None
+    assert tiny.allocatable() == before and tiny.num_referenced() == 0
+    assert tiny.metrics()["chains_adopted"] == 0
+    tiny.check()
+
+
+def test_block_pool_grow_appends_and_shrink_clamps_at_referenced_tail():
+    """Resize edges: grow appends fresh top ids; shrink never evicts a
+    referenced block — a deeper request is clamped at the eviction
+    boundary and counted, never raised — and a cached tail block is
+    evicted with its hash unpublished.  Block 0 (sink) never moves."""
+    pool = BlockPool(10, 4)
+    blocks = [pool.allocate() for _ in range(9)]
+    assert sorted(blocks) == list(range(1, 10))
+    assert pool.shrinkable() == 0
+    assert pool.shrink(3) == 0                  # fully referenced: clamp
+    assert pool.n_blocks == 10
+    assert pool.metrics()["resize_clamps"] == 1
+    # free the tail ids 6..9, with a hash published on 9 so the shrink
+    # also exercises the eviction + unpublish path
+    hs = pool.block_hashes([1, 2, 3, 4])
+    pool.insert(hs[0], 9)
+    for b in (6, 7, 8, 9):
+        pool.release(b)
+    assert pool.shrinkable() == 4
+    ev0 = pool.evictions
+    assert pool.shrink(6) == 4                  # clamped at boundary
+    assert pool.n_blocks == 6
+    assert pool.metrics()["resize_clamps"] == 2
+    assert pool.evictions == ev0 + 1
+    assert pool.lookup(hs) == []                # evicted hash unmatchable
+    pool.check()
+    assert pool.grow(2) == 2 and pool.n_blocks == 8
+    # 1 applied shrink + 1 grow; the fully-clamped shrink(3) applied
+    # zero blocks and is counted only as a clamp, not a resize
+    assert pool.metrics()["resizes"] == 2
+    pool.check()
+    got = [pool.allocate(), pool.allocate()]    # the fresh top ids
+    assert sorted(got) == [6, 7] and SINK_BLOCK not in got
+    pool.check()
+
+
+def test_engine_handoff_parity(lm):
+    """Acceptance pin (docs/serving_memory.md): prefill on engine A,
+    KV block-chain handoff at first-token time, decode on engine B —
+    greedy outputs bitwise-identical to each request's solo generate(),
+    in plain paged mode AND paged+chunked."""
+    model, variables = lm
+    rng = np.random.default_rng(11)
+    prompts = {f"h{i}": rng.integers(1, 32, rng.integers(2, 14)).astype(
+        np.int32) for i in range(4)}
+    for extra in ({}, {"chunked": True, "tick_token_budget": 8}):
+        kw = dict(max_new_tokens=5, max_slots=3, prompt_buckets=(8, 16),
+                  paged=True, block_size=4, **extra)
+        a = ContinuousEngine(model, variables, **kw)
+        b = ContinuousEngine(model, variables, **kw)
+        results = {}
+        for uri, p in prompts.items():
+            a.submit(uri, p, on_done=_collect(results),
+                     handoff_cb=b.submit_handoff)
+        for _ in range(500):
+            a.step()
+            b.step()
+            if len(results) == len(prompts):
+                break
+        assert set(results) == set(prompts)
+        assert a._handoffs_out == len(prompts)
+        assert b._handoffs_in == len(prompts)
+        assert a.n_active == 0 and b.n_active == 0
+        a._pool.check()
+        b._pool.check()
+        assert a._pool.num_referenced() == 0
+        assert b._pool.num_referenced() == 0
+        for uri, p in prompts.items():
+            solo = np.asarray(generate(model, variables,
+                                       jnp.asarray(p[None]), 5))[0]
+            np.testing.assert_array_equal(results[uri], solo, err_msg=uri)
+
+
+def test_engine_handoff_composition_errors(lm):
+    """The excluded compositions die at submit time with pointed
+    errors, never mid-pump: arena engines (no block tables), sampled
+    requests (unsplittable RNG stream), and speculative engines (the
+    ROADMAP 'spec-aware KV handoff' follow-on)."""
+    model, variables = lm
+    p = np.arange(1, 5, dtype=np.int32)
+    arena = ContinuousEngine(model, variables, max_new_tokens=4,
+                             max_slots=2, prompt_buckets=(8,))
+    with pytest.raises(ValueError, match="requires paged"):
+        arena.submit("a", p, handoff_cb=lambda st: None)
+    with pytest.raises(ValueError, match="paged engine"):
+        arena.submit_handoff({})
+    paged = ContinuousEngine(model, variables, max_new_tokens=4,
+                             max_slots=2, prompt_buckets=(8,),
+                             paged=True, block_size=4)
+    with pytest.raises(ValueError, match="greedy-only"):
+        paged.submit("s", p, temperature=0.7, rng_seed=1,
+                     handoff_cb=lambda st: None)
+    spec = ContinuousEngine(model, variables, max_new_tokens=4,
+                            max_slots=2, prompt_buckets=(8,),
+                            paged=True, block_size=4,
+                            draft_model=model, draft_variables=variables,
+                            speculation_k=2)
+    with pytest.raises(ValueError, match="spec-aware KV handoff"):
+        spec.submit("d", p, handoff_cb=lambda st: None)
+    with pytest.raises(ValueError, match="spec-aware KV handoff"):
+        spec.submit_handoff({})
+
+
+def test_engine_elastic_pool_resize_parity(lm):
+    """resize_pool moves the host pool and the device arena in
+    lockstep (blocks live on axis 1 of the stacked layout), clamps a
+    below-floor shrink at the floor — counted, never raised — and
+    greedy outputs stay bitwise-identical across grow and shrink."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=4, prompt_buckets=(8,),
+                           paged=True, block_size=4, n_blocks=13,
+                           elastic_pool=True)
+    assert eng._pool_floor == 4                 # M+1, M = (8+4)/4
+    assert eng._pool_ceiling == 13              # CPU: arena-equivalent
+    assert eng._resize_step == 4
+    p = np.arange(1, 8, dtype=np.int32)
+    solo = np.asarray(generate(model, variables, jnp.asarray(p[None]),
+                               4))[0]
+    results = {}
+    for phase, target in (("floor", 1), ("ceiling", eng._pool_ceiling)):
+        clamped0 = eng._pool_resize_clamps
+        eng.resize_pool(target)
+        n = eng._pool.n_blocks
+        assert n == max(eng._pool_floor, min(target, eng._pool_ceiling))
+        assert eng._pk.shape[1] == n            # device arena followed
+        if target < eng._pool_floor:
+            assert eng._pool_resize_clamps == clamped0 + 1
+        eng._pool.check()
+        uri = f"e-{phase}"
+        eng.submit(uri, p, on_done=_collect(results))
+        eng.drain()
+        np.testing.assert_array_equal(results[uri], solo, err_msg=phase)
+        eng._pool.check()
+
+
+def test_engine_maybe_autoresize_policy_loop(lm):
+    """The pump-side control loop: an idle over-provisioned pool
+    shrinks one step; a degraded goodput class holds the shrink; an
+    alloc-fail streak grows back toward the ceiling even while
+    goodput is degraded (grow outranks the hold)."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=4, prompt_buckets=(8,),
+                           paged=True, block_size=4, n_blocks=13,
+                           elastic_pool=True)
+    assert eng.maybe_autoresize() == -4         # idle: shrink one step
+    assert eng._pool.n_blocks == 9
+    bad = {"interactive": 0.2}
+    assert eng.maybe_autoresize(goodput=bad) == 0   # SLO hold
+    held = []
+    while True:                                 # dry the pool
+        blk = eng._pool.allocate()
+        if blk is None:
+            break
+        held.append(blk)
+    assert eng.maybe_autoresize(goodput=bad) == 4   # pressure beats hold
+    assert eng._pool.n_blocks == 13
+    assert eng._pk.shape[1] == 13
+    for blk in held:
+        eng._pool.release(blk)
+    eng._pool.check()
+    m = eng.cache_metrics()
+    assert m["pool_resizes"] == 2 and m["pool_floor"] == 4

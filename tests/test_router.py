@@ -228,3 +228,110 @@ def test_single_replica_layout_unchanged():
         assert np.asarray(oq.query("solo", timeout=60)).shape == (4,)
     finally:
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode roles (disaggregation)
+# ---------------------------------------------------------------------------
+
+
+def test_route_role_phase_match_first():
+    """Role mismatch is the TOP rank bit: a prefill request steers to
+    the prefill replica past a much emptier decode replica (and vice
+    versa); within the matching role set the usual signals decide; and
+    with no phase — or no roles anywhere — the rank is bit-identical
+    to role-less routing."""
+    sigs = [_sig(0, role="prefill", queue_depth=6),
+            _sig(1, role="decode", queue_depth=0)]
+    assert route_request(sigs, phase="prefill") == 0
+    assert route_request(sigs, phase="decode") == 1
+    sigs3 = [_sig(0, role="prefill", queue_depth=6),
+             _sig(1, role="prefill", queue_depth=1),
+             _sig(2, role="decode", queue_depth=0)]
+    assert route_request(sigs3, phase="prefill") == 1
+    for cur in range(3):
+        assert (route_request(sigs3, rr_cursor=cur)
+                == route_request([_sig(0, queue_depth=6),
+                                  _sig(1, queue_depth=1),
+                                  _sig(2, queue_depth=0)],
+                                 rr_cursor=cur))
+    # role-less replicas never mismatch any phase
+    assert route_request([_sig(0, queue_depth=2), _sig(1)],
+                         phase="prefill") == 1
+
+
+def test_route_role_is_preference_not_partition():
+    """Roles steer, they never strand: with the matching replica dead
+    the request falls through to a live mismatched one, while a merely
+    PRESSURED matching replica still keeps its phase's work (mismatch
+    outranks pressure in the tuple)."""
+    sigs = [_sig(0, role="prefill", live=False), _sig(1, role="decode")]
+    assert route_request(sigs, phase="prefill") == 1
+    sigs = [_sig(0, role="prefill", alloc_fail_streak=2),
+            _sig(1, role="decode")]
+    assert route_request(sigs, phase="prefill") == 0
+
+
+def test_replica_roles_config_validation():
+    """Invalid role configs die in the constructor with pointed
+    errors, never at first handoff."""
+    im = _generator_im()
+
+    def cfg(**kw):
+        return ServingConfig(prompt_col="tokens",
+                             continuous_batching=True, **kw)
+
+    with pytest.raises(ValueError, match="one role per replica"):
+        ClusterServing(im, cfg(n_replicas=2, engine_paged=True,
+                               replica_roles=["prefill"]))
+    with pytest.raises(ValueError, match="must be one of"):
+        ClusterServing(im, cfg(n_replicas=2, engine_paged=True,
+                               replica_roles=["prefill", "oops"]))
+    with pytest.raises(ValueError, match="engine_paged"):
+        ClusterServing(im, cfg(n_replicas=2,
+                               replica_roles=["prefill", "decode"]))
+    with pytest.raises(ValueError, match="n_replicas > 1"):
+        ClusterServing(im, cfg(engine_paged=True,
+                               replica_roles=["prefill"]))
+
+
+def test_disaggregated_fleet_handoff_round_trip():
+    """Live 2-replica prefill/decode fleet on one broker: every
+    request prefills on replica 0, ships its KV block chain to
+    replica 1 for decode, and the outputs stay bitwise-identical to
+    solo generation; the role counters surface in router_status()."""
+    im = _generator_im()
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=2, n_replicas=2,
+                        engine_paged=True, engine_block_size=4,
+                        engine_blocks=24,
+                        replica_roles=["prefill", "decode"])
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        rng = np.random.default_rng(7)
+        prompts = {f"d{i}": rng.integers(1, 32, 3 + i % 5)
+                   .astype(np.int32) for i in range(6)}
+        for u, p in prompts.items():
+            iq.enqueue(u, tokens=p)
+        outs = {u: np.asarray(oq.query(u, timeout=120))
+                for u in prompts}
+        from analytics_zoo_tpu.models import generate
+        for u, p in prompts.items():
+            ref = np.asarray(generate(im.model, im._variables,
+                                      jnp.asarray(p[None]), 4))[0]
+            np.testing.assert_array_equal(outs[u], ref, err_msg=u)
+        status = srv.router_status()
+        assert status["roles"] == ["prefill", "decode"]
+        assert status["routed"][0] == len(prompts)  # all enter at prefill
+        assert status["handoffs"] == len(prompts)
+        assert srv.engines[0]._handoffs_out == len(prompts)
+        assert srv.engines[1]._handoffs_in == len(prompts)
+        assert srv.engines[0].n_active == 0
+        assert srv.engines[1].n_active == 0
+        for eng in srv.engines:
+            eng._pool.check()
+            assert eng._pool.num_referenced() == 0
+    finally:
+        srv.stop()

@@ -772,3 +772,94 @@ class TestLiveBundleRoundTrip:
         # documented LOOSE tolerance (docs/simulation.md)
         for cls, d in report["sim_vs_observed"].items():
             assert abs(d["goodput"]) <= 0.5, (cls, d)
+
+
+# ---------------------------------------------------------------------------
+# disaggregated fleet model (sim/fleet.py)
+# ---------------------------------------------------------------------------
+
+class TestFleetModel:
+    def _fleet(self, **kw):
+        from analytics_zoo_tpu.serving.sim.fleet import FleetModel
+        cfg = EngineConfig(slots=2, max_new_tokens=4, chunked=True,
+                           tick_token_budget=16, prompt_buckets=(4, 8),
+                           paged=True, block_size=4, n_blocks=12)
+        kw.setdefault("roles", ["prefill", "decode"])
+        return FleetModel([cfg, cfg], **kw)
+
+    def test_every_request_hands_off_and_finishes(self):
+        fleet = self._fleet(handoff_s=0.001)
+        recs = fleet.run(_reqs([(8, 4, "standard")] * 6))
+        assert all(r.finished and not r.dropped for r in recs.values())
+        s = fleet.summary()
+        assert s["handoffs"] == 6 and s["handoffs_adopted"] == 6
+        assert s["routed"] == [6, 0]    # every arrival enters at prefill
+        assert s["finished"] == 6
+        assert all(t > 0 for t in s["per_replica_ticks"])
+
+    def test_single_token_requests_never_hand_off(self):
+        # gen_len == 1: the row finishes AT its first token — there is
+        # nothing left to decode on the other side
+        fleet = self._fleet()
+        recs = fleet.run(_reqs([(8, 1, "standard")] * 3))
+        assert all(r.finished for r in recs.values())
+        assert fleet.handoffs == 0
+
+    def test_handoff_preserves_arrival_clock(self):
+        # TTFT is measured from the ORIGINAL arrival: the first token
+        # stamps on the prefill replica, before the modelled copy lands
+        fleet = self._fleet(handoff_s=0.5)
+        recs = fleet.run(_reqs([(8, 4, "interactive")]))
+        rec = recs["r00"]
+        assert rec.finished
+        assert rec.first_tokens[0] < 0.5
+        assert rec.finish_t >= 0.5      # decode waited for the delivery
+
+    def test_fleet_run_is_deterministic(self):
+        def go():
+            fleet = self._fleet(handoff_s=0.001)
+            fleet.run(_reqs([(8, 4, "standard"), (4, 2, "interactive"),
+                             (8, 3, "batch")] * 4))
+            events = [e for eng in fleet.engines for e in eng.events]
+            return (json.dumps(fleet.summary(), sort_keys=True),
+                    json.dumps(events, sort_keys=True))
+        assert go() == go()
+
+    def test_role_and_shape_validation(self):
+        from analytics_zoo_tpu.serving.sim.fleet import FleetModel
+        cfg = EngineConfig(slots=2, max_new_tokens=4)
+        with pytest.raises(ValueError, match="at least one replica"):
+            FleetModel([])
+        with pytest.raises(ValueError, match="roles has"):
+            FleetModel([cfg, cfg], roles=["prefill"])
+        with pytest.raises(ValueError, match="unknown replica roles"):
+            FleetModel([cfg, cfg], roles=["prefill", "oops"])
+
+    def test_submit_prefilled_requires_handoff_mark(self):
+        from analytics_zoo_tpu.serving.sim.model import _SimReq
+        cfg = EngineConfig(slots=2, max_new_tokens=4, paged=True,
+                           block_size=4, n_blocks=8)
+        m = EngineModel(cfg)
+        req = _SimReq(_reqs([(8, 4, "standard")])[0], 4)
+        with pytest.raises(ValueError, match="handoff"):
+            m.submit_prefilled(req, None)
+
+    def test_golden_disagg_scenario_envelopes_hold(self):
+        doc = load_scenario(GOLDEN)
+        extras = doc.get("extra_scenarios") or []
+        assert any(d["name"] == "golden-disagg-fleet" for d in extras)
+        for sub in extras:
+            summary = run_scenario(sub)
+            violations = check_envelopes(summary, sub["envelopes"])
+            assert violations == [], (sub["name"], violations)
+
+    def test_golden_disagg_gate_trips_without_role_routing(self):
+        # the negative direction: strip the roles and the pinned
+        # handoff envelope must break (the gate is a real tripwire)
+        doc = copy.deepcopy(load_scenario(GOLDEN))
+        sub = next(d for d in doc["extra_scenarios"]
+                   if d["name"] == "golden-disagg-fleet")
+        sub["fleet"]["roles"] = None
+        summary = run_scenario(sub)
+        violations = check_envelopes(summary, sub["envelopes"])
+        assert any(v["metric"] == "handoffs" for v in violations)
