@@ -340,6 +340,9 @@ class InferenceModel:
                                tick_token_budget: Optional[int] = None,
                                speculation_k: Optional[int] = None,
                                elastic_pool: bool = False,
+                               kv_host_store_bytes: int = 0,
+                               prefix_directory=None,
+                               replica_id: int = 0,
                                record_timings: bool = False,
                                telemetry=None, qos=None,
                                flight=None, flight_capacity: int = 2048):
@@ -385,6 +388,15 @@ class InferenceModel:
         in block-granular steps at the eviction boundary
         (docs/serving_memory.md 'Disaggregation & elastic pools').
 
+        ``kv_host_store_bytes`` (paged only, no draft) arms the tiered
+        KV memory: evicted prefix chains spill to a bounded host-RAM
+        store and re-admit at admission via a host->HBM copy instead
+        of a re-prefill; ``prefix_directory`` (a shared
+        ``serving.kv_store.PrefixDirectory``) plus ``replica_id``
+        additionally publish this engine's prefix residency fleet-wide
+        for locality-aware routing (docs/serving_memory.md
+        'Tiered KV memory').
+
         ``flight`` / ``flight_capacity`` configure the engine's
         always-on per-tick flight recorder (serving/flight.py;
         ``flight_capacity=0`` disables, a shared
@@ -427,6 +439,8 @@ class InferenceModel:
             enable_prefix_cache=enable_prefix_cache,
             chunked=chunked, tick_token_budget=tick_token_budget,
             elastic_pool=elastic_pool,
+            kv_host_store_bytes=kv_host_store_bytes,
+            prefix_directory=prefix_directory, replica_id=replica_id,
             record_timings=record_timings, telemetry=telemetry,
             qos=qos, flight=flight, flight_capacity=flight_capacity,
             **spec)

@@ -56,6 +56,8 @@ from analytics_zoo_tpu.serving.paged_cache import (BlockPool,
                                                    block_bytes,
                                                    split_block_budget)
 from analytics_zoo_tpu.serving.flight import FlightRecorder
+from analytics_zoo_tpu.serving.kv_store import (HostKVStore, TIER_HBM,
+                                                TIER_HOST)
 from analytics_zoo_tpu.serving.telemetry import Telemetry
 
 logger = logging.getLogger("analytics_zoo_tpu")
@@ -203,6 +205,9 @@ class ContinuousEngine:
                  hbm_fraction: Optional[float] = None,
                  enable_prefix_cache: bool = True,
                  elastic_pool: bool = False,
+                 kv_host_store_bytes: int = 0,
+                 prefix_directory=None,
+                 replica_id: int = 0,
                  chunked: bool = False,
                  tick_token_budget: Optional[int] = None,
                  record_timings: bool = False,
@@ -270,7 +275,8 @@ class ContinuousEngine:
                              "budget_tokens": 0, "alloc_fail": 0,
                              "draft_alloc_fail": 0, "spec_proposed": 0,
                              "spec_accepted": 0, "pool_resizes": 0,
-                             "handoffs_out": 0, "handoffs_in": 0}
+                             "handoffs_out": 0, "handoffs_in": 0,
+                             "kv_spills": 0, "kv_readmits": 0}
         # ---- speculative mode (draft arena) ----------------------------
         # the slot arena is ALREADY per-row-positioned, which is exactly
         # what per-slot acceptance rates need: each verify round advances
@@ -351,6 +357,29 @@ class ContinuousEngine:
             raise ValueError(
                 "elastic_pool=True requires paged=True: the arena "
                 "engine has no block pool to grow or shrink")
+        # ---- tiered KV memory (serving/kv_store.py) --------------------
+        # a host-RAM second tier for evicted prefix chains plus an
+        # optional fleet-wide prefix directory.  Both default OFF —
+        # kv_host_store_bytes=0 and prefix_directory=None leave every
+        # pool hook None, bit-identical to the single-tier engine.
+        if kv_host_store_bytes < 0:
+            raise ValueError(
+                f"kv_host_store_bytes must be >= 0, got "
+                f"{kv_host_store_bytes}")
+        if (kv_host_store_bytes > 0 or prefix_directory is not None) \
+                and not paged:
+            raise ValueError(
+                "kv_host_store_bytes / prefix_directory require "
+                "paged=True: the tiered KV store spills and re-admits "
+                "BLOCK CHAINS (the arena engine has no blocks to "
+                "spill)")
+        if kv_host_store_bytes > 0 and draft_model is not None:
+            raise ValueError(
+                "kv_host_store_bytes does not compose with a draft "
+                "model: speculative mode runs two pool tenants in "
+                "lockstep and re-admitting only the target tenant's "
+                "chain would desynchronize them — serve the host tier "
+                "on non-speculative replicas")
         if kernel == "fused" and mesh is not None:
             raise ValueError(
                 "kernel='fused' does not run under a mesh yet: the "
@@ -420,6 +449,14 @@ class ContinuousEngine:
         self._paged_prefixes: Dict[int, tuple] = {}
         self._dpool: Optional[BlockPool] = None
         self._dpk = self._dpv = None
+        # tiered-KV state (None/0 = tier off on every path)
+        self._kv_store: Optional[HostKVStore] = None
+        self._prefix_directory = prefix_directory
+        self._replica_id = int(replica_id)
+        self._kv_spills = 0
+        self._kv_spill_bytes = 0
+        self._kv_readmits = 0
+        self._kv_readmit_tokens_saved = 0
         if self.paged:
             bs = int(block_size)
             if bs < 1:
@@ -477,11 +514,23 @@ class ContinuousEngine:
                     f"sequence: need >= {M + 1} ({M} logical blocks of "
                     f"{bs} positions + the sink block 0)")
             self._bs, self._M = bs, M
-            self._pool = BlockPool(n_blocks, bs, enable_prefix_cache,
-                                   event_cb=self.telemetry.pool_event,
-                                   name="target",
-                                   kv_dtype=self.kv_dtype,
-                                   bytes_per_block=per_block)
+            # host tier + directory hooks precede pool creation: the
+            # pool fires them from inside allocate()/shrink()/insert()
+            if kv_host_store_bytes > 0:
+                self._kv_store = HostKVStore(
+                    int(kv_host_store_bytes),
+                    evict_cb=self._store_evicted)
+            self._pool = BlockPool(
+                n_blocks, bs, enable_prefix_cache,
+                event_cb=self.telemetry.pool_event,
+                name="target",
+                kv_dtype=self.kv_dtype,
+                bytes_per_block=per_block,
+                spill_cb=(self._spill_block
+                          if self._kv_store is not None else None),
+                index_cb=(self._pool_index_event
+                          if self._prefix_directory is not None
+                          else None))
             # pool-mutation guard: admission/growth run on the pump
             # thread, but unregister_prefix releases from client threads
             self._pool_lock = threading.Lock()
@@ -1105,6 +1154,28 @@ class ContinuousEngine:
             m.gauge("zoo_engine_handoffs_in_total",
                     "prefilled rows adopted from a prefill replica",
                     fn=lambda: self._handoffs_in, kind="counter")
+            # tiered-KV surface (serving/kv_store.py): same contract —
+            # stable names for every paged engine, zero with the host
+            # store off
+            m.gauge("zoo_engine_kv_spill_chains_total",
+                    "evicted blocks accepted by the host KV store",
+                    fn=lambda: self._kv_spills, kind="counter")
+            m.gauge("zoo_engine_kv_spill_bytes_total",
+                    "KV bytes spilled to the host store",
+                    fn=lambda: self._kv_spill_bytes, kind="counter")
+            m.gauge("zoo_engine_kv_readmit_chains_total",
+                    "host-store chains adopted back into the pool at "
+                    "admission",
+                    fn=lambda: self._kv_readmits, kind="counter")
+            m.gauge("zoo_engine_kv_readmit_tokens_saved_total",
+                    "prompt tokens served host->HBM instead of "
+                    "re-prefilled",
+                    fn=lambda: self._kv_readmit_tokens_saved,
+                    kind="counter")
+            m.gauge("zoo_engine_kv_store_bytes",
+                    "host KV store occupancy in bytes",
+                    fn=lambda: (self._kv_store.occupancy_bytes
+                                if self._kv_store is not None else 0))
             if self._dpool is not None:
                 def _dpool_read(key):
                     def read():
@@ -1966,6 +2037,18 @@ class ContinuousEngine:
             if dmatch is not None:
                 for b in dmatch:
                     self._dpool.acquire(b)
+            if self._kv_store is not None:
+                # tiered KV: extend the pinned device match from the
+                # host store.  The probe window is capped so adoption
+                # leaves the >= 2 allocatable blocks the chunked dry
+                # gate just guaranteed — the first chunk must still be
+                # able to start.  (No draft tenant here: the store
+                # refuses speculative engines at construction.)
+                limit = min((plen - 1) // self._bs,
+                            len(matched)
+                            + max(0, self._pool.allocatable() - 2))
+                matched = matched + self._store_readmit(
+                    hashes, len(matched), limit)
         slot = self._free.popleft()
         self._row_blocks[slot] = list(matched)
         self._tables[slot, :] = SINK_BLOCK
@@ -2161,6 +2244,94 @@ class ContinuousEngine:
                                     priority=req.priority)
         return "admitted"
 
+    # ---- tiered KV memory (serving/kv_store.py) -----------------------
+
+    def _store_evicted(self, hash_: int) -> None:
+        """HostKVStore capacity-eviction callback: the host copy is
+        gone, retract the host-tier directory claim (device-tier
+        claims are untouched — the block may still be indexed)."""
+        if self._prefix_directory is not None:
+            self._prefix_directory.unpublish(self._replica_id, hash_,
+                                             TIER_HOST)
+
+    def _pool_index_event(self, kind: str, *, hash_: int,
+                          block: int) -> None:
+        """BlockPool index_cb: mirror device-index membership into the
+        fleet PrefixDirectory (fires under ``_pool_lock``; the
+        directory has its own lock and never re-enters the pool)."""
+        if kind == "publish":
+            self._prefix_directory.publish(self._replica_id, hash_,
+                                           TIER_HBM)
+        else:
+            self._prefix_directory.unpublish(self._replica_id, hash_,
+                                             TIER_HBM)
+
+    def _spill_block(self, block: int, hash_: int) -> None:
+        """BlockPool spill_cb: an indexed CACHED block is being
+        evicted — copy its K/V to the host tier before the block id is
+        reused.  Fires under ``_pool_lock`` on the pump thread, so
+        ``self._pk``/``self._pv`` are exactly the storage the hash
+        describes (every scatter/resize happens outside the pool
+        calls that evict).  The same ``jnp.take`` slice as the
+        prefill/decode handoff; int8 ``QuantKV`` pools spill
+        quantized, scales alongside (the tree_map carries every
+        leaf)."""
+        idx = jnp.asarray([block], jnp.int32)
+
+        def gather(x):
+            return jnp.take(x, idx, axis=1)
+
+        payload = jax.device_get({
+            "k": jax.tree_util.tree_map(gather, self._pk),
+            "v": jax.tree_util.tree_map(gather, self._pv),
+        })      # one D2H for the whole block payload
+        if self._kv_store.put(hash_, payload, self._per_block_bytes):
+            self._kv_spills += 1
+            self._kv_spill_bytes += self._per_block_bytes
+            if self._prefix_directory is not None:
+                self._prefix_directory.publish(self._replica_id, hash_,
+                                               TIER_HOST)
+
+    def _store_readmit(self, hashes, n_matched: int,
+                       max_blocks: int) -> List[int]:
+        """Extend a device-index prefix match from the host tier:
+        probe the store for the hashes PAST the device match, adopt
+        the hit chain back into the pool (all-or-nothing with
+        rollback, carried hashes republished first-writer-wins — the
+        PR 15 contract), and scatter the host payloads into the
+        device pool IMMEDIATELY, so a republished block never holds
+        garbage even if this request later blocks and releases it.
+        Returns the adopted block ids (ref=1 each, [] on miss or dry
+        pool — the store entries survive either way).  Caller holds
+        ``_pool_lock``; the caller already holds a reference on every
+        device-matched block (adoption's allocate may evict CACHED
+        blocks, and a pinned match cannot be among them)."""
+        run = self._kv_store.probe(hashes[n_matched:max_blocks])
+        if not run:
+            return []
+        chain = {"block_size": self._bs, "kv_dtype": self.kv_dtype,
+                 "n": len(run), "hashes": [h for h, _ in run]}
+        blocks = self._pool.adopt_chain(chain)
+        if blocks is None:
+            return []
+        idx = jnp.asarray(blocks, jnp.int32)
+
+        def cat(*leaves):
+            return np.concatenate(leaves, axis=1)
+
+        kcat = jax.tree_util.tree_map(cat, *[p["k"] for _, p in run])
+        vcat = jax.tree_util.tree_map(cat, *[p["v"] for _, p in run])
+
+        def scatter(d, s):
+            out = d.at[:, idx].set(jnp.asarray(s, d.dtype))
+            return jax.device_put(out, d.sharding)
+
+        self._pk = jax.tree_util.tree_map(scatter, self._pk, kcat)
+        self._pv = jax.tree_util.tree_map(scatter, self._pv, vcat)
+        self._kv_readmits += 1
+        self._kv_readmit_tokens_saved += len(blocks) * self._bs
+        return blocks
+
     def _admit_paged(self) -> int:
         """Paged admission: per request, match leading FULL prompt
         blocks in the chain-hash index (copy-free sharing), allocate
@@ -2243,6 +2414,20 @@ class ContinuousEngine:
                         continue
                     for b in matched:
                         self._pool.acquire(b)
+                    if self._kv_store is not None:
+                        # tiered KV: extend the (now pinned — the
+                        # adoption below allocates, and allocation may
+                        # evict CACHED blocks, never a pinned match)
+                        # device match from the host store.  Adoption
+                        # consumes exactly the allocatable blocks the
+                        # shrunken ``need`` no longer asks for, so the
+                        # dry gate above still guarantees the allocate
+                        # loop below.  No draft tenant here: the store
+                        # refuses speculative engines at construction.
+                        matched = matched + self._store_readmit(
+                            hashes, len(matched),
+                            (plen - 1) // self._bs)
+                        need = total - len(matched)
                     blocks = list(matched)
                     for _ in range(need):
                         blocks.append(self._pool.allocate())
@@ -2683,6 +2868,14 @@ class ContinuousEngine:
                 "pool_ceiling": self._pool_ceiling,
                 "handoffs_out": self._handoffs_out,
                 "handoffs_in": self._handoffs_in,
+                "kv_spills": self._kv_spills,
+                "kv_spill_bytes": self._kv_spill_bytes,
+                "kv_readmits": self._kv_readmits,
+                "kv_readmit_tokens_saved":
+                    self._kv_readmit_tokens_saved,
+                "kv_store_bytes": (self._kv_store.occupancy_bytes
+                                   if self._kv_store is not None
+                                   else 0),
             })
         return out
 
@@ -2975,6 +3168,10 @@ class ContinuousEngine:
                                         self._handoffs_out)
             rec["handoffs_in"] = delta("handoffs_in",
                                        self._handoffs_in)
+            # schema v3: host-tier traffic per tick (tiered KV memory)
+            rec["kv_spills"] = delta("kv_spills", self._kv_spills)
+            rec["kv_readmits"] = delta("kv_readmits",
+                                       self._kv_readmits)
             fails = delta("alloc_fail", af) \
                 + delta("draft_alloc_fail", daf)
             rec["alloc_failures"] = fails

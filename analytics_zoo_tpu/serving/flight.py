@@ -86,7 +86,13 @@ __all__ = [
 #: and prefill/decode handoffs are visible on the flight timeline.
 #: The reader backfills ``n_blocks`` for v1 bundles (static pools:
 #: free + used + sink), so v1 replays unchanged.
-FLIGHT_SCHEMA_VERSION = 2
+#:
+#: v3: paged ticks additionally record per-tick ``kv_spills`` /
+#: ``kv_readmits`` deltas (tiered KV memory, serving/kv_store.py) so
+#: host-tier traffic is visible on the flight timeline.  The replayer
+#: keeps accepting v1/v2 (the new fields are diagnostic-only — replay
+#: does not consume them, so nothing is backfilled).
+FLIGHT_SCHEMA_VERSION = 3
 
 # ---------------------------------------------------------------------------
 # request-id correlation

@@ -143,7 +143,9 @@ class BlockPool:
                  event_cb: Optional[Callable[..., None]] = None,
                  name: str = "target",
                  kv_dtype: str = "bf16",
-                 bytes_per_block: Optional[int] = None):
+                 bytes_per_block: Optional[int] = None,
+                 spill_cb: Optional[Callable[[int, int], None]] = None,
+                 index_cb: Optional[Callable[..., None]] = None):
         if n_blocks < 2:
             raise ValueError(
                 f"n_blocks must be >= 2 (block 0 is the sink), got "
@@ -174,6 +176,18 @@ class BlockPool:
         # record (the engine wires Telemetry.pool_event), never call
         # back into this pool.
         self.event_cb = event_cb
+        # tiered-KV hooks (serving/kv_store.py; both default None =
+        # tier off, zero behavior change).  ``spill_cb(block, hash)``
+        # fires when a CACHED block is evicted — the one moment its
+        # K/V is intact, unreferenced, and about to become garbage —
+        # giving the engine a last chance to copy it to the host
+        # store before the block id is reused.  ``index_cb(kind,
+        # hash_, block)`` mirrors index membership ("publish" /
+        # "unpublish") into the fleet PrefixDirectory.  Same contract
+        # as event_cb: called under the caller's pool lock, must only
+        # record, never re-enter this pool.
+        self.spill_cb = spill_cb
+        self.index_cb = index_cb
         self._free: deque = deque(range(1, self.n_blocks))
         self._ref: Dict[int, int] = {}
         self._hash_of: Dict[int, int] = {}     # block -> published hash
@@ -203,9 +217,12 @@ class BlockPool:
         references — call :meth:`acquire` on each returned block while
         still holding the engine lock, or another admission could
         evict them out from under you."""
-        self.prefix_queries += len(hashes)
         if not self.enable_prefix_cache:
+            # the index was never consulted: counting these as queries
+            # would drag the reported hit rate toward zero on a pool
+            # that has prefix caching switched off
             return []
+        self.prefix_queries += len(hashes)
         out: List[int] = []
         for h in hashes:
             blk = self._index.get(h)
@@ -237,6 +254,13 @@ class BlockPool:
             h = self._hash_of.pop(blk)
             del self._index[h]
             self.evictions += 1
+            # spill window: the block is unreferenced, unindexed, and
+            # its K/V is still intact on device — the engine copies it
+            # to the host tier here, before the id is reused below
+            if self.spill_cb is not None:
+                self.spill_cb(blk, h)
+            if self.index_cb is not None:
+                self.index_cb("unpublish", hash_=h, block=blk)
             if self.event_cb is not None:
                 self.event_cb("eviction", block=blk, tenant=self.name)
         else:
@@ -285,6 +309,8 @@ class BlockPool:
             return
         self._index[hash_] = block
         self._hash_of[block] = hash_
+        if self.index_cb is not None:
+            self.index_cb("publish", hash_=hash_, block=block)
 
     # -- prefill/decode handoff (docs/serving_memory.md) ---------------
 
@@ -392,6 +418,13 @@ class BlockPool:
                 h = self._hash_of.pop(b)
                 del self._index[h]
                 self.evictions += 1
+                # same spill window as allocate(): intact K/V about to
+                # vanish — the caller slices the arena only after
+                # shrink returns, so the device copy is still readable
+                if self.spill_cb is not None:
+                    self.spill_cb(b, h)
+                if self.index_cb is not None:
+                    self.index_cb("unpublish", hash_=h, block=b)
                 if self.event_cb is not None:
                     self.event_cb("eviction", block=b, tenant=self.name)
             else:

@@ -29,7 +29,9 @@ from):
   deficit/stride admission order (``WeightedWaitQueue.popleft``).
 * ``route_request`` — multi-replica placement (the ``ClusterServing``
   router thread, ``n_replicas > 1``): role match first (prefill/decode
-  disaggregation, constant when no replica carries a role), then pool
+  disaggregation, constant when no replica carries a role), then
+  prefix locality (deepest cached-prefix reuse per the fleet
+  PrefixDirectory, constant when no directory runs), then pool
   pressure, then per-class SLO goodput, then least-loaded with a
   deterministic round-robin cursor tie-break.
 * ``plan_pool_resize`` — the elastic-pool step
@@ -54,7 +56,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 #: behavior changes.  The simulator stamps it into every event log so
 #: a golden-trace mismatch distinguishes "policy changed" from "sim
 #: drifted".
-SCHEDULER_POLICY_VERSION = 2
+SCHEDULER_POLICY_VERSION = 3
 
 #: Priority classes, best-first.  The wire encodes a priority as its
 #: index in this tuple (the input queue transports ints, not strings);
@@ -149,7 +151,15 @@ class ReplicaSignals:
     a replica that served nothing yet must not read as degraded).
     ``role`` is the replica's disaggregation specialization
     (``"prefill"`` / ``"decode"`` / ``None`` = symmetric, takes
-    either phase)."""
+    either phase).
+    ``prefix_blocks`` is THIS request's estimated reuse depth on the
+    replica — leading prompt blocks the fleet ``PrefixDirectory``
+    says it already holds (HBM index or host KV store), i.e. blocks
+    it would not re-prefill.  Per-request, unlike every other field:
+    the router fills it from ``PrefixDirectory.match_depths`` after
+    snapshotting the rest.  0 (the default, and always when no
+    directory runs) keeps ranks bit-identical to the locality-blind
+    router."""
 
     replica: int
     live: bool = True
@@ -158,6 +168,7 @@ class ReplicaSignals:
     alloc_fail_streak: int = 0
     goodput: Optional[Dict[str, float]] = None
     role: Optional[str] = None
+    prefix_blocks: int = 0
 
 
 def replica_pressured(sig: ReplicaSignals,
@@ -205,12 +216,22 @@ def route_request(replicas: Sequence[ReplicaSignals],
        rather than failing, and with no roles configured anywhere the
        term is constant, leaving ranks bit-identical to the symmetric
        router;
-    1. not pool-pressured (``replica_pressured``) — a dry pool means
+    1. deepest ``prefix_blocks`` (prefix locality, tiered-KV fleets):
+       the replica already holding the most leading prompt blocks —
+       device index or host store — skips that much re-prefill, which
+       dwarfs a few queue positions.  Locality sits BELOW role match
+       (a disaggregated prefill replica is still the right place to
+       prefill even when a decode replica holds the prefix) and ABOVE
+       pool pressure (the reuse frees more blocks than the pressured
+       admission would need).  With no directory every signal carries
+       the 0 default, the term is constant, and ranks are
+       bit-identical to the locality-blind router;
+    2. not pool-pressured (``replica_pressured``) — a dry pool means
        admission would preempt or stall, so pressure outranks depth;
-    2. not SLO-degraded FOR THIS CLASS (``replica_degraded``) — a
+    3. not SLO-degraded FOR THIS CLASS (``replica_degraded``) — a
        replica failing interactive targets still takes batch work;
-    3. least ``queue_depth`` (least-loaded);
-    4. round-robin distance from ``rr_cursor`` — the DETERMINISTIC
+    4. least ``queue_depth`` (least-loaded);
+    5. round-robin distance from ``rr_cursor`` — the DETERMINISTIC
        tie-break: equal replicas take turns as the caller advances the
        cursor per routed request, never a coin flip.
 
@@ -225,6 +246,7 @@ def route_request(replicas: Sequence[ReplicaSignals],
         mismatch = (phase is not None and r.role is not None
                     and r.role != phase)
         return (mismatch,
+                -r.prefix_blocks,
                 replica_pressured(r, min_allocatable),
                 replica_degraded(r, priority, goodput_floor),
                 r.queue_depth,

@@ -36,6 +36,8 @@ from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
                                                  TokenEmitter,
                                                  decode_priority,
                                                  decode_str_field)
+from analytics_zoo_tpu.serving.kv_store import PrefixDirectory
+from analytics_zoo_tpu.serving.paged_cache import chain_hashes
 from analytics_zoo_tpu.serving.policy import (REPLICA_ROLES,
                                                 ReplicaSignals,
                                                 route_request)
@@ -109,6 +111,19 @@ class ServingConfig:
     # pressure and per-class goodput (policy.plan_pool_resize).  Off =
     # static pools, bit-identical to previous releases.
     engine_elastic_pool: bool = False
+    # Tiered KV memory (serving/kv_store.py, docs/serving_memory.md
+    # "Tiered KV memory"): a host-RAM second tier per paged engine —
+    # evicted prefix chains spill there and re-admit at admission as a
+    # host->HBM copy instead of a re-prefill.  0 = tier off,
+    # bit-identical to single-tier serving.
+    engine_kv_host_store_bytes: int = 0
+    # Fleet-wide prefix index: every replica publishes which chain
+    # hashes it holds (HBM index or host store) into one shared
+    # PrefixDirectory, and the router ranks candidates by estimated
+    # reuse depth (the prefix-locality term of route_request, between
+    # role match and pool pressure).  Off = locality-blind routing,
+    # bit-identical ranks.
+    prefix_directory: bool = False
     eos_id: Optional[int] = None
     # tokens decoded per device call: >1 trades admission-latency
     # granularity for fewer host round-trips (tunneled-device win)
@@ -231,6 +246,11 @@ class ServingConfig:
         if "engine_elastic_pool" in params:
             cfg.engine_elastic_pool = bool(
                 params["engine_elastic_pool"])
+        if "engine_kv_host_store_bytes" in params:
+            cfg.engine_kv_host_store_bytes = int(
+                params["engine_kv_host_store_bytes"])
+        if "prefix_directory" in params:
+            cfg.prefix_directory = bool(params["prefix_directory"])
         if "eos_id" in params:
             cfg.eos_id = int(params["eos_id"])
         if "engine_ticks" in params:
@@ -416,6 +436,28 @@ class ClusterServing:
             raise ValueError(
                 "engine_elastic_pool requires engine_paged: true — "
                 "the arena has no block pool to resize")
+        # tiered KV memory (serving/kv_store.py): validated eagerly
+        # like the knobs above
+        if getattr(self.config, "engine_kv_host_store_bytes", 0) > 0 \
+                and not self.config.engine_paged:
+            raise ValueError(
+                "engine_kv_host_store_bytes requires engine_paged: "
+                "true — the host tier spills and re-admits KV block "
+                "chains")
+        if getattr(self.config, "prefix_directory", False):
+            if not self.config.engine_paged:
+                raise ValueError(
+                    "prefix_directory requires engine_paged: true — "
+                    "the directory indexes KV block chain hashes")
+            if not self.config.continuous_batching:
+                raise ValueError(
+                    "prefix_directory requires continuous_batching: "
+                    "true — only continuous engines publish prefix "
+                    "residency")
+        self._prefix_directory = (
+            PrefixDirectory()
+            if getattr(self.config, "prefix_directory", False)
+            else None)
         # disaggregation counters (under _rq_cond like the router's
         # other placement state)
         self._role_handoffs = 0
@@ -661,6 +703,10 @@ class ClusterServing:
                 tick_token_budget=self.config.engine_tick_token_budget,
                 speculation_k=self.config.engine_speculation_k,
                 elastic_pool=self.config.engine_elastic_pool,
+                kv_host_store_bytes=getattr(
+                    self.config, "engine_kv_host_store_bytes", 0),
+                prefix_directory=self._prefix_directory,
+                replica_id=r,
                 telemetry=self.telemetries[r],
                 qos=qos,
                 flight=self.flights[r],
@@ -1344,6 +1390,34 @@ class ClusterServing:
                 priority = None
         sigs = [self.replica_signals(r)
                 for r in range(self.n_replicas)]
+        if self._prefix_directory is not None:
+            # prefix locality: hash the prompt's full blocks exactly
+            # like paged admission will and ask the fleet directory
+            # which replica already holds the deepest leading run
+            # (HBM index or host store).  Advisory only — a failed
+            # decode leaves prefix_blocks at 0, never blocks routing.
+            try:
+                pcol = self.config.prompt_col or "prompt"
+                if pcol in fields:
+                    toks = np.asarray(self._decode_value(
+                        fields[pcol])).reshape(-1)
+                    bs = self.config.engine_block_size
+                    # admission caps the usable match at (plen-1)//bs
+                    # blocks (the last prompt token always recomputes)
+                    hashes = chain_hashes(
+                        [int(t) for t in toks],
+                        bs)[: max(0, (len(toks) - 1) // bs)]
+                    if hashes:
+                        depths = self._prefix_directory.match_depths(
+                            hashes)
+                        sigs = [dataclasses.replace(
+                                    s, prefix_blocks=depths.get(
+                                        s.replica, 0))
+                                for s in sigs]
+            except Exception:
+                logger.exception(
+                    "prefix-locality probe failed; routing "
+                    "locality-blind")
         # a NEW request always enters at its prefill phase; without
         # replica_roles every signal's role is None and the rank is
         # bit-identical to role-less routing

@@ -63,7 +63,9 @@ def _build_trace(spec: Dict[str, Any], seed: int):
     kw = dict(prompt_len=spec.get("prompt_len", (16, 256)),
               gen_len=spec.get("gen_len", (8, 64)),
               class_mix=spec.get("classes"),
-              tenants=spec.get("tenants", ("",)))
+              tenants=spec.get("tenants", ("",)),
+              prefixes=spec.get("prefixes"),
+              prefix_frac=float(spec.get("prefix_frac", 0.0)))
     if kind == "poisson":
         return poisson_trace(n_requests=int(spec["n_requests"]),
                              rate_rps=float(spec["rate_rps"]),
@@ -136,6 +138,13 @@ def run_scenario(doc: Dict[str, Any],
     out["ticks"] = model.ticks
     out["preemptions"] = model.preemptions
     out["prefill_stall_ticks"] = model.prefill_stall_ticks
+    if model._prefix_on:
+        # tiered-KV counters, present only when the tier is on (see
+        # FleetModel.summary — same key-stability contract)
+        out["kv_spills"] = model.kv_spills
+        out["kv_readmits"] = model.kv_readmits
+        out["kv_readmit_tokens_saved"] = model.kv_readmit_tokens_saved
+        out["recompute_tokens_saved"] = model.recompute_tokens_saved
     if record_events:
         out["event_log_lines"] = model.event_log_lines()
     return out

@@ -7,7 +7,11 @@ rank tuple the live ``ClusterServing`` router evaluates — and models
 the prefill/decode KV-handoff path (docs/serving_memory.md):
 
 * arrivals route with ``phase="prefill"`` (when roles are configured),
-  so prefill-heavy replicas take new prompts first;
+  so prefill-heavy replicas take new prompts first; when replicas run
+  the tiered KV model (``EngineConfig.prefix_cache_blocks``), each
+  arrival's ``ReplicaSignals.prefix_blocks`` is filled from per-replica
+  tier residency — the sim's ``PrefixDirectory`` — so the same
+  locality rank term steers repeat prefixes back to their KV;
 * a prefill replica exports a row at its FIRST token
   (``EngineModel.handoff_cb`` — the sim's
   ``ContinuousEngine._handoff_slot``), the fleet routes the handoff
@@ -82,9 +86,21 @@ class FleetModel:
 
     # -- routing --------------------------------------------------------
 
-    def _signals(self) -> List[ReplicaSignals]:
+    def _signals(self, request=None) -> List[ReplicaSignals]:
+        """Fabricate per-replica signals; when ``request`` carries a
+        shared prefix, fill the per-request ``prefix_blocks`` rank
+        input from each replica's tier residency — the sim's
+        ``PrefixDirectory.match_depths`` (the live router fills it the
+        same way, so ``route_request`` sees identical inputs)."""
         sigs = []
         for i, e in enumerate(self.engines):
+            pb = 0
+            if request is not None and getattr(request, "prefix_id", ""):
+                cap = (min(int(request.prefix_len),
+                           int(request.prompt_len) - 1)
+                       // e.config.block_size)
+                pb = min(e.prefix_resident_blocks(request.prefix_id),
+                         max(0, cap))
             sigs.append(ReplicaSignals(
                 replica=i, live=True,
                 queue_depth=len(e._waiting) + e.n_active
@@ -92,13 +108,15 @@ class FleetModel:
                 allocatable_blocks=(e._pool.allocatable()
                                     if e._pool is not None else None),
                 role=(self.roles[i] if self.roles is not None
-                      else None)))
+                      else None),
+                prefix_blocks=pb))
         return sigs
 
     def _route(self, priority: Optional[str],
-               phase: Optional[str]) -> int:
+               phase: Optional[str], request=None) -> int:
         r = scheduler_policy.route_request(
-            self._signals(), priority=priority, rr_cursor=self._rr,
+            self._signals(request), priority=priority,
+            rr_cursor=self._rr,
             phase=phase if self.roles is not None else None)
         self._rr = (self._rr + 1) % len(self.engines)
         return r
@@ -159,7 +177,9 @@ class FleetModel:
                     frontier is None
                     or pending[p].arrival_t <= frontier):
                 r = pending[p]
-                dst = self._route(r.priority, "prefill")
+                # arrivals route prefix-locality-aware (handoffs stay
+                # locality-blind, like the live broker's rebalance)
+                dst = self._route(r.priority, "prefill", request=r)
                 self.routed[dst] += 1
                 self._deliver(dst, r.arrival_t, r, None)
                 p += 1
@@ -211,4 +231,15 @@ class FleetModel:
                                       for e in self.engines)
         out["routed"] = list(self.routed)
         out["per_replica_ticks"] = [e.ticks for e in self.engines]
+        if any(e._prefix_on for e in self.engines):
+            # tiered-KV sums, present only when a replica runs the
+            # tier — tier-off summaries stay key-identical to previous
+            # releases (golden envelopes pin on them)
+            out["kv_spills"] = sum(e.kv_spills for e in self.engines)
+            out["kv_readmits"] = sum(e.kv_readmits
+                                     for e in self.engines)
+            out["kv_readmit_tokens_saved"] = sum(
+                e.kv_readmit_tokens_saved for e in self.engines)
+            out["recompute_tokens_saved"] = sum(
+                e.recompute_tokens_saved for e in self.engines)
         return out

@@ -28,7 +28,15 @@ What is modelled exactly (same code path, same order):
 
 What is approximated (documented in docs/simulation.md):
 
-* no prefix cache — every admission matches zero blocks;
+* the prefix cache is modelled at prefix-ID granularity, not block
+  hashes: ``prefix_cache_blocks`` reserves a device-tier LRU region
+  (outside ``n_blocks``) and ``host_store_blocks`` a host-tier LRU
+  behind it; a tagged request (``Request.prefix_id``) matches its
+  shared prefix's resident depth, reducing both its block need and —
+  in chunked mode — its prefill work (``fill_pos`` starts past the
+  matched blocks).  Residency publishes at admission, not at fill
+  completion.  Both knobs default 0 = the historical no-prefix-cache
+  model, bit-identical event logs included;
 * non-chunked admission prefills monolithically at admission time and
   emits the first token there (the engine's grouped-prefill batching
   is a latency detail below the model's resolution);
@@ -46,7 +54,7 @@ produce byte-identical event logs (``event_log_lines``).
 import json
 import math
 import random
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -195,6 +203,13 @@ class EngineConfig:
     n_blocks: Optional[int] = None
     draft_n_blocks: Optional[int] = None
     spec_k: int = 0             # 0 = no draft model
+    # tiered KV memory (serving/kv_store.py): a device-tier prefix
+    # cache of ``prefix_cache_blocks`` blocks (reserved OUTSIDE
+    # ``n_blocks`` — pool pressure and prefix residency are separate
+    # modelled choices) with an optional ``host_store_blocks`` host
+    # tier behind it.  0/0 = tier off, the historical model.
+    prefix_cache_blocks: int = 0
+    host_store_blocks: int = 0
 
     def __post_init__(self):
         self.prompt_buckets = tuple(sorted(int(b)
@@ -208,6 +223,21 @@ class EngineConfig:
             raise ValueError("paged=True needs n_blocks")
         if self.spec_k > 0 and self.paged and self.draft_n_blocks is None:
             self.draft_n_blocks = self.n_blocks
+        if self.prefix_cache_blocks < 0 or self.host_store_blocks < 0:
+            raise ValueError("tier sizes must be >= 0")
+        if self.prefix_cache_blocks > 0 and not self.paged:
+            raise ValueError("prefix_cache_blocks needs paged=True")
+        if self.host_store_blocks > 0 and self.prefix_cache_blocks <= 0:
+            # the host tier is fed by device-tier evictions; without a
+            # device tier nothing ever spills into it
+            raise ValueError(
+                "host_store_blocks needs prefix_cache_blocks > 0")
+        if self.prefix_cache_blocks > 0 and self.spec_k > 0:
+            # mirror of ContinuousEngine: the tiered store refuses a
+            # draft model (two pool tenants in lockstep don't compose
+            # with shared-block offsets)
+            raise ValueError(
+                "prefix_cache_blocks does not compose with spec_k > 0")
         if self.chunked:
             per_row = self.spec_k + 1 if self.spec_k > 0 else 1
             if self.tick_token_budget is None:
@@ -266,7 +296,7 @@ class _Row:
     """A resident slot: the sim's ``_Slot``."""
 
     __slots__ = ("req", "state", "fill_pos", "emitted", "admit_seq",
-                 "blocks", "gen_len")
+                 "blocks", "shared", "gen_len")
 
     def __init__(self, req: "_SimReq", state: str, admit_seq: int):
         self.req = req
@@ -275,6 +305,9 @@ class _Row:
         self.emitted = 0
         self.admit_seq = admit_seq
         self.blocks = 0         # both tenants grow in lockstep
+        # blocks served by the prefix-cache tier, NOT held from the
+        # pool: growth targets subtract these and release ignores them
+        self.shared = 0
         self.gen_len = req.gen_len
 
     @property
@@ -294,11 +327,13 @@ class _SimReq:
     ``id()`` like the engine's ``_Req``."""
 
     __slots__ = ("uri", "prompt_len", "gen_len", "priority", "tenant",
-                 "enq_t", "handoff")
+                 "enq_t", "handoff", "prefix_id", "prefix_len")
 
     def __init__(self, r: Request, max_new_tokens: int):
         self.uri = r.uri
         self.prompt_len = int(r.prompt_len)
+        self.prefix_id = r.prefix_id
+        self.prefix_len = int(r.prefix_len)
         self.gen_len = max(1, min(int(r.gen_len), max_new_tokens))
         self.priority = r.priority if r.priority in PRIORITIES \
             else "standard"
@@ -391,6 +426,15 @@ class EngineModel:
         self._pool = _Pool(config.n_blocks) if config.paged else None
         self._dpool = (_Pool(config.draft_n_blocks)
                        if config.paged and config.spec_k > 0 else None)
+        # tiered KV memory: LRU residency at prefix-ID granularity,
+        # prefix_id -> resident blocks (see _prefix_admit)
+        self._prefix_on = config.paged and config.prefix_cache_blocks > 0
+        self._dev_prefix: "OrderedDict[str, int]" = OrderedDict()
+        self._host_prefix: "OrderedDict[str, int]" = OrderedDict()
+        self.kv_spills = 0
+        self.kv_readmits = 0
+        self.kv_readmit_tokens_saved = 0
+        self.recompute_tokens_saved = 0
 
         self.records: Dict[str, _Record] = {}
         self.events: List[Dict[str, Any]] = []
@@ -535,8 +579,12 @@ class EngineModel:
         self._ev_preempted.append(row.req.uri)
 
     def _grow_row(self, i: int, need: int) -> None:
+        # ``need`` counts TOTAL blocks for the row's context; blocks
+        # served by the prefix-cache tier are already resident outside
+        # the pool, so only the private remainder is allocated
         while (self._slots[i] is not None
-               and self._slots[i].blocks < need):
+               and (self._slots[i].blocks
+                    + self._slots[i].shared) < need):
             ok = self._pool.allocate()
             if ok and self._dpool is not None:
                 if not self._dpool.allocate():
@@ -576,6 +624,85 @@ class EngineModel:
                 continue
             self._grow_row(i, (row.fill_pos + clen - 1) // bs + 1)
 
+    # -- tiered KV memory (engine kv_store.py wiring) -------------------
+
+    def _shared_block_cap(self, req: "_SimReq") -> int:
+        """FULL leading blocks of ``req``'s shared prefix (the engine
+        caps matching at ``(plen - 1) // bs`` so the final write block
+        is always private)."""
+        return (min(int(req.prefix_len), req.prompt_len - 1)
+                // self.config.block_size)
+
+    def _prefix_peek(self, req: "_SimReq") -> int:
+        """Device-tier match depth, side-effect free.  Admission gates
+        use this exactly like the engine uses ``BlockPool.lookup``: the
+        host tier only extends the match AFTER the gates pass, so both
+        gate conservatively on the device match alone."""
+        if not self._prefix_on or not req.prefix_id:
+            return 0
+        n_shared = self._shared_block_cap(req)
+        if n_shared <= 0 or req.prefix_id not in self._dev_prefix:
+            return 0
+        return min(self._dev_prefix[req.prefix_id], n_shared)
+
+    def _prefix_admit(self, req: "_SimReq") -> int:
+        """Commit the tier transaction for an admitted request: match
+        against the device tier, fall back to a host-tier re-admission
+        (counted; the host entry stays, mirroring the engine's
+        rollback contract), then publish the request's full shared
+        depth to the device tier.  Returns matched full blocks."""
+        if not self._prefix_on or not req.prefix_id:
+            return 0
+        bs = self.config.block_size
+        n_shared = self._shared_block_cap(req)
+        if n_shared <= 0:
+            return 0
+        pid = req.prefix_id
+        if pid in self._dev_prefix:
+            matched = min(self._dev_prefix[pid], n_shared)
+        elif pid in self._host_prefix:
+            matched = min(self._host_prefix[pid], n_shared)
+            self._host_prefix.move_to_end(pid)
+            self.kv_readmits += 1
+            self.kv_readmit_tokens_saved += matched * bs
+        else:
+            matched = 0
+        self.recompute_tokens_saved += matched * bs
+        self._publish_prefix(pid, n_shared)
+        return matched
+
+    def _publish_prefix(self, pid: str, n: int) -> None:
+        """Install/refresh ``pid`` in the device tier (LRU over prefix
+        ids, capacity in blocks), spilling evictees to the host tier
+        when one is configured."""
+        self._dev_prefix[pid] = max(n, self._dev_prefix.get(pid, 0))
+        self._dev_prefix.move_to_end(pid)
+        cap = self.config.prefix_cache_blocks
+        while (self._dev_prefix
+               and sum(self._dev_prefix.values()) > cap):
+            victim, d = self._dev_prefix.popitem(last=False)
+            self._spill_prefix(victim, d)
+
+    def _spill_prefix(self, pid: str, d: int) -> None:
+        if self.config.host_store_blocks <= 0:
+            return
+        self.kv_spills += d     # the engine spills (and counts) blocks
+        self._host_prefix[pid] = max(d, self._host_prefix.get(pid, 0))
+        self._host_prefix.move_to_end(pid)
+        while (self._host_prefix
+               and (sum(self._host_prefix.values())
+                    > self.config.host_store_blocks)):
+            self._host_prefix.popitem(last=False)
+
+    def prefix_resident_blocks(self, prefix_id: str) -> int:
+        """Resident depth of ``prefix_id`` across BOTH tiers — what the
+        fleet's ``PrefixDirectory`` lookup would report for this
+        replica (``policy.ReplicaSignals.prefix_blocks``)."""
+        if not self._prefix_on or not prefix_id:
+            return 0
+        return max(self._dev_prefix.get(prefix_id, 0),
+                   self._host_prefix.get(prefix_id, 0))
+
     # -- admission (engine `_admit` family) -----------------------------
 
     def _pop_waiting(self) -> Optional["_SimReq"]:
@@ -608,10 +735,16 @@ class EngineModel:
                 break
         return admitted
 
-    def _install_prefill(self, req: "_SimReq") -> None:
+    def _install_prefill(self, req: "_SimReq", shared: int = 0) -> None:
         slot = self._free.popleft()
         row = _Row(req, "PREFILLING", self._admit_seq)
         self._admit_seq += 1
+        if shared:
+            # matched prefix blocks are already filled: prefill starts
+            # past them (this is where recompute savings become real
+            # work saved — chunked billing never sees those tokens)
+            row.shared = shared
+            row.fill_pos = shared * self.config.block_size
         self._slots[slot] = row
         self._record_admit(req)
 
@@ -622,8 +755,7 @@ class EngineModel:
     def _admit_one_chunked_paged(self, req: "_SimReq") -> str:
         bs = self.config.block_size
         plen = req.prompt_len
-        # no prefix cache in the model: matched == 0, need == total
-        need = -(-plen // bs)
+        need = -(-plen // bs) - self._prefix_peek(req)
         cap = self._pool.n_blocks - 1
         if self._dpool is not None:
             cap = min(cap, self._dpool.n_blocks - 1)
@@ -637,7 +769,9 @@ class EngineModel:
                 self._drop(req, "pool_dry_no_residents")
                 return "error"
             return "blocked"
-        self._install_prefill(req)
+        # commit the tier transaction only once the gates pass — a
+        # blocked request requeues and must not double-count readmits
+        self._install_prefill(req, self._prefix_admit(req))
         return "admitted"
 
     def _admit_adopted(self, req: "_SimReq") -> str:
@@ -700,7 +834,8 @@ class EngineModel:
                 continue
             if self.config.paged:
                 bs = self.config.block_size
-                need = -(-req.prompt_len // bs) + 1
+                need = -(-req.prompt_len // bs) + 1 \
+                    - self._prefix_peek(req)
                 cap = self._pool.n_blocks - 1
                 if self._dpool is not None:
                     cap = min(cap, self._dpool.n_blocks - 1)
@@ -716,6 +851,10 @@ class EngineModel:
                         continue
                     self._requeue_front(req)
                     break
+                # gates passed: commit the tier transaction (the host
+                # tier may extend the match, so recompute need)
+                shared = self._prefix_admit(req)
+                need = -(-req.prompt_len // bs) + 1 - shared
             slot = self._free.popleft()
             row = _Row(req, "DECODE", self._admit_seq)
             self._admit_seq += 1
@@ -723,6 +862,7 @@ class EngineModel:
             self._slots[slot] = row
             if self.config.paged:
                 row.blocks = need
+                row.shared = shared
                 self._pool.free -= need
                 if self._dpool is not None:
                     self._dpool.free -= need
@@ -794,6 +934,12 @@ class EngineModel:
             ev["free_blocks"] = self._pool.allocatable()
             if self._dpool is not None:
                 ev["draft_free_blocks"] = self._dpool.allocatable()
+        if self._prefix_on:
+            # cumulative, like the flight recorder's v3 counters; only
+            # tiered configs emit them so tier-off logs stay
+            # byte-identical to previous releases
+            ev["kv_spills"] = self.kv_spills
+            ev["kv_readmits"] = self.kv_readmits
         self.events.append(ev)
 
     # Emissions are decided during the tick but land at its END (see

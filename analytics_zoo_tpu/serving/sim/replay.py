@@ -44,7 +44,7 @@ __all__ = ["SUPPORTED_SCHEMA_VERSIONS", "SchemaVersionError",
 #: Flight/bundle schema versions this simulator understands.  Must
 #: track ``serving/flight.py::FLIGHT_SCHEMA_VERSION`` — pinned against
 #: it by tests/test_sim.py (this module cannot import flight.py: numpy).
-SUPPORTED_SCHEMA_VERSIONS: Tuple[int, ...] = (1, 2)
+SUPPORTED_SCHEMA_VERSIONS: Tuple[int, ...] = (1, 2, 3)
 
 #: Replay cross-check tolerances (documented in docs/simulation.md).
 #: ``goodput``: absolute per-class delta between trace-derived and

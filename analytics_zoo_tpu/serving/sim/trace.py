@@ -15,6 +15,15 @@ Arrival processes:
   between ``base_rps`` and ``peak_rps`` over ``period_s``, sampled by
   thinning against the peak rate.
 
+Shared-prefix traffic (tiered-KV scenarios): ``prefixes`` names a set
+of shared system prompts and ``prefix_frac`` the fraction of requests
+that open with one; a tagged request carries ``prefix_id`` (which
+prompt) and ``prefix_len`` (its length in tokens, a leading slice of
+``prompt_len``).  Both default off, and the prefix draws happen ONLY
+when ``prefix_frac > 0`` — a prefix-free call consumes exactly the
+RNG stream it always did, so existing seeded traces (and the golden
+envelopes pinned on them) are byte-identical.
+
 Stdlib only — this module is part of the bare-box import contract of
 ``serving/sim`` (see the package docstring).
 """
@@ -41,6 +50,13 @@ class Request:
     recorded bundle, ``gen_len`` is the realized token count from the
     bundle's trace, which is exactly the "completion-length oracle"
     trick the engine-vs-sim equivalence tests use.
+
+    ``prefix_id``/``prefix_len`` tag a request that opens with a
+    shared system prompt: the first ``prefix_len`` tokens of
+    ``prompt_len`` are identical across every request carrying the
+    same ``prefix_id`` (the tiered-KV model keys residency on it).
+    ``""``/0 — the defaults, and everything a prefix-free generator
+    emits — mean no shared prefix.
     """
 
     uri: str
@@ -49,9 +65,11 @@ class Request:
     gen_len: int
     priority: Optional[str] = "standard"
     tenant: str = ""
+    prefix_id: str = ""
+    prefix_len: int = 0
 
     def to_dict(self) -> Dict[str, object]:
-        return {
+        out = {
             "uri": self.uri,
             "arrival_t": round(float(self.arrival_t), 9),
             "prompt_len": int(self.prompt_len),
@@ -59,6 +77,12 @@ class Request:
             "priority": self.priority,
             "tenant": self.tenant,
         }
+        if self.prefix_id:
+            # only tagged requests carry the fields, so prefix-free
+            # traces serialize byte-identically to previous releases
+            out["prefix_id"] = self.prefix_id
+            out["prefix_len"] = int(self.prefix_len)
+        return out
 
 
 def requests_from_dicts(rows: Sequence[Dict[str, object]]) -> List[Request]:
@@ -72,6 +96,8 @@ def requests_from_dicts(rows: Sequence[Dict[str, object]]) -> List[Request]:
             gen_len=int(row.get("gen_len", row.get("max_new", 1))),
             priority=row.get("priority", "standard"),  # type: ignore[arg-type]
             tenant=str(row.get("tenant", "")),
+            prefix_id=str(row.get("prefix_id", "")),
+            prefix_len=int(row.get("prefix_len", 0)),
         ))
     out.sort(key=lambda r: (r.arrival_t, r.uri))
     return out
@@ -92,6 +118,23 @@ def _normalize_mix(class_mix) -> List[Tuple[str, float]]:
     return [(k, w / total) for k, w in items]
 
 
+def _normalize_prefixes(prefixes) -> List[Tuple[str, int]]:
+    """``prefixes`` as an explicit (id, length) list.  Accepts a dict
+    (``{"sysA": 128}``, insertion-ordered like the class mix) or a
+    sequence of (id, length) pairs."""
+    if isinstance(prefixes, dict):
+        items = [(str(k), int(v)) for k, v in prefixes.items()]
+    else:
+        items = [(str(k), int(v)) for k, v in prefixes]
+    if not items:
+        raise ValueError("prefixes must name at least one shared prefix")
+    for k, n in items:
+        if n < 1:
+            raise ValueError(f"prefix {k!r} needs a positive length, "
+                             f"got {n}")
+    return items
+
+
 def _pick(rng: random.Random, items: List[Tuple[str, float]]) -> str:
     x = rng.random()
     acc = 0.0
@@ -103,16 +146,35 @@ def _pick(rng: random.Random, items: List[Tuple[str, float]]) -> str:
 
 
 def _body(rng: random.Random, i: int, t: float, prompt_len, gen_len,
-          mix, tenants: Sequence[str]) -> Request:
+          mix, tenants: Sequence[str],
+          prefixes: Optional[List[Tuple[str, int]]] = None,
+          prefix_frac: float = 0.0) -> Request:
     plo, phi = int(prompt_len[0]), int(prompt_len[-1])
     glo, ghi = int(gen_len[0]), int(gen_len[-1])
+    plen = rng.randint(plo, phi)
+    glen = rng.randint(glo, ghi)
+    priority = _pick(rng, mix)
+    tenant = rng.choice(list(tenants)) if tenants else ""
+    prefix_id, prefix_len = "", 0
+    if prefixes is not None and prefix_frac > 0.0:
+        # the prefix draws run ONLY on this branch: prefix-free calls
+        # consume the exact RNG stream previous releases did, keeping
+        # every existing seeded trace byte-identical
+        if rng.random() < prefix_frac:
+            prefix_id, prefix_len = rng.choice(prefixes)
+            if plen <= prefix_len:
+                # the shared prefix is a LEADING slice; leave at least
+                # one private token so admission always has work
+                plen = prefix_len + 1
     return Request(
         uri="req-%06d" % i,
         arrival_t=t,
-        prompt_len=rng.randint(plo, phi),
-        gen_len=rng.randint(glo, ghi),
-        priority=_pick(rng, mix),
-        tenant=rng.choice(list(tenants)) if tenants else "",
+        prompt_len=plen,
+        gen_len=glen,
+        priority=priority,
+        tenant=tenant,
+        prefix_id=prefix_id,
+        prefix_len=prefix_len,
     )
 
 
@@ -120,17 +182,21 @@ def poisson_trace(*, n_requests: int, rate_rps: float, seed: int,
                   prompt_len: Sequence[int] = (16, 256),
                   gen_len: Sequence[int] = (8, 64),
                   class_mix=None,
-                  tenants: Sequence[str] = ("",)) -> List[Request]:
+                  tenants: Sequence[str] = ("",),
+                  prefixes=None,
+                  prefix_frac: float = 0.0) -> List[Request]:
     """Homogeneous Poisson arrivals: exponential gaps at ``rate_rps``."""
     if rate_rps <= 0:
         raise ValueError("rate_rps must be positive")
     rng = random.Random(seed)
     mix = _normalize_mix(class_mix)
+    pfx = _normalize_prefixes(prefixes) if prefixes is not None else None
     t = 0.0
     out = []
     for i in range(int(n_requests)):
         t += rng.expovariate(rate_rps)
-        out.append(_body(rng, i, t, prompt_len, gen_len, mix, tenants))
+        out.append(_body(rng, i, t, prompt_len, gen_len, mix, tenants,
+                         pfx, prefix_frac))
     return out
 
 
@@ -139,7 +205,9 @@ def diurnal_trace(*, n_requests: int, base_rps: float, peak_rps: float,
                   prompt_len: Sequence[int] = (16, 256),
                   gen_len: Sequence[int] = (8, 64),
                   class_mix=None,
-                  tenants: Sequence[str] = ("",)) -> List[Request]:
+                  tenants: Sequence[str] = ("",),
+                  prefixes=None,
+                  prefix_frac: float = 0.0) -> List[Request]:
     """Sinusoidal-rate Poisson arrivals sampled by thinning.
 
     Instantaneous rate at time ``t``::
@@ -155,6 +223,7 @@ def diurnal_trace(*, n_requests: int, base_rps: float, peak_rps: float,
         raise ValueError("period_s must be positive")
     rng = random.Random(seed)
     mix = _normalize_mix(class_mix)
+    pfx = _normalize_prefixes(prefixes) if prefixes is not None else None
     t = 0.0
     out = []
     i = 0
@@ -163,6 +232,7 @@ def diurnal_trace(*, n_requests: int, base_rps: float, peak_rps: float,
         rate = base_rps + (peak_rps - base_rps) * (
             1.0 - math.cos(2.0 * math.pi * t / period_s)) / 2.0
         if rng.random() * peak_rps < rate:
-            out.append(_body(rng, i, t, prompt_len, gen_len, mix, tenants))
+            out.append(_body(rng, i, t, prompt_len, gen_len, mix,
+                             tenants, pfx, prefix_frac))
             i += 1
     return out

@@ -1166,6 +1166,114 @@ def run_qos_scenario(slots: int = 4, n_requests: int = 80) -> dict:
     }
 
 
+def run_tiered_scenario(slots: int = 3, n_requests: int = 60) -> dict:
+    """Tiered-KV host-store head-to-head (docs/serving_memory.md
+    "Tiered KV"): the SAME prefix-heavy diurnal workload served twice
+    at equal device KV HBM — once with the host-DRAM spill store OFF
+    (an evicted prefix chain is recomputed on its next repeat) and
+    once ON (evicted chains spill to host RAM and re-admit) — so the
+    delta is recompute bought back by the second tier, never extra
+    device memory.
+
+    The workload is the honest worst case for a device-only prefix
+    cache: more live shared system prompts than the block pool keeps
+    resident, arriving on a diurnal rate curve so repeats cluster at
+    the peaks.  Reported per pass: TTFT p50/p99 from the engine's
+    always-on telemetry, prefix hit rate, evictions; the ON pass adds
+    the kv_spill/kv_readmit counters — ``recompute_tokens_saved``
+    (the engine's ``kv_readmit_tokens_saved``) is the claim column
+    and is structurally 0 for the OFF pass.
+
+    A failed device preflight returns a structured skip record instead
+    of dying — the bench keeps its row count on a wedged tunnel."""
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, InputQueue, OutputQueue, ServingConfig)
+
+    try:
+        model = TransformerLM(vocab_size=8192, hidden_size=128,
+                              num_layers=2, num_heads=4,
+                              intermediate_size=512, max_position=128)
+        variables = model.init(jax.random.key(0),
+                               np.zeros((1, 16), np.int32))
+        im = InferenceModel(batch_buckets=(1, slots))
+        im.load_flax_generator(model, variables, max_new_tokens=12,
+                               prompt_buckets=(16, 32, 80))
+    except Exception as e:          # wedged tunnel / dead device
+        return {"model": "lm-tiered",
+                "skipped": f"device preflight failed: {e!r}"}
+
+    rng = np.random.default_rng(31)
+    n_prefixes = 6
+    PFX = 64                        # 8 full blocks per shared prefix
+    prefixes = [rng.integers(1, 8192, PFX).astype(np.int32)
+                for _ in range(n_prefixes)]
+    # prefix-heavy diurnal arrivals: the rate swings base..peak over
+    # one period; both passes replay the SAME (time, prompt) list
+    base_rps, peak_rps, period_s = 4.0, 16.0, 6.0
+    reqs = []
+    t = 0.0
+    for _ in range(n_requests):
+        rate = base_rps + (peak_rps - base_rps) * (
+            1.0 - np.cos(2.0 * np.pi * t / period_s)) / 2.0
+        t += float(rng.exponential(1.0 / rate))
+        p = prefixes[int(rng.integers(n_prefixes))]
+        suffix = rng.integers(
+            1, 8192, int(rng.integers(4, 9))).astype(np.int32)
+        reqs.append((t, np.concatenate([p, suffix])))
+
+    def one_pass(store_bytes: int) -> dict:
+        # 40 usable blocks cannot keep 6 x 8-block prefix chains
+        # resident — the pool evicts, which is the tier's feedstock
+        cfg = ServingConfig(prompt_col="tokens",
+                            continuous_batching=True,
+                            engine_slots=slots, engine_ticks=2,
+                            engine_paged=True, engine_block_size=8,
+                            engine_blocks=41, engine_chunked=True,
+                            engine_kv_host_store_bytes=store_bytes)
+        serving = ClusterServing(im, cfg, embedded_broker=True).start()
+        inq = InputQueue(port=serving.port)
+        outq = OutputQueue(port=serving.port)
+        try:
+            inq.enqueue("warm", tokens=reqs[0][1])
+            assert outq.query("warm", timeout=600) is not None
+            serving.engine.telemetry.reset_windows()
+            t0 = time.perf_counter()
+            for i, (at, toks) in enumerate(reqs):
+                now = time.perf_counter() - t0
+                if at > now:
+                    time.sleep(at - now)
+                inq.enqueue(f"t{i}", tokens=toks)
+            for i in range(len(reqs)):
+                assert outq.query(f"t{i}", timeout=600) is not None, \
+                    f"t{i} lost"
+            cache = serving.engine.cache_metrics()
+            stream = _stream_percentiles(serving.engine.telemetry)
+            return {
+                "ttft_p50_ms": stream.get("ttft_p50_ms"),
+                "ttft_p99_ms": stream.get("ttft_p99_ms"),
+                "prefix_hit_rate": round(cache["prefix_hit_rate"], 3),
+                "evictions": cache["evictions"],
+                "kv_spills": cache["kv_spills"],
+                "kv_readmits": cache["kv_readmits"],
+                "recompute_tokens_saved":
+                    cache["kv_readmit_tokens_saved"],
+            }
+        finally:
+            serving.stop()
+            inq.close()
+            outq.close()
+
+    off = one_pass(0)
+    on = one_pass(1 << 20)          # 1 MiB host tier ~= 128 blocks
+    return {"model": "lm-tiered", "requests": n_requests,
+            "prefix_tokens": PFX, "n_prefixes": n_prefixes,
+            "host_store_off": off, "host_store_on": on}
+
+
 PLAN = [("resnet18", 64, 10, 64),
         ("resnet18-int8mxu", 64, 10, 64),
         ("resnet18-int8", 64, 10, 64),
@@ -1206,6 +1314,11 @@ PLAN = [("resnet18", 64, 10, 64),
         # one broker/router, plus the tp=2 paged-vs-arena bitwise
         # parity row; clients = engine slots per replica, rpc = burst
         ("lm-scale", 4, 96, 8),
+        # tiered KV memory: host-DRAM spill store off-vs-on at equal
+        # device KV HBM on a prefix-heavy diurnal workload — the
+        # recompute_tokens_saved column is the claim; clients = engine
+        # slots, rpc = total requests
+        ("lm-tiered", 3, 60, 8),
         ("lm", 16, 10, 32), ("lm-spec", 16, 10, 32),
         ("lm", 64, 5, 32), ("lm", 1, 20, 32),
         ("mlp", 256, 50, 128), ("mlp", 64, 50, 128),
@@ -1568,6 +1681,8 @@ def _one():
         r = run_qos_scenario(slots=clients, n_requests=rpc)
     elif kind == "lm-scale":
         r = run_scale_scenario(slots=clients, n_requests=rpc)
+    elif kind == "lm-tiered":
+        r = run_tiered_scenario(slots=clients, n_requests=rpc)
     elif kind == "lm-poisson-pg":
         r = run_poisson_scenario(True, rate_per_s=clients,
                                  n_requests=rpc, slots=bs, paged=True)
@@ -2113,6 +2228,83 @@ def _smoke_disagg():
     print("DISAGG_OK")
 
 
+def _smoke_tiered():
+    """serve-smoke tiered-KV leg (docs/serving_memory.md "Tiered KV"):
+    a paged engine with a deliberately tiny block pool plus a host-DRAM
+    spill store.  A first prompt's KV chain is cached, churned out of
+    the pool by other traffic (eviction -> spill to host RAM), then the
+    SAME prompt repeats and must re-admit its chain from the store —
+    asserted on the ``zoo_engine_kv_readmit_chains_total`` counter
+    through a real /metrics scrape, not internals."""
+    import urllib.request
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, HttpFrontend, InputQueue, OutputQueue,
+        ServingConfig)
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    im = InferenceModel(batch_buckets=(1, 2))
+    im.load_flax_generator(model, variables, max_new_tokens=12,
+                           prompt_buckets=(16, 32))
+    # 12 usable blocks: one resident request needs up to 5, so cached
+    # chains are evicted (and spilled) within a few churn prompts
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=2, engine_paged=True,
+                        engine_block_size=8, engine_blocks=13,
+                        engine_kv_host_store_bytes=1 << 20)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = HttpFrontend(redis_port=serving.port, timeout=600,
+                      serving=serving).start()
+    inq = InputQueue(port=serving.port)
+    outq = OutputQueue(port=serving.port)
+    try:
+        rng = np.random.default_rng(29)
+        # the repeat prompt: 17 tokens = 2 publishable full blocks
+        repeat = rng.integers(1, 8192, 17).astype(np.int32)
+        inq.enqueue("a0", tokens=repeat)
+        assert outq.query("a0", timeout=600) is not None, "a0 lost"
+        # churn: distinct prompts roll the tiny pool over so a0's
+        # cached chain is evicted and offered to the host store
+        for i in range(4):
+            inq.enqueue(f"c{i}", tokens=rng.integers(
+                1, 8192, 24).astype(np.int32))
+            assert outq.query(f"c{i}", timeout=600) is not None, \
+                f"c{i} lost"
+        # the repeat must re-admit at least one spilled block
+        inq.enqueue("a1", tokens=repeat)
+        assert outq.query("a1", timeout=600) is not None, "a1 lost"
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{fe.port}/metrics", timeout=30
+        ).read().decode()
+        scraped = {}
+        for line in body.splitlines():
+            if line.startswith("zoo_engine_kv_"):
+                name, val = line.split()
+                scraped[name] = float(val)
+        assert scraped.get("zoo_engine_kv_spill_chains_total", 0) >= 1, \
+            scraped
+        assert scraped.get(
+            "zoo_engine_kv_readmit_chains_total", 0) >= 1, scraped
+        assert scraped.get(
+            "zoo_engine_kv_readmit_tokens_saved_total", 0) >= 8, scraped
+        print(json.dumps({"leg": "tiered", "served": 6,
+                          "kv": {k: v for k, v in sorted(
+                              scraped.items())}}))
+    finally:
+        fe.stop()
+        serving.stop()
+        inq.close()
+        outq.close()
+    print("TIERED_OK")
+
+
 def _smoke():
     """``python bench_serving.py --smoke``: the `make serve-smoke` e2e
     leg — 20 requests through the full wire protocol on the PAGED
@@ -2126,8 +2318,9 @@ def _smoke():
     ``_smoke_frontdoor``, the flight-recorder overhead bound via
     ``_smoke_flight``, the anomaly-to-bundle-to-CLI path via
     ``_smoke_anomaly``, the 2-replica router spread + graceful
-    pump-kill drain via ``_smoke_replicas``, and the prefill/decode
-    KV-handoff fleet via ``_smoke_disagg``."""
+    pump-kill drain via ``_smoke_replicas``, the prefill/decode
+    KV-handoff fleet via ``_smoke_disagg``, and the host-DRAM
+    spill-store eviction/re-admission loop via ``_smoke_tiered``."""
     r = run_poisson_scenario(True, rate_per_s=20.0, n_requests=20,
                              slots=4, prefix_mode="full", paged=True,
                              chunked=True)
@@ -2144,6 +2337,7 @@ def _smoke():
     _smoke_anomaly()
     _smoke_replicas()
     _smoke_disagg()
+    _smoke_tiered()
     print("SMOKE_OK")
 
 

@@ -1,0 +1,510 @@
+"""Tiered KV memory tests (serving/kv_store.py + the engine/sim/fleet
+wiring): HostKVStore capacity + LRU + probe semantics, PrefixDirectory
+tier bookkeeping, BlockPool spill/index hooks, the engine's
+spill->readmit round trip (greedy outputs bitwise-identical to cold
+prefill, bf16 AND int8, paged AND paged+chunked), dry-pool rollback
+leaving the store intact, the prefix-locality routing rank, flight
+schema v3, and the simulator's prefix-ID tier model."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.lm import TransformerLM, generate
+from analytics_zoo_tpu.serving.continuous import ContinuousEngine
+from analytics_zoo_tpu.serving.flight import FLIGHT_SCHEMA_VERSION
+from analytics_zoo_tpu.serving.kv_store import (HostKVStore,
+                                                PrefixDirectory,
+                                                TIER_HBM, TIER_HOST)
+from analytics_zoo_tpu.serving.paged_cache import BlockPool
+from analytics_zoo_tpu.serving.policy import (SCHEDULER_POLICY_VERSION,
+                                              ReplicaSignals,
+                                              route_request)
+from analytics_zoo_tpu.serving.sim.replay import SUPPORTED_SCHEMA_VERSIONS
+from analytics_zoo_tpu.serving.telemetry import render_prometheus
+
+
+def _tiny_lm(**kw):
+    cfg = dict(vocab_size=32, hidden_size=32, num_layers=2, num_heads=2,
+               intermediate_size=64, max_position=64, dtype=jnp.float32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = _tiny_lm()
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+def _collect(results):
+    return lambda u, t: results.__setitem__(u, np.asarray(t))
+
+
+# ---------------------------------------------------------------------------
+# HostKVStore units
+# ---------------------------------------------------------------------------
+
+def test_store_put_probe_and_lru_eviction_order():
+    """Capacity is bytes-bounded with LRU eviction, and a probe bumps
+    recency — so the entry probed most recently survives the next
+    capacity squeeze, and the untouched one dies first."""
+    dropped = []
+    st = HostKVStore(30, evict_cb=dropped.append)
+    for h in (1, 2, 3):
+        assert st.put(h, f"p{h}", 10)
+    assert len(st) == 3 and st.occupancy_bytes == 30
+    assert st.probe([1]) == [(1, "p1")]        # 1 is now most recent
+    assert st.put(4, "p4", 10)                 # squeeze: 2 is LRU front
+    assert dropped == [2] and 2 not in st
+    assert 1 in st and 3 in st and 4 in st
+    m = st.metrics()
+    assert m["store_evictions"] == 1
+    assert m["spilled_chains"] == 4 and m["spilled_bytes"] == 40
+    assert m["occupancy_bytes"] == 30
+
+
+def test_store_oversized_put_rejected_without_flushing():
+    st = HostKVStore(16)
+    assert st.put(7, "small", 8)
+    assert not st.put(8, "huge", 17)           # bigger than the tier
+    assert 7 in st and 8 not in st             # residents undisturbed
+    assert st.metrics()["store_evictions"] == 0
+    with pytest.raises(ValueError):
+        HostKVStore(0)
+
+
+def test_store_probe_returns_longest_leading_run_only():
+    """Admission can only extend an unbroken prefix: a mid-chain gap
+    truncates the run, and a leading miss returns nothing even when
+    later hashes are resident."""
+    st = HostKVStore(100)
+    for h in (10, 11, 13):                     # 12 missing
+        st.put(h, f"p{h}", 5)
+    assert [h for h, _ in st.probe([10, 11, 12, 13])] == [10, 11]
+    assert st.probe([12, 13]) == []            # leading miss: no run
+    assert st.probe([99]) == []
+    m = st.metrics()
+    assert m["probes"] == 3 and m["probe_hits"] == 1
+    # a successful probe never consumes the entries (rollback contract:
+    # adopt_chain can still fail after the probe)
+    assert len(st) == 3
+
+
+def test_store_pop_and_clear_fire_evict_cb():
+    dropped = []
+    st = HostKVStore(100, evict_cb=dropped.append)
+    st.put(1, "a", 5)
+    st.put(2, "b", 5)
+    assert st.pop(1) == "a" and st.pop(1) is None
+    st.clear()
+    assert dropped == [1, 2]
+    assert len(st) == 0 and st.occupancy_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# PrefixDirectory units
+# ---------------------------------------------------------------------------
+
+def test_directory_match_depths_walks_leading_runs():
+    d = PrefixDirectory()
+    d.publish(0, 100, TIER_HBM)
+    d.publish(0, 101, TIER_HOST)               # depth extends across tiers
+    d.publish(1, 100, TIER_HBM)
+    assert d.match_depths([100, 101]) == {0: 2, 1: 1}
+    assert d.match_depths([101]) == {0: 1}     # leading run per replica
+    assert d.match_depths([999]) == {}
+    assert d.lookup(100) == {0: TIER_HBM, 1: TIER_HBM}
+    with pytest.raises(ValueError):
+        d.publish(0, 5, "tape")
+
+
+def test_directory_tier_qualified_unpublish_is_a_no_op_cross_tier():
+    """An HBM eviction must not retract a host-store claim published a
+    moment earlier (the spill hook publishes host BEFORE the pool's
+    unpublish fires)."""
+    d = PrefixDirectory()
+    d.publish(0, 7, TIER_HOST)
+    d.unpublish(0, 7, TIER_HBM)                # wrong tier: no-op
+    assert d.lookup(7) == {0: TIER_HOST}
+    d.unpublish(0, 7, TIER_HOST)
+    assert d.lookup(7) == {}
+    d.unpublish(0, 7, TIER_HOST)               # absent: silent
+    d.publish(0, 8, TIER_HBM)
+    d.unpublish(0, 8)                          # tier=None: unconditional
+    assert d.lookup(8) == {}
+    assert d.metrics()["unpublishes"] == 2
+
+
+# ---------------------------------------------------------------------------
+# BlockPool hooks + the lookup-counting regression
+# ---------------------------------------------------------------------------
+
+def test_pool_spill_and_index_callbacks_fire_on_eviction():
+    """spill_cb sees the (block, hash) pair while the K/V is still
+    intact, strictly before the index unpublish — and insert mirrors a
+    publish.  The shrink path fires the same hooks."""
+    log = []
+    pool = BlockPool(4, 4,
+                     spill_cb=lambda b, h: log.append(("spill", b, h)),
+                     index_cb=lambda kind, *, hash_, block:
+                     log.append((kind, block, hash_)))
+    hs = pool.block_hashes([1, 2, 3, 4])
+    b = pool.allocate()
+    pool.insert(hs[0], b)
+    assert log == [("publish", b, hs[0])]
+    pool.release(b)                            # parks CACHED
+    b2 = pool.allocate()
+    b3 = pool.allocate()                       # drains the free list
+    b4 = pool.allocate()                       # pool of 3: evicts b
+    assert b4 == b and pool.evictions == 1
+    assert log[1] == ("spill", b, hs[0])
+    assert log[2] == ("unpublish", b, hs[0])
+    for blk in (b2, b3, b4):
+        pool.release(blk)
+    pool.check()
+
+    log.clear()
+    pool2 = BlockPool(6, 4,
+                      spill_cb=lambda b, h: log.append(("spill", b, h)))
+    blk = 5                                    # top id: shrinkable tail
+    got = [pool2.allocate() for _ in range(5)]
+    assert blk in got
+    pool2.insert(hs[0], blk)
+    for g in got:
+        pool2.release(g)
+    pool2.shrink(1)                            # evicts the cached tail
+    assert ("spill", blk, hs[0]) in log
+    pool2.check()
+
+
+def test_pool_disabled_prefix_cache_counts_no_queries():
+    """Regression: lookup() with enable_prefix_cache=False used to
+    count prefix_queries before the early return, dragging the
+    reported hit rate toward zero on a pool that never consults its
+    index."""
+    pool = BlockPool(4, 2, enable_prefix_cache=False)
+    hs = pool.block_hashes([1, 2, 3, 4])
+    assert pool.lookup(hs) == []
+    assert pool.prefix_queries == 0
+    assert pool.metrics()["prefix_queries"] == 0
+    on = BlockPool(4, 2)
+    assert on.lookup(hs) == []
+    assert on.prefix_queries == len(hs)        # enabled pools still count
+
+
+# ---------------------------------------------------------------------------
+# engine: knob validation + telemetry surface
+# ---------------------------------------------------------------------------
+
+def test_engine_store_knob_validation(lm):
+    model, variables = lm
+    kw = dict(max_new_tokens=4, max_slots=2, prompt_buckets=(8,))
+    with pytest.raises(ValueError, match="require"):
+        ContinuousEngine(model, variables, kv_host_store_bytes=1 << 20,
+                         **kw)                 # arena mode: no pool
+    with pytest.raises(ValueError, match=">= 0"):
+        ContinuousEngine(model, variables, paged=True, block_size=4,
+                         kv_host_store_bytes=-1, **kw)
+    with pytest.raises(ValueError, match="draft"):
+        ContinuousEngine(model, variables, paged=True, block_size=4,
+                         kv_host_store_bytes=1 << 20,
+                         draft_model=model, draft_variables=variables,
+                         speculation_k=2, **kw)
+
+
+def test_kv_gauges_always_registered_on_paged_engines(lm):
+    """The doc-drift guard needs stable names: every paged engine
+    exports the tiered-KV families, zero with the store off."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8,),
+                           paged=True, block_size=4)
+    text = render_prometheus(eng.telemetry.metrics)
+    for name in ("zoo_engine_kv_spill_chains_total",
+                 "zoo_engine_kv_spill_bytes_total",
+                 "zoo_engine_kv_readmit_chains_total",
+                 "zoo_engine_kv_readmit_tokens_saved_total",
+                 "zoo_engine_kv_store_bytes"):
+        assert name in text, name
+    m = eng.cache_metrics()
+    assert m["kv_spills"] == 0 and m["kv_readmits"] == 0
+    assert m["kv_store_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: spill -> readmit round trip (THE tentpole contract)
+# ---------------------------------------------------------------------------
+
+_PA = np.arange(1, 14, dtype=np.int32)          # 13 tokens, 3 full blocks
+_PB = np.arange(15, 28, dtype=np.int32)         # disjoint head
+_PC = np.array([2, 4, 6, 8, 10, 12, 14, 16, 18, 20, 22, 24, 26],
+               np.int32)
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("extra", [{}, {"chunked": True,
+                                        "tick_token_budget": 8}],
+                         ids=["paged", "chunked"])
+def test_engine_spill_readmit_greedy_parity(lm, kv_dtype, extra):
+    """Acceptance pin (docs/serving_memory.md § Tiered KV): run a
+    prompt cold, churn the tiny pool until its cached chain spills to
+    the host store, run the same prompt again — admission must readmit
+    the chain host->HBM and the greedy output must be bitwise-identical
+    to the cold run.  bf16 and int8 (QuantKV spills quantized), plain
+    paged and paged+chunked."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8, 16),
+                           paged=True, block_size=4, n_blocks=8,
+                           kv_dtype=kv_dtype,
+                           kv_host_store_bytes=1 << 20, **extra)
+    results = {}
+    eng.submit("a0", _PA, on_done=_collect(results))
+    eng.drain()
+    # churn: two disjoint prompts force LRU eviction of a0's cached
+    # chain — each eviction spills the indexed block to the host store
+    for uri, p in (("b", _PB), ("c", _PC)):
+        eng.submit(uri, p, on_done=_collect(results))
+        eng.drain()
+    assert eng._kv_spills >= 3                  # a0's full chain spilled
+    hs = eng._pool.block_hashes([int(t) for t in _PA])
+    assert all(h in eng._kv_store for h in hs)
+
+    eng.submit("a1", _PA, on_done=_collect(results))
+    eng.drain()
+    assert eng._kv_readmits >= 1
+    assert eng._kv_readmit_tokens_saved >= 4
+    np.testing.assert_array_equal(results["a1"], results["a0"])
+    # readmission never consumes the store copy (rollback contract)
+    assert any(h in eng._kv_store for h in hs)
+    eng._pool.check()
+    assert eng._pool.num_referenced() == 0
+    m = eng.cache_metrics()
+    assert m["kv_spills"] == eng._kv_spills
+    assert m["kv_readmits"] == eng._kv_readmits
+    assert m["kv_store_bytes"] > 0
+    if kv_dtype == "bf16" and not extra:
+        # against an f32 model, bf16 storage is bit-exact on this tiny
+        # config — pin absolute correctness too, not just cold-vs-warm
+        solo = np.asarray(generate(model, variables,
+                                   jnp.asarray(_PA[None]), 4))[0]
+        np.testing.assert_array_equal(results["a0"], solo)
+
+
+def test_engine_dry_pool_readmit_rolls_back_and_store_survives(lm):
+    """A probe hit followed by a dry-pool adoption must change nothing:
+    _store_readmit returns [] and the host copies stay resident for
+    the next attempt."""
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8, 16),
+                           paged=True, block_size=4, n_blocks=8,
+                           kv_host_store_bytes=1 << 20)
+    results = {}
+    for uri, p in (("a0", _PA), ("b", _PB), ("c", _PC)):
+        eng.submit(uri, p, on_done=_collect(results))
+        eng.drain()
+    hs = eng._pool.block_hashes([int(t) for t in _PA])
+    assert all(h in eng._kv_store for h in hs)
+    # drain the pool dry (evicting every cached block spills it, which
+    # only grows the store) so adoption cannot allocate
+    held = []
+    with eng._pool_lock:
+        while True:
+            blk = eng._pool.allocate()
+            if blk is None:
+                break
+            held.append(blk)
+        before = len(eng._kv_store)
+        readmits0 = eng._kv_readmits
+        assert eng._store_readmit(hs, 0, len(hs)) == []
+        assert eng._kv_readmits == readmits0
+        assert len(eng._kv_store) == before     # entries intact
+        assert all(h in eng._kv_store for h in hs)
+        for blk in held:
+            eng._pool.release(blk)
+        eng._pool.check()
+
+
+def test_engine_spill_publishes_host_tier_and_eviction_retracts(lm):
+    """Directory flow end to end: insert publishes HBM, eviction
+    republishes as host (spill first, then the HBM retraction — which
+    must not clobber the fresh host claim), store capacity-eviction
+    retracts the host claim."""
+    model, variables = lm
+    d = PrefixDirectory()
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8, 16),
+                           paged=True, block_size=4, n_blocks=8,
+                           kv_host_store_bytes=1 << 20,
+                           prefix_directory=d, replica_id=3)
+    results = {}
+    eng.submit("a0", _PA, on_done=_collect(results))
+    eng.drain()
+    hs = eng._pool.block_hashes([int(t) for t in _PA])
+    assert d.lookup(hs[0]) == {3: TIER_HBM}
+    for uri, p in (("b", _PB), ("c", _PC)):
+        eng.submit(uri, p, on_done=_collect(results))
+        eng.drain()
+    assert d.lookup(hs[0]) == {3: TIER_HOST}    # spilled, not forgotten
+    assert d.match_depths(hs)[3] == len(hs)
+    # store capacity-eviction retracts the host claim
+    eng._kv_store.pop(hs[0])
+    assert d.lookup(hs[0]) == {}
+
+
+# ---------------------------------------------------------------------------
+# routing: the prefix-locality rank term
+# ---------------------------------------------------------------------------
+
+def test_route_request_ranks_prefix_locality_between_role_and_pressure():
+    assert SCHEDULER_POLICY_VERSION == 3
+    # locality outranks queue depth AND pool pressure...
+    rs = [ReplicaSignals(replica=0),
+          ReplicaSignals(replica=1, prefix_blocks=3, queue_depth=5,
+                         allocatable_blocks=0)]
+    assert route_request(rs, rr_cursor=0) == 1
+    # ...but sits BELOW role match in a disaggregated fleet
+    rs = [ReplicaSignals(replica=0, role="prefill"),
+          ReplicaSignals(replica=1, role="decode", prefix_blocks=3)]
+    assert route_request(rs, phase="prefill", rr_cursor=0) == 0
+    # all-zero depths leave ranks bit-identical to the blind router
+    rs = [ReplicaSignals(replica=0, queue_depth=2),
+          ReplicaSignals(replica=1, queue_depth=1)]
+    assert route_request(rs, rr_cursor=0) == 1
+
+
+# ---------------------------------------------------------------------------
+# flight schema v3 + replay support
+# ---------------------------------------------------------------------------
+
+def test_flight_v3_ticks_carry_kv_deltas(lm):
+    assert FLIGHT_SCHEMA_VERSION == 3
+    assert SUPPORTED_SCHEMA_VERSIONS == (1, 2, 3)
+    model, variables = lm
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           max_slots=2, prompt_buckets=(8, 16),
+                           paged=True, block_size=4, n_blocks=8,
+                           kv_host_store_bytes=1 << 20)
+    results = {}
+    for uri, p in (("a0", _PA), ("b", _PB), ("c", _PC), ("a1", _PA)):
+        eng.submit(uri, p, on_done=_collect(results))
+        eng.drain()
+    ticks = [r for r in eng.flight.snapshot() if "used_blocks" in r]
+    assert ticks
+    assert all(r["schema_version"] == 3 for r in ticks)
+    assert all("kv_spills" in r and "kv_readmits" in r for r in ticks)
+    # the per-tick deltas sum back to the cumulative counters
+    assert sum(r["kv_spills"] for r in ticks) == eng._kv_spills
+    assert sum(r["kv_readmits"] for r in ticks) == eng._kv_readmits
+    assert eng._kv_spills >= 3 and eng._kv_readmits >= 1
+
+
+# ---------------------------------------------------------------------------
+# simulator: the prefix-ID tier model
+# ---------------------------------------------------------------------------
+
+def _sim_reqs(specs):
+    from analytics_zoo_tpu.serving.sim.trace import Request
+    return [Request(uri=f"r{i:02d}", arrival_t=t, prompt_len=p,
+                    gen_len=g, priority="standard",
+                    prefix_id=pid, prefix_len=pl)
+            for i, (t, p, g, pid, pl) in enumerate(specs)]
+
+
+def test_sim_engine_config_tier_validation():
+    from analytics_zoo_tpu.serving.sim.model import EngineConfig
+    with pytest.raises(ValueError, match="paged"):
+        EngineConfig(prefix_cache_blocks=4)
+    with pytest.raises(ValueError, match="prefix_cache_blocks"):
+        EngineConfig(paged=True, block_size=4, n_blocks=8,
+                     host_store_blocks=4)
+    with pytest.raises(ValueError, match="spec_k"):
+        EngineConfig(paged=True, block_size=4, n_blocks=8,
+                     prefix_cache_blocks=4, spec_k=2)
+    with pytest.raises(ValueError):
+        EngineConfig(paged=True, block_size=4, n_blocks=8,
+                     prefix_cache_blocks=-1)
+
+
+def test_sim_tier_spills_readmits_and_saves_recompute():
+    """Device tier of 2 blocks, host tier behind it: pA resident ->
+    pB evicts it to host -> pA again readmits from host.  Counters
+    mirror the engine's: spills per block, readmits per event."""
+    from analytics_zoo_tpu.serving.sim.model import (EngineConfig,
+                                                     EngineModel)
+    cfg = EngineConfig(slots=1, max_new_tokens=2, paged=True,
+                       block_size=4, n_blocks=16, prompt_buckets=(16,),
+                       prefix_cache_blocks=2, host_store_blocks=8)
+    m = EngineModel(cfg)
+    m.run(_sim_reqs([(0.0, 12, 2, "pA", 8),
+                     (10.0, 12, 2, "pB", 8),
+                     (20.0, 12, 2, "pA", 8)]))
+    assert all(r.finished for r in m.records.values())
+    # pB evicts pA's 2 blocks to host, then pA's readmitted republish
+    # evicts pB's 2 blocks in turn — spills count per block
+    assert m.kv_spills == 4
+    assert m.kv_readmits == 1                   # one readmit event
+    assert m.kv_readmit_tokens_saved == 8
+    assert m.recompute_tokens_saved == 8
+    assert m.prefix_resident_blocks("pA") == 2  # republished on readmit
+
+
+def test_sim_tier_off_ignores_tags_and_trace_rng_is_gated():
+    """Tier off: tagged requests run exactly like untagged ones (no
+    counters, no shared blocks).  And a prefix-free generator call
+    consumes the same RNG stream whether or not `prefixes` is passed —
+    pre-existing seeded traces stay byte-identical."""
+    from analytics_zoo_tpu.serving.sim.model import (EngineConfig,
+                                                     EngineModel)
+    from analytics_zoo_tpu.serving.sim.trace import diurnal_trace
+    cfg = EngineConfig(slots=1, max_new_tokens=2, paged=True,
+                       block_size=4, n_blocks=16, prompt_buckets=(16,))
+
+    def go(tagged):
+        m = EngineModel(cfg)
+        m.run(_sim_reqs([(0.0, 12, 2, "pA" if tagged else "", 8),
+                         (10.0, 12, 2, "pA" if tagged else "", 8)]))
+        return m
+
+    a, b = go(True), go(False)
+    assert a.kv_spills == a.kv_readmits == 0
+    assert a.recompute_tokens_saved == 0
+    assert json.dumps(a.events, sort_keys=True) == \
+        json.dumps(b.events, sort_keys=True)
+    assert all("kv_spills" not in e for e in a.events
+               if e.get("event") == "tick")     # v-next fields are gated
+
+    kw = dict(n_requests=20, base_rps=5.0, peak_rps=20.0, period_s=10.0,
+              seed=9, prompt_len=(8, 32), gen_len=(2, 8))
+    plain = diurnal_trace(**kw)
+    gated = diurnal_trace(prefixes={"sysA": 8}, prefix_frac=0.0, **kw)
+    assert [r.to_dict() for r in plain] == [r.to_dict() for r in gated]
+    tagged = diurnal_trace(prefixes={"sysA": 8}, prefix_frac=1.0, **kw)
+    assert all(r.prefix_id == "sysA" and r.prefix_len == 8
+               and r.prompt_len > r.prefix_len for r in tagged)
+
+
+def test_sim_fleet_routes_by_prefix_locality():
+    """A fleet with per-replica tiers concentrates a shared prefix on
+    the replica that first served it — the same rank term the live
+    router uses."""
+    from analytics_zoo_tpu.serving.sim.fleet import FleetModel
+    from analytics_zoo_tpu.serving.sim.model import EngineConfig
+    cfg = EngineConfig(slots=2, max_new_tokens=2, paged=True,
+                       block_size=4, n_blocks=16, prompt_buckets=(16,),
+                       prefix_cache_blocks=4, host_store_blocks=8)
+    fleet = FleetModel([cfg, cfg])
+    recs = fleet.run(_sim_reqs(
+        [(float(i * 5), 12, 2, "pA", 8) for i in range(8)]))
+    assert all(r.finished for r in recs.values())
+    s = fleet.summary()
+    assert max(s["routed"]) >= 7                # locality sticks
+    assert s["recompute_tokens_saved"] > 0
+    assert "kv_spills" in s and "kv_readmits" in s
