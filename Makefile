@@ -32,8 +32,10 @@ examples:
 native:
 	$(PY) -c "from analytics_zoo_tpu import native; native.load_lib(); print('native data plane:', native.available())"
 
-# JAX staging/tracing lint (rules TZ001..TZ008, docs/lint.md); exits
-# non-zero on any finding not recorded in tpulint_baseline.json
+# JAX staging/tracing lint (TZ001..TZ008) + concurrency lock-discipline
+# pass (TZ101..TZ108), docs/lint.md; exits non-zero on any finding not
+# recorded in tpulint_baseline.json, or on stale baseline entries.
+# Pass --no-concurrency to run the staging family alone.
 lint:
 	$(PY) -m analytics_zoo_tpu.lint analytics_zoo_tpu/ \
 	    --baseline tpulint_baseline.json
@@ -57,6 +59,11 @@ serve-smoke:
 	    tests/test_frontdoor.py tests/test_router.py -q -m "not slow"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_spec_composed.py \
 	    tests/test_flight.py tests/test_paged_fused.py -q
+	# LockGuard leg: live paged+chunked engine ticks (speculative and
+	# host-tier spill->readmit churn) with every lock instrumented and
+	# jax.device_get/device_put patched — zero order inversions, zero
+	# device transfers under a lock (docs/lint.md, TZ1xx runtime twin)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lockguard.py -q
 	# fresh-bundle -> replay round trip + engine/sim decision equivalence
 	# (slow-marked classes in test_sim.py run unfiltered here, like
 	# test_flight.py above; docs/simulation.md)

@@ -161,13 +161,17 @@ class InferenceModel:
                 return int8_call(model, variables, *feats, **kw)
             return model.apply(variables, *feats, **kw)
 
-        self._apply_fn = apply_fn
+        with self._compile_lock:
+            # publish the new model and drop the stale wrapper as one
+            # step: a predict() compiling concurrently must not publish
+            # a wrapper built from the OLD apply_fn over this reset
+            self._apply_fn = apply_fn
+            self._jit = None    # new model -> stale compiled wrapper
         self._pre_pad = None    # a stale generator pad hook would corrupt
         #                         plain-model inputs
         self.max_prompt_width = None    # ditto the serving bounds limit
         self.prompt_pad_id = None
         self._gen_max_new_tokens = None
-        self._jit = None        # new model -> stale compiled wrapper
         self._jit_outer = True  # ditto a stale host-loop (draft) flag
         self.spec_stats = None  # ditto stale speculative stats
         self._spec_draft = False
@@ -319,9 +323,12 @@ class InferenceModel:
                     f"usable prompt bucket {pb}")
             return prompts, lengths
 
-        self._apply_fn = apply_fn
+        with self._compile_lock:
+            # same publish discipline as load_flax: new apply_fn and
+            # wrapper reset are atomic against a concurrent compile
+            self._apply_fn = apply_fn
+            self._jit = None
         self._pre_pad = pre_pad
-        self._jit = None
         return self
 
     def make_continuous_engine(self, max_slots: int = 8,

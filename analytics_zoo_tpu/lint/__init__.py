@@ -1,16 +1,23 @@
-"""tpulint — static + runtime staging/tracing analysis for JAX code.
+"""tpulint — static + runtime staging/tracing/concurrency analysis.
 
-Static half (``analyzer``): a stdlib-``ast`` linter with JAX-specific
-rules (TZ001..TZ008) that understands which functions are traced —
-reachability from ``jax.jit``/``pjit`` seeds through a local call graph
-— so it can tell host orchestration code from staged code instead of
-flagging the whole repo.
+Static half: a stdlib-``ast`` linter with JAX-specific staging rules
+(``analyzer``, TZ001..TZ008) that understands which functions are
+traced — reachability from ``jax.jit``/``pjit`` seeds through a local
+call graph — so it can tell host orchestration code from staged code
+instead of flagging the whole repo; plus a concurrency family
+(``lockflow``, TZ101..TZ108) built on a lock-context analysis of the
+same trees: held-lock sets per statement, propagated across
+intra-module call edges, checking guarded-attribute discipline,
+blocking calls and callback purity under locks, acquisition order,
+release paths, threaded-entry-point state, and ``Condition.wait``
+loops.
 
-Runtime half (``runtime``): :func:`trace_guard`, a context manager that
-counts retraces per jitted callable via the compile-cache size and
-raises when a budget is exceeded — the dynamic complement the static
-rules cannot express ("this decode loop retraces zero times in steady
-state").
+Runtime half: :func:`trace_guard` (``runtime``) counts retraces per
+jitted callable via the compile-cache size and raises over budget;
+:func:`lock_guard` (``lockguard``) instruments ``threading`` locks to
+record acquisition order and under-lock blocking calls at test time —
+each the dynamic complement of its static family, cross-validated on
+the same fixtures.
 
 Run the CLI with ``python -m analytics_zoo_tpu.lint <paths>``.
 """
@@ -26,7 +33,17 @@ from analytics_zoo_tpu.lint.analyzer import (  # noqa: F401
 from analytics_zoo_tpu.lint.baseline import (  # noqa: F401
     apply_baseline,
     load_baseline,
+    stale_entries,
     write_baseline,
+)
+from analytics_zoo_tpu.lint.lockflow import (  # noqa: F401
+    LOCK_RULES,
+    run_lockflow,
+)
+from analytics_zoo_tpu.lint.lockguard import (  # noqa: F401
+    LockGuard,
+    LockGuardError,
+    lock_guard,
 )
 from analytics_zoo_tpu.lint.runtime import (  # noqa: F401
     RetraceError,
@@ -39,12 +56,18 @@ __all__ = [
     "DEFAULT_HOT_PATHS",
     "Finding",
     "RULES",
+    "LOCK_RULES",
     "analyze_file",
     "analyze_paths",
     "analyze_source",
     "apply_baseline",
     "load_baseline",
+    "stale_entries",
     "write_baseline",
+    "run_lockflow",
+    "LockGuard",
+    "LockGuardError",
+    "lock_guard",
     "RetraceError",
     "TraceGuard",
     "retrace_count",

@@ -48,6 +48,17 @@ RULES: Dict[str, str] = {
     "TZ006": "host RNG (`np.random`/`random`) inside traced code",
     "TZ007": "`jnp.asarray`/`jnp.array` without explicit dtype in a serving hot path",
     "TZ008": "train-step-shaped jit without `donate_argnums`",
+    # TZ1xx: concurrency family — implemented in lockflow.py, listed
+    # here so --list-rules/--select/--rules see one catalog.
+    "TZ101": "write to a lock-guarded attribute outside its owning lock",
+    "TZ102": "blocking call (device sync/sleep/IO) while holding a lock",
+    "TZ103": "callback under lock is not provably record-only",
+    "TZ104": "inconsistent lock-acquisition order (deadlock cycle)",
+    "TZ105": "double-acquire of a non-reentrant Lock",
+    "TZ106": "manually acquired lock not released on an early exit path",
+    "TZ107": "shared mutable state touched from a threaded entry point "
+             "with no lock held",
+    "TZ108": "Condition.wait without an enclosing predicate re-check loop",
 }
 
 # Files where implicit-dtype conversions (TZ007) matter: the request
@@ -934,9 +945,12 @@ def _suppressions(lines: List[str]) -> Dict[int, Set[str]]:
 
 def analyze_source(src: str, path: str,
                    hot_paths: Sequence[str] = DEFAULT_HOT_PATHS,
-                   ) -> List[Finding]:
+                   concurrency: bool = True) -> List[Finding]:
     """Analyze one module's source. ``path`` is used for reporting and
-    hot-path matching (posix-normalized substring match)."""
+    hot-path matching (posix-normalized substring match).  The
+    concurrency pass (TZ1xx, lockflow.py) runs by default; pass
+    ``concurrency=False`` (CLI ``--no-concurrency``) for staging rules
+    only."""
     posix = path.replace(os.sep, "/")
     try:
         tree = ast.parse(src, filename=path)
@@ -946,11 +960,19 @@ def analyze_source(src: str, path: str,
     lines = src.splitlines()
     index = _ModuleIndex(tree)
     hot = any(pat in posix for pat in hot_paths)
-    return _RulePass(index, path, lines, hot, _suppressions(lines)).run(tree)
+    sup = _suppressions(lines)
+    findings = _RulePass(index, path, lines, hot, sup).run(tree)
+    if concurrency:
+        # import here: lockflow imports Finding/_dotted from this module
+        from analytics_zoo_tpu.lint.lockflow import run_lockflow
+        findings.extend(run_lockflow(tree, path, lines, sup))
+        findings.sort(key=lambda x: (x.path, x.line, x.rule))
+    return findings
 
 
 def analyze_file(path: str, hot_paths: Sequence[str] = DEFAULT_HOT_PATHS,
-                 rel_to: Optional[str] = None) -> List[Finding]:
+                 rel_to: Optional[str] = None,
+                 concurrency: bool = True) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as f:
         src = f.read()
     rep = path
@@ -959,7 +981,8 @@ def analyze_file(path: str, hot_paths: Sequence[str] = DEFAULT_HOT_PATHS,
             rep = os.path.relpath(path, rel_to)
         except ValueError:
             rep = path
-    return analyze_source(src, rep.replace(os.sep, "/"), hot_paths)
+    return analyze_source(src, rep.replace(os.sep, "/"), hot_paths,
+                          concurrency=concurrency)
 
 
 def iter_py_files(paths: Iterable[str]) -> List[str]:
@@ -980,7 +1003,8 @@ def iter_py_files(paths: Iterable[str]) -> List[str]:
 
 def analyze_paths(paths: Iterable[str],
                   hot_paths: Sequence[str] = DEFAULT_HOT_PATHS,
-                  rel_to: Optional[str] = None) -> List[Finding]:
+                  rel_to: Optional[str] = None,
+                  concurrency: bool = True) -> List[Finding]:
     """Analyze files/directories; directory walks skip hidden dirs and
     ``__pycache__``.  Paths are reported relative to ``rel_to`` (default
     cwd) so baselines are stable across checkouts."""
@@ -988,6 +1012,7 @@ def analyze_paths(paths: Iterable[str],
         rel_to = os.getcwd()
     findings: List[Finding] = []
     for f in iter_py_files(paths):
-        findings.extend(analyze_file(f, hot_paths, rel_to))
+        findings.extend(analyze_file(f, hot_paths, rel_to,
+                                     concurrency=concurrency))
     findings.sort(key=lambda x: (x.path, x.line, x.rule))
     return findings

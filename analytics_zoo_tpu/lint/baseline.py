@@ -53,6 +53,23 @@ def apply_baseline(findings: Sequence[Finding], baseline: Optional[Baseline],
     return kept, suppressed
 
 
+def stale_entries(baseline: Baseline, findings: Sequence[Finding],
+                  analyzed_paths: Sequence[str]) -> List[Dict[str, str]]:
+    """Baseline entries that matched NO finding in this run even
+    though their file was analyzed — the flagged line moved enough to
+    change its text, or the finding was fixed.  Either way the entry
+    is dead weight that would silently shadow a future finding with
+    the same text, so the CLI fails on it with a pointed message
+    instead of ignoring it.  Entries for files outside the analyzed
+    set are left alone (a partial run must not flag the rest of the
+    ledger)."""
+    matched = {(f.path, f.rule, f.text) for f in findings}
+    analyzed = set(analyzed_paths)
+    return [e for e in baseline.entries
+            if e["path"] in analyzed
+            and (e["path"], e["rule"], e["text"]) not in matched]
+
+
 def write_baseline(path: str, findings: Sequence[Finding],
                    old: Optional[Baseline] = None) -> int:
     """Write all ``findings`` as the new baseline, preserving reasons

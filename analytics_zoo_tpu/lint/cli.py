@@ -1,7 +1,8 @@
 """tpulint CLI — ``python -m analytics_zoo_tpu.lint <paths>``.
 
 Exit codes: 0 clean (all findings baselined or none), 1 non-baselined
-findings, 2 parse failures (reported as TZ000 alongside any findings).
+findings or stale baseline entries, 2 parse failures (reported as
+TZ000 alongside any findings).
 """
 
 from __future__ import annotations
@@ -13,9 +14,10 @@ import sys
 from typing import List, Optional
 
 from analytics_zoo_tpu.lint.analyzer import (DEFAULT_HOT_PATHS, RULES,
-                                             analyze_paths)
+                                             analyze_paths, iter_py_files)
 from analytics_zoo_tpu.lint.baseline import (Baseline, apply_baseline,
-                                             load_baseline, write_baseline)
+                                             load_baseline, stale_entries,
+                                             write_baseline)
 
 DEFAULT_BASELINE = "tpulint_baseline.json"
 
@@ -23,7 +25,8 @@ DEFAULT_BASELINE = "tpulint_baseline.json"
 def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m analytics_zoo_tpu.lint",
-        description="JAX staging/tracing analyzer (rules TZ001..TZ008). "
+        description="JAX staging/tracing analyzer (rules TZ001..TZ008) "
+                    "+ concurrency pass (TZ101..TZ108). "
                     "See docs/lint.md for the rule catalog.")
     p.add_argument("paths", nargs="*", default=["analytics_zoo_tpu"],
                    help="files or directories to analyze "
@@ -39,6 +42,13 @@ def _parser() -> argparse.ArgumentParser:
                         "(preserving existing reasons) and exit 0")
     p.add_argument("--select", default=None, metavar="TZ001,TZ007",
                    help="comma-separated rule IDs to report (default all)")
+    p.add_argument("--rules", default=None, metavar="TZ1",
+                   help="comma-separated rule-ID PREFIXES to report "
+                        "(e.g. --rules TZ1 runs the concurrency family "
+                        "in isolation); combines with --select")
+    p.add_argument("--no-concurrency", action="store_true",
+                   help="skip the TZ1xx lock-context pass (staging "
+                        "rules only)")
     p.add_argument("--hot-path", action="append", default=None,
                    metavar="PAT", help="hot-path substring pattern for "
                    "TZ007 (repeatable; default: "
@@ -57,11 +67,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     hot = tuple(args.hot_path) if args.hot_path else DEFAULT_HOT_PATHS
-    findings = analyze_paths(args.paths, hot_paths=hot)
+    findings = analyze_paths(args.paths, hot_paths=hot,
+                             concurrency=not args.no_concurrency)
 
+    filtered = False
     if args.select:
+        filtered = True
         selected = {r.strip() for r in args.select.split(",")}
         findings = [f for f in findings if f.rule in selected]
+    if args.rules:
+        filtered = True
+        prefixes = tuple(r.strip() for r in args.rules.split(","))
+        findings = [f for f in findings if f.rule.startswith(prefixes)]
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     baseline: Optional[Baseline] = None
@@ -80,20 +97,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     kept, suppressed = apply_baseline(findings, baseline)
     parse_failures = [f for f in kept if f.rule == "TZ000"]
 
+    # stale-entry detection: an entry whose file was analyzed but whose
+    # (path, rule, text) matched nothing is dead — the line was fixed
+    # or rewritten.  Only meaningful on an unfiltered run (a --select/
+    # --rules/--no-concurrency run simply doesn't produce the family).
+    stale: List[dict] = []
+    if baseline is not None and not filtered and not args.no_concurrency:
+        rel = os.getcwd()
+        analyzed = [os.path.relpath(f, rel).replace(os.sep, "/")
+                    for f in iter_py_files(args.paths)]
+        stale = stale_entries(baseline, findings, analyzed)
+
     if args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in kept],
             "baselined": len(suppressed),
+            "stale_baseline": stale,
             "total": len(findings),
         }, indent=2))
     else:
         for f in kept:
             print(f.format())
+        for e in stale:
+            print(f"tpulint: stale baseline entry (source line moved or "
+                  f"was fixed): {e['path']}: {e['rule']} \"{e['text']}\" "
+                  f"— refresh with --write-baseline or delete the entry",
+                  file=sys.stderr)
         tail = f"tpulint: {len(kept)} finding(s)"
         if suppressed:
             tail += f", {len(suppressed)} baselined"
+        if stale:
+            tail += f", {len(stale)} STALE baseline entr" + \
+                ("y" if len(stale) == 1 else "ies")
         print(tail, file=sys.stderr)
 
     if parse_failures:
         return 2
-    return 1 if kept else 0
+    return 1 if kept or stale else 0

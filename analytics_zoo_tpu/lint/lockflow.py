@@ -1,0 +1,854 @@
+"""tpulint lockflow — concurrency rules TZ101..TZ108.
+
+The serving fleet is a deeply multithreaded system: per-replica pump
+threads, a router thread, HTTP scrape threads, elastic-resize cadence,
+and pool callbacks that fire *under the pool lock* with a documented
+"record-only" contract.  None of that is visible to the staging rules
+(TZ00x), so this module adds a lock-context analysis over the same
+stdlib-``ast`` substrate:
+
+1.  **Lock discovery.**  Locks are attributes/names assigned
+    ``threading.Lock()`` / ``RLock()`` / ``Condition()`` anywhere in
+    the module, plus lock-ish-named context managers (``*lock*``,
+    ``*cond*``) the module did not construct itself.  Identity is
+    class-scoped for ``self.X`` (``Engine._pool_lock``) so two
+    instances share one order discipline.
+2.  **Held-set tracking.**  Each function body is walked with the set
+    of locks held at every statement: ``with lock:`` regions scope
+    naturally; manual ``acquire()``/``release()`` pairs are tracked
+    linearly, with ``try/finally`` release recognised as
+    path-complete.
+3.  **Call-edge propagation.**  Held sets flow across intra-module
+    call edges (``self.meth(...)``, bare local calls, and local
+    functions passed as arguments — the ``tree_map(scatter, ...)``
+    pattern) to a fixpoint, so a helper that only ever runs under its
+    caller's lock is analyzed as such.
+
+The rules (catalog in docs/lint.md):
+
+- **TZ101** — write to a guarded attribute outside its owning lock.
+  Guarding is inferred ("assigned under lock L in at least one
+  non-init method, and L is the only such lock") or declared with a
+  ``# tpulint: guarded-by(_lock)`` comment on any write line.
+- **TZ102** — blocking call (``jax.device_get``/``device_put``,
+  ``block_until_ready``, ``.item()``, ``time.sleep``, blocking
+  ``queue.get``/thread ``join``, socket/file I/O) while holding a
+  lock.  A device sync under the pool lock stalls every thread that
+  touches the pool for a full D2H round trip.
+- **TZ103** — callback discipline: a ``*_cb``/``on_*`` callable
+  invoked while holding a lock, or a callable registered via
+  ``event_cb``/``spill_cb``/``index_cb``/``evict_cb``/``handoff_cb``
+  whose body is not record-only (acquires locks, calls jax, does
+  I/O).  Registered callables that resolve locally are checked
+  directly; a cross-module registration to a pool-side hook cannot be
+  verified and is flagged for an explicit baseline decision.
+- **TZ104** — inconsistent lock-acquisition order: the module-level
+  graph of (held A -> acquired B) edges contains a cycle.
+- **TZ105** — double-acquire of a non-reentrant ``Lock`` (directly,
+  or via a call chain whose entry context already holds it).
+- **TZ106** — a manually ``acquire()``-d lock reaches a ``return`` or
+  ``raise`` with no ``try/finally`` release on that path.
+- **TZ107** — module-level mutable state (or a class attribute)
+  mutated from a known-threaded entry point (``_pump``, ``_loop*``,
+  ``_route_loop``, HTTP ``do_*`` handlers, ``maybe_autoresize``,
+  ``threading.Thread(target=...)`` targets) with no lock held.
+- **TZ108** — ``Condition.wait`` outside a ``while`` predicate loop
+  (``wait_for`` passes; a timed wait used as a bounded nap should be
+  baselined with its justification).
+
+Like the staging rules, everything here is a static approximation:
+the escape hatches are ``# tpulint: disable=TZ10x`` for one site and
+the baseline ledger for deliberate keepers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from analytics_zoo_tpu.lint.analyzer import Finding, _dotted
+
+__all__ = ["run_lockflow", "LOCK_RULES"]
+
+LOCK_RULES: Dict[str, str] = {
+    "TZ101": "write to a lock-guarded attribute outside its owning lock",
+    "TZ102": "blocking call (device sync/sleep/IO) while holding a lock",
+    "TZ103": "callback under lock is not provably record-only",
+    "TZ104": "inconsistent lock-acquisition order (deadlock cycle)",
+    "TZ105": "double-acquire of a non-reentrant Lock",
+    "TZ106": "manually acquired lock not released on an early exit path",
+    "TZ107": "shared mutable state touched from a threaded entry point "
+             "with no lock held",
+    "TZ108": "Condition.wait without an enclosing predicate re-check loop",
+}
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock", "Lock": "lock",
+    "threading.RLock": "rlock", "RLock": "rlock",
+    "threading.Condition": "condition", "Condition": "condition",
+    "multiprocessing.Lock": "lock", "multiprocessing.RLock": "rlock",
+}
+_LOCKISH_RE = re.compile(r"(lock|mutex|cond)", re.I)
+_CONDISH_RE = re.compile(r"cond", re.I)
+_GUARDED_BY_RE = re.compile(
+    r"#\s*tpulint:\s*guarded-by\(\s*(?P<lock>[A-Za-z_][A-Za-z0-9_]*)\s*\)")
+
+# TZ102: calls that block the calling thread (or force a device
+# rendezvous).  Deliberately tight — a noisy blocking set would teach
+# people to ignore the rule.
+_BLOCKING_EXACT = {
+    "jax.device_get": "jax.device_get (D2H sync)",
+    "device_get": "device_get (D2H sync)",
+    "jax.device_put": "jax.device_put (H2D transfer)",
+    "device_put": "device_put (H2D transfer)",
+    "jax.block_until_ready": "block_until_ready (device rendezvous)",
+    "time.sleep": "time.sleep",
+    "socket.create_connection": "socket connect",
+    "urllib.request.urlopen": "url fetch",
+    "urlopen": "url fetch",
+    "subprocess.run": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.call": "subprocess",
+    "open": "file open",
+}
+_THREADISH_RE = re.compile(r"(thread|worker|pump|proc)", re.I)
+_QUEUEISH_RE = re.compile(r"(^|_)(in_?q|out_?q|q|queue|jobs|work)\d*$", re.I)
+_SOCKISH_RE = re.compile(r"(sock|conn)", re.I)
+
+# TZ103: the pool/engine hook kwargs whose callables must be
+# record-only, and the invocation-site names treated as callbacks.
+_CB_KWARGS = ("event_cb", "spill_cb", "index_cb", "evict_cb", "handoff_cb")
+# hooks documented to fire OUTSIDE any lock may register cross-module
+# callables without a baseline entry; under-lock hooks may not
+_CB_KWARGS_UNDER_LOCK = ("event_cb", "spill_cb", "index_cb", "evict_cb")
+_CB_INVOKE_NAMES = {"on_done", "on_error", "on_token", "callback", "cb"}
+# jax roots whose calls disqualify a record-only callback (tree_util
+# is host-side bookkeeping and allowed)
+_JAXISH_RE = re.compile(r"^(jax|jnp|lax)\.")
+
+_THREAD_ROOT_NAMES = {"_pump", "_route_loop", "maybe_autoresize"}
+_MUTATING_METHODS = {"append", "appendleft", "extend", "extendleft", "add",
+                     "insert", "remove", "discard", "pop", "popleft",
+                     "popitem", "clear", "update", "setdefault"}
+
+
+class _FnRec:
+    """One function/method: identity, context, and everything the walk
+    recorded about it (findings are derived after propagation)."""
+
+    def __init__(self, node: ast.AST, key: str, cls: Optional[str],
+                 parent_key: Optional[str]):
+        self.node = node
+        self.key = key              # module-unique qualname
+        self.name = node.name
+        self.cls = cls              # nearest enclosing class name
+        self.parent_key = parent_key
+        self.threaded = False
+        # records: (data..., node, held_tuple)
+        self.calls: List[Tuple[str, Tuple[str, ...]]] = []  # callee key, held
+        self.attr_writes: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        self.blocking: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        self.cb_invokes: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        self.module_writes: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        self.acquires: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+
+
+class _Lockflow:
+    def __init__(self, tree: ast.Module, path: str, lines: List[str],
+                 suppressed: Dict[int, Set[str]]):
+        self.tree = tree
+        self.path = path
+        self.lines = lines
+        self.suppressed = suppressed
+        self.findings: List[Finding] = []
+
+        self.locks: Dict[str, str] = {}          # lock id -> kind
+        self.fns: Dict[str, _FnRec] = {}
+        self.module_funcs: Dict[str, str] = {}   # bare name -> key
+        self.methods: Dict[Tuple[str, str], str] = {}
+        self.nested: Dict[Tuple[str, str], str] = {}
+        self.class_names: Set[str] = set()
+        self.module_mutables: Set[str] = set()
+        self.thread_targets: Set[str] = set()    # method/function names
+        self.thread_classes: Set[str] = set()    # Thread subclasses
+        # (cls, attr) -> lock id declared via guarded-by comment
+        self.declared_guards: Dict[Tuple[str, str], str] = {}
+        self.guard_lines = {
+            i: m.group("lock")
+            for i, raw in enumerate(lines, start=1)
+            for m in [_GUARDED_BY_RE.search(raw)] if m}
+        # TZ104 order edges: (a, b) -> first (node, fn_key)
+        self.order_edges: Dict[Tuple[str, str], Tuple[ast.AST, str]] = {}
+        # TZ103 registrations: (kwarg, value expr, node, fn)
+        self.registrations: List[Tuple[str, ast.expr, ast.AST, _FnRec]] = []
+        # TZ105/TZ106/TZ108 findings are emitted during the walk
+        self.entry: Dict[str, Set[str]] = {}
+
+    # -- emission -----------------------------------------------------
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        sup = self.suppressed.get(line, set())
+        if "all" in sup or rule in sup:
+            return
+        text = self.lines[line - 1].strip() \
+            if 0 < line <= len(self.lines) else ""
+        self.findings.append(Finding(rule, self.path, line,
+                                     getattr(node, "col_offset", 0) + 1,
+                                     message, text))
+
+    # -- pass 1: discovery --------------------------------------------
+
+    def discover(self) -> None:
+        self._discover_body(self.tree.body, cls=None, parent=None)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d and d.rsplit(".", 1)[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    td = _dotted(kw.value)
+                    if td:
+                        self.thread_targets.add(td.rsplit(".", 1)[-1])
+
+    def _discover_body(self, body: Sequence[ast.stmt], cls: Optional[str],
+                       parent: Optional[str]) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{parent}.{st.name}" if parent else (
+                    f"{cls}.{st.name}" if cls else st.name)
+                rec = _FnRec(st, key, cls, parent)
+                self.fns[key] = rec
+                if cls is not None and parent is None:
+                    self.methods[(cls, st.name)] = key
+                elif parent is not None:
+                    self.nested[(parent, st.name)] = key
+                else:
+                    self.module_funcs[st.name] = key
+                self._discover_lock_defs(st, cls, key)
+                self._discover_body(st.body, cls, key)
+            elif isinstance(st, ast.ClassDef):
+                self.class_names.add(st.name)
+                if any("Thread" in (_dotted(b) or "") for b in st.bases):
+                    self.thread_classes.add(st.name)
+                self._discover_body(st.body, st.name, None)
+            else:
+                if cls is None and parent is None:
+                    self._discover_module_state(st)
+                for sub in ast.walk(st):
+                    if isinstance(sub, ast.ClassDef):
+                        self.class_names.add(sub.name)
+                        self._discover_body(sub.body, sub.name, None)
+                    elif isinstance(sub, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) and \
+                            not isinstance(st, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)):
+                        pass    # handled when its parent body recurses
+
+    def _discover_lock_defs(self, fn: ast.AST, cls: Optional[str],
+                            key: str) -> None:
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Assign) or \
+                    not isinstance(sub.value, ast.Call):
+                continue
+            kind = _LOCK_CTORS.get(_dotted(sub.value.func) or "")
+            if kind is None:
+                continue
+            for tgt in sub.targets:
+                td = _dotted(tgt)
+                if td and td.startswith("self.") and td.count(".") == 1 \
+                        and cls is not None:
+                    self.locks[f"{cls}.{td[5:]}"] = kind
+                elif isinstance(tgt, ast.Name):
+                    self.locks[f"{key}.{tgt.id}"] = kind
+
+    def _discover_module_state(self, st: ast.stmt) -> None:
+        if isinstance(st, ast.Assign):
+            mutable = isinstance(st.value, (ast.List, ast.Dict, ast.Set,
+                                            ast.ListComp, ast.DictComp))
+            if isinstance(st.value, ast.Call):
+                d = _dotted(st.value.func) or ""
+                mutable = d.rsplit(".", 1)[-1] in (
+                    "list", "dict", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter")
+                kind = _LOCK_CTORS.get(d)
+                if kind is not None:
+                    for tgt in st.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.locks[tgt.id] = kind
+                    return
+            if mutable:
+                for tgt in st.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_mutables.add(tgt.id)
+
+    # -- lock identity ------------------------------------------------
+
+    def lock_id(self, expr: ast.AST, rec: _FnRec) -> Optional[str]:
+        d = _dotted(expr)
+        if not d:
+            return None
+        if d.startswith("self.") and d.count(".") == 1 and rec.cls:
+            cand = f"{rec.cls}.{d[5:]}"
+            if cand in self.locks or _LOCKISH_RE.search(d[5:]):
+                return cand
+            return None
+        if "." not in d:
+            for scope in (rec.key, rec.parent_key):
+                if scope and f"{scope}.{d}" in self.locks:
+                    return f"{scope}.{d}"
+            if d in self.locks:
+                return d
+            if _LOCKISH_RE.search(d):
+                return f"{rec.key}.{d}"
+            return None
+        # foreign-object lock (s.cond, frontend._pool_lock): identity
+        # is the dotted path itself, module-scoped
+        if _LOCKISH_RE.search(d.rsplit(".", 1)[-1]):
+            return d
+        return None
+
+    def kind_of(self, lock_id: str) -> str:
+        if lock_id in self.locks:
+            return self.locks[lock_id]
+        return "condition" if _CONDISH_RE.search(
+            lock_id.rsplit(".", 1)[-1]) else "unknown"
+
+    def _short(self, lock_id: str) -> str:
+        return lock_id.rsplit(".", 1)[-1]
+
+    # -- pass 2: per-function walk ------------------------------------
+
+    def walk_all(self) -> None:
+        for rec in self.fns.values():
+            ctx = _WalkCtx()
+            self._walk_stmts(rec.node.body, rec, ctx)
+
+    def _record_acquire(self, lock: str, node: ast.AST, rec: _FnRec,
+                        ctx: "_WalkCtx") -> None:
+        for held in ctx.held:
+            if held == lock:
+                if self.kind_of(lock) in ("lock", "condition"):
+                    self.emit("TZ105", node,
+                              f"`{self._short(lock)}` is non-reentrant and "
+                              f"already held here — this acquire "
+                              f"deadlocks the thread against itself; use "
+                              f"one region or split a _locked() helper")
+            elif (held, lock) not in self.order_edges:
+                self.order_edges[(held, lock)] = (node, rec.key)
+        rec.acquires.append((lock, node, tuple(ctx.held)))
+        ctx.held.append(lock)
+
+    def _walk_stmts(self, body: Sequence[ast.stmt], rec: _FnRec,
+                    ctx: "_WalkCtx") -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue        # nested defs walk as their own functions
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                pushed = []
+                for item in st.items:
+                    self._scan_expr(item.context_expr, rec, ctx)
+                    lock = self.lock_id(item.context_expr, rec)
+                    if lock is not None:
+                        self._record_acquire(lock, item.context_expr,
+                                             rec, ctx)
+                        pushed.append(lock)
+                self._walk_stmts(st.body, rec, ctx)
+                for lock in reversed(pushed):
+                    if lock in ctx.held:
+                        ctx.held.remove(lock)
+            elif isinstance(st, ast.Try):
+                fin = set()
+                for fst in st.finalbody:
+                    for sub in ast.walk(fst):
+                        if isinstance(sub, ast.Call) and \
+                                isinstance(sub.func, ast.Attribute) and \
+                                sub.func.attr == "release":
+                            lid = self.lock_id(sub.func.value, rec)
+                            if lid:
+                                fin.add(lid)
+                ctx.protected |= fin
+                self._walk_stmts(st.body, rec, ctx)
+                for h in st.handlers:
+                    self._walk_stmts(h.body, rec, ctx)
+                self._walk_stmts(st.orelse, rec, ctx)
+                ctx.protected -= fin
+                self._walk_stmts(st.finalbody, rec, ctx)
+            elif isinstance(st, ast.If):
+                self._scan_expr(st.test, rec, ctx)
+                self._walk_stmts(st.body, rec, ctx)
+                self._walk_stmts(st.orelse, rec, ctx)
+            elif isinstance(st, ast.While):
+                self._scan_expr(st.test, rec, ctx)
+                ctx.in_while += 1
+                self._walk_stmts(st.body, rec, ctx)
+                self._walk_stmts(st.orelse, rec, ctx)
+                ctx.in_while -= 1
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._scan_expr(st.iter, rec, ctx)
+                self._walk_stmts(st.body, rec, ctx)
+                self._walk_stmts(st.orelse, rec, ctx)
+            elif isinstance(st, (ast.Return, ast.Raise)):
+                for child in ast.iter_child_nodes(st):
+                    if isinstance(child, ast.expr):
+                        self._scan_expr(child, rec, ctx)
+                leaked = [l for l in ctx.manual if l in ctx.held and
+                          l not in ctx.protected]
+                for lock in leaked:
+                    verb = "return" if isinstance(st, ast.Return) else "raise"
+                    self.emit("TZ106", st,
+                              f"`{self._short(lock)}` was acquire()d "
+                              f"manually and this `{verb}` leaves without "
+                              f"releasing it — every later acquirer "
+                              f"deadlocks; use `with` or try/finally")
+            elif isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = st.value
+                if value is not None:
+                    self._scan_expr(value, rec, ctx)
+                targets = st.targets if isinstance(st, ast.Assign) \
+                    else [st.target]
+                for tgt in targets:
+                    self._record_write(tgt, st, rec, ctx)
+            elif isinstance(st, ast.Delete):
+                for tgt in st.targets:
+                    self._record_write(tgt, st, rec, ctx)
+            else:
+                for child in ast.iter_child_nodes(st):
+                    if isinstance(child, ast.expr):
+                        self._scan_expr(child, rec, ctx)
+
+    def _record_write(self, tgt: ast.AST, st: ast.stmt, rec: _FnRec,
+                      ctx: "_WalkCtx") -> None:
+        # unwrap one subscript level: self.x[i] = v writes x
+        base = tgt
+        if isinstance(base, (ast.Subscript, ast.Starred)):
+            self._scan_expr(base, rec, ctx)
+            base = base.value
+        if isinstance(base, ast.Attribute):
+            bd = _dotted(base)
+            if bd and bd.startswith("self.") and bd.count(".") == 1 \
+                    and rec.cls:
+                attr = bd[5:]
+                rec.attr_writes.append((attr, st, tuple(ctx.held)))
+                g = self.guard_lines.get(getattr(st, "lineno", 0))
+                if g:
+                    self.declared_guards[(rec.cls, attr)] = \
+                        f"{rec.cls}.{g}"
+            elif bd and bd.split(".", 1)[0] in self.class_names:
+                rec.module_writes.append((bd, st, tuple(ctx.held)))
+        elif isinstance(base, ast.Name):
+            if base.id in self.module_mutables and base is not tgt:
+                rec.module_writes.append((base.id, st, tuple(ctx.held)))
+            elif base.id in self.module_mutables and \
+                    isinstance(tgt, ast.Name) and \
+                    any(isinstance(n, ast.Global) and base.id in n.names
+                        for n in ast.walk(rec.node)):
+                rec.module_writes.append((base.id, st, tuple(ctx.held)))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._record_write(elt, st, rec, ctx)
+
+    # -- expression scan (calls) --------------------------------------
+
+    def _scan_expr(self, expr: ast.AST, rec: _FnRec,
+                   ctx: "_WalkCtx") -> None:
+        for node in self._walk_no_lambda(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._handle_call(node, rec, ctx)
+
+    @staticmethod
+    def _walk_no_lambda(expr: ast.AST):
+        """ast.walk, but do not descend into Lambda bodies or nested
+        defs — their code runs later, not under the current locks."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.Lambda, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                stack.append(child)
+
+    def _handle_call(self, node: ast.Call, rec: _FnRec,
+                     ctx: "_WalkCtx") -> None:
+        d = _dotted(node.func)
+        held = tuple(ctx.held)
+        # manual acquire/release
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("acquire", "release"):
+            lock = self.lock_id(node.func.value, rec)
+            if lock is not None:
+                if node.func.attr == "acquire":
+                    self._record_acquire(lock, node, rec, ctx)
+                    ctx.manual.append(lock)
+                else:
+                    if lock in ctx.held:
+                        ctx.held.remove(lock)
+                    if lock in ctx.manual:
+                        ctx.manual.remove(lock)
+                return
+        # Condition.wait discipline (held or not: a wait outside any
+        # lock is its own bug, but the predicate loop is the rule here)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("wait", "wait_for"):
+            lock = self.lock_id(node.func.value, rec)
+            if lock is not None and self.kind_of(lock) == "condition" \
+                    and node.func.attr == "wait" and ctx.in_while == 0:
+                self.emit("TZ108", node,
+                          f"`{self._short(lock)}.wait()` outside a "
+                          f"`while <predicate>` loop: wakeups are "
+                          f"spurious and racy by spec — re-check the "
+                          f"predicate in a loop, or use wait_for()")
+        # blocking calls
+        blk = self._blocking_label(node, d)
+        if blk is not None:
+            rec.blocking.append((blk, node, held))
+        # callback invocation site
+        tail = (d or "").rsplit(".", 1)[-1]
+        if tail and (tail.endswith("_cb") or tail in _CB_INVOKE_NAMES):
+            rec.cb_invokes.append((tail, node, held))
+        # callback registration kwargs
+        for kw in node.keywords:
+            if kw.arg in _CB_KWARGS:
+                self.registrations.append((kw.arg, kw.value, node, rec))
+        # call edges (direct + local functions passed as arguments)
+        callee = self._resolve_call(d, rec)
+        if callee is not None:
+            rec.calls.append((callee, held))
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            ad = _dotted(arg)
+            target = self._resolve_call(ad, rec)
+            if target is not None:
+                rec.calls.append((target, held))
+
+    def _blocking_label(self, node: ast.Call, d: Optional[str],
+                        ) -> Optional[str]:
+        if d in _BLOCKING_EXACT:
+            return _BLOCKING_EXACT[d]
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        tail = node.func.attr
+        recv = _dotted(node.func.value) or ""
+        recv_leaf = recv.rsplit(".", 1)[-1]
+        if tail == "block_until_ready":
+            return "block_until_ready (device rendezvous)"
+        if tail == "item" and not node.args and not node.keywords:
+            return ".item() (D2H sync)"
+        if tail == "join" and recv_leaf and _THREADISH_RE.search(recv_leaf):
+            return f"{recv_leaf}.join() (thread join)"
+        if tail == "get" and recv_leaf and _QUEUEISH_RE.search(recv_leaf):
+            nowait = any(
+                kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in node.keywords)
+            if not nowait:
+                return f"{recv_leaf}.get() (blocking queue get)"
+        if tail in ("recv", "accept", "connect", "sendall") and \
+                recv_leaf and _SOCKISH_RE.search(recv_leaf):
+            return f"{recv_leaf}.{tail}() (socket I/O)"
+        return None
+
+    def _resolve_call(self, d: Optional[str], rec: _FnRec,
+                      ) -> Optional[str]:
+        if not d:
+            return None
+        if d.startswith("self.") and d.count(".") == 1 and rec.cls:
+            return self.methods.get((rec.cls, d[5:]))
+        if "." not in d:
+            for scope in (rec.key, rec.parent_key):
+                if scope and (scope, d) in self.nested:
+                    return self.nested[(scope, d)]
+            return self.module_funcs.get(d)
+        return None
+
+    # -- pass 3: entry-context fixpoint --------------------------------
+
+    def propagate(self) -> None:
+        self.entry = {k: set() for k in self.fns}
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed, rounds = False, rounds + 1
+            for rec in self.fns.values():
+                base = self.entry[rec.key]
+                for callee, held in rec.calls:
+                    add = set(held) | base
+                    tgt = self.entry.get(callee)
+                    if tgt is not None and not add <= tgt:
+                        tgt |= add
+                        changed = True
+
+    def may_held(self, rec: _FnRec, held: Tuple[str, ...]) -> Set[str]:
+        return set(held) | self.entry.get(rec.key, set())
+
+    # -- pass 4: derived findings --------------------------------------
+
+    def _init_exempt(self) -> Set[str]:
+        """Functions reachable only through ``__init__`` construction:
+        single-threaded by definition, so their bare writes are setup,
+        not races."""
+        out: Set[str] = set()
+        for (cls, name), key in self.methods.items():
+            if name in ("__init__", "__new__", "__del__"):
+                work = [key]
+                while work:
+                    k = work.pop()
+                    if k in out:
+                        continue
+                    out.add(k)
+                    for callee, _ in self.fns[k].calls:
+                        if self.fns[callee].cls == cls:
+                            work.append(callee)
+        return out
+
+    def rule_tz101(self) -> None:
+        exempt = self._init_exempt()
+        # (cls, attr) -> list of (rec, node, held)
+        writes: Dict[Tuple[str, str],
+                     List[Tuple[_FnRec, ast.AST, Tuple[str, ...]]]] = {}
+        for rec in self.fns.values():
+            if rec.key in exempt or rec.cls is None:
+                continue
+            for attr, node, held in rec.attr_writes:
+                writes.setdefault((rec.cls, attr), []).append(
+                    (rec, node, held))
+        for (cls, attr), sites in writes.items():
+            guard = self.declared_guards.get((cls, attr))
+            if guard is None:
+                own = set()
+                for rec, node, held in sites:
+                    for lock in self.may_held(rec, held):
+                        if lock.startswith(f"{cls}.") and \
+                                self.kind_of(lock) != "condition":
+                            own.add(lock)
+                if len(own) != 1:
+                    continue        # unguarded or ambiguous: no inference
+                guard = own.pop()
+            for rec, node, held in sites:
+                if guard not in self.may_held(rec, held):
+                    self.emit("TZ101", node,
+                              f"`self.{attr}` is guarded by "
+                              f"`{self._short(guard)}` (assigned under it "
+                              f"elsewhere or declared guarded-by) but "
+                              f"this write holds "
+                              f"{self._held_desc(rec, held)}; take the "
+                              f"lock or annotate the true owner")
+
+    def _held_desc(self, rec: _FnRec, held: Tuple[str, ...]) -> str:
+        locks = self.may_held(rec, held)
+        if not locks:
+            return "no lock"
+        return "only " + ", ".join(
+            f"`{self._short(l)}`" for l in sorted(locks))
+
+    def rule_tz102(self) -> None:
+        for rec in self.fns.values():
+            for label, node, held in rec.blocking:
+                locks = self.may_held(rec, held)
+                if not locks:
+                    continue
+                names = ", ".join(f"`{self._short(l)}`"
+                                  for l in sorted(locks))
+                self.emit("TZ102", node,
+                          f"{label} while holding {names}: every thread "
+                          f"contending on the lock stalls for the full "
+                          f"call — record under the lock, do the "
+                          f"blocking work after releasing it")
+
+    def rule_tz103(self) -> None:
+        for rec in self.fns.values():
+            for name, node, held in rec.cb_invokes:
+                locks = self.may_held(rec, held)
+                if not locks:
+                    continue
+                names = ", ".join(f"`{self._short(l)}`"
+                                  for l in sorted(locks))
+                self.emit("TZ103", node,
+                          f"callback `{name}` invoked while holding "
+                          f"{names}: an arbitrary callable under a lock "
+                          f"can block or re-enter and deadlock — "
+                          f"collect results and invoke after release")
+        for kwarg, value, node, rec in self.registrations:
+            self._check_registration(kwarg, value, node, rec)
+
+    def _check_registration(self, kwarg: str, value: ast.expr,
+                            node: ast.AST, rec: _FnRec) -> None:
+        if isinstance(value, ast.Constant):        # None / default
+            return
+        if isinstance(value, ast.IfExp):
+            self._check_registration(kwarg, value.body, node, rec)
+            self._check_registration(kwarg, value.orelse, node, rec)
+            return
+        vd = _dotted(value)
+        target_key = self._resolve_call(vd, rec)
+        if isinstance(value, ast.Lambda):
+            reason = self._impurity(value.body, rec)
+            if reason:
+                self.emit("TZ103", value,
+                          f"`{kwarg}` lambda is not record-only: "
+                          f"{reason}; this hook fires under the "
+                          f"caller's lock — record and defer")
+            return
+        if target_key is not None:
+            target = self.fns[target_key]
+            reason = self._impurity(target.node, rec, skip_def=True)
+            if reason:
+                self.emit("TZ103", node,
+                          f"`{kwarg}={vd}` is not record-only: "
+                          f"{reason}; this hook fires under the "
+                          f"caller's lock — record under the lock and "
+                          f"do the real work after release")
+            return
+        if kwarg in _CB_KWARGS_UNDER_LOCK:
+            self.emit("TZ103", node,
+                      f"`{kwarg}={vd or '<expr>'}` cannot be verified "
+                      f"record-only (defined outside this module); the "
+                      f"hook fires under the caller's pool lock — if "
+                      f"the callee only records under its own leaf "
+                      f"lock, baseline this with that justification")
+
+    def _impurity(self, body: ast.AST, rec: _FnRec,
+                  skip_def: bool = False) -> Optional[str]:
+        """Why a callback body is not record-only, or None if clean."""
+        nodes = ast.walk(body)
+        if skip_def:
+            nodes = (n for n in ast.walk(body)
+                     if n is not body)
+        for n in nodes:
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    lid = self.lock_id(item.context_expr, rec)
+                    if lid is not None:
+                        return (f"acquires `{self._short(lid)}` "
+                                f"(line {n.lineno})")
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func) or ""
+            if isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "acquire":
+                lid = self.lock_id(n.func.value, rec)
+                if lid is not None:
+                    return (f"acquires `{self._short(lid)}` "
+                            f"(line {n.lineno})")
+            if _JAXISH_RE.match(d) and not d.startswith("jax.tree_util."):
+                return f"calls `{d}` (line {n.lineno})"
+            blk = self._blocking_label(n, d)
+            if blk is not None:
+                return f"{blk} (line {n.lineno})"
+        return None
+
+    def rule_tz104(self) -> None:
+        # adjacency over recorded order edges, cycles via DFS coloring
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.order_edges:
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+        # strongly connected components (iterative Tarjan-lite: for the
+        # handful of locks per module, repeated reachability is fine)
+        def reaches(src: str, dst: str) -> bool:
+            seen, work = set(), [src]
+            while work:
+                n = work.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                work.extend(adj.get(n, ()))
+            return False
+
+        for (a, b), (node, fn_key) in sorted(
+                self.order_edges.items(),
+                key=lambda kv: getattr(kv[1][0], "lineno", 0)):
+            if a == b or not reaches(b, a):
+                continue
+            back = self.order_edges.get((b, a))
+            where = (f"line {getattr(back[0], 'lineno', '?')}"
+                     if back else "another path")
+            self.emit("TZ104", node,
+                      f"lock order inversion: `{self._short(b)}` "
+                      f"acquired while holding `{self._short(a)}`, but "
+                      f"{where} acquires them in the opposite order — "
+                      f"two threads interleaving these paths deadlock; "
+                      f"pick one global order")
+
+    def rule_tz105_propagated(self) -> None:
+        # direct double-acquire is emitted during the walk; this adds
+        # the cross-function case: fn acquires L and some caller path
+        # already holds L
+        for rec in self.fns.values():
+            ctx_held = self.entry.get(rec.key, set())
+            if not ctx_held:
+                continue
+            for lock, node, held in rec.acquires:
+                if lock in ctx_held and lock not in held and \
+                        self.kind_of(lock) in ("lock", "condition"):
+                    self.emit("TZ105", node,
+                              f"`{self._short(lock)}` is non-reentrant "
+                              f"and a caller of `{rec.name}` already "
+                              f"holds it on some path — this acquire "
+                              f"deadlocks that path; hoist the lock or "
+                              f"add a _locked() variant")
+
+    def rule_tz107(self) -> None:
+        threaded: Set[str] = set()
+        for rec in self.fns.values():
+            if (rec.name in _THREAD_ROOT_NAMES
+                    or rec.name.startswith("_loop")
+                    or rec.name.startswith("do_")
+                    or rec.name in self.thread_targets
+                    or (rec.name == "run" and rec.cls in
+                        self.thread_classes)):
+                threaded.add(rec.key)
+        work = list(threaded)
+        while work:
+            k = work.pop()
+            for callee, _ in self.fns[k].calls:
+                if callee not in threaded:
+                    threaded.add(callee)
+                    work.append(callee)
+        for key in threaded:
+            rec = self.fns[key]
+            for name, node, held in rec.module_writes:
+                if self.may_held(rec, held):
+                    continue
+                self.emit("TZ107", node,
+                          f"`{name}` is shared mutable state and "
+                          f"`{rec.name}` runs on a pump/handler thread "
+                          f"with no lock held here — concurrent "
+                          f"mutation corrupts it; guard it with a lock "
+                          f"or make it thread-local")
+
+    # -- driver --------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        self.discover()
+        self.walk_all()
+        self.propagate()
+        self.rule_tz101()
+        self.rule_tz102()
+        self.rule_tz103()
+        self.rule_tz104()
+        self.rule_tz105_propagated()
+        self.rule_tz107()
+        self.findings.sort(key=lambda x: (x.path, x.line, x.rule))
+        return self.findings
+
+
+class _WalkCtx:
+    def __init__(self) -> None:
+        self.held: List[str] = []
+        self.manual: List[str] = []
+        self.protected: Set[str] = set()
+        self.in_while = 0
+
+
+def run_lockflow(tree: ast.Module, path: str, lines: List[str],
+                 suppressed: Dict[int, Set[str]]) -> List[Finding]:
+    """Run the TZ101..TZ108 concurrency pass over one parsed module."""
+    return _Lockflow(tree, path, lines, suppressed).run()

@@ -1,0 +1,300 @@
+"""tpulint lockguard — runtime lock-discipline checks, the TraceGuard
+twin of the static TZ1xx pass (lockflow.py).
+
+The static pass proves shapes of code; it cannot see a lock that only
+exists at runtime, a callback registered through three layers of
+indirection, or the order two REAL threads actually take.  LockGuard
+closes that gap in tests: it swaps every ``threading.Lock``/``RLock``
+attribute of its targets (one ``vars()`` level deep, so an engine's
+``telemetry`` sub-object's leaf locks are covered too) for an
+instrumented wrapper that records, per thread,
+
+- the **acquisition-order graph**: every (held A -> acquired B) edge
+  with the source line that created it.  A cycle in that graph is a
+  latent deadlock even if this run never interleaved into it —
+  recording converts a probabilistic hang into a deterministic
+  assertion;
+- **under-lock blocking calls**: ``jax.device_get``/``device_put`` and
+  ``time.sleep`` are patched (module attributes, restored on exit) to
+  note when they run while the calling thread holds any instrumented
+  lock — the runtime analog of TZ102;
+- **hold sites**: the acquiring source line per lock, so a finding
+  names code, not objects.
+
+A same-thread re-acquire of a non-reentrant Lock raises
+:class:`LockGuardError` immediately instead of deadlocking the test
+run (the runtime analog of TZ105).
+
+Usage::
+
+    with lock_guard(engine) as lg:
+        for _ in range(20):
+            engine.step()
+        lg.assert_clean()       # no inversions, nothing blocking
+
+Static pass and runtime guard are cross-validated on the same
+fixtures: ``tests/tpulint_fixtures/bad_tz104.py`` is importable, and
+``test_lockguard.py`` drives its seeded inversion through both.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["LockGuardError", "LockGuard", "lock_guard"]
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+_RLOCK_TYPE = type(threading.RLock())
+_THIS_FILE = os.path.abspath(__file__)
+
+
+class LockGuardError(AssertionError):
+    """Lock discipline violated inside a guarded region."""
+
+
+def _call_site() -> str:
+    """`file:line` of the nearest stack frame outside this module."""
+    for frame in reversed(traceback.extract_stack()):
+        if os.path.abspath(frame.filename) != _THIS_FILE:
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _InstrumentedLock:
+    """Duck-typed stand-in for a ``threading`` lock: delegates to the
+    real lock, reporting every acquire/release to the guard."""
+
+    def __init__(self, guard: "LockGuard", name: str, real: Any):
+        self._guard = guard
+        self.name = name
+        self._real = real
+        self._reentrant = isinstance(real, _RLOCK_TYPE)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._guard._before_acquire(self)
+        got = self._real.acquire(blocking, timeout) if timeout != -1 \
+            else self._real.acquire(blocking)
+        if got:
+            self._guard._acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._real.release()
+        self._guard._released(self)
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+
+class LockGuard:
+    """Context manager instrumenting the locks of ``targets`` and
+    recording acquisition order, hold sites, and under-lock blocking
+    calls.  ``patch_blocking=False`` skips the jax/time monkeypatches
+    (pure order checking)."""
+
+    def __init__(self, *targets: Any, name: Optional[str] = None,
+                 patch_blocking: bool = True):
+        self._targets = targets
+        self.name = name or "lock_guard"
+        self._patch_blocking = patch_blocking
+        # (owner object, attr name, original lock) for restoration
+        self._replaced: List[Tuple[Any, str, Any]] = []
+        self._wrappers: Dict[int, _InstrumentedLock] = {}  # id(real)
+        self._held = threading.local()
+        self._rec = threading.Lock()    # guards the record dicts below
+        # (outer name, inner name) -> "site (outer held at site)"
+        self._edges: Dict[Tuple[str, str], str] = {}
+        # (call label, locks held, site)
+        self._blocking: List[Tuple[str, Tuple[str, ...], str]] = []
+        self._patches: List[Tuple[Any, str, Any]] = []
+
+    # -- instrumentation ----------------------------------------------
+
+    def _wrap(self, owner: Any, attr: str, real: Any) -> None:
+        w = self._wrappers.get(id(real))
+        if w is None:
+            w = _InstrumentedLock(
+                self, f"{type(owner).__name__}.{attr}", real)
+            self._wrappers[id(real)] = w
+        setattr(owner, attr, w)
+        self._replaced.append((owner, attr, real))
+
+    def _instrument(self, obj: Any, depth: int) -> None:
+        try:
+            attrs = vars(obj)
+        except TypeError:
+            return
+        for k, v in list(attrs.items()):
+            if isinstance(v, _LOCK_TYPES):
+                self._wrap(obj, k, v)
+            elif isinstance(v, threading.Condition):
+                # instrument the condition's inner lock: waiters and
+                # notifiers then participate in the order graph.  Only
+                # plain-Lock conditions — Condition captures an
+                # RLock's _release_save/_acquire_restore as bound
+                # methods at construction, which would bypass the
+                # wrapper and unbalance the held stack
+                inner = v._lock
+                if type(inner) is _LOCK_TYPES[0] and \
+                        id(inner) not in self._wrappers:
+                    w = _InstrumentedLock(
+                        self, f"{type(obj).__name__}.{k}", inner)
+                    self._wrappers[id(inner)] = w
+                    # Condition delegates acquire/release through
+                    # attributes captured at construction — rebind them
+                    v._lock = w
+                    v.acquire = w.acquire
+                    v.release = w.release
+                    self._replaced.append((v, "_lock", inner))
+                    self._replaced.append((v, "acquire", inner.acquire))
+                    self._replaced.append((v, "release", inner.release))
+            elif depth > 0 and hasattr(v, "__dict__") and \
+                    not isinstance(v, type):
+                self._instrument(v, depth - 1)
+
+    def _patch(self, mod: Any, attr: str) -> None:
+        orig = getattr(mod, attr, None)
+        if orig is None:
+            return
+        label = f"{getattr(mod, '__name__', mod)}.{attr}"
+
+        def wrapper(*a, _orig=orig, _label=label, **kw):
+            held = tuple(l.name for l in self._stack())
+            if held:
+                with self._rec:
+                    self._blocking.append((_label, held, _call_site()))
+            return _orig(*a, **kw)
+
+        setattr(mod, attr, wrapper)
+        self._patches.append((mod, attr, orig))
+
+    def __enter__(self) -> "LockGuard":
+        for t in self._targets:
+            self._instrument(t, depth=1)
+        if self._patch_blocking:
+            self._patch(time, "sleep")
+            try:
+                import jax
+            except Exception:   # no jax in this env: order checks only
+                jax = None
+            if jax is not None:
+                self._patch(jax, "device_get")
+                self._patch(jax, "device_put")
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        for mod, attr, orig in reversed(self._patches):
+            setattr(mod, attr, orig)
+        for owner, attr, real in reversed(self._replaced):
+            setattr(owner, attr, real)
+        self._patches.clear()
+        self._replaced.clear()
+        return False
+
+    # -- recording (called from _InstrumentedLock) --------------------
+
+    def _stack(self) -> List[_InstrumentedLock]:
+        if not hasattr(self._held, "stack"):
+            self._held.stack = []
+        return self._held.stack
+
+    def _before_acquire(self, lock: _InstrumentedLock) -> None:
+        stack = self._stack()
+        if not lock._reentrant and any(l is lock for l in stack):
+            raise LockGuardError(
+                f"{self.name}: double-acquire of non-reentrant "
+                f"{lock.name} at {_call_site()} — the un-guarded run "
+                f"deadlocks here")
+
+    def _acquired(self, lock: _InstrumentedLock) -> None:
+        stack = self._stack()
+        site = _call_site()
+        with self._rec:
+            for outer in stack:
+                self._edges.setdefault((outer.name, lock.name), site)
+        stack.append(lock)
+
+    def _released(self, lock: _InstrumentedLock) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                break
+
+    # -- results ------------------------------------------------------
+
+    def order_edges(self) -> Dict[Tuple[str, str], str]:
+        """(held, acquired) -> source line that first recorded it."""
+        with self._rec:
+            return dict(self._edges)
+
+    def inversions(self) -> List[str]:
+        """Human-readable description of every cycle in the order
+        graph (pairwise inversions and longer cycles)."""
+        edges = self.order_edges()
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen, work = set(), [src]
+            while work:
+                n = work.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                work.extend(adj.get(n, ()))
+            return False
+
+        out, seen_pairs = [], set()
+        for (a, b), site in sorted(edges.items()):
+            if frozenset((a, b)) in seen_pairs:
+                continue
+            if reaches(b, a):
+                seen_pairs.add(frozenset((a, b)))
+                back = edges.get((b, a))
+                out.append(
+                    f"{a} -> {b} at {site}"
+                    + (f" inverts {b} -> {a} at {back}" if back
+                       else f" closes a cycle back to {a}"))
+        return out
+
+    def blocking_calls(self) -> List[Tuple[str, Tuple[str, ...], str]]:
+        """(call, locks held, site) for every patched blocking call
+        that ran while this thread held an instrumented lock."""
+        with self._rec:
+            return list(self._blocking)
+
+    def assert_clean(self) -> None:
+        """Raise :class:`LockGuardError` on any recorded order
+        inversion or under-lock blocking call."""
+        problems = [f"lock-order inversion: {d}" for d in
+                    self.inversions()]
+        problems += [
+            f"blocking call under lock: {call} holding "
+            f"{', '.join(held)} at {site}"
+            for call, held, site in self.blocking_calls()]
+        if problems:
+            raise LockGuardError(
+                f"{self.name}: {len(problems)} lock-discipline "
+                f"violation(s):\n  " + "\n  ".join(problems))
+
+
+def lock_guard(*targets: Any, name: Optional[str] = None,
+               patch_blocking: bool = True) -> LockGuard:
+    """Guard a region with instrumented locks over ``targets`` (an
+    engine, a store, any object holding ``threading`` locks one
+    attribute level deep).  Pair with ``assert_clean()``."""
+    return LockGuard(*targets, name=name, patch_blocking=patch_blocking)

@@ -457,6 +457,12 @@ class ContinuousEngine:
         self._kv_spill_bytes = 0
         self._kv_readmits = 0
         self._kv_readmit_tokens_saved = 0
+        # deferred device work recorded by pool callbacks / readmission
+        # while ``_pool_lock`` is held — the pump thread drains both
+        # BEFORE the next device write to the pool (tpulint TZ102/TZ103:
+        # no D2H/H2D under the pool lock)
+        self._pending_spills: List[Tuple[int, int]] = []   # (block, hash)
+        self._pending_readmits: List[tuple] = []    # (blocks, kcat, vcat)
         if self.paged:
             bs = int(block_size)
             if bs < 1:
@@ -1996,6 +2002,9 @@ class ContinuousEngine:
         plen = len(full)
         hashes = self._pool.block_hashes(full)
         total = -(-plen // self._bs)
+        # errors surface AFTER the lock: on_error is arbitrary user
+        # code and must never run under _pool_lock
+        err: Optional[Exception] = None
         with self._pool_lock:
             matched = self._pool.lookup(
                 hashes[:(plen - 1) // self._bs])
@@ -2011,11 +2020,6 @@ class ContinuousEngine:
             cap = self._pool.n_blocks - 1
             if self._dpool is not None:
                 cap = min(cap, self._dpool.n_blocks - 1)
-            if need + 1 > cap:
-                self._req_error(req.uri, req.on_error, ValueError(
-                    f"prompt needs {need} private blocks + headroom "
-                    f"but the pool holds {cap}"))
-                return "error"
             # per-chunk allocation only needs room to START (first
             # chunk block + decode headroom); monolithic admission's
             # need+1 gate would block exactly the long prompts
@@ -2023,32 +2027,45 @@ class ContinuousEngine:
             dry = self._pool.allocatable() < 2 or (
                 self._dpool is not None
                 and self._dpool.allocatable() < 2)
-            if dry:
+            if need + 1 > cap:
+                err = ValueError(
+                    f"prompt needs {need} private blocks + headroom "
+                    f"but the pool holds {cap}")
+            elif dry:
                 if self.n_active == 0:
-                    self._req_error(req.uri, req.on_error, RuntimeError(
+                    err = RuntimeError(
                         f"pool dry with no residents: "
                         f"{self._pool.num_referenced()} of "
                         f"{self._pool.n_blocks} blocks are pinned "
-                        f"(unregister a prefix or raise n_blocks)"))
-                    return "error"
-                return "blocked"
-            for b in matched:
-                self._pool.acquire(b)
-            if dmatch is not None:
-                for b in dmatch:
-                    self._dpool.acquire(b)
-            if self._kv_store is not None:
-                # tiered KV: extend the pinned device match from the
-                # host store.  The probe window is capped so adoption
-                # leaves the >= 2 allocatable blocks the chunked dry
-                # gate just guaranteed — the first chunk must still be
-                # able to start.  (No draft tenant here: the store
-                # refuses speculative engines at construction.)
-                limit = min((plen - 1) // self._bs,
-                            len(matched)
-                            + max(0, self._pool.allocatable() - 2))
-                matched = matched + self._store_readmit(
-                    hashes, len(matched), limit)
+                        f"(unregister a prefix or raise n_blocks)")
+                else:
+                    return "blocked"
+            else:
+                for b in matched:
+                    self._pool.acquire(b)
+                if dmatch is not None:
+                    for b in dmatch:
+                        self._dpool.acquire(b)
+                if self._kv_store is not None:
+                    # tiered KV: extend the pinned device match from
+                    # the host store.  The probe window is capped so
+                    # adoption leaves the >= 2 allocatable blocks the
+                    # chunked dry gate just guaranteed — the first
+                    # chunk must still be able to start.  (No draft
+                    # tenant here: the store refuses speculative
+                    # engines at construction.)
+                    limit = min((plen - 1) // self._bs,
+                                len(matched)
+                                + max(0, self._pool.allocatable() - 2))
+                    matched = matched + self._store_readmit(
+                        hashes, len(matched), limit)
+        if err is not None:
+            self._req_error(req.uri, req.on_error, err)
+            return "error"
+        # adoption may have evicted (spill pending) and recorded host
+        # payloads; flush both before the tick's device work
+        self._drain_spills()
+        self._apply_readmits()
         slot = self._free.popleft()
         self._row_blocks[slot] = list(matched)
         self._tables[slot, :] = SINK_BLOCK
@@ -2140,6 +2157,11 @@ class ContinuousEngine:
                             f"({pool.num_referenced()} of "
                             f"{pool.n_blocks} blocks referenced)")
                     blocks.append(b)
+            # allocation may have evicted indexed blocks: gather their
+            # old bytes before the admit below rewrites the ids (the
+            # buffers are still self._pk/_pv here — admit's donation
+            # hasn't happened yet; the draft tenant never spills)
+            self._drain_spills()
             if len(matched) < nfull:
                 span = tokens[len(matched) * bs:nfull * bs]
                 sb = _next_bucket(len(span), self.prompt_buckets)
@@ -2193,27 +2215,36 @@ class ContinuousEngine:
         state = req.handoff_state
         chain = state["chain"]
         n = int(chain["n"])
+        # errors surface AFTER the lock: on_error is arbitrary user
+        # code and must never run under _pool_lock
+        err: Optional[Exception] = None
         with self._pool_lock:
             # +1 headroom mirrors monolithic admission: the first
             # decode tokens must not instantly preempt the adoption
             cap = self._pool.n_blocks - 1
             if n + 1 > cap:
-                self._req_error(req.uri, req.on_error, ValueError(
+                err = ValueError(
                     f"handoff chain needs {n} blocks + headroom but "
-                    f"the pool holds {cap}"))
-                return "error"
-            if self._pool.allocatable() < n + 1:
+                    f"the pool holds {cap}")
+            elif self._pool.allocatable() < n + 1:
                 if self.n_active == 0:
-                    self._req_error(req.uri, req.on_error, RuntimeError(
+                    err = RuntimeError(
                         f"pool dry with no residents: "
                         f"{self._pool.num_referenced()} of "
                         f"{self._pool.n_blocks} blocks are pinned "
-                        f"(unregister a prefix or raise n_blocks)"))
-                    return "error"
-                return "blocked"
-            blocks = self._pool.adopt_chain(chain)
-            if blocks is None:
-                return "blocked"
+                        f"(unregister a prefix or raise n_blocks)")
+                else:
+                    return "blocked"
+            else:
+                blocks = self._pool.adopt_chain(chain)
+                if blocks is None:
+                    return "blocked"
+        if err is not None:
+            self._req_error(req.uri, req.on_error, err)
+            return "error"
+        # adoption may have evicted indexed blocks (spill pending) and
+        # an adopted id may BE one — gather before the scatter below
+        self._drain_spills()
         idx = jnp.asarray(blocks, jnp.int32)
 
         def scatter(d, s):
@@ -2268,29 +2299,48 @@ class ContinuousEngine:
 
     def _spill_block(self, block: int, hash_: int) -> None:
         """BlockPool spill_cb: an indexed CACHED block is being
-        evicted — copy its K/V to the host tier before the block id is
-        reused.  Fires under ``_pool_lock`` on the pump thread, so
-        ``self._pk``/``self._pv`` are exactly the storage the hash
-        describes (every scatter/resize happens outside the pool
-        calls that evict).  The same ``jnp.take`` slice as the
-        prefill/decode handoff; int8 ``QuantKV`` pools spill
-        quantized, scales alongside (the tree_map carries every
-        leaf)."""
-        idx = jnp.asarray([block], jnp.int32)
+        evicted — record it so the pump thread copies its K/V to the
+        host tier before the block id is rewritten.  Fires under
+        ``_pool_lock``, so per the record-only contract
+        (``paged_cache.CALLBACK_CONTRACT``) it must not touch the
+        device: the D2H gather happens in ``_drain_spills``, which
+        every evicting path runs before its next device write.  Until
+        then ``self._pk``/``self._pv`` still hold exactly the bytes
+        the hash describes — the pump thread is the only arena
+        writer, and it drains before it scatters."""
+        self._pending_spills.append((int(block), hash_))
+
+    def _drain_spills(self) -> None:
+        """Flush pool-eviction spills recorded by ``_spill_block``:
+        ONE batched D2H gather for the whole wave (vs the per-block
+        fetch the under-lock path used to make), then host-store puts
+        and directory publishes — all outside ``_pool_lock``.  Must
+        run before any device write that could touch an evicted block
+        id (a just-allocated or adopted id may BE one): admission,
+        growth, handoff scatter, and pool-shrink slicing all drain
+        first.  Pump thread only, like every arena access."""
+        with self._pool_lock:
+            pending, self._pending_spills = self._pending_spills, []
+        if not pending:
+            return
+        idx = jnp.asarray([b for b, _ in pending], jnp.int32)
 
         def gather(x):
             return jnp.take(x, idx, axis=1)
 
-        payload = jax.device_get({
+        fetched = jax.device_get({
             "k": jax.tree_util.tree_map(gather, self._pk),
             "v": jax.tree_util.tree_map(gather, self._pv),
-        })      # one D2H for the whole block payload
-        if self._kv_store.put(hash_, payload, self._per_block_bytes):
-            self._kv_spills += 1
-            self._kv_spill_bytes += self._per_block_bytes
-            if self._prefix_directory is not None:
-                self._prefix_directory.publish(self._replica_id, hash_,
-                                               TIER_HOST)
+        })      # one D2H for the whole spill wave
+        for i, (_, hash_) in enumerate(pending):
+            payload = jax.tree_util.tree_map(
+                lambda x: x[:, i:i + 1], fetched)
+            if self._kv_store.put(hash_, payload, self._per_block_bytes):
+                self._kv_spills += 1
+                self._kv_spill_bytes += self._per_block_bytes
+                if self._prefix_directory is not None:
+                    self._prefix_directory.publish(
+                        self._replica_id, hash_, TIER_HOST)
 
     def _store_readmit(self, hashes, n_matched: int,
                        max_blocks: int) -> List[int]:
@@ -2298,9 +2348,12 @@ class ContinuousEngine:
         probe the store for the hashes PAST the device match, adopt
         the hit chain back into the pool (all-or-nothing with
         rollback, carried hashes republished first-writer-wins — the
-        PR 15 contract), and scatter the host payloads into the
-        device pool IMMEDIATELY, so a republished block never holds
-        garbage even if this request later blocks and releases it.
+        PR 15 contract), and RECORD the host payloads for
+        ``_apply_readmits`` to scatter after the lock is released
+        (tpulint TZ102: no H2D under the pool lock).  Admission
+        applies every recorded scatter before its prefill device call
+        — and before releasing blocks on a failure — so a republished
+        block is never read, shared, or recycled holding garbage.
         Returns the adopted block ids (ref=1 each, [] on miss or dry
         pool — the store entries survive either way).  Caller holds
         ``_pool_lock``; the caller already holds a reference on every
@@ -2314,23 +2367,33 @@ class ContinuousEngine:
         blocks = self._pool.adopt_chain(chain)
         if blocks is None:
             return []
-        idx = jnp.asarray(blocks, jnp.int32)
 
         def cat(*leaves):
             return np.concatenate(leaves, axis=1)
 
         kcat = jax.tree_util.tree_map(cat, *[p["k"] for _, p in run])
         vcat = jax.tree_util.tree_map(cat, *[p["v"] for _, p in run])
-
-        def scatter(d, s):
-            out = d.at[:, idx].set(jnp.asarray(s, d.dtype))
-            return jax.device_put(out, d.sharding)
-
-        self._pk = jax.tree_util.tree_map(scatter, self._pk, kcat)
-        self._pv = jax.tree_util.tree_map(scatter, self._pv, vcat)
+        self._pending_readmits.append((list(blocks), kcat, vcat))
         self._kv_readmits += 1
         self._kv_readmit_tokens_saved += len(blocks) * self._bs
         return blocks
+
+    def _apply_readmits(self) -> None:
+        """Scatter host-tier payloads recorded by ``_store_readmit``
+        into the device pool.  Runs outside ``_pool_lock``, AFTER
+        ``_drain_spills`` (an adopted id may be a just-evicted id
+        whose old content the spill must gather first) and before the
+        admission's prefill call reads the blocks."""
+        pending, self._pending_readmits = self._pending_readmits, []
+        for blocks, kcat, vcat in pending:
+            idx = jnp.asarray(blocks, jnp.int32)
+
+            def scatter(d, s):
+                out = d.at[:, idx].set(jnp.asarray(s, d.dtype))
+                return jax.device_put(out, d.sharding)
+
+            self._pk = jax.tree_util.tree_map(scatter, self._pk, kcat)
+            self._pv = jax.tree_util.tree_map(scatter, self._pv, vcat)
 
     def _admit_paged(self) -> int:
         """Paged admission: per request, match leading FULL prompt
@@ -2371,6 +2434,10 @@ class ContinuousEngine:
                 plen = len(full)
                 hashes = self._pool.block_hashes(full)
                 total = -(-plen // self._bs)
+                # errors surface AFTER the lock: on_error is arbitrary
+                # user code and must never run under _pool_lock
+                err: Optional[Exception] = None
+                planned = False
                 with self._pool_lock:
                     matched = self._pool.lookup(
                         hashes[:(plen - 1) // self._bs])
@@ -2389,61 +2456,71 @@ class ContinuousEngine:
                     cap = self._pool.n_blocks - 1
                     if self._dpool is not None:
                         cap = min(cap, self._dpool.n_blocks - 1)
-                    if need + 1 > cap:
-                        self._req_error(req.uri, req.on_error, ValueError(
-                            f"prompt needs {need} private blocks + "
-                            f"headroom but the pool holds {cap}"))
-                        continue
                     dry = self._pool.allocatable() < need + 1 or (
                         self._dpool is not None
                         and self._dpool.allocatable() < need + 1)
-                    if dry:
+                    if need + 1 > cap:
+                        err = ValueError(
+                            f"prompt needs {need} private blocks + "
+                            f"headroom but the pool holds {cap}")
+                    elif dry:
                         if (self.n_active == 0 and not plans
                                 and admitted == 0):
                             # nothing in flight will ever free blocks:
                             # only prefix pins hold the pool
-                            self._req_error(
-                                req.uri, req.on_error, RuntimeError(
-                                    f"pool dry with no residents: "
-                                    f"{self._pool.num_referenced()} of "
-                                    f"{self._pool.n_blocks} blocks are "
-                                    f"pinned (unregister a prefix or "
-                                    f"raise n_blocks)"))
-                            continue
-                        blocked.append(req)
-                        continue
-                    for b in matched:
-                        self._pool.acquire(b)
-                    if self._kv_store is not None:
-                        # tiered KV: extend the (now pinned — the
-                        # adoption below allocates, and allocation may
-                        # evict CACHED blocks, never a pinned match)
-                        # device match from the host store.  Adoption
-                        # consumes exactly the allocatable blocks the
-                        # shrunken ``need`` no longer asks for, so the
-                        # dry gate above still guarantees the allocate
-                        # loop below.  No draft tenant here: the store
-                        # refuses speculative engines at construction.
-                        matched = matched + self._store_readmit(
-                            hashes, len(matched),
-                            (plen - 1) // self._bs)
-                        need = total - len(matched)
-                    blocks = list(matched)
-                    for _ in range(need):
-                        blocks.append(self._pool.allocate())
-                    dblocks = None
-                    if self._dpool is not None:
-                        for b in dmatch:
-                            self._dpool.acquire(b)
-                        dblocks = list(dmatch)
+                            err = RuntimeError(
+                                f"pool dry with no residents: "
+                                f"{self._pool.num_referenced()} of "
+                                f"{self._pool.n_blocks} blocks are "
+                                f"pinned (unregister a prefix or "
+                                f"raise n_blocks)")
+                        else:
+                            blocked.append(req)
+                    else:
+                        for b in matched:
+                            self._pool.acquire(b)
+                        if self._kv_store is not None:
+                            # tiered KV: extend the (now pinned — the
+                            # adoption below allocates, and allocation
+                            # may evict CACHED blocks, never a pinned
+                            # match) device match from the host store.
+                            # Adoption consumes exactly the allocatable
+                            # blocks the shrunken ``need`` no longer
+                            # asks for, so the dry gate above still
+                            # guarantees the allocate loop below.  No
+                            # draft tenant here: the store refuses
+                            # speculative engines at construction.
+                            matched = matched + self._store_readmit(
+                                hashes, len(matched),
+                                (plen - 1) // self._bs)
+                            need = total - len(matched)
+                        blocks = list(matched)
                         for _ in range(need):
-                            dblocks.append(self._dpool.allocate())
+                            blocks.append(self._pool.allocate())
+                        dblocks = None
+                        if self._dpool is not None:
+                            for b in dmatch:
+                                self._dpool.acquire(b)
+                            dblocks = list(dmatch)
+                            for _ in range(need):
+                                dblocks.append(self._dpool.allocate())
+                        planned = True
+                if err is not None:
+                    self._req_error(req.uri, req.on_error, err)
+                    continue
+                if not planned:
+                    continue
                 plans.append((req, full, hashes, len(matched), blocks,
                               dblocks))
             if blocked:
                 with self._lock:
                     for req in reversed(blocked):
                         self._waiting.appendleft(req)
+            # deferred pool-callback device work, in dependency order:
+            # spills gather an evicted id's OLD bytes before the
+            # readmit scatter (or the group prefill below) rewrites it
+            self._drain_spills()
+            self._apply_readmits()
             groups: Dict[int, list] = {}
             for plan in plans:
                 slen = len(plan[1]) - plan[3] * self._bs
@@ -2565,6 +2642,9 @@ class ContinuousEngine:
                 last_write = min(int(self._pos[i]) + ticks - 1,
                                  self._L - 1)
             self._grow_row(i, last_write // self._bs + 1)
+        # growth allocations may have evicted indexed blocks: gather
+        # their bytes before the coming step writes the reused ids
+        self._drain_spills()
         return [i for i in active if self._slots[i] is not None]
 
     def _grow_row(self, i: int, need: int) -> None:
@@ -2614,6 +2694,9 @@ class ContinuousEngine:
             if st is None:
                 continue
             self._grow_row(i, (st.fill_pos + clen - 1) // self._bs + 1)
+        # growth allocations may have evicted indexed blocks: gather
+        # their bytes before the fused step writes the reused ids
+        self._drain_spills()
 
     def _publish_chunk_blocks(self, i: int, st: _Slot) -> None:
         """Hash-publish the prompt blocks a landed chunk fully covered
@@ -2734,6 +2817,9 @@ class ContinuousEngine:
                     self._dpool.shrink(m)
             else:
                 applied = 0
+        # shrink evicts the cached tail: gather those blocks' bytes
+        # into the host tier BEFORE fit() slices them off the arena
+        self._drain_spills()
         if clamped:
             self._pool_resize_clamps += 1
         if applied == 0:

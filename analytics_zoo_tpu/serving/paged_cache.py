@@ -59,6 +59,38 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 SINK_BLOCK = 0
 
+# The ONE statement of the pool-callback discipline.  The per-hook
+# parameter docs below and every dispatch-site comment point here
+# instead of paraphrasing it — three slightly-different wordings of
+# "record-only under the pool lock" had already drifted apart once.
+CALLBACK_CONTRACT = """\
+BlockPool callback contract (event_cb / spill_cb / index_cb — and the
+tiered-store hooks evict_cb/handoff_cb in serving/kv_store.py):
+
+Every hook fires synchronously inside a pool mutation, while the
+CALLER is typically holding its pool lock (the engine's _pool_lock).
+A callback must therefore be RECORD-ONLY:
+
+- append into its own structures, taking at most a private leaf lock
+  that is never held around pool or engine calls (the documented
+  fleet lock order is pool -> telemetry / store / directory, never
+  inverted);
+- never call back into this pool or the engine — re-entry would
+  deadlock a non-reentrant pool lock or corrupt allocator state
+  mid-mutation.  Under __debug__ the pool traps this with an
+  assertion at every public entry point;
+- never block: no device transfers (jax.device_get / device_put), no
+  sleeps, no queue or socket waits.  Heavy work (the actual D2H spill
+  copy) is deferred by the caller and drained after the pool lock is
+  released — see _drain_spills in serving/continuous.py.
+
+tpulint enforces this statically (TZ103 checks every callable passed
+as event_cb=/spill_cb=/index_cb=/evict_cb= plus in-module invocation
+sites under held locks) and dynamically (lint.lockguard.LockGuard
+records under-lock blocking calls and raises on re-entry at test
+time).
+"""
+
 # bytes per stored K (or V) element, keyed by the pool's ``kv_dtype``
 # mode.  int8 rows carry a per-(block, position, kv-head) bfloat16
 # scale alongside the 1-byte elements (see
@@ -172,22 +204,24 @@ class BlockPool:
         # observability hook, called as event_cb(kind, **info) for
         # "eviction" and "alloc_failure" (the two transitions the
         # cumulative counters alone cannot place on a timeline).  The
-        # caller may hold its pool lock here: the callback must only
-        # record (the engine wires Telemetry.pool_event), never call
-        # back into this pool.
+        # engine wires Telemetry.pool_event; record-only per
+        # CALLBACK_CONTRACT (module top).
         self.event_cb = event_cb
         # tiered-KV hooks (serving/kv_store.py; both default None =
         # tier off, zero behavior change).  ``spill_cb(block, hash)``
         # fires when a CACHED block is evicted — the one moment its
         # K/V is intact, unreferenced, and about to become garbage —
-        # giving the engine a last chance to copy it to the host
-        # store before the block id is reused.  ``index_cb(kind,
+        # giving the engine a last chance to note it for host-store
+        # copy before the block id is reused.  ``index_cb(kind,
         # hash_, block)`` mirrors index membership ("publish" /
-        # "unpublish") into the fleet PrefixDirectory.  Same contract
-        # as event_cb: called under the caller's pool lock, must only
-        # record, never re-enter this pool.
+        # "unpublish") into the fleet PrefixDirectory.  Record-only
+        # per CALLBACK_CONTRACT, same as event_cb.
         self.spill_cb = spill_cb
         self.index_cb = index_cb
+        # True only while one of the three hooks above is on the
+        # stack; armed by _fire, checked (``__debug__`` only) at every
+        # public entry point to trap contract-breaking re-entry
+        self._in_cb = False
         self._free: deque = deque(range(1, self.n_blocks))
         self._ref: Dict[int, int] = {}
         self._hash_of: Dict[int, int] = {}     # block -> published hash
@@ -203,6 +237,29 @@ class BlockPool:
         self.chains_exported = 0   # export_chain() calls
         self.chains_adopted = 0    # successful adopt_chain() calls
 
+    # -- callback dispatch (see CALLBACK_CONTRACT) --------------------
+
+    def _fire(self, cb: Callable[..., None], *args, **kwargs) -> None:
+        """Run one registered hook with the re-entrancy trap armed:
+        while a callback is on the stack, every public pool method
+        asserts instead of deadlocking on the caller's pool lock or
+        corrupting allocator state mid-mutation."""
+        self._in_cb = True
+        try:
+            cb(*args, **kwargs)
+        finally:
+            self._in_cb = False
+
+    def _entered(self) -> bool:
+        """Used as ``assert self._entered()`` so ``-O`` strips the
+        whole check along with the assert statement."""
+        if self._in_cb:
+            raise AssertionError(
+                f"BlockPool({self.name!r}) re-entered from inside one "
+                f"of its own callbacks; hooks are record-only — see "
+                f"paged_cache.CALLBACK_CONTRACT")
+        return True
+
     # -- hashing / lookup --------------------------------------------
 
     def block_hashes(self, tokens: Sequence[int]) -> List[int]:
@@ -217,6 +274,7 @@ class BlockPool:
         references — call :meth:`acquire` on each returned block while
         still holding the engine lock, or another admission could
         evict them out from under you."""
+        assert self._entered()
         if not self.enable_prefix_cache:
             # the index was never consulted: counting these as queries
             # would drag the reported hit rate toward zero on a pool
@@ -237,6 +295,7 @@ class BlockPool:
     def acquire(self, block: int) -> None:
         """ref++ on an indexed block a lookup returned (resurrects it
         from the LRU if it was unreferenced)."""
+        assert self._entered()
         if block == SINK_BLOCK:
             raise ValueError("cannot acquire the sink block")
         self._ref[block] = self._ref.get(block, 0) + 1
@@ -247,6 +306,7 @@ class BlockPool:
         first, else evict the least-recently-parked CACHED block
         (unpublishing its hash).  ``None`` when every block is
         referenced — the engine's cue to stop admitting / preempt."""
+        assert self._entered()
         if self._free:
             blk = self._free.popleft()
         elif self._lru:
@@ -255,23 +315,25 @@ class BlockPool:
             del self._index[h]
             self.evictions += 1
             # spill window: the block is unreferenced, unindexed, and
-            # its K/V is still intact on device — the engine copies it
-            # to the host tier here, before the id is reused below
+            # its K/V is still intact on device — the engine notes it
+            # for the host tier here, before the id is reused below
+            # (record-only per CALLBACK_CONTRACT)
             if self.spill_cb is not None:
-                self.spill_cb(blk, h)
+                self._fire(self.spill_cb, blk, h)
             if self.index_cb is not None:
-                self.index_cb("unpublish", hash_=h, block=blk)
+                self._fire(self.index_cb, "unpublish", hash_=h, block=blk)
             if self.event_cb is not None:
-                self.event_cb("eviction", block=blk, tenant=self.name)
+                self._fire(self.event_cb, "eviction", block=blk,
+                           tenant=self.name)
         else:
             self.alloc_failures += 1
             if self.event_cb is not None:
                 # every block is referenced — stamp who holds them so a
                 # flight-ring/timeline reader sees the dry pool's shape
                 # without a separate scrape
-                self.event_cb("alloc_failure", tenant=self.name,
-                              referenced=len(self._ref),
-                              n_blocks=self.n_blocks)
+                self._fire(self.event_cb, "alloc_failure",
+                           tenant=self.name, referenced=len(self._ref),
+                           n_blocks=self.n_blocks)
             return None
         self._ref[blk] = 1
         return blk
@@ -279,6 +341,7 @@ class BlockPool:
     def release(self, block: int) -> None:
         """ref--; at zero the block parks in the LRU if it is still
         hash-indexed (K/V reusable), else returns to the free list."""
+        assert self._entered()
         if block == SINK_BLOCK:
             raise ValueError("cannot release the sink block")
         r = self._ref.get(block, 0) - 1
@@ -299,6 +362,7 @@ class BlockPool:
         already indexed (two identical prompts prefetched in the same
         admission wave) the existing mapping stands and this block
         simply stays private — correct, merely not deduplicated."""
+        assert self._entered()
         if not self.enable_prefix_cache:
             return
         if block == SINK_BLOCK or self._ref.get(block, 0) < 1:
@@ -310,7 +374,7 @@ class BlockPool:
         self._index[hash_] = block
         self._hash_of[block] = hash_
         if self.index_cb is not None:
-            self.index_cb("publish", hash_=hash_, block=block)
+            self._fire(self.index_cb, "publish", hash_=hash_, block=block)
 
     # -- prefill/decode handoff (docs/serving_memory.md) ---------------
 
@@ -324,6 +388,7 @@ class BlockPool:
         the source pool's refcounts are untouched (the engine releases
         the source chain through the normal completion path once the
         export is materialized)."""
+        assert self._entered()
         hashes: List[Optional[int]] = []
         for b in blocks:
             if b == SINK_BLOCK or self._ref.get(b, 0) < 1:
@@ -344,6 +409,7 @@ class BlockPool:
         ``None`` when the pool cannot take the whole chain right now
         (any partial allocation is rolled back — the caller's
         requeue/blocked path)."""
+        assert self._entered()
         if int(chain["block_size"]) != self.block_size:
             raise ValueError(
                 f"adopt_chain block_size {chain['block_size']} != "
@@ -373,6 +439,7 @@ class BlockPool:
         (ids ``n_blocks .. n_blocks+n-1``).  The caller must have
         already extended the device arena to match — block ids are
         indices into it.  Returns ``n``."""
+        assert self._entered()
         if n < 0:
             raise ValueError(f"grow needs n >= 0, got {n}")
         if n == 0:
@@ -405,6 +472,7 @@ class BlockPool:
         actually removed; a clamped request (achieved < asked) bumps
         ``resize_clamps`` instead of raising.  The caller slices the
         device arena to the new ``n_blocks`` afterwards."""
+        assert self._entered()
         if n < 0:
             raise ValueError(f"shrink needs n >= 0, got {n}")
         m = min(int(n), self.shrinkable())
@@ -422,11 +490,12 @@ class BlockPool:
                 # vanish — the caller slices the arena only after
                 # shrink returns, so the device copy is still readable
                 if self.spill_cb is not None:
-                    self.spill_cb(b, h)
+                    self._fire(self.spill_cb, b, h)
                 if self.index_cb is not None:
-                    self.index_cb("unpublish", hash_=h, block=b)
+                    self._fire(self.index_cb, "unpublish", hash_=h, block=b)
                 if self.event_cb is not None:
-                    self.event_cb("eviction", block=b, tenant=self.name)
+                    self._fire(self.event_cb, "eviction", block=b,
+                               tenant=self.name)
             else:
                 self._free.remove(b)
         self.n_blocks -= m

@@ -1,0 +1,55 @@
+"""TZ101 fixture: guarded-attribute writes outside the owning lock."""
+import threading
+
+
+class Counter:
+    """Guard inferred: `_count` is assigned under `_lock` in bump()."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0         # __init__ writes are exempt (setup)
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def race(self):
+        self._count = 0                         # LINE: inferred
+
+    def reset_quiesced(self):
+        self._count = -1  # tpulint: disable=TZ101
+
+
+class Declared:
+    """Guard declared: the annotation names `_b` as the true owner, so
+    the write under `_a` (which bare inference would call ambiguous)
+    is exposed as a straggler."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._mode = "idle"
+
+    def set_a(self):
+        with self._a:
+            self._mode = "a"                    # LINE: declared
+
+    def set_b(self):
+        with self._b:
+            self._mode = "b"  # tpulint: guarded-by(_b)
+
+
+class Clean:
+    """Annotated AND consistent: every write holds the declared lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = None
+
+    def put(self, v):
+        with self._lock:
+            self._state = v  # tpulint: guarded-by(_lock)
+
+    def put_pair(self, v):
+        with self._lock:
+            self._state = (v, v)

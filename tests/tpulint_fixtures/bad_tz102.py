@@ -1,0 +1,29 @@
+"""TZ102 fixture: blocking calls while holding a lock."""
+import threading
+import time
+
+import jax
+
+
+class Engine:
+    def __init__(self):
+        self._pool_lock = threading.Lock()
+        self._host = {}
+
+    def spill(self, arr):
+        with self._pool_lock:
+            self._host["x"] = jax.device_get(arr)   # LINE: device_get
+
+    def nap(self):
+        with self._pool_lock:
+            time.sleep(0.01)                        # LINE: sleep
+
+    def baselined_nap(self):
+        with self._pool_lock:
+            time.sleep(0.01)  # tpulint: disable=TZ102
+
+    def fine(self, arr):
+        # record under the lock, do the device work after release
+        with self._pool_lock:
+            pending = list(self._host)
+        return jax.device_get(arr), pending

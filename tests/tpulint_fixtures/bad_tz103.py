@@ -1,0 +1,42 @@
+"""TZ103 fixture: callbacks under lock and non-record-only hooks."""
+import threading
+
+import jax.numpy as jnp
+from collections import OrderedDict as external_cb
+
+EVENTS = []
+
+
+def record_event(kind, **info):
+    EVENTS.append((kind, info))
+
+
+def heavy_hook(block, hash_):
+    return jnp.zeros((block,), jnp.float32)
+
+
+class Pool:
+    def __init__(self, event_cb=None):
+        self.event_cb = event_cb
+
+
+class Engine:
+    def __init__(self, on_done):
+        self._lock = threading.Lock()
+        self.on_done = on_done
+        self.clean = Pool(event_cb=record_event)    # record-only: fine
+        self.bad = Pool(event_cb=heavy_hook)        # LINE: impure
+        self.ext = Pool(event_cb=external_cb)       # LINE: foreign
+
+    def finish(self, req):
+        with self._lock:
+            self.on_done(req)                       # LINE: invoke
+
+    def finish_deferred(self, req):
+        with self._lock:
+            done = self.on_done
+        done(req)
+
+    def finish_suppressed(self, req):
+        with self._lock:
+            self.on_done(req)  # tpulint: disable=TZ103

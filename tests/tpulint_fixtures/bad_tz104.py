@@ -1,0 +1,41 @@
+"""TZ104 fixture: inconsistent lock-acquisition order.
+
+Deliberately importable (stdlib threading only): test_lockguard.py
+drives the SAME seeded inversion through the runtime LockGuard, so the
+static pass and the dynamic guard are cross-validated on one fixture.
+"""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._pool_lock = threading.Lock()
+        self._store_lock = threading.Lock()
+        self.spilled = 0
+        self.readmitted = 0
+
+    def spill(self):
+        with self._pool_lock:
+            with self._store_lock:              # LINE: forward
+                self.spilled += 1
+
+    def readmit(self):
+        with self._store_lock:
+            with self._pool_lock:               # LINE: inverted
+                self.readmitted += 1
+
+
+class Suppressed:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:  # tpulint: disable=TZ104
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:  # tpulint: disable=TZ104
+                pass

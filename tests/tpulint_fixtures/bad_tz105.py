@@ -1,0 +1,48 @@
+"""TZ105 fixture: double-acquire of a non-reentrant Lock."""
+import threading
+
+
+class Direct:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:                    # LINE: direct
+                pass
+
+
+class ViaCall:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []
+
+    def flush(self):
+        with self._lock:
+            self._drain()
+
+    def _drain(self):
+        with self._lock:                        # LINE: propagated
+            self._q.clear()
+
+
+class Reentrant:
+    """RLock: same shape, no finding — re-acquire is legal."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:
+                pass
+
+
+class Silenced:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:  # tpulint: disable=TZ105
+                pass

@@ -1,0 +1,32 @@
+"""TZ106 fixture: manual acquire() with a leaky early exit."""
+import threading
+
+
+class Leaky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def pop_bad(self):
+        self._lock.acquire()
+        if not self._items:
+            return None                         # LINE: leak
+        out = self._items.pop()
+        self._lock.release()
+        return out
+
+    def pop_good(self):
+        self._lock.acquire()
+        try:
+            if not self._items:
+                return None
+            return self._items.pop()
+        finally:
+            self._lock.release()
+
+    def pop_silenced(self):
+        self._lock.acquire()
+        if not self._items:
+            return None  # tpulint: disable=TZ106
+        self._lock.release()
+        return True

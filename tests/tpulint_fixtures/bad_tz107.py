@@ -1,0 +1,23 @@
+"""TZ107 fixture: threaded entry points touching shared state bare."""
+import threading
+
+STATS = {}
+
+_stats_lock = threading.Lock()
+
+
+class Router:
+    inflight = 0
+
+    def _route_loop(self):
+        STATS["last"] = 1                       # LINE: module
+        Router.inflight = 5                     # LINE: classattr
+
+    def _pump(self):
+        with _stats_lock:
+            STATS["ok"] = 1
+
+
+class Worker(threading.Thread):
+    def run(self):
+        STATS["worker"] = 1  # tpulint: disable=TZ107

@@ -1,0 +1,30 @@
+"""TZ108 fixture: Condition.wait without a predicate re-check loop."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._msgs = []
+
+    def take_bad(self):
+        with self._cond:
+            if not self._msgs:
+                self._cond.wait()               # LINE: bare
+            return self._msgs.pop()
+
+    def take_good(self):
+        with self._cond:
+            while not self._msgs:
+                self._cond.wait()
+            return self._msgs.pop()
+
+    def take_wait_for(self):
+        with self._cond:
+            self._cond.wait_for(lambda: self._msgs)
+            return self._msgs.pop()
+
+    def take_napped(self):
+        with self._cond:
+            self._cond.wait(0.1)  # tpulint: disable=TZ108
+            return self._msgs.pop()

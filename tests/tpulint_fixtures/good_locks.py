@@ -1,0 +1,59 @@
+"""Clean concurrency idioms: everything the TZ1xx pass must accept.
+
+Consistent pool -> store order, record-only hook, blocking work done
+after release, guarded writes, try/finally manual region, predicate
+loop around Condition.wait.
+"""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._pool_lock = threading.Lock()
+        self._store_lock = threading.Lock()
+        self._pending = []
+        self._count = 0
+
+    def _note_spill(self, block, hash_):
+        # record-only hook body: appends, no locks, no device work
+        self._pending.append((block, hash_))
+
+    def bump(self):
+        with self._pool_lock:
+            self._count += 1
+
+    def spill(self):
+        with self._pool_lock:
+            with self._store_lock:
+                work = list(self._pending)
+        return work
+
+    def readmit(self):
+        # same order as spill(): pool before store, always
+        with self._pool_lock:
+            with self._store_lock:
+                return len(self._pending)
+
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify()
+
+    def take(self):
+        with self._cond:
+            while not self._items:
+                self._cond.wait()
+            return self._items.pop(0)
+
+    def snapshot(self):
+        self._cond.acquire()
+        try:
+            return list(self._items)
+        finally:
+            self._cond.release()
