@@ -490,7 +490,7 @@ class DecoderAttention(nn.Module):
         return self.attn_out(o.astype(self.dtype)), cache_k, cache_v
 
     def decode_paged(self, xs, pool_k, pool_v, tables, pos, limit=None,
-                     kernel="gather"):
+                     kernel="gather", mesh=None, kv_sharded=True):
         """Cached decode of S tokens per row against a PAGED KV cache.
 
         Same contract as :meth:`decode_k` except the cache is one flat
@@ -508,7 +508,11 @@ class DecoderAttention(nn.Module):
         at positions >= limit[b] — chunked prefill's padding guard (see
         ops.flash_attention.paged_kv_update).  ``kernel`` selects the
         attention read path (``"gather"`` fallback or the ``"fused"``
-        Pallas kernel — ops.flash_attention.paged_attention).
+        Pallas kernel — ops.flash_attention.paged_attention).  ``mesh``
+        + ``kv_sharded`` (fused only) run the kernel per-chip under
+        shard_map against a tp-sharded (or, hatch, replicated) pool —
+        passed explicitly by the serving engine rather than read from
+        ``self.mesh`` because the engine owns the pool placement.
         """
         from analytics_zoo_tpu.ops.flash_attention import (
             paged_attention, paged_kv_update)
@@ -523,7 +527,8 @@ class DecoderAttention(nn.Module):
         pool_k, pool_v = paged_kv_update(pool_k, pool_v, tables, pos,
                                          ks, vs, limit=limit)
         o = paged_attention(q, pool_k, pool_v, tables, pos,
-                            kernel=kernel)
+                            kernel=kernel, mesh=mesh,
+                            kv_sharded=kv_sharded)
         return self.attn_out(o.astype(self.dtype)), pool_k, pool_v
 
 
@@ -629,10 +634,11 @@ class DecoderLayer(nn.Module):
         return xs, ck, cv
 
     def decode_paged(self, xs, pool_k, pool_v, tables, pos, limit=None,
-                     kernel="gather"):
+                     kernel="gather", mesh=None, kv_sharded=True):
         a, pk, pv = self.attention.decode_paged(
             self.ln_attn(xs).astype(self.dtype), pool_k, pool_v,
-            tables, pos, limit=limit, kernel=kernel)
+            tables, pos, limit=limit, kernel=kernel, mesh=mesh,
+            kv_sharded=kv_sharded)
         xs = xs + a
         xs = xs + self._mlp(self.ln_ffn(xs).astype(self.dtype), False)
         return xs, pk, pv
@@ -936,7 +942,7 @@ class TransformerLM(nn.Module):
         return self.ln_f(x), jnp.stack(ks), jnp.stack(vs)
 
     def decode_step_paged(self, tok, pools_k, pools_v, tables, pos,
-                          kernel="gather"):
+                          kernel="gather", mesh=None, kv_sharded=True):
         """One cached decode step against a PAGED KV cache.
 
         tok: [B] current tokens; pools_k/v: [n_layers, N, kv_heads, bs,
@@ -948,7 +954,10 @@ class TransformerLM(nn.Module):
         K/V written through its table at position pos[b] — attention
         reads only logical positions <= pos[b], so garbage in
         unwritten/sink blocks is never attended.  ``kernel`` picks the
-        gather fallback or the fused Pallas paged-attention kernel.
+        gather fallback or the fused Pallas paged-attention kernel;
+        ``mesh``/``kv_sharded`` run the fused kernel per-chip under
+        shard_map against the engine's tp-sharded (or replicated-hatch)
+        pool layout (ops.flash_attention.paged_attention).
         """
         if self.pp_stages > 0:
             raise NotImplementedError(
@@ -962,14 +971,16 @@ class TransformerLM(nn.Module):
         ks, vs = [], []
         for i, layer in enumerate(self.layers):
             x, pk, pv = layer.decode_paged(x, pools_k[i], pools_v[i],
-                                           tables, pos, kernel=kernel)
+                                           tables, pos, kernel=kernel,
+                                           mesh=mesh,
+                                           kv_sharded=kv_sharded)
             ks.append(pk)
             vs.append(pv)
         logits = self._logits(self.ln_f(x))[:, 0]
         return logits, _stack_kv(ks), _stack_kv(vs)
 
     def verify_step_paged(self, toks, pools_k, pools_v, tables, pos,
-                          kernel="gather"):
+                          kernel="gather", mesh=None, kv_sharded=True):
         """``verify_step`` against a paged cache: S tokens per row in one
         block-causal forward, K/V scattered through the block tables.
         Returns (logits [B, S, V], pools_k, pools_v).
@@ -982,11 +993,14 @@ class TransformerLM(nn.Module):
         costs zero block copies (ops/flash_attention.paged_kv_update
         documents the write/clamp contract)."""
         h, pk, pv = self.verify_hidden_paged(toks, pools_k, pools_v,
-                                             tables, pos, kernel=kernel)
+                                             tables, pos, kernel=kernel,
+                                             mesh=mesh,
+                                             kv_sharded=kv_sharded)
         return self._logits(h), pk, pv
 
     def verify_hidden_paged(self, toks, pools_k, pools_v, tables, pos,
-                            limit=None, kernel="gather"):
+                            limit=None, kernel="gather", mesh=None,
+                            kv_sharded=True):
         """``verify_step_paged`` minus the vocab head: (hidden [B, S,
         H], pools).  The paged-admission prefill consumes ONE position
         per row, gathers that hidden state, and applies the head to
@@ -1008,7 +1022,8 @@ class TransformerLM(nn.Module):
         for i, layer in enumerate(self.layers):
             x, pk, pv = layer.decode_paged(x, pools_k[i], pools_v[i],
                                            tables, pos, limit=limit,
-                                           kernel=kernel)
+                                           kernel=kernel, mesh=mesh,
+                                           kv_sharded=kv_sharded)
             ks.append(pk)
             vs.append(pv)
         return self.ln_f(x), _stack_kv(ks), _stack_kv(vs)
@@ -1039,7 +1054,8 @@ class TransformerLM(nn.Module):
         return self._logits(last_h)[:, 0], ck, cv
 
     def prefill_chunk_paged(self, toks, pools_k, pools_v, tables, pos,
-                            lens, kernel="gather"):
+                            lens, kernel="gather", mesh=None,
+                            kv_sharded=True):
         """The paged twin of :meth:`prefill_chunk`: the chunk's K/V
         scatter through per-row block tables into the shared pool, with
         writes LIMITED to ``pos + lens`` — padding columns write
@@ -1050,7 +1066,8 @@ class TransformerLM(nn.Module):
         h, pk, pv = self.verify_hidden_paged(toks, pools_k, pools_v,
                                              tables, pos,
                                              limit=pos + lens,
-                                             kernel=kernel)
+                                             kernel=kernel, mesh=mesh,
+                                             kv_sharded=kv_sharded)
         last_h = jnp.take_along_axis(h, (lens - 1)[:, None, None],
                                      axis=1)
         return self._logits(last_h)[:, 0], pk, pv

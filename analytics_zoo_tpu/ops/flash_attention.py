@@ -705,9 +705,68 @@ def _paged_attention_fused(q, pool_k, pool_v, tables, pos, interpret):
     return out.transpose(0, 2, 1, 3, 4).reshape(B, S, H, D)
 
 
+def _paged_attention_fused_tp(q, pool_k, pool_v, tables, pos, mesh,
+                              kv_sharded, interpret):
+    """The fused kernel under a tensor-parallel mesh.
+
+    A Mosaic kernel is a custom call XLA cannot GSPMD-partition, so the
+    tp-sharded pool is read through :func:`shard_map` instead: each chip
+    runs :func:`_paged_attention_fused` on its LOCAL pool shard — the
+    kv-heads grid dimension shrinks tp-fold (grid ``(B, KH/tp, M)`` per
+    chip) and the block-table indirection needs no change because
+    tables/pos are replicated host-side state.  Correctness rides the
+    head-contiguity of the layout: query head ``h = kh*G + g`` (GQA
+    fold), so a contiguous shard of the KV heads owns exactly the
+    contiguous shard of the query heads that attend through it — zero
+    collectives, like :func:`sharded_flash_attention`.
+
+    ``kv_sharded=False`` is the divisibility hatch (``KH % tp != 0``:
+    the engine replicates the pool instead of sharding it) — every spec
+    drops to replicated and each chip redundantly computes the full
+    attention, bitwise-equal across chips.
+
+    int8 ``QuantKV`` pools are unpacked into (data, scale) leaves so the
+    per-block scales shard on the same kv-heads axis as the data — one
+    spec per leaf, rebuilt into ``QuantKV`` inside the per-chip body.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    quant = isinstance(pool_k, QuantKV)
+    KH = (pool_k.data if quant else pool_k).shape[1]
+    tp = "tp" if ("tp" in mesh.axis_names and mesh.shape["tp"] > 1
+                  and kv_sharded) else None
+    if tp is not None and KH % mesh.shape["tp"]:
+        raise ValueError(
+            f"kv heads {KH} not divisible by tp={mesh.shape['tp']}: a "
+            f"pool this shape must be replicated (pass kv_sharded=False)")
+    q_spec = P(None, None, tp, None)        # [B, S, H, D]: heads
+    pool_spec = P(None, tp, None, None)     # [N, KH, bs, D]: kv heads
+    scale_spec = P(None, tp, None)          # [N, KH, bs]: kv heads
+    tab_spec = P(None, None)                # replicated host-side state
+    pos_spec = P(None)
+
+    if quant:
+        def local(qs, kd, ksc, vd, vsc, t, p):
+            return _paged_attention_fused(qs, QuantKV(kd, ksc),
+                                          QuantKV(vd, vsc), t, p,
+                                          interpret)
+        in_specs = (q_spec, pool_spec, scale_spec, pool_spec,
+                    scale_spec, tab_spec, pos_spec)
+        operands = (q, pool_k.data, pool_k.scale, pool_v.data,
+                    pool_v.scale, tables, pos)
+    else:
+        def local(qs, kd, vd, t, p):
+            return _paged_attention_fused(qs, kd, vd, t, p, interpret)
+        in_specs = (q_spec, pool_spec, pool_spec, tab_spec, pos_spec)
+        operands = (q, pool_k, pool_v, tables, pos)
+    return _shard_map(local, mesh=mesh, in_specs=in_specs,
+                      out_specs=q_spec, check_vma=False)(*operands)
+
+
 def paged_attention(q, pool_k, pool_v, tables, pos, *,
                     kernel: str = "gather",
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    mesh=None, kv_sharded: bool = True):
     """Block-causal attention of S query tokens per row against a PAGED
     KV cache: keys/values live behind per-row block tables in one flat
     head-major ``[N, KH, bs, D]`` pool (or a :class:`QuantKV` int8 pool
@@ -751,6 +810,14 @@ def paged_attention(q, pool_k, pool_v, tables, pos, *,
 
     ``interpret`` (fused only): run the kernel in Pallas interpret mode;
     defaults to True off-TPU, like :func:`flash_attention`.
+
+    ``mesh`` (fused only): run the kernel per-chip under
+    :func:`shard_map` — the tp-sharded-pool read path
+    (:func:`_paged_attention_fused_tp`).  ``kv_sharded`` says whether
+    the pool actually shards over ``tp`` on the kv-heads dim (the
+    engine's default layout) or is replicated (the ``KH % tp != 0``
+    hatch); it must match the pool's real placement.  The gather
+    fallback ignores both — ``jnp.take`` is GSPMD-partitionable as-is.
     """
     if kernel not in ("gather", "fused"):
         raise ValueError(f"kernel must be 'gather' or 'fused', got "
@@ -758,6 +825,10 @@ def paged_attention(q, pool_k, pool_v, tables, pos, *,
     if kernel == "fused":
         if interpret is None:
             interpret = _interpret_default()
+        if mesh is not None:
+            return _paged_attention_fused_tp(q, pool_k, pool_v, tables,
+                                             pos, mesh, kv_sharded,
+                                             bool(interpret))
         return _paged_attention_fused(q, pool_k, pool_v, tables, pos,
                                       bool(interpret))
     B, S, H, D = q.shape

@@ -380,14 +380,6 @@ class ContinuousEngine:
                 "lockstep and re-admitting only the target tenant's "
                 "chain would desynchronize them — serve the host tier "
                 "on non-speculative replicas")
-        if kernel == "fused" and mesh is not None:
-            raise ValueError(
-                "kernel='fused' does not run under a mesh yet: the "
-                "Pallas paged-attention kernel reads one chip's pool "
-                "(the ROADMAP follow-on 'fused paged-attention under "
-                "tp' lifts this); tp-sharded paged serving reads the "
-                "pool through kernel='gather' — drop kernel='fused' "
-                "to serve paged on this mesh")
         self.kernel = kernel
         if kv_dtype == "bf16":
             # explicit storage request wins over cache_dtype/model dtype
@@ -769,8 +761,13 @@ class ContinuousEngine:
 
         Lmax = L
         # static under jit: every paged program below compiles in the
-        # selected read kernel (gather reference / fused Pallas)
+        # selected read kernel (gather reference / fused Pallas).  The
+        # fused kernel under a mesh runs per-chip via shard_map against
+        # the pool's placement (tp-sharded kv heads, or the replicated
+        # KH % tp hatch) — kmesh/kv_tp are compile-time constants too.
         kern = self.kernel
+        kmesh = self.mesh if kern == "fused" else None
+        kv_tp = self._kv_tp
 
         def pick_next(logits, pos, done, temps, seeds, topps,
                       use_sample, use_topp):
@@ -834,6 +831,7 @@ class ContinuousEngine:
                 tok, pos, done, pk, pv = carry
                 logits, pk, pv = model.apply(
                     variables, tok, pk, pv, tables, pos, kernel=kern,
+                    mesh=kmesh, kv_sharded=kv_tp,
                     method=TransformerLM.decode_step_paged)
                 nxt, done = pick_next(logits, pos, done, temps, seeds,
                                       topps, use_sample, use_topp)
@@ -879,7 +877,7 @@ class ContinuousEngine:
             — never the [kb, sb, V] cube)."""
             return model.apply(
                 variables, suffixes, pk, pv, tables, pos, slens,
-                kernel=kern,
+                kernel=kern, mesh=kmesh, kv_sharded=kv_tp,
                 method=TransformerLM.prefill_chunk_paged)
 
         self._paged_admit = jax.jit(paged_admit_fn,
@@ -963,6 +961,7 @@ class ContinuousEngine:
             if with_decode:
                 logits, pk, pv = model.apply(
                     variables, tok, pk, pv, tables, pos, kernel=kern,
+                    mesh=kmesh, kv_sharded=kv_tp,
                     method=TransformerLM.decode_step_paged)
                 nxt, done = pick_next(logits, pos, done, temps, seeds,
                                       topps, use_sample, use_topp)
@@ -971,7 +970,7 @@ class ContinuousEngine:
                 nxt = tok
             clog, pk, pv = model.apply(
                 variables, ctoks, pk, pv, ctabs, cpos, clens,
-                kernel=kern,
+                kernel=kern, mesh=kmesh, kv_sharded=kv_tp,
                 method=TransformerLM.prefill_chunk_paged)
             cnxt, _ = pick_next(
                 clog, cpos + clens - 1,
@@ -1221,6 +1220,8 @@ class ContinuousEngine:
         S, L, k = self._S, self._L, self._spec_k
         eos_id = self.eos_id
         kern = self.kernel
+        kmesh = self.mesh if kern == "fused" else None
+        kv_tp, dkv_tp = self._kv_tp, self._dkv_tp
         self._dpos = np.zeros(S, np.int32)
 
         if self.paged:
@@ -1234,6 +1235,7 @@ class ContinuousEngine:
                     t, dpk, dpv, p = c
                     lg, dpk, dpv = draft.apply(
                         dvars, t, dpk, dpv, dtables, p, kernel=kern,
+                        mesh=kmesh, kv_sharded=dkv_tp,
                         method=TransformerLM.decode_step_paged)
                     nxt = jnp.argmax(lg, -1).astype(jnp.int32)
                     return (nxt, dpk, dpv, p + 1), nxt
@@ -1248,7 +1250,7 @@ class ContinuousEngine:
                 inputs = jnp.concatenate([tok[:, None], d], axis=1)
                 logits, pk, pv = model.apply(
                     variables, inputs, pk, pv, tables, pos,
-                    kernel=kern,
+                    kernel=kern, mesh=kmesh, kv_sharded=kv_tp,
                     method=TransformerLM.verify_step_paged)
                 t, n_emit, new_tok, done = accept_proposals(
                     logits, d, tok, done, k=k, eos_id=eos_id)
@@ -1271,7 +1273,7 @@ class ContinuousEngine:
                 logits are discarded (only the target picks tokens)."""
                 _, dpk, dpv = draft.apply(
                     dvars, suffixes, dpk, dpv, dtables, pos, slens,
-                    kernel=kern,
+                    kernel=kern, mesh=kmesh, kv_sharded=dkv_tp,
                     method=TransformerLM.prefill_chunk_paged)
                 return dpk, dpv
 
@@ -1346,11 +1348,11 @@ class ContinuousEngine:
                                     clens, ctabs, dctabs):
                 clog, pk, pv = model.apply(
                     variables, ctoks, pk, pv, ctabs, cpos, clens,
-                    kernel=kern,
+                    kernel=kern, mesh=kmesh, kv_sharded=kv_tp,
                     method=TransformerLM.prefill_chunk_paged)
                 _, dpk, dpv = draft.apply(
                     dvars, ctoks, dpk, dpv, dctabs, cpos, clens,
-                    kernel=kern,
+                    kernel=kern, mesh=kmesh, kv_sharded=dkv_tp,
                     method=TransformerLM.prefill_chunk_paged)
                 # greedy-only by the submit() contract, so the first
                 # pick is plain argmax (pick_next minus sampling/eos —

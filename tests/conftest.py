@@ -65,6 +65,7 @@ _HEAVY_NODEIDS = frozenset((
     "tests/test_continuous.py::test_cluster_serving_continuous_round_trip",
     "tests/test_continuous.py::test_cluster_serving_prefix_round_trip",
     "tests/test_continuous.py::test_engine_matches_solo_generation",
+    "tests/test_continuous.py::test_engine_multi_tick_matches_single_tick[4]",
     "tests/test_continuous.py::test_engine_multi_tick_sampling_reproducible",
     "tests/test_continuous.py::test_prefix_requests_match_concatenated_solo[False]",
     "tests/test_continuous.py::test_prefix_requests_match_concatenated_solo[True]",
@@ -98,6 +99,7 @@ _HEAVY_NODEIDS = frozenset((
     "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[spec-chunked]",
     "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[spec-paged]",
     "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[spec]",
+    "tests/test_mesh_paged.py::test_tp2_matches_tp1_all_combos[spec-paged-chunked]",
     "tests/test_model_zoo.py::test_dien_learns_history_membership",
     "tests/test_model_zoo.py::test_forecast_nets",
     "tests/test_moe.py::test_moe_bert_trains_ep_sharded",
@@ -121,7 +123,9 @@ _HEAVY_NODEIDS = frozenset((
     "tests/test_speculative.py::test_greedy_equality_random_draft",
     "tests/test_speculative.py::test_serving_path_speculative_equals_plain",
     "tests/test_speculative.py::test_verify_step_equals_sequential_decode",
+    "tests/test_tcmf.py::test_forecast_beats_last_value_baseline",
     "tests/test_tfpark_text.py::test_bert_classifier_builds_and_steps",
+    "tests/test_tfpark_text.py::test_ner_estimator_tags_tokens",
     "tests/test_tfpark_text.py::test_text_classification_lstm_encoder",
     "tests/test_transformer.py::test_bert_classifier_trains",
 ))

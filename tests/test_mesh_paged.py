@@ -6,7 +6,10 @@ mesh path pinned: greedy outputs BITWISE-identical between a tp=2 mesh
 engine, through admission, chunked prefill, speculative verify, EOS
 recycling and preemption alike — the pool shards over tp on the
 kv-heads dim, the block tables stay host-side/replicated, and XLA
-propagates the layout through every jitted program.
+propagates the layout through every jitted program.  ``kernel='fused'``
+now holds the same bar (it was the last read-path exclusion): the
+Pallas kernel runs per-chip under shard_map against the pool shard,
+with int8 QuantKV scales sharded on the same kv-heads axis.
 """
 
 import numpy as np
@@ -178,27 +181,104 @@ def test_mqa_fallback_replicates_pool(tp2_mesh):
     np.testing.assert_array_equal(solo["u0"], tp2["u0"])
 
 
-def test_fused_kernel_rejects_mesh(lm, tp2_mesh):
-    """The fused Pallas kernel is the ONE surviving mesh exclusion
-    (ROADMAP follow-on): rejected with a pointed error that names the
-    gather path as the mesh read path."""
+# fused kernel under the mesh: the former ValueError exclusion is
+# gone — the Pallas kernel runs per-chip via shard_map against the
+# tp-sharded pool (kv-heads grid dim shrinks tp-fold per chip), so the
+# parity bar is tp2-FUSED vs tp1-GATHER: one comparison crosses both
+# the kernel and the mesh at once
+FUSED_COMBOS = {
+    "paged": dict(paged=True, block_size=4),
+    "paged-chunked": dict(paged=True, block_size=4, chunked=True,
+                          tick_token_budget=8),
+    "spec-paged": dict(paged=True, block_size=4, _spec=True),
+    "spec-paged-chunked": dict(paged=True, block_size=4, chunked=True,
+                               tick_token_budget=12, _spec=True),
+}
+
+
+@pytest.mark.parametrize("combo", [
+    # the three-way composition rides the slow lane (two engines x two
+    # program families compile-heavy); the pairwise combos stay tier-1
+    pytest.param(m, marks=pytest.mark.slow)
+    if m == "spec-paged-chunked" else m
+    for m in FUSED_COMBOS])
+def test_fused_tp2_matches_gather_tp1(lm, draft_lm, tp2_mesh, combo):
+    """The acceptance bar for the fused-under-tp read path: greedy
+    decode under tp=2 with kernel='fused' (Pallas interpret mode on
+    the 8-device host mesh) BITWISE-identical to the tp=1 gather
+    reference for every {paged, chunked, speculative} combination."""
     model, variables = lm
-    with pytest.raises(ValueError, match="gather"):
-        ContinuousEngine(model, variables, mesh=tp2_mesh,
-                         max_new_tokens=4, max_slots=2,
-                         prompt_buckets=(8,), paged=True,
-                         block_size=4, kernel="fused")
+    kw = dict(FUSED_COMBOS[combo])
+    if kw.pop("_spec", False):
+        dm, dvv = draft_lm
+        kw.update(draft_model=dm, draft_variables=dvv, speculation_k=2)
+    rng = np.random.default_rng(33)
+    lengths = (4, 12, 6) if "chunked" in combo else (4, 6, 5)
+    prompts = {f"u{i}": rng.integers(1, 32, n).astype(np.int32)
+               for i, n in enumerate(lengths)}
+    ref = _run(model, variables, None, dict(kw, kernel="gather"),
+               prompts)
+    out = _run(model, variables, tp2_mesh, dict(kw, kernel="fused"),
+               prompts)
+    for u in prompts:
+        np.testing.assert_array_equal(ref[u], out[u],
+                                      err_msg=f"{combo}:{u}")
 
 
-def test_paged_mesh_zero_steady_state_retraces(lm, tp2_mesh):
+def test_fused_tp_int8_matches_f32_argmax(lm, tp2_mesh):
+    """int8 QuantKV under the fused-tp path: the per-block scales shard
+    on the same kv-heads axis as the data, and on this peaked-free tiny
+    model the greedy tokens equal the f32 tp=1 gather engine's exactly
+    (the same f32-argmax bar test_paged_fused.py pins on one chip)."""
+    model, variables = lm
+    prompts = {"u0": np.asarray([3, 5, 9, 4], np.int32),
+               "u1": np.asarray([11, 2, 8, 6, 1, 7], np.int32)}
+    ref = _run(model, variables, None,
+               dict(paged=True, block_size=4), prompts)
+    out = _run(model, variables, tp2_mesh,
+               dict(paged=True, block_size=4, kernel="fused",
+                    kv_dtype="int8"), prompts)
+    for u in prompts:
+        np.testing.assert_array_equal(ref[u], out[u], err_msg=u)
+
+
+def test_fused_mqa_replicated_pool_hatch(tp2_mesh):
+    """The KH % tp != 0 divisibility hatch carries to the fused kernel:
+    with the k/v kernels replicated by partition_rules the pool stays
+    replicated and the fused read runs per-chip on the FULL pool
+    (kv_sharded=False under shard_map) — same tokens as the single-chip
+    fused engine."""
+    from jax.sharding import PartitionSpec as P
+
+    mqa = TransformerLM(vocab_size=32, hidden_size=32, num_layers=1,
+                        num_heads=4, num_kv_heads=1,
+                        intermediate_size=48, max_position=64,
+                        dtype=jnp.float32)
+    mv = mqa.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+    rules = ((r"(key|value)/kernel", P()),) + LM_PARTITION_RULES
+    prompts = {"u0": np.asarray([3, 5, 9], np.int32)}
+    solo = _run(mqa, mv, None,
+                dict(paged=True, block_size=4, kernel="fused"), prompts)
+    tp2 = _run(mqa, mv, tp2_mesh,
+               dict(paged=True, block_size=4, kernel="fused",
+                    partition_rules=rules), prompts)
+    np.testing.assert_array_equal(solo["u0"], tp2["u0"])
+
+
+@pytest.mark.parametrize("mode", ["gather", "fused-int8"])
+def test_paged_mesh_zero_steady_state_retraces(lm, tp2_mesh, mode):
     """The acceptance bar from the arena path carries over: after
     warmup, the tp-sharded paged decode loop compiles NOTHING —
-    shardings ride the trace, they are not part of its key."""
+    shardings ride the trace, they are not part of its key.  The
+    fused-int8 mode holds the same bar: the shard_map-wrapped Pallas
+    call and the QuantKV scale leaves must not add per-tick compiles."""
     model, variables = lm
+    kw = (dict(kernel="fused", kv_dtype="int8")
+          if mode == "fused-int8" else {})
     eng = ContinuousEngine(model, variables, mesh=tp2_mesh,
                            max_new_tokens=5, max_slots=3,
                            prompt_buckets=(8, 16), paged=True,
-                           block_size=4)
+                           block_size=4, **kw)
     rng = np.random.default_rng(7)
 
     def _round(tag):
@@ -213,5 +293,5 @@ def test_paged_mesh_zero_steady_state_retraces(lm, tp2_mesh):
 
     _round("warm1")
     _round("warm2")
-    with trace_guard(eng, name="mesh-paged-steady"):
+    with trace_guard(eng, name=f"mesh-paged-{mode}-steady"):
         _round("live")

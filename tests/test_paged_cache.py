@@ -373,13 +373,13 @@ def test_paged_validation_and_cache_dtype_errors(lm):
         ContinuousEngine(model, variables, max_new_tokens=4, paged=True,
                          block_size=4, draft_model=draft,
                          draft_variables=dvars, draft_n_blocks=2)
-    # paged + mesh composes now (tests/test_mesh_paged.py pins parity);
-    # the one exclusion left is the fused Pallas kernel, which reads a
-    # single chip's pool
+    # paged + mesh composes for BOTH kernels now: the fused Pallas
+    # kernel runs per-chip under shard_map (tests/test_mesh_paged.py
+    # pins parity), so fused + mesh constructs without complaint
     mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("dp",))
-    with pytest.raises(ValueError, match="fused"):
-        ContinuousEngine(model, variables, max_new_tokens=4, paged=True,
-                         kernel="fused", mesh=mesh)
+    eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                           paged=True, kernel="fused", mesh=mesh)
+    assert eng.kernel == "fused" and eng.mesh is mesh
 
 
 def test_paged_gqa_cache_dtype_parity():

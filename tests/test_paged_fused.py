@@ -112,6 +112,69 @@ def test_paged_attention_rejects_unknown_kernel():
 
 
 # ---------------------------------------------------------------------------
+# op-level under a tensor-parallel mesh: the fused kernel reads a
+# tp-SHARDED pool per-chip via shard_map (kv-heads grid dim shrinks
+# tp-fold), int8 scales sharded on the same kv-heads axis
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tp2_mesh():
+    from analytics_zoo_tpu.parallel.mesh import make_mesh
+    return make_mesh(axes={"dp": -1, "tp": 2})
+
+
+def _shard_pool(pool, mesh):
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    if isinstance(pool, fa.QuantKV):
+        return fa.QuantKV(
+            jax.device_put(pool.data,
+                           NamedSharding(mesh, P(None, "tp", None,
+                                                 None))),
+            jax.device_put(pool.scale,
+                           NamedSharding(mesh, P(None, "tp", None))))
+    return jax.device_put(pool,
+                          NamedSharding(mesh, P(None, "tp", None,
+                                                None)))
+
+
+@pytest.mark.parametrize("int8", [False, True], ids=["f32", "int8"])
+def test_fused_tp_sharded_pool_matches(tp2_mesh, int8):
+    """Fused on a tp-sharded pool: BITWISE-equal to the single-chip
+    fused kernel (each chip computes its own kv heads' fold with the
+    identical per-head program) and gather-close like the solo path."""
+    q, pk, pv, tables, pos = _pool_case(S=3, int8=int8)
+    solo = fa.paged_attention(q, pk, pv, tables, pos, kernel="fused",
+                              interpret=True)
+    ref = fa.paged_attention(q, pk, pv, tables, pos, kernel="gather")
+    out = fa.paged_attention(q, _shard_pool(pk, tp2_mesh),
+                             _shard_pool(pv, tp2_mesh), tables, pos,
+                             kernel="fused", interpret=True,
+                             mesh=tp2_mesh)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(solo))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_tp_replicated_hatch_and_divisibility(tp2_mesh):
+    """KH % tp != 0 (MQA, KH=1 under tp=2): kv_sharded=True is a loud
+    error (the pool CANNOT shard that way), and kv_sharded=False — the
+    replicated-pool hatch the engine takes — computes the full
+    attention redundantly per chip, bitwise-equal to one chip."""
+    q, pk, pv, tables, pos = _pool_case(H=4, KH=1)
+    solo = fa.paged_attention(q, pk, pv, tables, pos, kernel="fused",
+                              interpret=True)
+    with pytest.raises(ValueError, match="divisible"):
+        fa.paged_attention(q, pk, pv, tables, pos, kernel="fused",
+                           interpret=True, mesh=tp2_mesh)
+    out = fa.paged_attention(q, pk, pv, tables, pos, kernel="fused",
+                             interpret=True, mesh=tp2_mesh,
+                             kv_sharded=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(solo))
+
+
+# ---------------------------------------------------------------------------
 # quantization: round-trip bounds + pytree behavior + write path
 # ---------------------------------------------------------------------------
 
