@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test verify examples bench native serve-smoke sim-gate lint clean
+.PHONY: test verify examples bench native serve-smoke chaos-smoke \
+	sim-gate lint clean
 
 # full suite on the 8-virtual-device CPU mesh (tests/conftest.py forces it)
 test:
@@ -69,6 +70,16 @@ serve-smoke:
 	# test_flight.py above; docs/simulation.md)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sim.py -q
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --smoke
+
+# crash-tolerance chaos leg, standalone (also runs inside serve-smoke's
+# bench_serving --smoke chain): a live 3-replica prefill/decode fleet
+# under deterministic fault injection — one decode pump crashes and one
+# KV handoff is dropped; every request must reach a terminal result
+# with at-least-once `attempts` recorded, and /metrics must show the
+# death, the redispatch, and the handoff ack-timeout recovery
+# (docs/debugging.md "Crash recovery runbook").
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --chaos-smoke
 
 # CI gate for scheduler regressions: run the pinned golden scenario
 # (tests/golden/sim_golden.json) through the offline discrete-event

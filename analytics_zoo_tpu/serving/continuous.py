@@ -208,6 +208,7 @@ class ContinuousEngine:
                  kv_host_store_bytes: int = 0,
                  prefix_directory=None,
                  replica_id: int = 0,
+                 fault_injector=None,
                  chunked: bool = False,
                  tick_token_budget: Optional[int] = None,
                  record_timings: bool = False,
@@ -445,6 +446,9 @@ class ContinuousEngine:
         self._kv_store: Optional[HostKVStore] = None
         self._prefix_directory = prefix_directory
         self._replica_id = int(replica_id)
+        # chaos harness (serving/fault.py): None = injection off, and
+        # every hook below is a no-op — bit-identical behavior
+        self._fault = fault_injector
         self._kv_spills = 0
         self._kv_spill_bytes = 0
         self._kv_readmits = 0
@@ -2275,6 +2279,18 @@ class ContinuousEngine:
         self._handoffs_in += 1
         self.telemetry.req_admitted(req.uri, slot,
                                     priority=req.priority)
+        # two-phase handoff ack: adoption is now durable on THIS
+        # engine, so the source may release its retained state.  The
+        # callback is record-only by contract (the broker pops a
+        # pending-handoff entry and bumps a counter) and must never
+        # re-enter this engine.
+        ack = state.get("on_adopt")
+        if ack is not None:
+            try:
+                ack(req.uri, self._replica_id)
+            except Exception:
+                logger.exception("handoff adoption ack failed for %r",
+                                 req.uri)
         return "admitted"
 
     # ---- tiered KV memory (serving/kv_store.py) -----------------------
@@ -3162,6 +3178,8 @@ class ContinuousEngine:
             # idle poll (the serving pump spins on step()): no work to
             # do or measure, and no tick event to spam the ring with
             return 0
+        if self._fault is not None:
+            self._fault_tick()
         t0 = time.monotonic()
         n = self._step_impl()
         dur = time.monotonic() - t0
@@ -3170,6 +3188,29 @@ class ContinuousEngine:
         if self.flight is not None:
             self._flight_record(t0, dur, samples)
         return n
+
+    def _fault_tick(self) -> None:
+        """Apply the due engine-side fault actions for this BUSY tick
+        (serving/fault.py): a ``freeze_tick`` sleeps here (a wedged
+        device — the pump misses heartbeats), an ``alloc_storm`` tick
+        records a pool allocation failure (driving the alloc-fail
+        streak, anomaly trigger, and router pressure without draining
+        the pool), and a ``raise_step`` escapes as
+        :class:`~analytics_zoo_tpu.serving.fault.InjectedFault` out of
+        ``step()`` — the pump's crash handler path."""
+        acts = self._fault.tick_actions(self._replica_id)
+        if not acts:
+            return
+        freeze = acts.get("freeze_s", 0.0)
+        if freeze > 0:
+            time.sleep(freeze)
+        if acts.get("alloc_fail") and self._pool is not None:
+            with self._pool_lock:
+                self._pool.alloc_failures += 1
+        msg = acts.get("raise_step")
+        if msg:
+            from .fault import InjectedFault
+            raise InjectedFault(msg)
 
     def _tick_samples(self, n_active: int) -> dict:
         """Post-tick residency mix + queue/pool pressure, as plain host

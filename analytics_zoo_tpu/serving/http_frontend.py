@@ -202,6 +202,23 @@ class HttpFrontend:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_503_dead(self):
+                # zero live replicas: refusing with a finite
+                # Retry-After beats accepting a submit that can never
+                # be placed (the supervisor may be restarting pumps —
+                # clients should back off and retry, not hang)
+                ra = frontend._retry_after(None)
+                body = json.dumps(
+                    {"error": "no live replicas — fleet is "
+                              "recovering",
+                     "retry_after_s": ra}).encode()
+                self.send_response(503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", str(ra))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 if path == "/healthz":
@@ -255,6 +272,9 @@ class HttpFrontend:
                     return
                 if self.path != "/predict":
                     self._send(404, {"error": f"no route {self.path}"})
+                    return
+                if frontend._fleet_dead():
+                    self._send_503_dead()
                     return
                 t0 = time.perf_counter()
                 # record failures too — excluding timeouts would hide the
@@ -346,6 +366,9 @@ class HttpFrontend:
 
             def _do_generate(self):
                 t0 = time.perf_counter()
+                if frontend._fleet_dead():
+                    self._send_503_dead()
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -430,6 +453,15 @@ class HttpFrontend:
                             self.wfile.write(sse_event(
                                 "token", {"index": ev["index"],
                                           "token": ev["token"]}))
+                        elif "restart" in ev:
+                            # crash-recovery redispatch: the token
+                            # index resets to 0 and the generation
+                            # re-streams — the client must drop what
+                            # it buffered, never splice
+                            self.wfile.write(sse_event(
+                                "restart",
+                                {"uri": uri,
+                                 "attempt": ev["restart"]}))
                         elif "done" in ev:
                             self.wfile.write(sse_event(
                                 "done", {"uri": uri}))
@@ -611,6 +643,19 @@ class HttpFrontend:
             out["text"] = self.tokenizer.decode(ids.tolist())
         return out
 
+    def _fleet_dead(self) -> bool:
+        """True only when the attached serving job positively reports
+        ZERO live pumps (``accepting_replicas() == 0``): detached
+        frontends and micro-batch jobs (``None``) keep accepting —
+        this guard is about not swallowing submits the router can
+        never place."""
+        if self.serving is None:
+            return False
+        try:
+            return self.serving.accepting_replicas() == 0
+        except Exception:
+            return False
+
     def _retry_after(self, depth=None) -> int:
         """Finite Retry-After for a 429: queue depth over the engine's
         recent completion throughput (frontdoor.retry_after_s clamps
@@ -645,12 +690,19 @@ class HttpFrontend:
             depth = None
         accepting = (depth is None or not self.max_backlog
                      or depth < self.max_backlog)
+        fleet_dead = self._fleet_dead()
+        if fleet_dead:
+            # zero live replicas beats any backlog arithmetic: the
+            # fleet cannot place work at all until a pump returns
+            accepting = False
         out.update({
             "backlog": depth,
             "accepting": accepting,
             "backpressure": not accepting,
             "engine": self.serving.mode_flags(),
         })
+        if fleet_dead:
+            out["live_replicas"] = 0
         wd = getattr(self.serving, "watchdog", None)
         if wd is not None:
             # the routing view of the SLO score: per-class goodput and

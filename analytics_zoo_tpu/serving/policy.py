@@ -169,6 +169,12 @@ class ReplicaSignals:
     goodput: Optional[Dict[str, float]] = None
     role: Optional[str] = None
     prefix_blocks: int = 0
+    #: Seconds since the replica's pump thread last stamped its
+    #: heartbeat (``None`` = no supervisor running / pump never beat).
+    #: A liveness input for ``replica_dead``, NOT a rank term —
+    #: ``route_request`` ignores it, so fleets without a supervisor
+    #: keep bit-identical ranks.
+    heartbeat_age_s: Optional[float] = None
 
 
 def replica_pressured(sig: ReplicaSignals,
@@ -253,6 +259,88 @@ def route_request(replicas: Sequence[ReplicaSignals],
                 (r.replica - rr_cursor) % n)
 
     return min(live, key=rank).replica
+
+
+# ---------------------------------------------------------------------------
+# fleet supervision: declare-dead / retry-budget / pick-retry-target
+# (ClusterServing supervisor + serving/sim FleetModel faults)
+# ---------------------------------------------------------------------------
+
+def replica_dead(heartbeat_age_s: Optional[float],
+                 miss_s: float) -> bool:
+    """Liveness verdict for the supervisor: a pump that has not
+    stamped its heartbeat for ``miss_s`` seconds is declared dead
+    (wedged tick, frozen device, or a thread that silently exited).
+    ``miss_s <= 0`` disables heartbeat-based death (escaped pump
+    exceptions still declare death explicitly); ``None`` age means no
+    beat was ever observed — never declared dead on silence alone,
+    the pump may simply not have started."""
+    if miss_s <= 0 or heartbeat_age_s is None:
+        return False
+    return heartbeat_age_s > miss_s
+
+
+def plan_redispatch(*, attempt: int, retry_budget: int,
+                    cancelled: bool = False,
+                    age_s: float = 0.0,
+                    deadline_s: float = 0.0) -> str:
+    """Terminal-or-retry decision for one lost in-flight request (its
+    replica was declared dead).  Returns one of:
+
+    - ``"cancel"`` — the client already cancelled it; surface the
+      terminal *cancelled*, never resurrect it on a survivor;
+    - ``"error"`` — retry budget exhausted (``attempt`` placements
+      already happened and ``attempt >= retry_budget``) or the
+      request's deadline passed (``deadline_s > 0`` and
+      ``age_s > deadline_s``): terminal error, at-least-once gives up
+      loudly rather than looping forever;
+    - ``"retry"`` — re-dispatch to a survivor (the caller increments
+      the attempt counter and emits the client-visible ``restart``).
+
+    ``attempt`` counts placements so far (first submit = 1);
+    ``retry_budget`` is the MAX total placements a request may
+    consume."""
+    if cancelled:
+        return "cancel"
+    if attempt >= max(1, retry_budget):
+        return "error"
+    if deadline_s > 0 and age_s > deadline_s:
+        return "error"
+    return "retry"
+
+
+def pick_retry_target(replicas: Sequence[ReplicaSignals],
+                      priority: Optional[str] = None,
+                      rr_cursor: int = 0,
+                      *,
+                      exclude: Sequence[int] = (),
+                      phase: Optional[str] = None) -> Optional[int]:
+    """Placement for a re-dispatched request: ``route_request`` over
+    the survivors, never the replicas in ``exclude`` (the dead source,
+    or a handoff destination that already timed out) even if their
+    signals still read live — the supervisor may re-dispatch before
+    the death propagates into a fresh snapshot.  Returns ``None``
+    when no eligible replica remains (the caller parks or errors)."""
+    bad = set(exclude)
+    eligible = [r for r in replicas if r.replica not in bad]
+    return route_request(eligible, priority, rr_cursor, phase=phase)
+
+
+def plan_handoff_recovery(*, age_s: float, timeout_s: float,
+                          retries: int, retry_budget: int) -> str:
+    """Two-phase handoff: the prefill source holds the exported chain
+    until the decode side acks adoption.  Given a pending (un-acked)
+    handoff's age, decide ``"wait"`` (not yet timed out), ``"retry"``
+    (timed out, budget left: re-dispatch to an alternate decode
+    replica), or ``"give_up"`` (timed out past the budget: the caller
+    errors the request terminally).  ``timeout_s <= 0`` disables the
+    timeout — pending entries wait for the ack forever (the pre-
+    supervisor fire-and-forget behavior)."""
+    if timeout_s <= 0 or age_s <= timeout_s:
+        return "wait"
+    if retries < max(0, retry_budget):
+        return "retry"
+    return "give_up"
 
 
 # ---------------------------------------------------------------------------

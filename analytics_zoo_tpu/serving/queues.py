@@ -201,8 +201,13 @@ class OutputQueue:
         touch its socket and detect a dead client while the engine is
         between tokens.  Re-emitted tokens after an engine preemption
         are deduplicated by index (a readmitted row regenerates its
-        tokens deterministically).  Raises ``TimeoutError`` when no
-        event lands for ``timeout`` seconds."""
+        tokens deterministically).  A ``{"restart": attempt}`` event
+        surfaces a crash-recovery redispatch (the broker re-placed
+        the request on a surviving replica): the emitted-token index
+        resets to 0 and the generation re-streams from the start —
+        consumers must discard buffered tokens, never splice.
+        Raises ``TimeoutError`` when no event lands for ``timeout``
+        seconds."""
         key = TOKEN_PREFIX + uri
         last = b"0-0"
         next_index = 0
@@ -224,7 +229,14 @@ class OutputQueue:
                 last = eid
                 f = {flat[i].decode(): flat[i + 1]
                      for i in range(0, len(flat), 2)}
-                if "t" in f:
+                if "restart" in f:
+                    # crash-recovery redispatch: the replay starts
+                    # over at index 0, so the dedup watermark must
+                    # reset or every re-emitted token gets swallowed
+                    next_index = 0
+                    deadline = time.monotonic() + timeout
+                    yield {"restart": int(f["restart"])}
+                elif "t" in f:
                     idx = int(f.get("i", b"-1"))
                     if idx < next_index:    # preemption re-emission
                         continue

@@ -130,6 +130,20 @@ def _id_after(eid: bytes, last: bytes) -> bool:
     return parse(eid) > parse(last)
 
 
+def _range_bound(x: bytes, *, high: bool) -> Tuple[int, int]:
+    """Parse an XRANGE start/end bound: '-'/'+' sentinels, and a bare
+    ms timestamp means seq 0 at the start bound / seq max at the end
+    bound (Redis semantics — both bounds are inclusive)."""
+    if x == b"-":
+        return (0, 0)
+    if x == b"+":
+        return (1 << 63, 1 << 63)
+    a, dash, b = x.partition(b"-")
+    if dash:
+        return (int(a), int(b))
+    return (int(a), (1 << 63) if high else 0)
+
+
 def _scan_read_opts(args: List[bytes], i: int):
     """Parse [COUNT c] [BLOCK ms] up to STREAMS; returns (count, block_ms,
     index-of-STREAMS) — shared by XREAD and XREADGROUP."""
@@ -302,8 +316,23 @@ class RespServer:
         if cmd == b"XLEN":
             return len(self._stream(args[1]).entries)
         if cmd == b"XRANGE":
+            # XRANGE key start end [COUNT n] — inclusive id range; the
+            # router leans on exact-id lookups (`XRANGE k eid eid`) to
+            # re-read a dead replica's in-flight entries, so honouring
+            # the bounds is correctness-critical, not a nicety.
             s = self._stream(args[1])
-            return [[eid, fv] for eid, fv in s.entries]
+            lo = _range_bound(args[2], high=False)
+            hi = _range_bound(args[3], high=True)
+            count = int(args[5]) if len(args) > 5 and \
+                args[4].upper() == b"COUNT" else None
+
+            def _pid(eid: bytes) -> Tuple[int, int]:
+                a, _, b = eid.partition(b"-")
+                return (int(a), int(b or 0))
+            with s.cond:
+                got = [[eid, fv] for eid, fv in s.entries
+                       if lo <= _pid(eid) <= hi]
+            return got[:count] if count else got
         if cmd == b"XDEL":
             s = self._stream(args[1])
             ids = set(args[2:])

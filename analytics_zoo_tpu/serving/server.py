@@ -36,10 +36,15 @@ from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
                                                  TokenEmitter,
                                                  decode_priority,
                                                  decode_str_field)
+from analytics_zoo_tpu.serving.fault import FaultInjector, InjectedFault
 from analytics_zoo_tpu.serving.kv_store import PrefixDirectory
 from analytics_zoo_tpu.serving.paged_cache import chain_hashes
 from analytics_zoo_tpu.serving.policy import (REPLICA_ROLES,
                                                 ReplicaSignals,
+                                                pick_retry_target,
+                                                plan_handoff_recovery,
+                                                plan_redispatch,
+                                                replica_dead,
                                                 route_request)
 from analytics_zoo_tpu.serving.queues import (
     CANCEL_STREAM, IMG_MAGIC, INPUT_STREAM, RESULT_PREFIX, SIGNAL_PREFIX,
@@ -203,6 +208,36 @@ class ServingConfig:
     anomaly_breach_window_s: float = 10.0
     anomaly_alloc_streak: int = 8
     anomaly_steady_ticks: int = 500
+    # Fleet crash-tolerance (serving/fault.py + the broker supervisor;
+    # docs/debugging.md "Crash recovery runbook").  fault_injection is
+    # a deterministic chaos schedule — a list of fault-spec dicts
+    # (fault.FaultSpec fields: kind / replica / at_tick / at_handoff /
+    # count / duration_s).  None = injection OFF, every serving path
+    # bit-identical to previous releases.
+    fault_injection: Optional[List[dict]] = None
+    fault_seed: int = 0
+    # Supervisor: a pump silent for supervisor_miss_s seconds is
+    # declared dead (policy.replica_dead) and its lost in-flight
+    # requests re-dispatch to survivors; 0 disables heartbeat-based
+    # death (an exception ESCAPING a pump thread always declares it).
+    supervisor_miss_s: float = 0.0
+    # At-least-once recovery: max total placements one request may
+    # consume (first submit counts as attempt 1); past the budget the
+    # supervisor publishes a terminal error instead of re-dispatching.
+    retry_budget: int = 2
+    # Two-phase handoff: the prefill source retains the exported state
+    # until the decode side acks adoption; un-acked entries this old
+    # re-dispatch to an alternate decode replica (0 = fire-and-forget,
+    # the pre-supervisor behavior).
+    handoff_ack_timeout_s: float = 5.0
+    # A request the router cannot place (zero live replicas) parks for
+    # at most this long before a terminal error — bounded wait, never
+    # forever.
+    unrouted_ttl_s: float = 5.0
+    # Optional end-to-end deadline: a lost request older than this is
+    # errored instead of re-dispatched (0 = no deadline; the
+    # result_ttl_s prune remains the backstop).
+    request_deadline_s: float = 0.0
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -305,9 +340,19 @@ class ServingConfig:
                           ("anomaly_breach_burst", int),
                           ("anomaly_breach_window_s", float),
                           ("anomaly_alloc_streak", int),
-                          ("anomaly_steady_ticks", int)):
+                          ("anomaly_steady_ticks", int),
+                          ("fault_seed", int),
+                          ("supervisor_miss_s", float),
+                          ("retry_budget", int),
+                          ("handoff_ack_timeout_s", float),
+                          ("unrouted_ttl_s", float),
+                          ("request_deadline_s", float)):
             if key in params:
                 setattr(cfg, key, cast(params[key]))
+        if "fault_injection" in params:
+            v = params["fault_injection"]
+            cfg.fault_injection = (None if v is None
+                                   else [dict(d) for d in v])
         return cfg
 
     def slo_policy(self) -> SloPolicy:
@@ -506,6 +551,38 @@ class ClusterServing:
         self._router_cancelled: set = set()
         self._routed_counts = [0] * self.n_replicas
         self._rerouted_count = 0
+        # ---- supervisor state (fleet crash-tolerance) ------------------
+        # heartbeats: each pump stamps its slot once per loop pass;
+        # the router's liveness sweep reads them through
+        # replica_signals -> policy.replica_dead.  Death bookkeeping,
+        # per-request attempt counters, parked-unrouted entries and
+        # pending (un-acked) two-phase handoffs all live under
+        # _rq_cond with the rest of the placement state.
+        self._beats = [0.0] * self.n_replicas
+        self._death_reasons: List[Optional[str]] = \
+            [None] * self.n_replicas
+        self._dead_unswept: set = set()
+        self._deaths = 0
+        self._redispatched = 0
+        self._unrouted_expired = 0
+        # uri -> total placements so far (absent = 1, the first submit)
+        self._attempts: Dict[str, int] = {}
+        # (fields, eid, parked_at) the router could not place anywhere
+        self._unrouted: collections.deque = collections.deque()
+        # uri -> {state, src, dst, sent_at, retries} exported prefills
+        # whose decode-side adoption has not acked yet — the retained
+        # reference that makes the handoff two-phase
+        self._pending_handoffs: Dict[str, dict] = {}
+        self._handoff_acks = 0
+        self._handoff_timeouts = 0
+        self._handoff_retries = 0
+        # chaos harness: parse the schedule eagerly so a bad spec
+        # fails at assembly, not from a pump thread mid-request.
+        # None/empty = injection off — every path bit-identical.
+        faults = getattr(self.config, "fault_injection", None)
+        self._fault = (FaultInjector(
+            faults, seed=getattr(self.config, "fault_seed", 0))
+            if faults else None)
         if self.n_replicas > 1:
             self._register_router_gauges()
         self._img_resize = None
@@ -592,6 +669,27 @@ class ClusterServing:
             "zoo_router_handoff_seconds",
             "wall seconds from prefill export to decode-side "
             "adoption enqueue (route + chain ship)")
+        # crash-tolerance families (docs/debugging.md "Crash recovery
+        # runbook"): stable names whether or not faults ever fire
+        m.gauge("zoo_router_replica_deaths_total",
+                "replicas the supervisor declared dead (escaped pump "
+                "exception or missed heartbeats)",
+                fn=lambda: self._deaths, kind="counter")
+        m.gauge("zoo_router_requests_redispatched_total",
+                "lost in-flight requests re-dispatched to survivors "
+                "(at-least-once recovery)",
+                fn=lambda: self._redispatched, kind="counter")
+        m.gauge("zoo_engine_handoff_acks_total",
+                "two-phase handoffs whose decode-side adoption acked "
+                "(the source's retained state released)",
+                fn=lambda: self._handoff_acks, kind="counter")
+        m.gauge("zoo_engine_handoff_timeouts_total",
+                "pending handoffs that hit the ack timeout",
+                fn=lambda: self._handoff_timeouts, kind="counter")
+        m.gauge("zoo_engine_handoff_retries_total",
+                "timed-out handoffs re-dispatched to an alternate "
+                "decode replica",
+                fn=lambda: self._handoff_retries, kind="counter")
 
     # ---- lifecycle ----------------------------------------------------
 
@@ -707,6 +805,7 @@ class ClusterServing:
                     self.config, "engine_kv_host_store_bytes", 0),
                 prefix_directory=self._prefix_directory,
                 replica_id=r,
+                fault_injector=self._fault,
                 telemetry=self.telemetries[r],
                 qos=qos,
                 flight=self.flights[r],
@@ -943,12 +1042,19 @@ class ClusterServing:
                 # tokens, so the flush preserves emission order
                 streaming.discard(uri)
                 emitter.finish(uri)
+            att = self._attempts.get(uri, 1)
             try:
-                client.pipeline([
+                cmds = [
                     ("HSET", RESULT_PREFIX + uri, "value",
                      encode_ndarray(toks)),
                     ("XADD", SIGNAL_PREFIX + uri, "*", "ok", "1"),
-                    ("SADD", "__result_keys__", uri)])
+                    ("SADD", "__result_keys__", uri)]
+                if att > 1:
+                    # at-least-once: surface how many placements this
+                    # request took (clients and the chaos smoke read it)
+                    cmds.insert(1, ("HSET", RESULT_PREFIX + uri,
+                                    "attempts", str(att)))
+                client.pipeline(cmds)
             except Exception as e:
                 # the slot is already freed: a swallowed publish failure
                 # would be a silent vanish (client blocks to timeout).
@@ -978,6 +1084,7 @@ class ClusterServing:
                 self._written.append((uri, time.monotonic()))
                 self._inflight.pop(uri, None)
                 self._uri_replica.pop(uri, None)
+                self._attempts.pop(uri, None)
 
         # the continuous pump must prune too (the micro-batch path
         # prunes per publish): time-gated so the idle poll loop isn't
@@ -1000,6 +1107,26 @@ class ClusterServing:
         try:
             while not self._stop.is_set():
                 now = time.monotonic()
+                # heartbeat: the supervisor's liveness input.  Stamped
+                # every pass (busy or idle) so a healthy-but-quiet pump
+                # never looks dead; only a wedged/crashed one does.
+                self._beats[replica] = now
+                if self._fault is not None:
+                    act = self._fault.pump_action(replica)
+                    if act == "kill":
+                        # planned retirement: same path an operator's
+                        # /admin/kill_pump takes (graceful drain)
+                        try:
+                            self.kill_pump(replica)
+                        except Exception:
+                            logger.exception(
+                                "injected kill_pump refused "
+                                "(replica %d)", replica)
+                    elif act == "crash":
+                        # unplanned death: escapes the pump loop and
+                        # exercises the supervisor's redispatch path
+                        raise InjectedFault(
+                            f"injected pump crash (replica {replica})")
                 if replica == 0 and now >= next_prune:
                     next_prune = now + _prune_cadence()
                     self._prune_abandoned(client, now)
@@ -1148,6 +1275,22 @@ class ClusterServing:
                                 "elastic pool autoresize failed "
                                 "(replica %d)", replica)
                 self._flush_emitter(client, emitter)
+        except Exception:
+            # an exception escaping the pump loop used to die silently
+            # in the thread, leaving a zombie entry in the router's
+            # live set and stranding every admitted request.  Dump a
+            # flight bundle (the ring holds the ticks leading here)
+            # and declare the replica dead so the supervisor
+            # re-dispatches its in-flight work to survivors.
+            logger.exception("continuous pump crashed (replica %d)",
+                             replica)
+            try:
+                self.anomaly_monitors[replica].crash(
+                    traceback.format_exc())
+            except Exception:
+                logger.exception("crash bundle dump failed "
+                                 "(replica %d)", replica)
+            self._declare_dead(replica, "pump_exception")
         finally:
             self._pump_live[replica] = False
             with self._rq_cond:
@@ -1315,6 +1458,7 @@ class ClusterServing:
         eng = self.engines[replica]
         pool = getattr(eng, "_pool", None)
         per_class = self.watchdogs[replica].status()["per_class"]
+        beat = self._beats[replica]
         return ReplicaSignals(
             replica=replica,
             live=self._pump_live[replica],
@@ -1325,7 +1469,9 @@ class ClusterServing:
             alloc_fail_streak=eng.alloc_fail_streak,
             goodput={c: d["goodput"] for c, d in per_class.items()},
             role=(self.replica_roles[replica]
-                  if self.replica_roles is not None else None))
+                  if self.replica_roles is not None else None),
+            heartbeat_age_s=((time.monotonic() - beat)
+                             if beat > 0.0 else None))
 
     def router_status(self) -> dict:
         """Live routing view — the observability surface behind the
@@ -1340,7 +1486,18 @@ class ClusterServing:
             "roles": (list(self.replica_roles)
                       if self.replica_roles is not None else None),
             "handoffs": self._role_handoffs,
+            # supervisor view (docs/debugging.md § Crash recovery)
+            "deaths": self._deaths,
+            "death_reasons": list(self._death_reasons),
+            "redispatched": self._redispatched,
+            "handoff_acks": self._handoff_acks,
+            "handoff_timeouts": self._handoff_timeouts,
+            "handoff_retries": self._handoff_retries,
+            "unrouted": len(self._unrouted),
+            "unrouted_expired": self._unrouted_expired,
         }
+        if self._fault is not None:
+            status["faults"] = self._fault.snapshot()
         if self.engines:
             status["signals"] = [
                 dataclasses.asdict(self.replica_signals(r))
@@ -1425,11 +1582,12 @@ class ClusterServing:
                           phase=("prefill" if self.replica_roles
                                  else None))
         if r is None:
-            # no live pump anywhere: fail fast rather than letting
-            # every client ride out its timeout against dead queues
-            self._publish_error({"uri": fields.get("uri", b"")},
-                                "no live replicas")
-            self._finish_entries(client, [eid])
+            # no live pump anywhere: park the entry — the fleet may be
+            # mid-recovery (a replica restarting, a supervisor sweep in
+            # flight).  The router's unrouted sweep re-places it when a
+            # pump returns, or expires it to a terminal error after
+            # unrouted_ttl_s so no client waits forever.
+            self._unrouted.append((fields, eid, time.monotonic()))
             return
         with self._rq_cond:
             self._rqueues[r].append((fields, eid))
@@ -1458,7 +1616,16 @@ class ClusterServing:
         happen later on the destination pump at admission.  The
         ``kill_pump`` drain contract holds unchanged: an exported
         request counts as admitted work on its DESTINATION, whose pump
-        keeps stepping until its engine drains."""
+        keeps stepping until its engine drains.
+
+        Two-phase delivery (``handoff_ack_timeout_s > 0``): the state
+        dict — which holds the exported chain's host tensors, keeping
+        them referenced — is retained in ``_pending_handoffs`` until
+        the destination's ``_admit_handoff`` fires the ``on_adopt``
+        ack; the router's ``_sweep_handoffs`` re-dispatches a delivery
+        whose ack never lands (dropped transfer, destination died
+        mid-adoption) to an alternate replica, giving the handoff leg
+        the same at-least-once contract as fresh admissions."""
         t0 = time.monotonic()
         uri = state.get("uri", "")
         sigs = [self.replica_signals(r)
@@ -1467,18 +1634,51 @@ class ClusterServing:
                           self._rr_cursor, phase="decode")
         if r is None:
             r = src
-        try:
-            self.engines[r].submit_handoff(state)
-        except Exception:
-            if r == src:
-                # _handoff_slot catches this and error-publishes the
-                # request through its on_error
-                raise
-            logger.exception(
-                "handoff of %r to replica %d failed; self-adopting on "
-                "replica %d", uri, r, src)
-            r = src
-            self.engines[r].submit_handoff(state)
+        ack_timeout = getattr(self.config, "handoff_ack_timeout_s", 0.0)
+        two_phase = bool(uri) and ack_timeout > 0 and r != src
+        if two_phase:
+            state = dict(state)
+            state["on_adopt"] = self._ack_handoff
+            self._pending_handoffs[uri] = {
+                "state": state, "src": src, "dst": r,
+                "sent_at": time.monotonic(), "retries": 0}
+        deliver = True
+        if self._fault is not None and r != src:
+            act = self._fault.handoff_action()
+            if act is not None:
+                kind, delay = act
+                if kind == "drop" and two_phase:
+                    # swallowed delivery: the pending entry stays; the
+                    # router's ack-timeout sweep recovers the request
+                    deliver = False
+                    logger.warning("fault injection dropped handoff "
+                                   "of %r to replica %d", uri, r)
+                elif kind == "drop":
+                    logger.warning(
+                        "drop_handoff fired but two-phase ack is off "
+                        "(handoff_ack_timeout_s=0) — delivering "
+                        "anyway, a drop would strand %r", uri)
+                elif kind == "delay":
+                    # a slow DCN transfer: the source pump stalls for
+                    # the transfer time (ack sweep may beat it)
+                    time.sleep(delay)
+        if deliver:
+            try:
+                self.engines[r].submit_handoff(state)
+            except Exception:
+                if r == src:
+                    if two_phase:
+                        self._pending_handoffs.pop(uri, None)
+                    # _handoff_slot catches this and error-publishes
+                    # the request through its on_error
+                    raise
+                logger.exception(
+                    "handoff of %r to replica %d failed; self-adopting "
+                    "on replica %d", uri, r, src)
+                if two_phase:
+                    self._pending_handoffs.pop(uri, None)
+                r = src
+                self.engines[r].submit_handoff(state)
         with self._rq_cond:
             self._role_handoffs += 1
             if self.replica_roles is not None and \
@@ -1490,6 +1690,79 @@ class ClusterServing:
             self._rq_cond.notify_all()   # wake an idle decode pump
         if self._h_handoff is not None:
             self._h_handoff.record(time.monotonic() - t0)
+
+    def _ack_handoff(self, uri: str, dst: int) -> None:
+        """Adoption ack — fired by the DESTINATION engine's
+        ``_admit_handoff`` under its lock, so this must stay record-
+        only (no locks, no engine calls): pop the pending entry (its
+        drop releases the source-side chain references) and count the
+        ack.  ``pop`` with a default keeps a late duplicate ack (a
+        retried delivery whose first copy survived after all)
+        harmless."""
+        if self._pending_handoffs.pop(uri, None) is not None:
+            self._handoff_acks += 1
+
+    def _sweep_handoffs(self, client: RespClient) -> None:
+        """Router-side ack-timeout sweep: a pending handoff whose
+        adoption never acked within ``handoff_ack_timeout_s`` is
+        re-dispatched to an alternate replica (``pick_retry_target``
+        excludes the unresponsive destination; the source itself is
+        the last resort), bounded by ``retry_budget`` — beyond it the
+        request error-terminates rather than ping-ponging forever."""
+        timeout = getattr(self.config, "handoff_ack_timeout_s", 0.0)
+        if timeout <= 0 or not self._pending_handoffs:
+            return
+        now = time.monotonic()
+        budget = int(getattr(self.config, "retry_budget", 2))
+        for uri in list(self._pending_handoffs):
+            info = self._pending_handoffs.get(uri)
+            if info is None:        # acked while we swept
+                continue
+            verdict = plan_handoff_recovery(
+                age_s=now - info["sent_at"], timeout_s=timeout,
+                retries=info["retries"], retry_budget=budget)
+            if verdict == "wait":
+                continue
+            self._handoff_timeouts += 1
+            if verdict == "give_up":
+                self._pending_handoffs.pop(uri, None)
+                logger.error("handoff of %r never adopted after %d "
+                             "retries — error-terminating", uri,
+                             info["retries"])
+                self._publish_error(
+                    {"uri": uri.encode()},
+                    f"handoff adoption failed after "
+                    f"{info['retries']} retries")
+                with self._stats_lock:
+                    held = self._inflight.pop(uri, None)
+                self._uri_replica.pop(uri, None)
+                self._attempts.pop(uri, None)
+                if held is not None:
+                    self._finish_entries(client, [held[1]])
+                continue
+            sigs = [self.replica_signals(r)
+                    for r in range(self.n_replicas)]
+            r = pick_retry_target(
+                sigs, info["state"].get("priority"), self._rr_cursor,
+                exclude=(info["dst"],), phase="decode")
+            if r is None:
+                r = info["src"]
+            logger.warning("handoff of %r to replica %d timed out "
+                           "(no adoption ack in %.1fs) — retrying on "
+                           "replica %d", uri, info["dst"], timeout, r)
+            info["retries"] += 1
+            info["dst"] = r
+            info["sent_at"] = now
+            self._handoff_retries += 1
+            try:
+                self.engines[r].submit_handoff(info["state"])
+            except Exception:
+                logger.exception("handoff retry of %r to replica %d "
+                                 "failed; next sweep retries", uri, r)
+                continue
+            with self._rq_cond:
+                self._uri_replica[uri] = r
+                self._rq_cond.notify_all()
 
     def _route_cancels(self, client: RespClient) -> int:
         """Router-side cancel fan-out: owning replicas get the uri in
@@ -1539,6 +1812,197 @@ class ClusterServing:
             self._rerouted_count += 1
             self._route_one(client, fields, eid)
 
+    # ---- supervisor: liveness, death, at-least-once redispatch --------
+
+    def _declare_dead(self, replica: int, reason: str) -> None:
+        """UNPLANNED death: mark the replica dead (idempotent), stop
+        routing to it, and queue it for the router's redispatch sweep.
+        Distinct from ``kill_pump`` — a graceful kill drains admitted
+        work in place and never lands here; a declared death's
+        in-flight requests are lost and must be re-placed."""
+        with self._rq_cond:
+            if self._death_reasons[replica] is not None:
+                return
+            self._death_reasons[replica] = reason
+            self._deaths += 1
+            self._pump_live[replica] = False
+            self._pump_stops[replica].set()
+            self._dead_unswept.add(replica)
+            self._rq_cond.notify_all()
+        logger.error("replica %d declared dead (%s) — its in-flight "
+                     "requests will be re-dispatched", replica, reason)
+
+    def _supervise(self, client: RespClient) -> None:
+        """One router-loop supervision pass: (a) heartbeat-miss death
+        (opt-in via ``supervisor_miss_s``; escaped pump exceptions
+        declare themselves regardless), (b) redispatch of dead
+        replicas' lost in-flight requests, (c) handoff ack-timeout
+        sweep, (d) parked-unrouted TTL sweep.  Every DECISION here is
+        a pure ``policy.py`` function (replica_dead / plan_redispatch
+        / pick_retry_target / plan_handoff_recovery) that the sim's
+        ``FleetModel`` exercises identically."""
+        miss = float(getattr(self.config, "supervisor_miss_s", 0.0))
+        if miss > 0.0:
+            now = time.monotonic()
+            for r in range(self.n_replicas):
+                if (self._pump_live[r]
+                        and not self._pump_stops[r].is_set()
+                        and self._beats[r] > 0.0
+                        and replica_dead(now - self._beats[r], miss)):
+                    self._declare_dead(r, "heartbeat_miss")
+        while True:
+            with self._rq_cond:
+                if not self._dead_unswept:
+                    break
+                dead = self._dead_unswept.pop()
+            self._redispatch_replica(client, dead)
+        self._sweep_handoffs(client)
+        self._sweep_unrouted(client)
+
+    def _reread_entry(self, client: RespClient,
+                      eid) -> Optional[Dict[str, bytes]]:
+        """Re-read one UNACKED input-stream entry by id — the broker
+        retains every claimed entry until ``_finish_entries`` acks it,
+        which is exactly what makes at-least-once redispatch possible:
+        the original request fields survive the replica that was
+        serving them."""
+        try:
+            if isinstance(eid, bytes):
+                eid = eid.decode()
+            entries = client.execute("XRANGE", INPUT_STREAM, eid, eid)
+        except Exception:
+            logger.exception("redispatch re-read failed for entry %r",
+                             eid)
+            return None
+        want = eid.encode() if isinstance(eid, str) else eid
+        for got, flat in entries or []:
+            # trust nothing: a broker with sloppy range semantics must
+            # not make us resurrect the WRONG request N times while the
+            # real lost one stays stranded
+            if got == want:
+                return {flat[i].decode(): flat[i + 1]
+                        for i in range(0, len(flat), 2)}
+        return None
+
+    def _redispatch_replica(self, client: RespClient,
+                            dead: int) -> None:
+        """Re-place a dead replica's lost in-flight requests on
+        survivors with at-least-once semantics: ``plan_redispatch``
+        decides retry / terminal-error (budget or deadline exhausted)
+        / terminal-cancelled per request; a retry re-reads the
+        original entry from the unacked stream, bumps the attempt
+        counter, and XADDs a ``restart`` marker on the token stream so
+        streaming clients see the emitted-token index reset instead of
+        a silent splice."""
+        with self._stats_lock:
+            lost = [(uri, info) for uri, info in self._inflight.items()
+                    if self._uri_replica.get(uri) == dead]
+        budget = int(getattr(self.config, "retry_budget", 2))
+        deadline = float(getattr(self.config, "request_deadline_s",
+                                 0.0))
+        now = time.monotonic()
+        for uri, (t_submit, eid) in lost:
+            with self._stats_lock:
+                if self._inflight.pop(uri, None) is None:
+                    continue        # published while we swept
+            attempt = self._attempts.get(uri, 1)
+            was_cancelled = (uri in self._rcancels[dead]
+                             or uri in self._router_cancelled)
+            verdict = plan_redispatch(
+                attempt=attempt, retry_budget=budget,
+                cancelled=was_cancelled, age_s=now - t_submit,
+                deadline_s=deadline)
+            if verdict == "cancel":
+                self._rcancels[dead].discard(uri)
+                self._router_cancelled.discard(uri)
+                self._publish_error({"uri": uri.encode()}, "cancelled")
+                self._finish_entries(client, [eid])
+                self._uri_replica.pop(uri, None)
+                self._attempts.pop(uri, None)
+                continue
+            if verdict == "error":
+                why = ("deadline" if deadline > 0.0
+                       and now - t_submit > deadline else "retry budget")
+                self._publish_error(
+                    {"uri": uri.encode()},
+                    f"replica {dead} died; {why} exhausted "
+                    f"(attempts={attempt})")
+                self._finish_entries(client, [eid])
+                self._uri_replica.pop(uri, None)
+                self._attempts.pop(uri, None)
+                continue
+            fields = self._reread_entry(client, eid)
+            if fields is None:
+                self._publish_error(
+                    {"uri": uri.encode()},
+                    f"replica {dead} died; original request entry "
+                    f"lost — cannot redispatch")
+                self._finish_entries(client, [eid])
+                self._uri_replica.pop(uri, None)
+                self._attempts.pop(uri, None)
+                continue
+            self._attempts[uri] = attempt + 1
+            self._redispatched += 1
+            logger.warning("re-dispatching %r (attempt %d/%d) after "
+                           "replica %d died", uri, attempt + 1,
+                           max(1, budget), dead)
+            if "stream" in fields:
+                # client-visible restart: the consumer resets its
+                # emitted-token index to 0 (queues.stream_events /
+                # the SSE leg surface it as a `restart` event)
+                try:
+                    client.execute("XADD", TOKEN_PREFIX + uri, "*",
+                                   "restart", str(attempt + 1))
+                except Exception:
+                    logger.exception("restart marker publish failed "
+                                     "for %r", uri)
+            self._route_one(client, fields, eid)
+            r2 = self._uri_replica.get(uri)
+            if r2 is not None:
+                try:
+                    self.telemetries[r2].req_redispatched(
+                        uri, attempt + 1)
+                except Exception:
+                    pass
+        # the dead replica's pending cancels follow their requests:
+        # re-placed uris move to the new owner's cancel set, the rest
+        # park router-side so a late-claimed entry still dies
+        with self._rq_cond:
+            orphans = list(self._rcancels[dead])
+            self._rcancels[dead].clear()
+            for uri in orphans:
+                r = self._uri_replica.get(uri)
+                if r is not None and r != dead:
+                    self._rcancels[r].add(uri)
+                elif len(self._router_cancelled) < 4096:
+                    self._router_cancelled.add(uri)
+            self._rq_cond.notify_all()
+
+    def _sweep_unrouted(self, client: RespClient) -> None:
+        """Parked-unrouted sweep: entries ``_route_one`` could not
+        place (zero live replicas) wait bounded — re-placed the moment
+        a pump is live again, error-terminated after
+        ``unrouted_ttl_s`` so no client waits forever (the HTTP front
+        door additionally 503s new submits while the fleet is dead)."""
+        if not self._unrouted:
+            return
+        ttl = float(getattr(self.config, "unrouted_ttl_s", 5.0))
+        now = time.monotonic()
+        any_live = any(self._pump_live)
+        for _ in range(len(self._unrouted)):
+            fields, eid, parked = self._unrouted.popleft()
+            if any_live:
+                self._route_one(client, fields, eid)
+            elif ttl > 0 and now - parked > ttl:
+                self._unrouted_expired += 1
+                self._publish_error(
+                    {"uri": fields.get("uri", b"")},
+                    f"no live replicas for {ttl:.1f}s — request "
+                    f"expired unplaced")
+                self._finish_entries(client, [eid])
+            else:
+                self._unrouted.append((fields, eid, parked))
+
     def _loop_router(self) -> None:
         """Router thread (``n_replicas > 1``): the SOLE claimer of the
         broker's consumer group — XREADGROUP as consumer "router" —
@@ -1555,6 +2019,7 @@ class ClusterServing:
             while not self._stop.is_set():
                 self._route_cancels(client)
                 self._reroute_dead(client)
+                self._supervise(client)
                 try:
                     requests, ids = self._read_batch(client, "router",
                                                      20)
@@ -1878,3 +2343,14 @@ class ClusterServing:
 
     def backlog(self) -> int:
         return int(self.client.execute("XLEN", INPUT_STREAM))
+
+    def accepting_replicas(self) -> Optional[int]:
+        """Live pump count for readiness checks, or ``None`` when pump
+        liveness doesn't apply (micro-batch mode, or the job not yet
+        started).  The HTTP front door treats only an explicit 0 as
+        fleet-dead: /healthz flips ``accepting: false`` and submits
+        503 with a finite Retry-After instead of accepting work that
+        can never be placed."""
+        if not self.config.continuous_batching or not self._threads:
+            return None
+        return sum(1 for v in self._pump_live if v)

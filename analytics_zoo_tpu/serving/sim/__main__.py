@@ -121,7 +121,15 @@ def run_scenario(doc: Dict[str, Any],
              for _ in range(n)],
             roles=roles, qos=qos, acceptance=acc, timing=timing,
             seed=seed, record_events=record_events,
-            handoff_s=float(fleet_doc.get("handoff_s", 0.0)))
+            handoff_s=float(fleet_doc.get("handoff_s", 0.0)),
+            # chaos twin: the same fault-schedule dicts
+            # ServingConfig.fault_injection takes (serving/fault.py)
+            faults=fleet_doc.get("faults"),
+            retry_budget=int(fleet_doc.get("retry_budget", 2)),
+            handoff_timeout_s=float(
+                fleet_doc.get("handoff_timeout_s", 0.0)),
+            request_deadline_s=float(
+                fleet_doc.get("request_deadline_s", 0.0)))
         fleet.run(_build_trace(doc["trace"], seed))
         out = fleet.summary(targets)
         out["seed"] = seed

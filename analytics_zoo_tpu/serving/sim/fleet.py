@@ -35,7 +35,10 @@ disaggregated scenario's envelopes.
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import policy as scheduler_policy
-from ..policy import REPLICA_ROLES, QosPolicy, ReplicaSignals
+from ..fault import FaultInjector
+from ..policy import (REPLICA_ROLES, QosPolicy, ReplicaSignals,
+                      pick_retry_target, plan_handoff_recovery,
+                      plan_redispatch)
 from .model import (AcceptanceModel, EngineConfig, EngineModel,
                     TimingModel, summarize)
 from .trace import Request
@@ -44,7 +47,17 @@ __all__ = ["FleetModel"]
 
 
 class FleetModel:
-    """N modelled replicas + the real routing policy + KV handoff."""
+    """N modelled replicas + the real routing policy + KV handoff.
+
+    Fault twin (``faults``): the fleet consumes the SAME fault
+    schedules the live ``ClusterServing`` does (``serving/fault.py``),
+    against virtual time — ``crash_pump`` at ``at_t`` kills a replica
+    and re-dispatches its lost requests through the same pure policy
+    functions the live supervisor calls (``plan_redispatch`` /
+    ``pick_retry_target``), ``drop_handoff``/``delay_handoff`` hit the
+    two-phase handoff path recovered by ``plan_handoff_recovery``.
+    ``faults=None`` (the default) leaves every code path bit-identical
+    to the fault-free model the golden envelopes pin."""
 
     def __init__(self, configs: Sequence[EngineConfig],
                  roles: Optional[Sequence[Optional[str]]] = None,
@@ -52,7 +65,11 @@ class FleetModel:
                  acceptance: Optional[AcceptanceModel] = None,
                  timing: Optional[TimingModel] = None,
                  seed: int = 0, record_events: bool = True,
-                 handoff_s: float = 0.0):
+                 handoff_s: float = 0.0,
+                 faults: Optional[Sequence[Any]] = None,
+                 retry_budget: int = 2,
+                 handoff_timeout_s: float = 0.0,
+                 request_deadline_s: float = 0.0):
         if not configs:
             raise ValueError("FleetModel needs at least one replica")
         if roles is not None:
@@ -78,6 +95,22 @@ class FleetModel:
         # per-replica pending deliveries: (available_t, seq, req, record)
         self._inbox: List[List[Tuple[float, int, Any, Any]]] = [
             [] for _ in configs]
+        # -- crash tolerance (the live supervisor's virtual twin) -----
+        self.injector = FaultInjector(faults) if faults else None
+        self.retry_budget = int(retry_budget)
+        self.handoff_timeout_s = float(handoff_timeout_s)
+        self.request_deadline_s = float(request_deadline_s)
+        self.dead = [False] * len(configs)
+        self.replica_deaths = 0
+        self.redispatched = 0
+        self.handoff_timeouts = 0
+        self.handoff_retries = 0
+        self.dropped_handoffs = 0
+        #: uri -> original trace Request (the sim's "unacked stream
+        #: entry": what a redispatch re-reads to re-run from scratch)
+        self._requests: Dict[str, Request] = {}
+        #: uri -> pending two-phase handoff awaiting adoption ack
+        self._pending_handoffs: Dict[str, Dict[str, Any]] = {}
         if self.roles is not None:
             for i, e in enumerate(self.engines):
                 if self.roles[i] == "prefill":
@@ -102,7 +135,7 @@ class FleetModel:
                 pb = min(e.prefix_resident_blocks(request.prefix_id),
                          max(0, cap))
             sigs.append(ReplicaSignals(
-                replica=i, live=True,
+                replica=i, live=not self.dead[i],
                 queue_depth=len(e._waiting) + e.n_active
                 + len(self._inbox[i]),
                 allocatable_blocks=(e._pool.allocatable()
@@ -119,6 +152,13 @@ class FleetModel:
             rr_cursor=self._rr,
             phase=phase if self.roles is not None else None)
         self._rr = (self._rr + 1) % len(self.engines)
+        if r is None:
+            # only reachable with faults: every replica crashed.  The
+            # live broker parks unrouted work; the sim treats a fully
+            # dead fleet as a scenario bug and says so.
+            raise RuntimeError(
+                "sim fleet has no live replicas left to route to "
+                "(fault schedule killed every replica?)")
         return r
 
     def _deliver(self, dst: int, available_t: float, req, record) -> None:
@@ -131,13 +171,147 @@ class FleetModel:
         decode phase and deliver the adopted request ``handoff_s``
         later.  The router may pick the source itself (every decode
         replica saturated) — self-adoption, same as the live broker's
-        fallback."""
+        fallback.
+
+        With ``handoff_timeout_s > 0`` the delivery is two-phase: a
+        pending entry holds the (req, record) pair — the sim twin of
+        the source keeping the exported chain referenced — until the
+        destination's adoption (``_drain_inbox``) acks it; the fault
+        injector may drop or delay the delivery, and ``_fault_sweep``
+        recovers un-acked entries via ``plan_handoff_recovery``."""
         req = row.req
         req.handoff = int(row.emitted)
         dst = self._route(req.priority, "decode")
         self.handoffs += 1
-        self._deliver(dst, t + self.handoff_s, req,
-                      self.engines[src].records[req.uri])
+        record = self.engines[src].records[req.uri]
+        if self.handoff_timeout_s > 0:
+            self._pending_handoffs[req.uri] = {
+                "req": req, "record": record, "src": src, "dst": dst,
+                "sent_at": t, "retries": 0}
+        delay = self.handoff_s
+        if self.injector is not None:
+            act = self.injector.handoff_action(t)
+            if act is not None:
+                kind, extra = act
+                if kind == "drop" and self.handoff_timeout_s > 0:
+                    # swallowed delivery: the pending entry stays;
+                    # the ack-timeout sweep recovers the request
+                    self.dropped_handoffs += 1
+                    return
+                if kind == "delay":
+                    delay += extra
+        self._deliver(dst, t + delay, req, record)
+
+    # -- crash tolerance (virtual twin of server.py's _supervise) -------
+
+    def _fault_sweep(self) -> None:
+        """One pass of the supervisor's virtual twin: fire due
+        ``crash_pump`` faults, then recover un-acked two-phase
+        handoffs — the SAME pure policy calls the live router makes
+        (``plan_handoff_recovery`` / ``pick_retry_target``)."""
+        n = len(self.engines)
+        for i in range(n):
+            if not self.dead[i] and self.injector.due_crashes(
+                    i, self.engines[i].now):
+                self._crash_replica(i)
+        if self.handoff_timeout_s <= 0 or not self._pending_handoffs:
+            return
+        now = max(e.now for e in self.engines)
+        for uri in list(self._pending_handoffs):
+            info = self._pending_handoffs.get(uri)
+            if info is None:
+                continue
+            verdict = plan_handoff_recovery(
+                age_s=now - info["sent_at"],
+                timeout_s=self.handoff_timeout_s,
+                retries=info["retries"],
+                retry_budget=self.retry_budget)
+            if verdict == "wait":
+                continue
+            self.handoff_timeouts += 1
+            if verdict == "give_up":
+                self._pending_handoffs.pop(uri, None)
+                info["record"].dropped = "handoff_failed"
+                continue
+            r = pick_retry_target(
+                self._signals(), info["req"].priority, self._rr,
+                exclude=(info["dst"],),
+                phase="decode" if self.roles is not None else None)
+            if r is None:
+                # nothing else eligible: back to any live replica
+                # (the source itself is the live broker's last resort)
+                r = self._route(info["req"].priority, "decode")
+            info["retries"] += 1
+            info["dst"] = r
+            info["sent_at"] = now
+            self.handoff_retries += 1
+            self._deliver(r, now + self.handoff_s, info["req"],
+                          info["record"])
+
+    def _crash_replica(self, i: int) -> None:
+        """An unplanned replica death at its own virtual ``now`` (the
+        live path: InjectedFault escaping the pump loop → supervisor
+        declare-dead): mark it dead, then re-dispatch every lost
+        request — active rows, queued waiters, and undelivered inbox
+        entries — through ``plan_redispatch``, bumping each record's
+        ``attempts`` exactly like the live at-least-once recovery."""
+        e = self.engines[i]
+        t = e.now
+        self.dead[i] = True
+        self.replica_deaths += 1
+        lost = []
+        for s in range(len(e._slots)):
+            row = e._slots[s]
+            if row is None:
+                continue
+            e._slots[s] = None
+            e._free.append(s)
+            e._release_blocks(row)
+            lost.append(row.req)
+        while len(e._waiting):
+            lost.append(e._waiting.popleft())
+        inbox, self._inbox[i] = self._inbox[i], []
+        for _avail, _seq, req, record in inbox:
+            if record is None:
+                # routed-but-undelivered arrival: the live router's
+                # _reroute_dead — re-place, no attempt bump (the
+                # request never started anywhere)
+                dst = self._route(req.priority, "prefill", request=req)
+                self._deliver(dst, max(_avail, t), req, None)
+            elif req.uri in self._pending_handoffs:
+                pass    # the ack-timeout sweep recovers it
+            elif getattr(req, "handoff", None) is not None:
+                # in-flight adoption with two-phase off: re-route the
+                # decode leg directly to a survivor
+                dst = self._route(req.priority, "decode")
+                self.handoff_retries += 1
+                self._deliver(dst, t + self.handoff_s, req, record)
+            else:
+                lost.append(req)
+        for req in lost:
+            rec = e.records.get(req.uri)
+            if rec is None or rec.finished or rec.dropped:
+                continue
+            orig = self._requests.get(req.uri)
+            deadline = (orig.deadline_s if orig is not None
+                        and orig.deadline_s > 0
+                        else self.request_deadline_s)
+            verdict = plan_redispatch(
+                attempt=rec.attempts, retry_budget=self.retry_budget,
+                cancelled=False, age_s=t - rec.arrival,
+                deadline_s=deadline)
+            if verdict != "retry":
+                rec.dropped = ("cancelled" if verdict == "cancel"
+                               else "retry_budget")
+                continue
+            if orig is None:    # adopted row whose origin we never saw
+                rec.dropped = "lost_entry"
+                continue
+            self._pending_handoffs.pop(req.uri, None)
+            rec.attempts += 1
+            self.redispatched += 1
+            dst = self._route(orig.priority, "prefill", request=orig)
+            self._deliver(dst, t, orig, rec)
 
     # -- driving --------------------------------------------------------
 
@@ -152,10 +326,19 @@ class FleetModel:
             _, _, req, record = box.pop(0)
             if record is None:
                 e.submit(req)
-            else:
+            elif getattr(req, "handoff", None) is not None:
                 e.submit_prefilled(req, record)
+                # adoption IS the ack: release the source-side pending
+                # entry (the live engine's on_adopt callback)
+                self._pending_handoffs.pop(req.uri, None)
+            else:
+                # crash-recovery redispatch: full re-run on a survivor,
+                # lifecycle record continued
+                e.submit_retry(req, record)
 
     def _has_work(self, i: int) -> bool:
+        if self.dead[i]:
+            return False
         e = self.engines[i]
         return e.n_active > 0 or len(e._waiting) > 0
 
@@ -168,6 +351,9 @@ class FleetModel:
         p = 0
         n = len(self.engines)
         while True:
+            # 0. fault sweep: due crashes + un-acked handoff recovery
+            if self.injector is not None:
+                self._fault_sweep()
             # 1. route arrivals due at/before the busiest frontier (or
             #    all remaining ones once the fleet has gone idle)
             busy_now = [self.engines[i].now for i in range(n)
@@ -177,6 +363,7 @@ class FleetModel:
                     frontier is None
                     or pending[p].arrival_t <= frontier):
                 r = pending[p]
+                self._requests[r.uri] = r
                 # arrivals route prefix-locality-aware (handoffs stay
                 # locality-blind, like the live broker's rebalance)
                 dst = self._route(r.priority, "prefill", request=r)
@@ -188,6 +375,8 @@ class FleetModel:
             # 2. deliver matured inbox entries; fast-forward idle
             #    replicas to their next delivery
             for i in range(n):
+                if self.dead[i]:
+                    continue
                 e = self.engines[i]
                 if (not self._has_work(i)) and self._inbox[i]:
                     e.now = max(e.now, self._inbox[i][0][0])
@@ -198,6 +387,18 @@ class FleetModel:
                 if p < len(pending) or any(self._inbox[i]
                                            for i in range(n)):
                     continue    # future arrivals/deliveries remain
+                if self._pending_handoffs and self.handoff_timeout_s > 0:
+                    # idle fleet with un-acked handoffs (a dropped
+                    # delivery): fast-forward virtual time to the
+                    # earliest ack deadline so the recovery sweep
+                    # fires instead of stranding the request
+                    t_next = min(h["sent_at"] + self.handoff_timeout_s
+                                 for h in self._pending_handoffs.values())
+                    for i in range(n):
+                        if not self.dead[i]:
+                            self.engines[i].now = max(
+                                self.engines[i].now, t_next + 1e-9)
+                    continue
                 break
             i = min(work, key=lambda j: (self.engines[j].now, j))
             self.engines[i].step()
@@ -231,6 +432,22 @@ class FleetModel:
                                       for e in self.engines)
         out["routed"] = list(self.routed)
         out["per_replica_ticks"] = [e.ticks for e in self.engines]
+        if self.injector is not None:
+            # chaos counters, present only when a fault schedule is
+            # configured — fault-free summaries stay key-identical to
+            # previous releases (golden envelopes pin on them)
+            recs = list(self.records.values())
+            out["replica_deaths"] = self.replica_deaths
+            out["redispatched"] = self.redispatched
+            out["handoff_timeouts"] = self.handoff_timeouts
+            out["handoff_retries"] = self.handoff_retries
+            out["dropped_handoffs"] = self.dropped_handoffs
+            out["max_attempts"] = max(
+                [r.attempts for r in recs] or [1])
+            # the gate's zero-stranded contract: every request reached
+            # a terminal state (finished or an explicit drop reason)
+            out["stranded"] = sum(1 for r in recs
+                                  if not r.finished and not r.dropped)
         if any(e._prefix_on for e in self.engines):
             # tiered-KV sums, present only when a replica runs the
             # tier — tier-off summaries stay key-identical to previous

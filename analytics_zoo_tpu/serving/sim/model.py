@@ -365,6 +365,10 @@ class _Record:
     finish_t: Optional[float] = None
     tokens: int = 0
     dropped: Optional[str] = None
+    #: Total placements (first submit = 1).  Bumped by the fleet's
+    #: crash-recovery redispatch — the sim twin of the live router's
+    #: per-request ``attempt`` counter.
+    attempts: int = 1
 
     @property
     def finished(self) -> bool:
@@ -499,6 +503,18 @@ class EngineModel:
         (``_admit_adopted``)."""
         if req.handoff is None:
             raise ValueError("submit_prefilled needs req.handoff set")
+        self.records[req.uri] = record
+        self._waiting.append(req)
+
+    def submit_retry(self, r: Request, record: _Record) -> None:
+        """Crash-recovery redispatch (``FleetModel``): re-admit a lost
+        request from scratch — full re-prefill, full re-generation,
+        exactly like the live broker re-reading the original unacked
+        stream entry — while CONTINUING its lifecycle record (same
+        arrival, ``attempts`` already bumped), so the merged fleet
+        records keep one shared entry per uri and TTFT observes the
+        original arrival across the death."""
+        req = _SimReq(r, self.config.max_new_tokens)
         self.records[req.uri] = record
         self._waiting.append(req)
 

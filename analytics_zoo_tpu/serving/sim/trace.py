@@ -67,6 +67,12 @@ class Request:
     tenant: str = ""
     prefix_id: str = ""
     prefix_len: int = 0
+    #: Optional end-to-end deadline (seconds after arrival).  Read by
+    #: the fleet's crash-recovery redispatch (``plan_redispatch``):
+    #: a lost request older than its deadline error-terminates instead
+    #: of retrying.  0 = no per-request deadline (the fleet-level
+    #: ``request_deadline_s`` still applies, if set).
+    deadline_s: float = 0.0
 
     def to_dict(self) -> Dict[str, object]:
         out = {
@@ -82,6 +88,8 @@ class Request:
             # traces serialize byte-identically to previous releases
             out["prefix_id"] = self.prefix_id
             out["prefix_len"] = int(self.prefix_len)
+        if self.deadline_s:
+            out["deadline_s"] = float(self.deadline_s)
         return out
 
 
@@ -98,6 +106,7 @@ def requests_from_dicts(rows: Sequence[Dict[str, object]]) -> List[Request]:
             tenant=str(row.get("tenant", "")),
             prefix_id=str(row.get("prefix_id", "")),
             prefix_len=int(row.get("prefix_len", 0)),
+            deadline_s=float(row.get("deadline_s", 0.0)),
         ))
     out.sort(key=lambda r: (r.arrival_t, r.uri))
     return out

@@ -525,6 +525,10 @@ class Telemetry:
         # it into diagnostic bundles (docs/simulation.md)
         self._spec_accept_counts: Dict[int, int] = {}
         self._spec_rounds = 0
+        # crash-recovery attempt counters (req_redispatched): uri ->
+        # total placements, consumed into the request span at finish
+        # so a trace shows which requests rode a replica death
+        self._redispatch_attempts: Dict[str, int] = {}
 
     # -- request lifecycle (engine state transitions) ----------------
 
@@ -611,11 +615,16 @@ class Telemetry:
             self.watchdog.observe_finish(ck.priority if ck else None,
                                          uri, tpot)
         start = ck.admitted if ck and ck.admitted is not None else now
-        self.events.span(
-            "request", start, now - start, slot,
-            {"uri": uri,
-             "tokens": n_tokens if n_tokens is not None
-             else (ck.n_tokens if ck else 0)})
+        args = {"uri": uri,
+                "tokens": n_tokens if n_tokens is not None
+                else (ck.n_tokens if ck else 0)}
+        with self._lock:
+            attempts = self._redispatch_attempts.pop(uri, None)
+        if attempts is not None:
+            # the request survived a replica death: the span records
+            # how many placements its at-least-once recovery took
+            args["attempts"] = attempts
+        self.events.span("request", start, now - start, slot, args)
 
     def req_preempted(self, uri: str, slot: int,
                       prefilling: bool = False) -> None:
@@ -648,6 +657,23 @@ class Telemetry:
         self.c_errored.inc()
         self.events.instant("request_error", None, EventLog.TID_QUEUE,
                             {"uri": uri, "error": exc or ""})
+
+    def req_redispatched(self, uri: str, attempt: int) -> None:
+        """The broker re-placed this request on a surviving replica
+        after its original replica died (at-least-once recovery).
+        ``attempt`` is the TOTAL placement count (first submit = 1),
+        surfaced in the request span at finish; the fleet-level
+        ``zoo_router_requests_redispatched_total`` counter lives on
+        the router, not here, so per-replica registries never
+        double-count one fleet event."""
+        with self._lock:
+            self._redispatch_attempts[uri] = int(attempt)
+            if len(self._redispatch_attempts) > 65536:
+                self._redispatch_attempts.pop(
+                    next(iter(self._redispatch_attempts)))
+        self.events.instant("request_redispatched", None,
+                            EventLog.TID_QUEUE,
+                            {"uri": uri, "attempt": int(attempt)})
 
     def req_abandoned(self, uri: str, age_s: float) -> None:
         """A published result nobody ever collected was pruned — the

@@ -2280,6 +2280,122 @@ def _smoke_disagg():
     print("DISAGG_OK")
 
 
+def _smoke_chaos():
+    """chaos-smoke leg (docs/debugging.md "Crash recovery runbook"): a
+    3-replica prefill/decode fleet under a deterministic fault
+    schedule — one decode pump CRASHES mid-backlog (unplanned death,
+    not a graceful kill) and the first KV handoff is DROPPED in
+    flight.  Every request must still reach a terminal result, the
+    redispatched ones with their ``attempts`` counter recorded, and
+    the recovery must be visible on the real /metrics scrape: at
+    least one supervisor-declared death, one at-least-once
+    redispatch, and one handoff ack-timeout retry."""
+    import urllib.request
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, HttpFrontend, InputQueue, OutputQueue,
+        ServingConfig)
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    im = InferenceModel(batch_buckets=(1, 2))
+    im.load_flax_generator(model, variables, max_new_tokens=12,
+                           prompt_buckets=(16,))
+    cfg = ServingConfig(
+        prompt_col="tokens", continuous_batching=True,
+        engine_slots=2, engine_paged=True, engine_block_size=8,
+        engine_blocks=48, n_replicas=3,
+        replica_roles=["prefill", "decode", "decode"],
+        retry_budget=3,
+        # generous: a cold adoption jit-compiles its scatter, which
+        # must not read as a dropped delivery to the sweep
+        handoff_ack_timeout_s=3.0,
+        fault_injection=[
+            {"kind": "crash_pump", "replica": 1, "at_tick": 2},
+            {"kind": "drop_handoff", "at_handoff": 0},
+        ])
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = HttpFrontend(redis_port=serving.port, timeout=600,
+                      serving=serving).start()
+    inq = InputQueue(port=serving.port)
+    outq = OutputQueue(port=serving.port)
+    try:
+        rng = np.random.default_rng(29)
+        n = 8
+        uris = [f"c{i}" for i in range(n)]
+        for u in uris:
+            inq.enqueue(u, tokens=rng.integers(
+                1, 8192, int(rng.integers(6, 14))).astype(np.int32))
+        # every request must go TERMINAL — poll the raw result hashes
+        # (not outq.query, which consumes them) so the per-request
+        # `attempts` stamp is still observable
+        deadline = time.time() + 300
+        attempts = {}
+        for u in uris:
+            while True:
+                h = inq.client.execute("HGETALL", "result:" + u)
+                if h:
+                    f = {h[i].decode(): h[i + 1]
+                         for i in range(0, len(h), 2)}
+                    if "attempts" in f:
+                        attempts[u] = int(f["attempts"])
+                    break
+                assert time.time() < deadline, \
+                    f"{u} stranded — never reached a terminal result"
+                time.sleep(0.02)
+        errors = 0
+        for u in uris:
+            try:
+                r = outq.query(u, timeout=60)
+                assert r is not None, f"{u} vanished after landing"
+            except RuntimeError:
+                errors += 1   # terminal error IS a terminal outcome
+        # the crash redispatch must have bumped at least one request
+        # past its first placement
+        assert attempts and all(a >= 2 for a in attempts.values()), \
+            f"no at-least-once attempts recorded: {attempts}"
+        # recovery is visible on the SCRAPE surface, not internals
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{fe.port}/metrics", timeout=30
+        ).read().decode()
+        scraped = {}
+        for line in body.splitlines():
+            if line.startswith(("zoo_router_replica_deaths_total",
+                                "zoo_router_requests_redispatched_total",
+                                "zoo_engine_handoff_")):
+                name, val = line.split()
+                scraped[name] = float(val)
+        assert scraped.get("zoo_router_replica_deaths_total", 0) >= 1, \
+            scraped
+        assert scraped.get(
+            "zoo_router_requests_redispatched_total", 0) >= 1, scraped
+        assert scraped.get(
+            "zoo_engine_handoff_timeouts_total", 0) >= 1, scraped
+        assert scraped.get(
+            "zoo_engine_handoff_retries_total", 0) >= 1, scraped
+        status = serving.router_status()
+        assert status["deaths"] == 1, status
+        assert status["death_reasons"][1] == "pump_exception", status
+        print(json.dumps({
+            "leg": "chaos", "served": n, "errors": errors,
+            "attempts": attempts, "deaths": status["deaths"],
+            "redispatched": status["redispatched"],
+            "handoff_timeouts": status["handoff_timeouts"],
+            "handoff_retries": status["handoff_retries"]}))
+    finally:
+        fe.stop()
+        serving.stop()
+        inq.close()
+        outq.close()
+    print("CHAOS_OK")
+
+
 def _smoke_tiered():
     """serve-smoke tiered-KV leg (docs/serving_memory.md "Tiered KV"):
     a paged engine with a deliberately tiny block pool plus a host-DRAM
@@ -2489,9 +2605,11 @@ def _smoke():
     ``_smoke_anomaly``, the 2-replica router spread + graceful
     pump-kill drain via ``_smoke_replicas``, the prefill/decode
     KV-handoff fleet via ``_smoke_disagg``, the host-DRAM spill-store
-    eviction/re-admission loop via ``_smoke_tiered``, and the fused
+    eviction/re-admission loop via ``_smoke_tiered``, the fused
     Pallas kernel reading a tp=2-sharded int8 pool via
-    ``_smoke_fused_tp``."""
+    ``_smoke_fused_tp``, and the crash-tolerance chaos leg (pump
+    crash + dropped handoff under fault injection) via
+    ``_smoke_chaos`` (also standalone: ``make chaos-smoke``)."""
     r = run_poisson_scenario(True, rate_per_s=20.0, n_requests=20,
                              slots=4, prefix_mode="full", paged=True,
                              chunked=True)
@@ -2510,6 +2628,7 @@ def _smoke():
     _smoke_disagg()
     _smoke_tiered()
     _smoke_fused_tp()
+    _smoke_chaos()
     print("SMOKE_OK")
 
 
@@ -2518,6 +2637,8 @@ if __name__ == "__main__":
 
     if "--probe" in sys.argv:
         _probe_main()
+    elif "--chaos-smoke" in sys.argv:
+        _smoke_chaos()
     elif "--smoke" in sys.argv:
         _smoke()
     elif "--fused-tp" in sys.argv:

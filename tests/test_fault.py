@@ -1,0 +1,231 @@
+"""Deterministic fault injection (serving/fault.py) and the pure
+crash-recovery policy (serving/policy.py): spec parsing, exact-tick /
+exact-handoff firing, replayability, and the declare-dead /
+retry-budget / pick-retry-target / handoff-recovery decisions the
+supervisor and the sim fleet share.  No engine, no jax — this file
+exercises the same stdlib-only surface the simulator imports."""
+
+import pytest
+
+from analytics_zoo_tpu.serving.fault import (FAULT_KINDS, FaultInjector,
+                                             FaultSpec, InjectedFault,
+                                             parse_faults)
+from analytics_zoo_tpu.serving.policy import (ReplicaSignals,
+                                              pick_retry_target,
+                                              plan_handoff_recovery,
+                                              plan_redispatch,
+                                              replica_dead)
+
+# ---------------------------------------------------------------------------
+# FaultSpec parsing / validation
+# ---------------------------------------------------------------------------
+
+
+def test_spec_from_dict_roundtrip():
+    s = FaultSpec.from_dict({"kind": "crash_pump", "replica": 2,
+                             "at_tick": 40})
+    assert s.kind == "crash_pump" and s.replica == 2 and s.at_tick == 40
+    assert s.count == 1 and s.duration_s == 0.0
+
+
+def test_spec_rejects_unknown_kind_and_fields():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec.from_dict({"kind": "explode"})
+    with pytest.raises(ValueError, match="unknown fault spec fields"):
+        FaultSpec.from_dict({"kind": "kill_pump", "at_tick": 1,
+                             "when": "now"})
+    with pytest.raises(TypeError):
+        FaultSpec.from_dict(["kill_pump"])
+
+
+def test_spec_tick_kinds_need_a_trigger():
+    """Every tick-triggered kind must say WHEN — a schedule that never
+    fires is a config bug, not chaos."""
+    for kind in ("kill_pump", "crash_pump", "raise_step", "freeze_tick",
+                 "alloc_storm"):
+        with pytest.raises(ValueError, match="needs at_tick"):
+            FaultSpec.from_dict({"kind": kind})
+        FaultSpec.from_dict({"kind": kind, "at_tick": 0})   # ok
+        FaultSpec.from_dict({"kind": kind, "at_t": 1.5})    # sim ok
+    # handoff kinds may omit both: "the next handoff" is well-defined
+    FaultSpec.from_dict({"kind": "drop_handoff"})
+
+
+def test_parse_faults_none_is_off():
+    assert parse_faults(None) == []
+    assert parse_faults([]) == []
+    inj = FaultInjector(None)
+    assert not inj.enabled
+    # a disabled injector is inert on every path
+    assert inj.tick_actions(0) == {}
+    assert inj.pump_action(0) is None
+    assert inj.handoff_action() is None
+    assert not inj.due_crashes(0, 1e9)
+
+
+def test_parse_faults_accepts_prebuilt_specs():
+    spec = FaultSpec(kind="kill_pump", at_tick=3)
+    assert parse_faults([spec]) == [spec]
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector firing
+# ---------------------------------------------------------------------------
+
+
+def test_pump_action_fires_once_at_or_after_tick():
+    """``at_tick`` is at-or-after (a pump may never land exactly on
+    the named tick) and consumes the spec — one kill, not a kill per
+    subsequent poll."""
+    inj = FaultInjector([{"kind": "kill_pump", "replica": 1,
+                          "at_tick": 3}])
+    # replica 1 hasn't ticked yet
+    assert inj.pump_action(1) is None
+    for _ in range(5):
+        inj.tick_actions(1)
+    assert inj.pump_action(0) is None        # wrong replica
+    assert inj.pump_action(1) == "kill"
+    assert inj.pump_action(1) is None        # consumed
+    assert inj.snapshot()["armed"] == []
+
+
+def test_crash_pump_action():
+    inj = FaultInjector([{"kind": "crash_pump", "at_tick": 0}])
+    inj.tick_actions(0)
+    assert inj.pump_action(0) == "crash"
+    assert inj.fired[0][0] == "crash_pump"
+
+
+def test_tick_actions_raise_and_freeze():
+    inj = FaultInjector([
+        {"kind": "raise_step", "at_tick": 1},
+        {"kind": "freeze_tick", "at_tick": 1, "duration_s": 0.25},
+    ])
+    assert inj.tick_actions(0) == {}          # tick 0: nothing due
+    acts = inj.tick_actions(0)                # tick 1: both fire
+    assert acts["freeze_s"] == pytest.approx(0.25)
+    assert "raise_step" in acts and "tick 1" in acts["raise_step"]
+    assert inj.tick_actions(0) == {}          # both consumed
+
+
+def test_alloc_storm_spans_count_consecutive_ticks():
+    inj = FaultInjector([{"kind": "alloc_storm", "at_tick": 2,
+                          "count": 3}])
+    hits = [bool(inj.tick_actions(0).get("alloc_fail"))
+            for _ in range(8)]
+    assert hits == [False, False, True, True, True, False, False, False]
+
+
+def test_handoff_drop_and_delay_by_sequence():
+    """``at_handoff`` is a fleet-wide 0-based sequence number; a spec
+    covers ``count`` consecutive deliveries."""
+    inj = FaultInjector([
+        {"kind": "drop_handoff", "at_handoff": 1},
+        {"kind": "delay_handoff", "at_handoff": 3, "count": 2,
+         "duration_s": 0.5},
+    ])
+    acts = [inj.handoff_action() for _ in range(6)]
+    assert acts == [None, ("drop", 0.0), None,
+                    ("delay", 0.5), ("delay", 0.5), None]
+
+
+def test_handoff_next_delivery_when_unpinned():
+    inj = FaultInjector([{"kind": "drop_handoff"}])
+    assert inj.handoff_action() == ("drop", 0.0)
+    assert inj.handoff_action() is None
+
+
+def test_handoff_by_virtual_time():
+    inj = FaultInjector([{"kind": "drop_handoff", "at_t": 2.0}])
+    assert inj.handoff_action(t=1.0) is None
+    assert inj.handoff_action(t=2.5) == ("drop", 0.0)
+    assert inj.handoff_action(t=3.0) is None
+
+
+def test_due_crashes_virtual_time_once():
+    inj = FaultInjector([{"kind": "crash_pump", "replica": 2,
+                          "at_t": 2.0}])
+    assert not inj.due_crashes(2, 1.0)
+    assert not inj.due_crashes(0, 5.0)        # wrong replica
+    assert inj.due_crashes(2, 2.0)
+    assert not inj.due_crashes(2, 9.0)        # consumed
+
+
+def test_injector_replay_is_deterministic():
+    """The same schedule driven by the same call sequence fires
+    identically — no wall clock, no RNG in the firing decisions."""
+    schedule = [
+        {"kind": "kill_pump", "at_tick": 2},
+        {"kind": "raise_step", "replica": 1, "at_tick": 1},
+        {"kind": "drop_handoff", "at_handoff": 1},
+    ]
+
+    def drive():
+        inj = FaultInjector(schedule, seed=7)
+        log = []
+        for _ in range(4):
+            log.append(("t0", sorted(inj.tick_actions(0).items())))
+            log.append(("t1", sorted(inj.tick_actions(1).items())))
+            log.append(("p0", inj.pump_action(0)))
+            log.append(("h", inj.handoff_action()))
+        log.append(inj.snapshot())
+        return log
+
+    assert drive() == drive()
+
+
+def test_injected_fault_is_distinct_type():
+    assert issubclass(InjectedFault, RuntimeError)
+    assert set(FAULT_KINDS) == {
+        "kill_pump", "crash_pump", "raise_step", "freeze_tick",
+        "alloc_storm", "drop_handoff", "delay_handoff"}
+
+
+# ---------------------------------------------------------------------------
+# pure recovery policy
+# ---------------------------------------------------------------------------
+
+
+def test_replica_dead_thresholds():
+    assert not replica_dead(None, 1.0)        # no beat ever seen
+    assert not replica_dead(10.0, 0.0)        # miss_s <= 0 disables
+    assert not replica_dead(0.5, 1.0)
+    assert replica_dead(1.5, 1.0)
+
+
+def test_plan_redispatch_precedence():
+    """cancel > budget/deadline error > retry — a cancelled request is
+    never resurrected on a survivor, even with budget left."""
+    assert plan_redispatch(attempt=1, retry_budget=3,
+                           cancelled=True) == "cancel"
+    assert plan_redispatch(attempt=3, retry_budget=3) == "error"
+    assert plan_redispatch(attempt=1, retry_budget=3, age_s=9.0,
+                           deadline_s=5.0) == "error"
+    assert plan_redispatch(attempt=1, retry_budget=3, age_s=9.0,
+                           deadline_s=0.0) == "retry"   # no deadline
+    assert plan_redispatch(attempt=2, retry_budget=3) == "retry"
+    # a degenerate budget still allows the FIRST placement only
+    assert plan_redispatch(attempt=1, retry_budget=0) == "error"
+
+
+def test_pick_retry_target_excludes_dead():
+    sigs = [ReplicaSignals(replica=0), ReplicaSignals(replica=1),
+            ReplicaSignals(replica=2)]
+    # the dead source is never eligible, even while its signals still
+    # read live (the supervisor re-dispatches before the next snapshot)
+    for _ in range(4):
+        assert pick_retry_target(sigs, exclude=(1,)) != 1
+    assert pick_retry_target(sigs, exclude=(0, 1, 2)) is None
+    got = pick_retry_target(sigs, "interactive", 2, exclude=(2,))
+    assert got in (0, 1)
+
+
+def test_plan_handoff_recovery_ladder():
+    assert plan_handoff_recovery(age_s=1.0, timeout_s=5.0, retries=0,
+                                 retry_budget=2) == "wait"
+    assert plan_handoff_recovery(age_s=9.0, timeout_s=0.0, retries=0,
+                                 retry_budget=2) == "wait"   # disabled
+    assert plan_handoff_recovery(age_s=9.0, timeout_s=5.0, retries=0,
+                                 retry_budget=2) == "retry"
+    assert plan_handoff_recovery(age_s=9.0, timeout_s=5.0, retries=2,
+                                 retry_budget=2) == "give_up"

@@ -2,7 +2,9 @@
 (serving/policy.py — plain signals in, replica id out, sim-testable
 with no engine anywhere near it) and the live ``ClusterServing``
 replica set behind one embedded broker — placement spread, cancel
-fan-out, and the graceful ``kill_pump`` drain contract."""
+fan-out, the graceful ``kill_pump`` drain contract, and the
+supervisor's unplanned-death recovery (injected pump crashes,
+heartbeat-miss declaration, at-least-once redispatch)."""
 
 import time
 
@@ -334,4 +336,218 @@ def test_disaggregated_fleet_handoff_round_trip():
             eng._pool.check()
             assert eng._pool.num_referenced() == 0
     finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# supervisor: unplanned death, at-least-once redispatch
+# (docs/debugging.md § Crash recovery runbook)
+# ---------------------------------------------------------------------------
+
+
+def test_crash_pump_redispatch_no_request_lost():
+    """UNPLANNED death under load: an injected pump crash on replica 1
+    kills it mid-generation; the supervisor declares it dead
+    (``pump_exception``), re-dispatches its lost in-flight requests to
+    the survivor, and EVERY admitted request still publishes the
+    bitwise-correct greedy output — the no-dropped-admitted-request
+    contract that ``kill_pump`` pins for planned drains, now for
+    crashes.  Redispatched results carry the ``attempts`` counter."""
+    im = _generator_im()
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=1, n_replicas=2, retry_budget=3,
+                        fault_injection=[{"kind": "crash_pump",
+                                          "replica": 1, "at_tick": 2}])
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        rng = np.random.default_rng(11)
+        prompts = {f"x{i}": rng.integers(1, 32, 3 + i % 4)
+                   .astype(np.int32) for i in range(8)}
+        for u, p in prompts.items():
+            iq.enqueue(u, tokens=p)
+        # wait for every result hash to land WITHOUT consuming it, so
+        # the per-request `attempts` field is still observable
+        deadline = time.monotonic() + 120
+        attempts = {}
+        for u in prompts:
+            while True:
+                h = iq.client.execute("HGETALL", "result:" + u)
+                if h:
+                    f = {h[i].decode(): h[i + 1]
+                         for i in range(0, len(h), 2)}
+                    if "attempts" in f:
+                        attempts[u] = int(f["attempts"])
+                    break
+                assert time.monotonic() < deadline, f"{u} never landed"
+                time.sleep(0.02)
+        from analytics_zoo_tpu.models import generate
+        for u, p in prompts.items():
+            out = np.asarray(oq.query(u, timeout=30))
+            ref = np.asarray(generate(im.model, im._variables,
+                                      jnp.asarray(p[None]), 4))[0]
+            np.testing.assert_array_equal(out, ref, err_msg=u)
+        status = srv.router_status()
+        assert status["deaths"] == 1
+        assert status["death_reasons"] == [None, "pump_exception"]
+        assert status["live"] == [True, False]
+        assert status["redispatched"] >= 1, status
+        # every redispatch surfaced its placement count to the client
+        assert len(attempts) >= 1 and all(a >= 2
+                                          for a in attempts.values())
+        assert status["faults"]["fired"][0]["kind"] == "crash_pump"
+    finally:
+        srv.stop()
+
+
+def test_cancelled_request_not_resurrected_after_death():
+    """A request cancelled while in flight on a dying replica
+    terminates as *cancelled* — the redispatch sweep must not
+    resurrect it on a survivor.  The replica wedges on an injected
+    ``freeze_tick`` (a frozen device step), the cancel lands during
+    the freeze, and the supervisor's heartbeat-miss verdict declares
+    the death."""
+    im = _generator_im()
+    # the freeze fires on replica 1's FIRST busy tick — a guaranteed
+    # in-flight window for the cancel to land.  miss_s sits ABOVE the
+    # first-step jit compile (a cold engine is legitimately silent for
+    # seconds and must not read as dead — that is replica 0's story)
+    # and far BELOW the freeze.
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=1, n_replicas=2,
+                        supervisor_miss_s=5.0,
+                        fault_injection=[{"kind": "freeze_tick",
+                                          "replica": 1, "at_tick": 0,
+                                          "duration_s": 30.0}])
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        iq.enqueue("keep", tokens=np.asarray([3, 5, 9], np.int32))
+        iq.enqueue("gone", tokens=np.asarray([7, 2, 4], np.int32))
+        deadline = time.monotonic() + 60
+        while srv.router_status()["routed"][1] == 0:
+            assert time.monotonic() < deadline, \
+                "replica 1 never saw traffic"
+            time.sleep(0.01)
+        victim = ("gone" if srv._uri_replica.get("gone") == 1
+                  else "keep")
+        other = "keep" if victim == "gone" else "gone"
+        iq.cancel(victim)
+        with pytest.raises(RuntimeError, match="cancelled"):
+            oq.query(victim, timeout=60)
+        assert np.asarray(oq.query(other, timeout=60)).shape == (4,)
+        status = srv.router_status()
+        assert status["death_reasons"][1] == "heartbeat_miss"
+        assert status["live"] == [True, False]
+    finally:
+        srv.stop()
+
+
+def test_dropped_handoff_recovered_by_ack_timeout():
+    """Two-phase handoff: fault injection swallows the first
+    prefill→decode delivery; the source-side pending entry times out,
+    the sweep re-dispatches the retained chain, and the request still
+    publishes the bitwise-correct output.  No handoff is ever
+    fire-and-forget — acks account for every adoption."""
+    im = _generator_im()
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=2, n_replicas=2,
+                        engine_paged=True, engine_block_size=4,
+                        engine_blocks=24,
+                        replica_roles=["prefill", "decode"],
+                        # generous ack timeout: a cold adoption jit-
+                        # compiles its scatter, which must not look
+                        # like a dropped delivery to the sweep
+                        handoff_ack_timeout_s=2.0, retry_budget=3,
+                        fault_injection=[{"kind": "drop_handoff",
+                                          "at_handoff": 0}])
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        rng = np.random.default_rng(13)
+        prompts = {f"h{i}": rng.integers(1, 32, 3 + i % 5)
+                   .astype(np.int32) for i in range(3)}
+        for u, p in prompts.items():
+            iq.enqueue(u, tokens=p)
+        from analytics_zoo_tpu.models import generate
+        for u, p in prompts.items():
+            out = np.asarray(oq.query(u, timeout=120))
+            ref = np.asarray(generate(im.model, im._variables,
+                                      jnp.asarray(p[None]), 4))[0]
+            np.testing.assert_array_equal(out, ref, err_msg=u)
+        status = srv.router_status()
+        assert status["handoff_timeouts"] >= 1, status
+        assert status["handoff_retries"] >= 1, status
+        assert status["handoff_acks"] == len(prompts)
+        assert status["deaths"] == 0     # nobody died — only the wire
+        # the retained chains were all released on adoption
+        for eng in srv.engines:
+            eng._pool.check()
+            assert eng._pool.num_referenced() == 0
+        assert not srv._pending_handoffs
+    finally:
+        srv.stop()
+
+
+def test_zero_live_replicas_front_door_and_unrouted_ttl():
+    """Whole-fleet outage contract: with ZERO live pumps the HTTP
+    front door refuses new work with 503 + a finite Retry-After and
+    /healthz flips ``accepting: false`` — while a request already in
+    the queue parks unrouted and error-terminates after
+    ``unrouted_ttl_s`` instead of hanging forever."""
+    import http.client
+    import json
+
+    from analytics_zoo_tpu.serving import HttpFrontend
+
+    im = _generator_im()
+    cfg = ServingConfig(prompt_col="tokens", continuous_batching=True,
+                        engine_slots=1, n_replicas=2,
+                        unrouted_ttl_s=1.0)
+    srv = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = HttpFrontend(redis_port=srv.port, timeout=60,
+                      serving=srv).start()
+    try:
+        iq = InputQueue(port=srv.port)
+        oq = OutputQueue(port=srv.port)
+        srv.kill_pump(0)
+        srv.kill_pump(1)
+        deadline = time.monotonic() + 30
+        while srv.accepting_replicas() != 0:
+            assert time.monotonic() < deadline, "pumps never drained"
+            time.sleep(0.01)
+        # /healthz: readiness for LOAD says no
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        h = json.loads(resp.read())
+        assert h["accepting"] is False and h["backpressure"] is True
+        assert h["live_replicas"] == 0
+        # new submits bounce with a finite Retry-After, both routes
+        for route, body in (("/v1/generate",
+                             {"tokens": [3, 5], "max_new": 4}),
+                            ("/predict",
+                             {"instances": [{"tokens": [3, 5]}]})):
+            conn.request("POST", route, json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            assert resp.status == 503, (route, payload)
+            assert float(resp.getheader("Retry-After")) > 0
+            assert b"no live replicas" in payload
+        conn.close()
+        # queue-surface submit: parks unrouted, then a TERMINAL error
+        # after the TTL — bounded wait, never forever
+        iq.enqueue("orphan", tokens=np.asarray([3, 5, 9], np.int32))
+        with pytest.raises(RuntimeError, match="expired unplaced"):
+            oq.query("orphan", timeout=60)
+        assert srv.router_status()["unrouted_expired"] == 1
+        # graceful kills are NOT deaths — no supervisor verdicts here
+        assert srv.router_status()["deaths"] == 0
+    finally:
+        fe.stop()
         srv.stop()

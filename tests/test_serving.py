@@ -67,6 +67,36 @@ class TestRespBroker:
         finally:
             srv.stop()
 
+    def test_xrange_id_bounds(self):
+        """XRANGE honours real Redis range semantics — the supervisor's
+        redispatch re-reads a dead replica's entries by EXACT id, so a
+        broker that ignores the bounds resurrects the wrong request."""
+        srv = RespServer(port=0).start()
+        try:
+            c = RespClient("127.0.0.1", srv.port)
+            ids = [c.execute("XADD", "s", "*", "k", str(i))
+                   for i in range(4)]
+            # full range: '-' .. '+'
+            assert len(c.execute("XRANGE", "s", "-", "+")) == 4
+            # exact-id lookup returns THAT entry, not the stream head
+            for i, eid in enumerate(ids):
+                got = c.execute("XRANGE", "s", eid, eid)
+                assert len(got) == 1
+                assert got[0][0] == eid
+                assert got[0][1] == [b"k", str(i).encode()]
+            # sub-range is inclusive on both ends
+            mid = c.execute("XRANGE", "s", ids[1], ids[2])
+            assert [e[0] for e in mid] == [ids[1], ids[2]]
+            # COUNT caps the reply
+            assert len(c.execute(
+                "XRANGE", "s", "-", "+", "COUNT", "2")) == 2
+            # a bare-ms start bound means seq 0 (catches everything
+            # at that millisecond)
+            ms = ids[0].decode().split("-")[0]
+            assert len(c.execute("XRANGE", "s", ms, "+")) == 4
+        finally:
+            srv.stop()
+
 
 # ---------------------------------------------------------------------------
 # end-to-end: queues -> serving loop -> results
