@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: test verify examples bench native serve-smoke chaos-smoke \
-	sim-gate lint clean
+	overload-smoke sim-gate lint clean
 
 # full suite on the 8-virtual-device CPU mesh (tests/conftest.py forces it)
 test:
@@ -80,6 +80,16 @@ serve-smoke:
 # (docs/debugging.md "Crash recovery runbook").
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench_serving.py --chaos-smoke
+
+# graceful-degradation overload leg, standalone (also runs inside
+# serve-smoke's bench_serving --smoke chain): a live 2-replica fleet
+# under a saturating mixed-class burst with a tiny brownout ladder —
+# the ladder must ascend AND fully unwind on /metrics, expired-deadline
+# requests must shed at admission (before prefill) as terminal
+# deadline_exceeded errors, and every interactive request must finish
+# (docs/serving_qos.md "Overload & brownout").
+overload-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench_serving.py --overload-smoke
 
 # CI gate for scheduler regressions: run the pinned golden scenario
 # (tests/golden/sim_golden.json) through the offline discrete-event

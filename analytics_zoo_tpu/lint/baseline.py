@@ -70,6 +70,22 @@ def stale_entries(baseline: Baseline, findings: Sequence[Finding],
             and (e["path"], e["rule"], e["text"]) not in matched]
 
 
+#: The placeholder ``--write-baseline`` stamps on a new entry.  An
+#: entry still carrying it was never justified by a human; the CLI
+#: fails an unfiltered run on it (same posture as a stale entry).
+TODO_REASON = "TODO: justify"
+
+
+def todo_entries(baseline: Baseline) -> List[Dict[str, str]]:
+    """Baseline entries whose ``reason`` is still the write-time
+    placeholder.  A baseline exists to carry *justified* exceptions;
+    a ``TODO: justify`` that survives past its own PR is a suppressed
+    finding nobody signed off on, so the CLI fails on it instead of
+    letting the placeholder quietly become permanent."""
+    return [e for e in baseline.entries
+            if e.get("reason", "").strip() == TODO_REASON]
+
+
 def write_baseline(path: str, findings: Sequence[Finding],
                    old: Optional[Baseline] = None) -> int:
     """Write all ``findings`` as the new baseline, preserving reasons
@@ -87,7 +103,7 @@ def write_baseline(path: str, findings: Sequence[Finding],
             "rule": f.rule,
             "line": f.line,        # informational; matching ignores it
             "text": f.text,
-            "reason": (prior or {}).get("reason", "TODO: justify"),
+            "reason": (prior or {}).get("reason", TODO_REASON),
         })
     with open(path, "w", encoding="utf-8") as fp:
         json.dump({"version": _VERSION, "entries": entries}, fp, indent=2,

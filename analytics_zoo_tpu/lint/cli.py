@@ -17,7 +17,7 @@ from analytics_zoo_tpu.lint.analyzer import (DEFAULT_HOT_PATHS, RULES,
                                              analyze_paths, iter_py_files)
 from analytics_zoo_tpu.lint.baseline import (Baseline, apply_baseline,
                                              load_baseline, stale_entries,
-                                             write_baseline)
+                                             todo_entries, write_baseline)
 
 DEFAULT_BASELINE = "tpulint_baseline.json"
 
@@ -102,17 +102,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     # or rewritten.  Only meaningful on an unfiltered run (a --select/
     # --rules/--no-concurrency run simply doesn't produce the family).
     stale: List[dict] = []
+    todo: List[dict] = []
     if baseline is not None and not filtered and not args.no_concurrency:
         rel = os.getcwd()
         analyzed = [os.path.relpath(f, rel).replace(os.sep, "/")
                     for f in iter_py_files(args.paths)]
         stale = stale_entries(baseline, findings, analyzed)
+        # unjustified entries fail the same unfiltered runs stale ones
+        # do: a partial run must not nag about the rest of the ledger,
+        # but CI's full run refuses a "TODO: justify" placeholder that
+        # outlived its own PR
+        todo = todo_entries(baseline)
 
     if args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in kept],
             "baselined": len(suppressed),
             "stale_baseline": stale,
+            "todo_baseline": todo,
             "total": len(findings),
         }, indent=2))
     else:
@@ -123,14 +130,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"was fixed): {e['path']}: {e['rule']} \"{e['text']}\" "
                   f"— refresh with --write-baseline or delete the entry",
                   file=sys.stderr)
+        for e in todo:
+            print(f"tpulint: unjustified baseline entry: {e['path']}: "
+                  f"{e['rule']} \"{e['text']}\" still says "
+                  f"\"TODO: justify\" — replace the placeholder with "
+                  f"the real reason this finding is kept",
+                  file=sys.stderr)
         tail = f"tpulint: {len(kept)} finding(s)"
         if suppressed:
             tail += f", {len(suppressed)} baselined"
         if stale:
             tail += f", {len(stale)} STALE baseline entr" + \
                 ("y" if len(stale) == 1 else "ies")
+        if todo:
+            tail += f", {len(todo)} UNJUSTIFIED baseline entr" + \
+                ("y" if len(todo) == 1 else "ies")
         print(tail, file=sys.stderr)
 
     if parse_failures:
         return 2
-    return 1 if kept or stale else 0
+    return 1 if kept or stale or todo else 0

@@ -78,6 +78,16 @@ def _kv_label(dtype) -> str:
         jnp.dtype(dtype).name, jnp.dtype(dtype).name)
 
 
+class DeadlineExceeded(RuntimeError):
+    """Terminal error for a request shed at ADMISSION because its
+    end-to-end deadline already passed — distinct on purpose from the
+    supervisor's in-flight deadline give-up (``plan_redispatch``'s
+    error verdict), so the two show up separately on /metrics and in
+    postmortems.  The message always starts with ``deadline_exceeded``
+    so the wire error event is greppable and the SSE stream can name
+    the event type."""
+
+
 class _Req(NamedTuple):
     """One waiting-queue entry — named fields, because positional
     indexing across three consumers silently breaks when a field is
@@ -105,6 +115,10 @@ class _Req(NamedTuple):
     # chain (submit_handoff); admission adopts it instead of prefilling.
     handoff_cb: Optional[Callable] = None
     handoff_state: Optional[dict] = None
+    # end-to-end deadline (docs/serving_qos.md "Overload & brownout"):
+    # an absolute ``time.monotonic`` instant; 0.0 = no deadline.
+    # Admission sheds entries already past it BEFORE any prefill work.
+    deadline_t: float = 0.0
 
 
 @dataclass
@@ -277,7 +291,18 @@ class ContinuousEngine:
                              "draft_alloc_fail": 0, "spec_proposed": 0,
                              "spec_accepted": 0, "pool_resizes": 0,
                              "handoffs_out": 0, "handoffs_in": 0,
-                             "kv_spills": 0, "kv_readmits": 0}
+                             "kv_spills": 0, "kv_readmits": 0,
+                             "deadline_sheds": 0}
+        # ---- overload brownout + deadline admission (policy.py) --------
+        # per-tick engine state the broker's plan_brownout controller
+        # pushes via set_brownout(); 0/off by default, and every gate
+        # below checks the level first, so an engine nobody browns out
+        # makes bit-identical decisions to the pre-brownout engine.
+        self._brownout_level = 0
+        self._brownout_enabled = False
+        self._brownout_clamp = 0
+        self._deadline_seen = False
+        self._deadline_sheds = 0
         # ---- speculative mode (draft arena) ----------------------------
         # the slot arena is ALREADY per-row-positioned, which is exactly
         # what per-slot acceptance rates need: each verify round advances
@@ -1078,6 +1103,11 @@ class ContinuousEngine:
         m = self.telemetry.metrics
         m.gauge("zoo_engine_queue_depth",
                 "requests waiting for a slot", fn=lambda: self.n_waiting)
+        # pre-registered (not lazily on first shed) so dashboards see
+        # the stable zero whether or not any deadline ever expires
+        m.counter("zoo_engine_deadline_admission_sheds_total",
+                  "requests shed at admission because their deadline "
+                  "had already passed (never reached prefill)")
         m.gauge("zoo_engine_active_slots",
                 "resident requests (decode + prefilling)",
                 fn=lambda: self.n_active)
@@ -1614,7 +1644,8 @@ class ContinuousEngine:
                on_token: Optional[Callable] = None,
                priority: str = "standard",
                tenant: str = "",
-               handoff_cb: Optional[Callable] = None) -> None:
+               handoff_cb: Optional[Callable] = None,
+               deadline_t: float = 0.0) -> None:
         """Queue one request.  ``prompt``: 1-D int32 token array.
         ``on_done(uri, tokens)`` fires from the pump thread when the
         request finishes (tokens: ``[max_new]`` int32, eos-padded frozen
@@ -1703,6 +1734,12 @@ class ContinuousEngine:
                     "(the ROADMAP follow-on 'spec-aware KV handoff' "
                     "lifts this); serve the disaggregated fleet without "
                     "a draft model")
+        deadline_t = float(deadline_t or 0.0)
+        if deadline_t > 0.0:
+            # deadline-aware admission sweeps cost a queue scan per
+            # tick — armed only once the FIRST deadline ever arrives,
+            # so deadline-free deployments pay nothing
+            self._deadline_seen = True
         # stamp AFTER validation: a rejected submit never existed as
         # far as queue-wait/TTFT accounting is concerned
         self.telemetry.req_enqueued(uri)
@@ -1710,7 +1747,8 @@ class ContinuousEngine:
             self._waiting.append(_Req(
                 uri, prompt, on_done, on_error, float(temperature),
                 rng_seed, mn, prefix, float(top_p), on_token,
-                priority, str(tenant), time.monotonic(), handoff_cb))
+                priority, str(tenant), time.monotonic(), handoff_cb,
+                deadline_t=deadline_t))
 
     def submit_handoff(self, state: dict) -> None:
         """Adopt a prefilled request exported by another engine's
@@ -1769,10 +1807,84 @@ class ContinuousEngine:
         to a power of two so a burst costs a handful of compiles, not
         one per burst size); their K/V splice into slots one
         dynamic_update_slice each.  Returns the number admitted."""
+        if self._deadline_seen:
+            self._shed_expired_waiting()
+        deferred = (self._brownout_defer_extract()
+                    if self._brownout_level >= 1 else None)
+        try:
+            admitted = self._admit_pass()
+            if deferred and admitted == 0 and self._free \
+                    and not len(self._waiting):
+                # work-conserving brownout: the ladder gates NEW
+                # arrivals (front door 429s), but work already accepted
+                # must not strand — with zero admissible demand and
+                # slots free, idling while holding a backlog wastes the
+                # very capacity the ladder protects AND latches the
+                # controller (the held queue keeps the depth signal
+                # above the exit threshold forever).  Serve the held
+                # classes opportunistically; under real pressure the
+                # first pass admits or leaves admissible waiting, so
+                # this pass never runs and the shed holds.
+                with self._lock:
+                    for req in reversed(deferred):
+                        self._waiting.appendleft(req)
+                deferred = None
+                admitted = self._admit_pass()
+            return admitted
+        finally:
+            if deferred:
+                # deferred classes return to the FRONT of their own
+                # subqueues in original order — held, not reordered, so
+                # they admit untouched the moment the ladder descends
+                with self._lock:
+                    for req in reversed(deferred):
+                        self._waiting.appendleft(req)
+
+    def _admit_pass(self) -> int:
         if self.chunked:
             return self._admit_chunked()
         if self.paged:
             return self._admit_paged()
+        return self._admit_arena()
+
+    def _shed_expired_waiting(self) -> None:
+        """Admission-time deadline shed: every waiting request whose
+        ``deadline_t`` already passed terminates NOW with a
+        ``deadline_exceeded`` error — before any prefill work, before
+        claiming a slot, before touching either KV pool.  An overloaded
+        engine must not burn its scarcest resource (tick budget) on
+        work nobody is waiting for anymore."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [r for r in self._waiting
+                       if getattr(r, "deadline_t", 0.0) > 0.0
+                       and now > r.deadline_t]
+            for r in expired:
+                self._waiting.remove(r)
+        for r in expired:
+            self._deadline_sheds += 1
+            self.telemetry.deadline_shed(r.uri)
+            late_ms = (now - r.deadline_t) * 1e3
+            self._req_error(r.uri, r.on_error, DeadlineExceeded(
+                f"deadline_exceeded: deadline passed {late_ms:.0f}ms "
+                f"before admission"))
+
+    def _brownout_defer_extract(self) -> list:
+        """Pull every waiting request whose class the current brownout
+        level sheds OUT of the queue for this admission pass (the
+        caller reinserts them at the front afterwards).  Held requests
+        keep aging — their enq_t is untouched — so a descending ladder
+        admits them with their full waited-time priority."""
+        lvl = self._brownout_level
+        with self._lock:
+            deferred = [r for r in self._waiting
+                        if not scheduler_policy.brownout_admit(
+                            lvl, getattr(r, "priority", "standard"))]
+            for r in deferred:
+                self._waiting.remove(r)
+        return deferred
+
+    def _admit_arena(self) -> int:
         admitted = 0
         while self._free:
             with self._lock:
@@ -2095,7 +2207,8 @@ class ContinuousEngine:
         prefix-matched blocks; arena rows start past the spliced
         prefix (``base``)."""
         self._slots[slot] = _Slot(
-            uri=req.uri, plen=plen, max_new=req.max_new,
+            uri=req.uri, plen=plen,
+            max_new=self._brownout_mn(req.priority, req.max_new),
             on_done=req.on_done, on_error=req.on_error,
             temperature=req.temperature, rng_seed=req.rng_seed,
             top_p=req.top_p, req=req, admit_seq=self._admit_seq,
@@ -3012,7 +3125,8 @@ class ContinuousEngine:
         """Shared slot-state installation for every admission path —
         plain bucket splice and prefix admission must never drift."""
         self._slots[slot] = _Slot(
-            uri=uri, plen=plen, max_new=mn, on_done=on_done,
+            uri=uri, plen=plen, max_new=self._brownout_mn(priority, mn),
+            on_done=on_done,
             on_error=on_error, temperature=temp, rng_seed=seed,
             top_p=top_p, req=req, admit_seq=self._admit_seq,
             on_token=on_token)
@@ -3313,6 +3427,14 @@ class ContinuousEngine:
             rec["qos_depths"] = {f"{c}/{t}" if t else c: n
                                  for (c, t), n in
                                  self._waiting.depths().items()}
+        # schema v3 pure additions: brownout/deadline fields appear
+        # only once the feature is live, so records from untouched
+        # engines stay byte-identical to the pre-brownout build
+        if self._brownout_enabled:
+            rec["brownout_level"] = self._brownout_level
+        if self._deadline_seen:
+            rec["deadline_sheds"] = delta("deadline_sheds",
+                                          self._deadline_sheds)
         self.flight.record(rec)
 
     @property
@@ -3320,6 +3442,44 @@ class ContinuousEngine:
         """Consecutive ticks whose flight record saw >= 1 block-pool
         allocation failure (0 when not paged or currently healthy)."""
         return self._alloc_fail_streak
+
+    # ---- overload brownout (docs/serving_qos.md) ----------------------
+
+    @property
+    def brownout_level(self) -> int:
+        return self._brownout_level
+
+    @property
+    def deadline_sheds(self) -> int:
+        """Requests shed at admission because their deadline already
+        passed (separate from the supervisor's in-flight give-ups)."""
+        return self._deadline_sheds
+
+    def set_brownout(self, level: int,
+                     standard_max_new: int = 0) -> None:
+        """Push the broker controller's ladder level into per-tick
+        engine state (thread-safe: plain int stores the pump reads at
+        tick boundaries).  Level >= 1 defers batch-class admission,
+        >= 2 clamps standard-class ``max_new`` to ``standard_max_new``,
+        >= 3 drops speculative rounds (the target decodes alone — the
+        draft cache goes cold for in-flight rows, which costs
+        acceptance after recovery, never correctness: the verify step
+        is what picks tokens), >= 4 admits interactive only.  Never
+        calling this keeps every gate at 0 and the engine bit-identical
+        to the pre-brownout build."""
+        self._brownout_enabled = True
+        self._brownout_level = max(
+            0, min(int(level), scheduler_policy.BROWNOUT_MAX_LEVEL))
+        self._brownout_clamp = max(0, int(standard_max_new))
+
+    def _brownout_mn(self, priority, mn: int) -> int:
+        """Level-2 token clamp at slot install (one choke point per
+        admission family; handoff adoption is exempt — its token count
+        is already mid-flight)."""
+        if self._brownout_level < 2:
+            return mn
+        return scheduler_policy.brownout_max_new(
+            self._brownout_level, priority, mn, self._brownout_clamp)
 
     def spec_acceptance(self) -> Optional[dict]:
         """The recorded speculative-acceptance distribution (exact
@@ -3341,7 +3501,16 @@ class ContinuousEngine:
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
             return 0
-        if self.draft_model is not None:
+        # brownout level >= 3: speculative rounds are dropped — the
+        # dispatch below falls through to the target-only tick paths.
+        # Mechanically safe: _ensure_blocks/_grow_chunk_blocks still
+        # cover pos + spec_k writes, draft tables grow in lockstep, and
+        # _dpos merely goes stale (proposals degrade after recovery;
+        # the target verify alone picks tokens, so outputs stay exact).
+        spec_on = (self.draft_model is not None
+                   and scheduler_policy.brownout_spec_enabled(
+                       self._brownout_level))
+        if spec_on:
             if self.chunked and any(
                     self._slots[i].state == "PREFILLING"
                     for i in active):
@@ -3383,6 +3552,11 @@ class ContinuousEngine:
             self.ticks_per_step,
             max(self._slots[i].max_new - len(self._slots[i].tokens)
                 for i in active)))
+        if self.draft_model is not None:
+            # only reachable with spec browned out (level >= 3):
+            # single-tick steps keep the write frontier inside the
+            # pos + spec_k coverage _ensure_blocks grants this engine
+            n_eff = 1
         step = self._get_step(n_eff, sampled, use_topp)
         if self.paged:
             toks, tok, pos, done, self._pk, self._pv = step(

@@ -314,6 +314,13 @@ class SloWatchdog:
             (c, m): 0 for c in PRIORITIES for m in SLO_METRICS}
         # (monotonic_ts, cls, metric, value, target, uri) — newest last
         self._recent: deque = deque(maxlen=int(recent_capacity))
+        # per-class ring of recent finish outcomes (True = met every
+        # target) — the brownout controller's goodput signal.  The
+        # CUMULATIVE ratio above never recovers after a bad hour, so a
+        # controller keyed on it could latch degraded forever; this
+        # window forgets.
+        self._window: Dict[str, deque] = {
+            c: deque(maxlen=int(recent_capacity)) for c in PRIORITIES}
         self.metrics = registry if registry is not None else MetricsRegistry()
         self._register(self.metrics)
 
@@ -375,6 +382,7 @@ class SloWatchdog:
             self._finished[cls] += 1
             if not breached:
                 self._good[cls] += 1
+            self._window[cls].append(not breached)
 
     def drop(self, uri: str) -> None:
         """Forget an in-flight request that errored or was cancelled —
@@ -383,6 +391,17 @@ class SloWatchdog:
             self._open_breaches.pop(uri, None)
 
     # -- introspection -------------------------------------------------
+
+    def windowed_goodput(self, cls: str) -> float:
+        """Fraction of the last ``recent_capacity`` finished ``cls``
+        requests that met every SLO target — 1.0 before any finish.
+        This (not the cumulative gauge) is what ``plan_brownout``
+        consumes: it recovers when the engine does."""
+        with self._lock:
+            win = self._window.get(cls)
+            if not win:
+                return 1.0
+            return sum(1 for ok in win if ok) / len(win)
 
     def breach_burst(self, window_s: float) -> int:
         """Breaches recorded in the trailing ``window_s`` seconds."""

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import time
 from typing import List, Optional, Tuple
 
@@ -136,13 +137,82 @@ class ThroughputEstimator:
 
 
 def retry_after_s(depth: int, rate: float, lo: float = 1.0,
-                  hi: float = 120.0) -> int:
+                  hi: float = 120.0, level: int = 0) -> int:
     """Seconds a 429'd client should wait: queue depth over recent
     completion throughput, clamped to ``[lo, hi]`` so the header is
-    always finite and never tells a client to hammer back instantly."""
+    always finite and never tells a client to hammer back instantly.
+    ``level`` is the brownout ladder level: each level scales the
+    pre-clamp estimate by one extra multiple, so the hint is monotone
+    non-decreasing as degradation deepens (a shed class should back
+    off LONGER than a merely-queued one) while the ``hi`` clamp keeps
+    even level-4 finite."""
     if rate <= 0:
         return int(hi)
-    return int(min(hi, max(lo, float(depth) / rate)))
+    base = float(depth) / rate * (1 + max(0, int(level)))
+    return int(min(hi, max(lo, base)))
+
+
+# ---- request deadlines (docs/serving_qos.md "Overload & brownout") ----
+
+#: Deadlines past 24h are a client bug (an absolute timestamp sent
+#: where a relative budget belongs, a ms/s unit mix-up), not patience.
+MAX_DEADLINE_MS = 24 * 3600 * 1000
+
+
+def validate_deadline_ms(value) -> int:
+    """A client-supplied deadline budget (``X-Request-Deadline-Ms``
+    header or ``deadline_ms`` body field): milliseconds from now.
+    Returns the validated integer budget; raises ``ValueError`` (the
+    front door's 400 path) with a pointed message on anything
+    non-numeric, non-finite, non-positive, or past the 24h ceiling."""
+    if isinstance(value, bool):
+        raise ValueError(
+            f"deadline_ms must be a number of milliseconds, "
+            f"got {value!r}")
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"deadline_ms must be a number of milliseconds, "
+            f"got {value!r}")
+    if math.isnan(f) or math.isinf(f):
+        raise ValueError(
+            f"deadline_ms must be finite, got {value!r}")
+    if f <= 0:
+        raise ValueError(
+            f"deadline_ms must be > 0 (milliseconds from now), "
+            f"got {value!r}")
+    if f > MAX_DEADLINE_MS:
+        raise ValueError(
+            f"deadline_ms {value!r} exceeds the 24h ceiling "
+            f"({MAX_DEADLINE_MS} ms) — send a relative budget, not an "
+            f"absolute timestamp")
+    return int(f)
+
+
+def encode_deadline(deadline_ms, now_wall: Optional[float] = None
+                    ) -> np.ndarray:
+    """Validated relative budget -> the int64 ABSOLUTE unix wall-clock
+    millisecond the input queue transports.  Wall clock (not monotonic)
+    because the queue entry crosses process boundaries; the consumer
+    converts back to its own monotonic domain at decode."""
+    ms = validate_deadline_ms(deadline_ms)
+    now_wall = time.time() if now_wall is None else now_wall
+    return np.int64(int(now_wall * 1000.0) + ms)
+
+
+def decode_deadline(v, now_wall: Optional[float] = None,
+                    now_mono: Optional[float] = None) -> float:
+    """Wire deadline (absolute wall-clock ms) -> the engine-side
+    ``deadline_t`` in the consumer's ``time.monotonic`` domain
+    (seconds).  0.0 means no deadline; an already-passed wall time
+    yields a ``deadline_t`` in the past, which admission sheds."""
+    wall_ms = int(np.asarray(v).reshape(-1)[0])
+    if wall_ms <= 0:
+        return 0.0
+    now_wall = time.time() if now_wall is None else now_wall
+    now_mono = time.monotonic() if now_mono is None else now_mono
+    return now_mono + (wall_ms / 1000.0 - now_wall)
 
 
 # ---- wire codecs ------------------------------------------------------

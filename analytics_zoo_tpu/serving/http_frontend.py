@@ -59,10 +59,15 @@ import numpy as np
 from analytics_zoo_tpu.common.log import logger
 from analytics_zoo_tpu.serving.flight import request_uri_context
 from analytics_zoo_tpu.serving.frontdoor import (ThroughputEstimator,
+                                                 decode_priority,
+                                                 encode_deadline,
                                                  encode_priority,
                                                  encode_str_field,
                                                  normalize_request_id,
-                                                 retry_after_s, sse_event)
+                                                 retry_after_s, sse_event,
+                                                 validate_deadline_ms)
+from analytics_zoo_tpu.serving.policy import (brownout_admit,
+                                              brownout_classes)
 from analytics_zoo_tpu.serving.queues import (
     BacklogFull, ImageBytes, InputQueue, OutputQueue)
 from analytics_zoo_tpu.serving.telemetry import (
@@ -191,8 +196,10 @@ class HttpFrontend:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _send_429(self, depth, message):
-                ra = frontend._retry_after(depth)
+            def _send_429(self, depth, message, level=0):
+                # header and body carry the SAME value by construction
+                # — a client honoring either backs off identically
+                ra = frontend._retry_after(depth, level=level)
                 body = json.dumps({"error": message,
                                    "retry_after_s": ra}).encode()
                 self.send_response(429)
@@ -374,11 +381,33 @@ class HttpFrontend:
                     req = json.loads(self.rfile.read(n) or b"{}")
                     if not isinstance(req, dict):
                         raise ValueError("body must be a JSON object")
+                    deadline_ms = frontend._deadline_ms(
+                        req.pop("deadline_ms", None),
+                        self.headers.get("X-Request-Deadline-Ms"))
                     fields, stream = frontend._generate_fields(req)
+                    if deadline_ms is not None:
+                        fields["deadline"] = encode_deadline(deadline_ms)
                 except (json.JSONDecodeError, KeyError, ValueError,
                         TypeError, AttributeError) as e:
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
                     return
+                # brownout admission gate (docs/serving_qos.md): with
+                # at least one replica live (the fleet-dead 503 above
+                # owns zero-live), a browned-out class gets 429 + a
+                # level-scaled Retry-After — an honest "come back
+                # later", never a silent queue-then-shed
+                level = frontend._brownout_level()
+                if level > 0:
+                    pri = (decode_priority(fields["priority"])
+                           if "priority" in fields else "standard")
+                    if not brownout_admit(level, pri):
+                        frontend._count_shed(pri)
+                        self._send_429(
+                            None,
+                            f"brownout level {level}: {pri}-class "
+                            f"admissions are shed — retry later",
+                            level=level)
+                        return
                 pair = frontend._acquire()
                 inq, outq = pair
                 # a client-supplied X-Request-Id becomes the uri end to
@@ -471,9 +500,16 @@ class HttpFrontend:
                                 "cancelled", {"uri": uri}))
                             clean = True
                         else:
+                            err = ev.get("error", "")
+                            # admission-time deadline sheds get their
+                            # OWN terminal event so clients can
+                            # distinguish "arrived too late" from a
+                            # server-side failure without parsing text
+                            kind = ("deadline_exceeded"
+                                    if "deadline_exceeded" in err
+                                    else "error")
                             self.wfile.write(sse_event(
-                                "error", {"uri": uri,
-                                          "error": ev.get("error", "")}))
+                                kind, {"uri": uri, "error": err}))
                             clean = True
                         self.wfile.flush()
                         if clean:
@@ -656,11 +692,13 @@ class HttpFrontend:
         except Exception:
             return False
 
-    def _retry_after(self, depth=None) -> int:
+    def _retry_after(self, depth=None, level: int = 0) -> int:
         """Finite Retry-After for a 429: queue depth over the engine's
         recent completion throughput (frontdoor.retry_after_s clamps
         it, and the estimator falls back to a default rate, so the
-        header is finite even on a cold or detached frontend)."""
+        header is finite even on a cold or detached frontend).
+        ``level`` is the brownout ladder level — the hint scales up
+        monotonically with it, clamped finite at every level."""
         if depth is None and self.serving is not None:
             try:
                 depth = self.serving.backlog()
@@ -674,7 +712,39 @@ class HttpFrontend:
                     float(self.serving.telemetry.c_finished.value))
             except Exception:
                 pass
-        return retry_after_s(int(depth), self._throughput.rate())
+        return retry_after_s(int(depth), self._throughput.rate(),
+                             level=level)
+
+    def _brownout_level(self) -> int:
+        """The attached fleet's brownout ladder level (0 detached or
+        when the controller is off)."""
+        if self.serving is None:
+            return 0
+        try:
+            return int(self.serving.brownout_level())
+        except Exception:
+            return 0
+
+    def _deadline_ms(self, body_value, header_value):
+        """Merge the ``deadline_ms`` body field and the
+        ``X-Request-Deadline-Ms`` header into ONE validated relative
+        budget (milliseconds), or None when neither was sent.  Raises
+        ``ValueError`` (the 400 path) on anything invalid, or when
+        both arrive and disagree — a split-brain deadline is a client
+        bug, not a tiebreak."""
+        vals = []
+        if header_value is not None:
+            vals.append(validate_deadline_ms(header_value))
+        if body_value is not None:
+            vals.append(validate_deadline_ms(body_value))
+        if not vals:
+            return None
+        if len(vals) == 2 and vals[0] != vals[1]:
+            raise ValueError(
+                f"X-Request-Deadline-Ms header ({vals[0]}) and "
+                f"deadline_ms body field ({vals[1]}) disagree — send "
+                f"one, or the same value in both")
+        return vals[0]
 
     def health(self) -> dict:
         """/healthz body: readiness for LOAD, not just liveness —
@@ -703,6 +773,9 @@ class HttpFrontend:
         })
         if fleet_dead:
             out["live_replicas"] = 0
+        lvl = self._brownout_level()
+        out["brownout"] = {"level": lvl,
+                           "admitting": list(brownout_classes(lvl))}
         wd = getattr(self.serving, "watchdog", None)
         if wd is not None:
             # the routing view of the SLO score: per-class goodput and
@@ -723,6 +796,13 @@ class HttpFrontend:
         if self.serving is not None:
             try:
                 self.serving.telemetry.backpressure_rejection()
+            except Exception:
+                pass
+
+    def _count_shed(self, priority: str) -> None:
+        if self.serving is not None:
+            try:
+                self.serving.telemetry.brownout_shed(priority)
             except Exception:
                 pass
 

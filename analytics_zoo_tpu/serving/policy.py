@@ -37,6 +37,12 @@ from):
 * ``plan_pool_resize`` — the elastic-pool step
   (``ContinuousEngine.maybe_autoresize``): grow under pool pressure,
   hold while SLO-degraded, hand blocks back when the pool runs slack.
+* ``plan_brownout`` — the overload degradation ladder
+  (``ClusterServing`` broker loop + ``serving/sim`` models): sustained
+  breach walks the fleet one level up (shed batch -> clamp standard ->
+  drop speculative rounds -> interactive-only); cooldown below the
+  recovery threshold walks it back one level at a time, so the
+  controller cannot flap (docs/serving_qos.md "Overload & brownout").
 
 Everything here is stdlib-only ON PURPOSE: the simulator (and the
 bare-box ``debug.py --replay`` path) import this file with no numpy,
@@ -56,7 +62,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 #: behavior changes.  The simulator stamps it into every event log so
 #: a golden-trace mismatch distinguishes "policy changed" from "sim
 #: drifted".
-SCHEDULER_POLICY_VERSION = 3
+SCHEDULER_POLICY_VERSION = 4
 
 #: Priority classes, best-first.  The wire encodes a priority as its
 #: index in this tuple (the input queue transports ints, not strings);
@@ -392,6 +398,187 @@ def plan_pool_resize(*, n_blocks: int, allocatable: int,
     return 0
 
 
+# ---------------------------------------------------------------------------
+# overload brownout ladder (docs/serving_qos.md "Overload & brownout")
+# ---------------------------------------------------------------------------
+
+#: Deepest degradation level: interactive-only serving.  Levels are
+#: cumulative — every restriction of level N-1 stays active at N.
+BROWNOUT_MAX_LEVEL = 4
+
+
+@dataclass(frozen=True)
+class BrownoutPolicy:
+    """Knobs for the overload degradation ladder.
+
+    A tick is a *breach* when any still-admitted class's windowed
+    goodput sits below ``goodput_floor``, the admission backlog reaches
+    ``queue_high``, the paged pool's alloc-fail streak reaches
+    ``alloc_streak_high``, or (``tick_s_high > 0``) the engine tick
+    duration exceeds ``tick_s_high``.  ``enter_ticks`` consecutive
+    breaches ascend ONE level; descending needs ``exit_ticks``
+    consecutive *recovered* ticks — backlog at or below
+    ``queue_recover_frac * queue_high`` with no alloc pressure and
+    every admitted class back above the floor.  The asymmetric gap
+    between breach and recovery is the hysteresis band: a fleet
+    hovering at the breach threshold holds its level instead of
+    flapping.  ``standard_max_new`` is the level-2 per-request token
+    clamp for ``standard`` class (0 disables the clamp)."""
+
+    goodput_floor: float = 0.9
+    queue_high: int = 64
+    queue_recover_frac: float = 0.5
+    alloc_streak_high: int = 4
+    tick_s_high: float = 0.0
+    enter_ticks: int = 3
+    exit_ticks: int = 6
+    standard_max_new: int = 16
+
+    def __post_init__(self):
+        if not 0.0 < self.goodput_floor <= 1.0:
+            raise ValueError(f"goodput_floor must be in (0, 1], got "
+                             f"{self.goodput_floor}")
+        if self.queue_high < 1:
+            raise ValueError(f"queue_high must be >= 1, got "
+                             f"{self.queue_high}")
+        if not 0.0 <= self.queue_recover_frac <= 1.0:
+            raise ValueError(f"queue_recover_frac must be in [0, 1], "
+                             f"got {self.queue_recover_frac}")
+        if self.enter_ticks < 1 or self.exit_ticks < 1:
+            raise ValueError("enter_ticks/exit_ticks must be >= 1")
+
+
+@dataclass(frozen=True)
+class BrownoutState:
+    """The controller's whole memory, as plain immutable data: the
+    current ladder level plus the consecutive breach/clear streaks the
+    hysteresis gates count.  Callers thread it through
+    ``plan_brownout`` and persist nothing else, so replays are exact."""
+
+    level: int = 0
+    breach_streak: int = 0
+    clear_streak: int = 0
+
+
+def brownout_classes(level: int) -> Tuple[str, ...]:
+    """Priority classes still admitted at ``level`` (best-first).
+    Shedding is strictly worst-class-first: batch goes at level 1,
+    standard at level 4, interactive NEVER sheds."""
+    if level >= 4:
+        return ("interactive",)
+    if level >= 1:
+        return ("interactive", "standard")
+    return PRIORITIES
+
+
+def brownout_admit(level: int, priority: Optional[str]) -> bool:
+    """Admission verdict for one request under the ladder.  Unknown
+    priorities rank as ``standard`` (the ``class_rank`` convention —
+    a stale wire value must degrade, not crash)."""
+    cls = priority if priority in PRIORITIES else "standard"
+    return cls in brownout_classes(level)
+
+
+def brownout_max_new(level: int, priority: Optional[str],
+                     max_new: int, clamp: int) -> int:
+    """Level-2 token clamp: ``standard``-class requests are capped at
+    ``clamp`` new tokens (never raised, never below 1).  Interactive
+    is untouched at every level; batch is already shed by level 2."""
+    if level < 2 or clamp <= 0:
+        return max_new
+    cls = priority if priority in PRIORITIES else "standard"
+    if cls != "standard":
+        return max_new
+    return max(1, min(max_new, clamp))
+
+
+def brownout_spec_enabled(level: int) -> bool:
+    """Level-3 switch: speculative rounds are pure overhead when the
+    fleet is saturated (draft ticks burn budget the verify can't
+    repay), so the ladder drops them before it sheds standard."""
+    return level < 3
+
+
+def brownout_breached(policy: BrownoutPolicy, level: int, *,
+                      goodput: Optional[Dict[str, float]] = None,
+                      queue_depth: int = 0,
+                      alloc_fail_streak: int = 0,
+                      tick_s: Optional[float] = None) -> bool:
+    """One tick's breach verdict.  Only classes the CURRENT level still
+    admits are judged — a shed class's collapsing goodput must not
+    hold the ladder up after the shedding already handled it."""
+    if queue_depth >= policy.queue_high:
+        return True
+    if alloc_fail_streak >= policy.alloc_streak_high:
+        return True
+    if (policy.tick_s_high > 0 and tick_s is not None
+            and tick_s > policy.tick_s_high):
+        return True
+    if goodput:
+        for cls in brownout_classes(level):
+            g = goodput.get(cls)
+            if g is not None and g < policy.goodput_floor:
+                return True
+    return False
+
+
+def brownout_recovered(policy: BrownoutPolicy, level: int, *,
+                       goodput: Optional[Dict[str, float]] = None,
+                       queue_depth: int = 0,
+                       alloc_fail_streak: int = 0,
+                       tick_s: Optional[float] = None) -> bool:
+    """One tick's recovery verdict — deliberately STRICTER than "not
+    breached": the backlog must fall to ``queue_recover_frac`` of the
+    breach threshold, not merely below it.  The gap is the hysteresis
+    band that keeps the ladder from flapping at the boundary."""
+    if queue_depth > policy.queue_recover_frac * policy.queue_high:
+        return False
+    if alloc_fail_streak > 0:
+        return False
+    if (policy.tick_s_high > 0 and tick_s is not None
+            and tick_s > policy.tick_s_high):
+        return False
+    if goodput:
+        for cls in brownout_classes(level):
+            g = goodput.get(cls)
+            if g is not None and g < policy.goodput_floor:
+                return False
+    return True
+
+
+def plan_brownout(policy: BrownoutPolicy, state: BrownoutState, *,
+                  goodput: Optional[Dict[str, float]] = None,
+                  queue_depth: int = 0,
+                  alloc_fail_streak: int = 0,
+                  tick_s: Optional[float] = None) -> BrownoutState:
+    """One controller step: fold this tick's overload signals into the
+    ladder state.  Pure and deterministic — the live broker
+    (``ClusterServing``), ``EngineModel``, and ``FleetModel`` all call
+    exactly this function, so the golden-brownout scenario replays the
+    production controller byte-for-byte.
+
+    Transitions move ONE level per decision: ``enter_ticks``
+    consecutive breaches ascend, ``exit_ticks`` consecutive recovered
+    ticks descend, and a tick that is neither (inside the hysteresis
+    band) resets BOTH streaks — holding the level is the default
+    outcome, flapping requires the signals themselves to oscillate
+    across the full band."""
+    kw = dict(goodput=goodput, queue_depth=queue_depth,
+              alloc_fail_streak=alloc_fail_streak, tick_s=tick_s)
+    if brownout_breached(policy, state.level, **kw):
+        streak = state.breach_streak + 1
+        if (streak >= policy.enter_ticks
+                and state.level < BROWNOUT_MAX_LEVEL):
+            return BrownoutState(level=state.level + 1)
+        return BrownoutState(level=state.level, breach_streak=streak)
+    if brownout_recovered(policy, state.level, **kw):
+        streak = state.clear_streak + 1
+        if streak >= policy.exit_ticks and state.level > 0:
+            return BrownoutState(level=state.level - 1)
+        return BrownoutState(level=state.level, clear_streak=streak)
+    return BrownoutState(level=state.level)
+
+
 def grant_rank(policy: Optional[QosPolicy], priority: Optional[str],
                waited_s: float, admit_seq: int):
     """Prefill-grant sort key for the chunked ticks.  QoS off: the
@@ -522,7 +709,23 @@ class WeightedWaitQueue:
         return q
 
     def append(self, req) -> None:
-        self._subqueue(req).append(req)
+        """Enqueue at the tail — except that a deadline-carrying entry
+        (``req.deadline_t > 0``, monotonic seconds) ranks earliest-
+        deadline-first WITHIN its subqueue: it slots ahead of the first
+        entry with a later deadline or none at all (no-deadline entries
+        read as infinitely patient).  Traffic without deadlines takes
+        the plain tail append, so FIFO order — and with it the QoS-off
+        parity guarantee — is bit-identical when nobody sends one."""
+        q = self._subqueue(req)
+        dl = getattr(req, "deadline_t", 0.0) or 0.0
+        if dl > 0 and q:
+            for i, other in enumerate(q):
+                od = getattr(other, "deadline_t", 0.0) or 0.0
+                if od <= 0 or od > dl:
+                    q.insert(i, req)
+                    self._n += 1
+                    return
+        q.append(req)
         self._n += 1
 
     def appendleft(self, req) -> None:

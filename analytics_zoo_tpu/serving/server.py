@@ -34,14 +34,18 @@ from analytics_zoo_tpu.serving.flight import (SLO_METRICS, AnomalyMonitor,
                                               prune_bundles)
 from analytics_zoo_tpu.serving.frontdoor import (PRIORITIES, QosPolicy,
                                                  TokenEmitter,
+                                                 decode_deadline,
                                                  decode_priority,
                                                  decode_str_field)
 from analytics_zoo_tpu.serving.fault import FaultInjector, InjectedFault
 from analytics_zoo_tpu.serving.kv_store import PrefixDirectory
 from analytics_zoo_tpu.serving.paged_cache import chain_hashes
 from analytics_zoo_tpu.serving.policy import (REPLICA_ROLES,
+                                                BrownoutPolicy,
+                                                BrownoutState,
                                                 ReplicaSignals,
                                                 pick_retry_target,
+                                                plan_brownout,
                                                 plan_handoff_recovery,
                                                 plan_redispatch,
                                                 replica_dead,
@@ -238,6 +242,24 @@ class ServingConfig:
     # errored instead of re-dispatched (0 = no deadline; the
     # result_ttl_s prune remains the backstop).
     request_deadline_s: float = 0.0
+    # Brownout ladder (docs/serving_qos.md "Overload & brownout"): a
+    # broker-level controller walks policy.plan_brownout over the
+    # fleet's aggregated signals (min per-class windowed goodput, max
+    # queue depth, max alloc-fail streak, recent tick trend) and
+    # pushes the resulting level into every engine — level 1 stops
+    # admitting batch, 2 clamps standard max_new, 3 disables
+    # speculative rounds, 4 serves interactive only.  Off (the
+    # default) = controller never runs, every decision bit-identical
+    # to previous releases.
+    brownout: bool = False
+    brownout_goodput_floor: float = 0.9
+    brownout_queue_high: int = 64
+    brownout_enter_ticks: int = 3
+    brownout_exit_ticks: int = 6
+    brownout_standard_max_new: int = 16
+    # tick-duration breach threshold, seconds (0 disables that signal)
+    brownout_tick_s_high: float = 0.0
+    brownout_interval_s: float = 0.25
 
     @staticmethod
     def from_yaml(path: str) -> "ServingConfig":
@@ -346,7 +368,15 @@ class ServingConfig:
                           ("retry_budget", int),
                           ("handoff_ack_timeout_s", float),
                           ("unrouted_ttl_s", float),
-                          ("request_deadline_s", float)):
+                          ("request_deadline_s", float),
+                          ("brownout", bool),
+                          ("brownout_goodput_floor", float),
+                          ("brownout_queue_high", int),
+                          ("brownout_enter_ticks", int),
+                          ("brownout_exit_ticks", int),
+                          ("brownout_standard_max_new", int),
+                          ("brownout_tick_s_high", float),
+                          ("brownout_interval_s", float)):
             if key in params:
                 setattr(cfg, key, cast(params[key]))
         if "fault_injection" in params:
@@ -576,6 +606,20 @@ class ClusterServing:
         self._handoff_acks = 0
         self._handoff_timeouts = 0
         self._handoff_retries = 0
+        # ---- brownout controller (docs/serving_qos.md) -----------------
+        # The POLICY object exists only when the knob is on: with
+        # `brownout: false` _brownout_eval never runs, no engine ever
+        # sees set_brownout, and every decision stays bit-identical.
+        self._brownout_policy = (BrownoutPolicy(
+            goodput_floor=float(self.config.brownout_goodput_floor),
+            queue_high=int(self.config.brownout_queue_high),
+            enter_ticks=int(self.config.brownout_enter_ticks),
+            exit_ticks=int(self.config.brownout_exit_ticks),
+            standard_max_new=int(self.config.brownout_standard_max_new),
+            tick_s_high=float(self.config.brownout_tick_s_high))
+            if getattr(self.config, "brownout", False) else None)
+        self._brownout_state = BrownoutState()
+        self._brownout_transitions = 0
         # chaos harness: parse the schedule eagerly so a bad spec
         # fails at assembly, not from a pump thread mid-request.
         # None/empty = injection off — every path bit-identical.
@@ -630,6 +674,25 @@ class ClusterServing:
                   "streaming clients that disconnected mid-response")
         m.counter("zoo_serving_backpressure_rejections_total",
                   "admissions refused with 429 under a full backlog")
+        # brownout families (docs/serving_qos.md "Overload & brownout"):
+        # registered unconditionally so dashboards see stable names
+        # whether or not the ladder is enabled — all zero when off
+        m.gauge("zoo_brownout_level",
+                "current brownout ladder level (0 = normal service)",
+                fn=lambda: self._brownout_state.level)
+        m.counter("zoo_brownout_transitions_total",
+                  "brownout ladder level changes (either direction)")
+        for cls in PRIORITIES:
+            m.counter(f"zoo_brownout_shed_total_{cls}",
+                      f"admissions refused with 429 because the "
+                      f"brownout ladder browned the {cls} class out")
+        m.gauge("zoo_brownout_deadline_shed_total",
+                "requests shed at admission fleet-wide because their "
+                "deadline had already passed (never reached prefill)",
+                fn=lambda: sum(
+                    getattr(e, "deadline_sheds", 0)
+                    for e in getattr(self, "engines", ()) or ()),
+                kind="counter")
 
     def _register_router_gauges(self) -> None:
         """The ``zoo_router_*`` families (docs/observability.md): fleet
@@ -1027,6 +1090,13 @@ class ClusterServing:
                 if self.replica_roles is not None else None)
         elastic = bool(self.config.engine_elastic_pool)
         next_resize = time.monotonic() + 0.25
+        # brownout controller cadence: evaluated from replica 0's pump
+        # (the same throttled-control-step pattern as elastic resize);
+        # the single evaluation pushes the level to EVERY engine so the
+        # fleet walks the ladder together
+        brownout_every = max(0.05, float(
+            getattr(self.config, "brownout_interval_s", 0.25)))
+        next_brownout = time.monotonic() + brownout_every
         # streaming state is PUMP-THREAD-ONLY (on_done/on_token fire
         # inside engine.step() on this thread): the emitter buffers
         # per-token events between steps; one pipeline per step ships
@@ -1188,6 +1258,14 @@ class ClusterServing:
                         if "tenant" in r:
                             kw["tenant"] = decode_str_field(
                                 self._decode_value(r["tenant"]))
+                        if "deadline" in r:
+                            # wire deadline (absolute wall-clock ms,
+                            # frontdoor.encode_deadline) -> this pump's
+                            # monotonic domain; an already-passed one
+                            # still submits — admission sheds it with a
+                            # terminal deadline_exceeded, never prefill
+                            kw["deadline_t"] = decode_deadline(
+                                self._decode_value(r["deadline"]))
                         stream = "stream" in r and bool(int(np.asarray(
                             self._decode_value(r["stream"])
                         ).reshape(-1)[0]))
@@ -1274,6 +1352,16 @@ class ClusterServing:
                             logger.exception(
                                 "elastic pool autoresize failed "
                                 "(replica %d)", replica)
+                    if replica == 0 \
+                            and self._brownout_policy is not None \
+                            and time.monotonic() >= next_brownout:
+                        next_brownout = (time.monotonic()
+                                         + brownout_every)
+                        try:
+                            self._brownout_eval()
+                        except Exception:
+                            logger.exception(
+                                "brownout controller step failed")
                 self._flush_emitter(client, emitter)
         except Exception:
             # an exception escaping the pump loop used to die silently
@@ -1309,6 +1397,61 @@ class ClusterServing:
             ticks=tm.c_ticks.value,
             compiles=(tm.c_jit_builds.value + tm.c_retraces.value),
             watchdog=self.watchdogs[replica])
+
+    def _brownout_eval(self) -> None:
+        """One broker-level brownout controller step (replica 0's pump,
+        every ``brownout_interval_s``): aggregate the WORST signal on
+        every axis across the fleet — min per-class windowed goodput,
+        max effective queue depth, max alloc-fail streak, replica 0's
+        recent tick trend from the flight ring — hand them to the pure
+        ``plan_brownout``, and on a level change push the new level
+        into every engine and leave a trace instant.  One controller,
+        one ladder: the fleet degrades (and recovers) together, so a
+        client never sees replica-dependent admission."""
+        pol = self._brownout_policy
+        if pol is None:
+            return
+        goodput = {
+            cls: min(self.watchdogs[r].windowed_goodput(cls)
+                     for r in range(self.n_replicas))
+            for cls in PRIORITIES}
+        queue_depth = 0
+        streak = 0
+        for r in range(self.n_replicas):
+            eng = self.engines[r]
+            queue_depth = max(queue_depth,
+                              len(self._rqueues[r]) + eng.n_waiting)
+            streak = max(streak, eng.alloc_fail_streak)
+        tick_s = 0.0
+        if self.flight is not None and len(self.flight):
+            tail = self.flight.snapshot(last=8)
+            tick_s = sum(t.get("dur_ms", 0.0) for t in tail) \
+                / len(tail) / 1e3
+        prev = self._brownout_state
+        state = plan_brownout(pol, prev, goodput=goodput,
+                              queue_depth=queue_depth,
+                              alloc_fail_streak=streak, tick_s=tick_s)
+        self._brownout_state = state
+        if state.level == prev.level:
+            return
+        self._brownout_transitions += 1
+        self.telemetry.brownout_transition(state.level, prev.level)
+        log = (logger.warning if state.level > prev.level
+               else logger.info)
+        log("brownout level %d -> %d (goodput=%s queue=%d streak=%d "
+            "tick_s=%.3f)", prev.level, state.level,
+            {c: round(g, 3) for c, g in goodput.items()}, queue_depth,
+            streak, tick_s)
+        clamp = int(getattr(self.config, "brownout_standard_max_new",
+                            0))
+        for e in self.engines:
+            e.set_brownout(state.level, standard_max_new=clamp)
+
+    def brownout_level(self) -> int:
+        """The fleet's current brownout ladder level (0 = normal) —
+        the HTTP front door's per-class admission gate and /healthz
+        read it here."""
+        return self._brownout_state.level
 
     def _dump_bundle(self, reason: str, detail: dict) -> str:
         """AnomalyMonitor's dump callback: one self-contained bundle
@@ -2337,6 +2480,7 @@ class ClusterServing:
                 eng is not None and
                 getattr(eng, "draft_model", None) is not None),
             "qos": bool(self.config.qos_enabled),
+            "brownout": bool(getattr(self.config, "brownout", False)),
         }
 
     # ---- observability (SURVEY §5: queue depth = backlog metric) ------

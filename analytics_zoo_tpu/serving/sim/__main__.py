@@ -26,7 +26,7 @@ import json
 import sys
 from typing import Any, Dict, List, Optional
 
-from ..policy import QosPolicy
+from ..policy import BrownoutPolicy, QosPolicy
 from .fleet import FleetModel
 from .model import (DEFAULT_SLO_TARGETS, AcceptanceModel, EngineConfig,
                     EngineModel, TimingModel, summarize)
@@ -67,19 +67,38 @@ def _build_trace(spec: Dict[str, Any], seed: int):
               prefixes=spec.get("prefixes"),
               prefix_frac=float(spec.get("prefix_frac", 0.0)))
     if kind == "poisson":
-        return poisson_trace(n_requests=int(spec["n_requests"]),
-                             rate_rps=float(spec["rate_rps"]),
-                             seed=seed, **kw)
+        return _stamp_deadlines(
+            poisson_trace(n_requests=int(spec["n_requests"]),
+                          rate_rps=float(spec["rate_rps"]),
+                          seed=seed, **kw), spec)
     if kind == "diurnal":
-        return diurnal_trace(n_requests=int(spec["n_requests"]),
-                             base_rps=float(spec["base_rps"]),
-                             peak_rps=float(spec["peak_rps"]),
-                             period_s=float(spec["period_s"]),
-                             seed=seed, **kw)
+        return _stamp_deadlines(
+            diurnal_trace(n_requests=int(spec["n_requests"]),
+                          base_rps=float(spec["base_rps"]),
+                          peak_rps=float(spec["peak_rps"]),
+                          period_s=float(spec["period_s"]),
+                          seed=seed, **kw), spec)
     if kind == "explicit":
-        return requests_from_dicts(spec["requests"])
+        return _stamp_deadlines(requests_from_dicts(spec["requests"]),
+                                spec)
     raise SystemExit(f"unknown trace kind {kind!r} "
                      f"(poisson | diurnal | explicit)")
+
+
+def _stamp_deadlines(trace, spec: Dict[str, Any]):
+    """Apply a per-class ``deadlines`` mapping (class -> seconds after
+    arrival) AFTER generation: no RNG draws, so traces without the
+    section stay byte-identical to previous releases."""
+    dls = spec.get("deadlines")
+    if not dls:
+        return trace
+    from dataclasses import replace
+    out = []
+    for r in trace:
+        d = dls.get(r.priority)
+        out.append(replace(r, deadline_s=float(d))
+                   if d is not None else r)
+    return out
 
 
 def run_scenario(doc: Dict[str, Any],
@@ -108,6 +127,21 @@ def run_scenario(doc: Dict[str, Any],
                             or {"base_s": 0.002,
                                 "per_token_s": 0.00005}))
     targets = doc.get("slo") or DEFAULT_SLO_TARGETS
+    brownout = None
+    b_doc = doc.get("brownout") or {}
+    if b_doc.get("enabled"):
+        # the SAME BrownoutPolicy knobs ServingConfig exposes (see
+        # docs/serving_qos.md "Overload & brownout")
+        brownout = BrownoutPolicy(
+            goodput_floor=float(b_doc.get("goodput_floor", 0.9)),
+            queue_high=int(b_doc.get("queue_high", 64)),
+            queue_recover_frac=float(
+                b_doc.get("queue_recover_frac", 0.5)),
+            alloc_streak_high=int(b_doc.get("alloc_streak_high", 4)),
+            tick_s_high=float(b_doc.get("tick_s_high", 0.0)),
+            enter_ticks=int(b_doc.get("enter_ticks", 3)),
+            exit_ticks=int(b_doc.get("exit_ticks", 6)),
+            standard_max_new=int(b_doc.get("standard_max_new", 16)))
     fleet_doc = doc.get("fleet")
     if fleet_doc:
         # disaggregated fleet scenario (docs/simulation.md): N modelled
@@ -129,7 +163,8 @@ def run_scenario(doc: Dict[str, Any],
             handoff_timeout_s=float(
                 fleet_doc.get("handoff_timeout_s", 0.0)),
             request_deadline_s=float(
-                fleet_doc.get("request_deadline_s", 0.0)))
+                fleet_doc.get("request_deadline_s", 0.0)),
+            brownout=brownout, slo_targets=targets)
         fleet.run(_build_trace(doc["trace"], seed))
         out = fleet.summary(targets)
         out["seed"] = seed
@@ -139,13 +174,22 @@ def run_scenario(doc: Dict[str, Any],
                 for line in e.event_log_lines()]
         return out
     model = EngineModel(econf, qos=qos, acceptance=acc, timing=timing,
-                        seed=seed, record_events=record_events)
+                        seed=seed, record_events=record_events,
+                        brownout=brownout, slo_targets=targets)
     model.run(_build_trace(doc["trace"], seed))
     out = summarize(model.records, targets)
     out["seed"] = seed
     out["ticks"] = model.ticks
     out["preemptions"] = model.preemptions
     out["prefill_stall_ticks"] = model.prefill_stall_ticks
+    if model.brownout is not None:
+        # only-when-on keys, like the tiered-KV block below
+        out["brownout_sheds"] = model.brownout_sheds
+        out["brownout_max_level"] = model.brownout_max_level
+        out["brownout_final_level"] = model.brownout_level
+        out["brownout_transitions"] = model.brownout_transitions
+    if model.brownout is not None or model.deadline_seen:
+        out["deadline_sheds"] = model.deadline_sheds
     if model._prefix_on:
         # tiered-KV counters, present only when the tier is on (see
         # FleetModel.summary — same key-stability contract)

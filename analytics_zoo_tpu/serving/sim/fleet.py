@@ -36,9 +36,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import policy as scheduler_policy
 from ..fault import FaultInjector
-from ..policy import (REPLICA_ROLES, QosPolicy, ReplicaSignals,
-                      pick_retry_target, plan_handoff_recovery,
-                      plan_redispatch)
+from ..policy import (PRIORITIES, REPLICA_ROLES, QosPolicy,
+                      ReplicaSignals, pick_retry_target,
+                      plan_handoff_recovery, plan_redispatch)
 from .model import (AcceptanceModel, EngineConfig, EngineModel,
                     TimingModel, summarize)
 from .trace import Request
@@ -69,7 +69,11 @@ class FleetModel:
                  faults: Optional[Sequence[Any]] = None,
                  retry_budget: int = 2,
                  handoff_timeout_s: float = 0.0,
-                 request_deadline_s: float = 0.0):
+                 request_deadline_s: float = 0.0,
+                 brownout: Optional[
+                     "scheduler_policy.BrownoutPolicy"] = None,
+                 slo_targets: Optional[
+                     Dict[str, Dict[str, float]]] = None):
         if not configs:
             raise ValueError("FleetModel needs at least one replica")
         if roles is not None:
@@ -84,8 +88,21 @@ class FleetModel:
                                  f"(choose from {REPLICA_ROLES})")
         self.engines = [
             EngineModel(c, qos=qos, acceptance=acceptance, timing=timing,
-                        seed=seed + i, record_events=record_events)
+                        seed=seed + i, record_events=record_events,
+                        brownout=brownout, slo_targets=slo_targets)
             for i, c in enumerate(configs)]
+        # overload brownout: ONE broker-level controller over the whole
+        # fleet (the live ClusterServing._brownout_eval twin) — engines
+        # keep their per-replica goodput windows / alloc streaks / tick
+        # trends but never self-evaluate; the fleet aggregates the
+        # worst-case signals and pushes one shared level, so replicas
+        # degrade and recover together
+        self.brownout = brownout
+        self._bstate = scheduler_policy.BrownoutState()
+        self.brownout_transitions = 0
+        self.brownout_max_level = 0
+        for e in self.engines:
+            e.brownout_managed = True
         self.roles = list(roles) if roles is not None else None
         self.handoff_s = float(handoff_s)
         self.handoffs = 0
@@ -313,6 +330,41 @@ class FleetModel:
             dst = self._route(orig.priority, "prefill", request=orig)
             self._deliver(dst, t, orig, rec)
 
+    # -- overload brownout (broker controller twin) ---------------------
+
+    def _brownout_sweep(self) -> None:
+        """One shared-controller decision over aggregated worst-case
+        fleet signals: min per-class windowed goodput, max backlog
+        (engine queue + undelivered inbox), max alloc-fail streak, max
+        per-replica tick trend — the same aggregation the live broker's
+        ``_brownout_eval`` performs over its replicas."""
+        live = [i for i in range(len(self.engines)) if not self.dead[i]]
+        if not live:
+            return
+        goodput = {
+            cls: min(self.engines[i].windowed_goodput()[cls]
+                     for i in live)
+            for cls in PRIORITIES}
+        queue_depth = max(
+            len(self.engines[i]._waiting) + len(self._inbox[i])
+            for i in live)
+        streak = max(self.engines[i]._alloc_streak for i in live)
+        tick_means = [
+            sum(self.engines[i]._tick_durs)
+            / len(self.engines[i]._tick_durs)
+            for i in live if self.engines[i]._tick_durs]
+        prev = self._bstate
+        self._bstate = scheduler_policy.plan_brownout(
+            self.brownout, prev, goodput=goodput,
+            queue_depth=queue_depth, alloc_fail_streak=streak,
+            tick_s=max(tick_means) if tick_means else None)
+        if self._bstate.level != prev.level:
+            self.brownout_transitions += 1
+            self.brownout_max_level = max(self.brownout_max_level,
+                                          self._bstate.level)
+            for i in live:
+                self.engines[i].set_brownout(self._bstate.level)
+
     # -- driving --------------------------------------------------------
 
     def _drain_inbox(self, i: int) -> None:
@@ -402,6 +454,8 @@ class FleetModel:
                 break
             i = min(work, key=lambda j: (self.engines[j].now, j))
             self.engines[i].step()
+            if self.brownout is not None:
+                self._brownout_sweep()
             if sum(e.ticks for e in self.engines) >= guard:
                 raise RuntimeError(
                     f"fleet simulation exceeded {guard} ticks "
@@ -448,6 +502,19 @@ class FleetModel:
             # a terminal state (finished or an explicit drop reason)
             out["stranded"] = sum(1 for r in recs
                                   if not r.finished and not r.dropped)
+        if self.brownout is not None:
+            # brownout counters, present only when the ladder is
+            # configured — brownout-off summaries stay key-identical
+            # to previous releases (golden envelopes pin on them)
+            out["brownout_sheds"] = sum(e.brownout_sheds
+                                        for e in self.engines)
+            out["brownout_max_level"] = self.brownout_max_level
+            out["brownout_final_level"] = self._bstate.level
+            out["brownout_transitions"] = self.brownout_transitions
+        if (self.brownout is not None
+                or any(e.deadline_seen for e in self.engines)):
+            out["deadline_sheds"] = sum(e.deadline_sheds
+                                        for e in self.engines)
         if any(e._prefix_on for e in self.engines):
             # tiered-KV sums, present only when a replica runs the
             # tier — tier-off summaries stay key-identical to previous

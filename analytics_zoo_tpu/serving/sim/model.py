@@ -327,7 +327,8 @@ class _SimReq:
     ``id()`` like the engine's ``_Req``."""
 
     __slots__ = ("uri", "prompt_len", "gen_len", "priority", "tenant",
-                 "enq_t", "handoff", "prefix_id", "prefix_len")
+                 "enq_t", "handoff", "prefix_id", "prefix_len",
+                 "deadline_t")
 
     def __init__(self, r: Request, max_new_tokens: int):
         self.uri = r.uri
@@ -339,6 +340,13 @@ class _SimReq:
             else "standard"
         self.tenant = r.tenant
         self.enq_t = float(r.arrival_t)
+        # absolute virtual-time deadline (the live wire carries an
+        # absolute wall-clock ms; decode_deadline turns it into the
+        # consumer's clock — here that clock is the model's ``now``).
+        # 0 = none; WeightedWaitQueue EDF-ranks on this attribute.
+        self.deadline_t = (self.enq_t + float(r.deadline_s)
+                           if float(getattr(r, "deadline_s", 0.0)) > 0
+                           else 0.0)
         # tokens already emitted on a prefill replica; None for a plain
         # request.  Set by FleetModel's handoff path — an adopted
         # request admits straight into DECODE (``_admit_adopted``), and
@@ -403,7 +411,11 @@ class EngineModel:
                  qos: Optional[QosPolicy] = None,
                  acceptance: Optional[AcceptanceModel] = None,
                  timing: Optional[TimingModel] = None,
-                 seed: int = 0, record_events: bool = True):
+                 seed: int = 0, record_events: bool = True,
+                 brownout: Optional["scheduler_policy.BrownoutPolicy"]
+                 = None,
+                 slo_targets: Optional[Dict[str, Dict[str, float]]]
+                 = None):
         self.config = config
         self.qos = qos
         self.timing = timing or TimingModel()
@@ -439,6 +451,28 @@ class EngineModel:
         self.kv_readmits = 0
         self.kv_readmit_tokens_saved = 0
         self.recompute_tokens_saved = 0
+
+        # overload brownout (policy.plan_brownout — the SAME pure
+        # controller the live broker runs).  ``brownout=None`` (the
+        # default) leaves every code path bit-identical to the
+        # pre-brownout model the golden envelopes pin.  A standalone
+        # model evaluates the controller itself each tick; FleetModel
+        # flips ``brownout_managed`` and pushes fleet-wide levels via
+        # ``set_brownout`` instead (the sim's broker-side controller).
+        self.brownout = brownout
+        self.brownout_managed = False
+        self.slo_targets = slo_targets or DEFAULT_SLO_TARGETS
+        self._bstate = scheduler_policy.BrownoutState()
+        self._goodput_win: Dict[str, deque] = {
+            c: deque(maxlen=32) for c in PRIORITIES}
+        self._tick_durs: deque = deque(maxlen=8)
+        self._alloc_streak = 0
+        self._spec_on = True
+        self.brownout_sheds = 0
+        self.brownout_max_level = 0
+        self.brownout_transitions = 0
+        self.deadline_sheds = 0
+        self.deadline_seen = False
 
         self.records: Dict[str, _Record] = {}
         self.events: List[Dict[str, Any]] = []
@@ -493,6 +527,19 @@ class EngineModel:
         self.records[req.uri] = _Record(
             uri=req.uri, priority=req.priority, tenant=req.tenant,
             arrival=req.enq_t)
+        if req.deadline_t > 0:
+            self.deadline_seen = True
+        if (self.brownout is not None and self._bstate.level > 0
+                and not scheduler_policy.brownout_admit(
+                    self._bstate.level, req.priority)):
+            # the live front door's brownout 429: a shed class never
+            # enters the queue (drops, not deferrals — the client is
+            # told to retry later, so the request leaves the system)
+            self.brownout_sheds += 1
+            self.records[req.uri].dropped = "brownout_shed"
+            self._emit("brownout_shed", uri=req.uri,
+                       level=self._bstate.level)
+            return
         self._waiting.append(req)
 
     def submit_prefilled(self, req: "_SimReq", record: _Record) -> None:
@@ -563,6 +610,12 @@ class EngineModel:
             self._free.append(i)
             self._release_blocks(row)
             self._emit("finish", uri=row.req.uri, tokens=row.gen_len)
+            if self.brownout is not None:
+                # per-class windowed goodput: what SloWatchdog's
+                # finish-outcome window feeds the live controller — a
+                # bounded window, so a bad burst can UNLATCH once the
+                # recent finishes come good again
+                self._goodput_win[rec.priority].append(self._slo_ok(rec))
 
     def _release_blocks(self, row: _Row) -> None:
         if self._pool is not None and row.blocks:
@@ -618,7 +671,8 @@ class EngineModel:
             if row is None:
                 continue
             if self.config.spec_k > 0:
-                last_write = row.pos + self.config.spec_k
+                last_write = row.pos + (self.config.spec_k
+                                        if self._spec_on else 0)
             else:
                 ticks = max(1, min(self.config.ticks_per_step,
                                    row.gen_len - row.emitted))
@@ -632,7 +686,8 @@ class EngineModel:
             if self._slots[i] is None:
                 continue
             last_write = self._slots[i].pos + (
-                self.config.spec_k if self.config.spec_k > 0 else 0)
+                self.config.spec_k
+                if self.config.spec_k > 0 and self._spec_on else 0)
             self._grow_row(i, last_write // bs + 1)
         for i, clen in chunks:
             row = self._slots[i]
@@ -719,6 +774,66 @@ class EngineModel:
         return max(self._dev_prefix.get(prefix_id, 0),
                    self._host_prefix.get(prefix_id, 0))
 
+    # -- overload brownout (engine/broker controller twin) --------------
+
+    @property
+    def brownout_level(self) -> int:
+        return self._bstate.level
+
+    def set_brownout(self, level: int) -> None:
+        """External (fleet) controller pushing a ladder level — the
+        sim's ``ContinuousEngine.set_brownout``."""
+        lvl = max(0, min(int(level), scheduler_policy.BROWNOUT_MAX_LEVEL))
+        if lvl != self._bstate.level:
+            self.brownout_transitions += 1
+            self.brownout_max_level = max(self.brownout_max_level, lvl)
+            self._emit("brownout_level", level=lvl,
+                       prev=self._bstate.level)
+            self._bstate = scheduler_policy.BrownoutState(level=lvl)
+
+    def _slo_ok(self, rec: _Record) -> bool:
+        """Judge one finished request exactly like ``summarize`` (and
+        the live SloWatchdog): good iff no observation of any dimension
+        breached its class target."""
+        tgt = self.slo_targets.get(rec.priority, {})
+        for metric, obs in (("queue_wait", rec.queue_waits),
+                            ("ttft", rec.ttfts)):
+            lim = float(tgt.get(metric, 0.0))
+            if lim > 0 and any(v > lim for v in obs):
+                return False
+        lim = float(tgt.get("tpot", 0.0))
+        if lim > 0 and rec.tpot is not None and rec.tpot > lim:
+            return False
+        return True
+
+    def windowed_goodput(self) -> Dict[str, float]:
+        """Per-class goodput over the recent-finish window (1.0 cold,
+        like the live ``SloWatchdog.windowed_goodput``)."""
+        out: Dict[str, float] = {}
+        for cls in PRIORITIES:
+            win = self._goodput_win[cls]
+            out[cls] = (sum(1 for ok in win if ok) / len(win)
+                        if win else 1.0)
+        return out
+
+    def _brownout_step(self) -> None:
+        """One standalone-controller decision on this tick's signals —
+        the engine-level twin of the live broker's ``_brownout_eval``."""
+        prev = self._bstate
+        self._bstate = scheduler_policy.plan_brownout(
+            self.brownout, prev,
+            goodput=self.windowed_goodput(),
+            queue_depth=len(self._waiting),
+            alloc_fail_streak=self._alloc_streak,
+            tick_s=(sum(self._tick_durs) / len(self._tick_durs)
+                    if self._tick_durs else None))
+        if self._bstate.level != prev.level:
+            self.brownout_transitions += 1
+            self.brownout_max_level = max(self.brownout_max_level,
+                                          self._bstate.level)
+            self._emit("brownout_level", level=self._bstate.level,
+                       prev=prev.level)
+
     # -- admission (engine `_admit` family) -----------------------------
 
     def _pop_waiting(self) -> Optional["_SimReq"]:
@@ -728,6 +843,46 @@ class EngineModel:
         self._waiting.appendleft(req)
 
     def _admit(self) -> int:
+        if self.deadline_seen:
+            # the engine's _shed_expired_waiting: sweep the WHOLE
+            # queue — including brownout-deferred classes, which is
+            # what lets a shed class's backlog drain (and the ladder
+            # recover) while the class is not being admitted
+            expired = [r for r in self._waiting
+                       if r.deadline_t > 0 and self.now > r.deadline_t]
+            for r in expired:
+                self._waiting.remove(r)
+                self.deadline_sheds += 1
+                self._drop(r, "deadline_exceeded")
+        deferred: List[_SimReq] = []
+        if self.brownout is not None and self._bstate.level >= 1:
+            # the engine's _brownout_defer_extract: already-queued
+            # requests of a shed class are HELD (still aging), not
+            # dropped — only the front door drops new arrivals
+            lvl = self._bstate.level
+            deferred = [r for r in self._waiting
+                        if not scheduler_policy.brownout_admit(
+                            lvl, r.priority)]
+            for r in deferred:
+                self._waiting.remove(r)
+        try:
+            admitted = self._admit_pass()
+            if deferred and admitted == 0 and self._free \
+                    and not len(self._waiting):
+                # work-conserving brownout (engine `_admit` second
+                # pass): zero admissible demand + free slots means the
+                # held backlog serves opportunistically instead of
+                # idling the engine and latching the depth signal
+                for r in reversed(deferred):
+                    self._waiting.appendleft(r)
+                deferred = []
+                admitted = self._admit_pass()
+            return admitted
+        finally:
+            for r in reversed(deferred):
+                self._waiting.appendleft(r)
+
+    def _admit_pass(self) -> int:
         if self.config.chunked:
             return self._admit_chunked()
         return self._admit_monolithic()
@@ -755,6 +910,14 @@ class EngineModel:
         slot = self._free.popleft()
         row = _Row(req, "PREFILLING", self._admit_seq)
         self._admit_seq += 1
+        if self.brownout is not None:
+            # level-2 clamp, applied at install time like the engine's
+            # _install_prefill — the level in force WHEN the row lands
+            # decides its budget, so a descending ladder restores full
+            # completions for later admissions
+            row.gen_len = scheduler_policy.brownout_max_new(
+                self._bstate.level, req.priority, row.gen_len,
+                self.brownout.standard_max_new)
         if shared:
             # matched prefix blocks are already filled: prefill starts
             # past them (this is where recompute savings become real
@@ -874,6 +1037,10 @@ class EngineModel:
             slot = self._free.popleft()
             row = _Row(req, "DECODE", self._admit_seq)
             self._admit_seq += 1
+            if self.brownout is not None:
+                row.gen_len = scheduler_policy.brownout_max_new(
+                    self._bstate.level, req.priority, row.gen_len,
+                    self.brownout.standard_max_new)
             row.fill_pos = req.prompt_len
             self._slots[slot] = row
             if self.config.paged:
@@ -906,13 +1073,36 @@ class EngineModel:
         self._ev_admitted, self._ev_preempted = [], []
         self._ev_chunks, self._ev_dropped = [], []
         t0 = self.now
+        f0 = 0
+        if self._pool is not None:
+            f0 = self._pool.alloc_failures + (
+                self._dpool.alloc_failures
+                if self._dpool is not None else 0)
         self._admit()
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
-            # every waiting request errored out during admission
+            # every waiting request errored out during admission — or,
+            # under brownout, everything left waiting is a deferred
+            # shed-class request: idle-tick the clock forward so the
+            # controller can observe the drained engine and descend
+            # (the model must not spin without advancing time)
             self._tick_event("admit", t0, 0.0, 0)
+            if self.brownout is not None and len(self._waiting) > 0:
+                dur = self.timing.tick_s(0)
+                self.now = t0 + dur
+                self.ticks += 1
+                self._tick_durs.append(dur)
+                self._alloc_streak = 0
+                if not self.brownout_managed:
+                    self._brownout_step()
             return 0
         spec = self.config.spec_k > 0
+        if spec and self.brownout is not None:
+            # level-3: park the draft model (the engine's
+            # brownout_spec_enabled gate in _step_impl)
+            spec = scheduler_policy.brownout_spec_enabled(
+                self._bstate.level)
+        self._spec_on = spec or self.config.spec_k == 0
         prefilling = any(self._slots[i].state == "PREFILLING"
                          for i in active)
         if spec and self.config.chunked and prefilling:
@@ -931,6 +1121,16 @@ class EngineModel:
         self._admit()       # freed slots recycle on the SAME iteration
         self.ticks += 1
         self._tick_event(kind, t0, dur, work)
+        if self.brownout is not None:
+            self._tick_durs.append(dur)
+            if self._pool is not None:
+                f1 = self._pool.alloc_failures + (
+                    self._dpool.alloc_failures
+                    if self._dpool is not None else 0)
+                self._alloc_streak = (self._alloc_streak + 1
+                                      if f1 > f0 else 0)
+            if not self.brownout_managed:
+                self._brownout_step()
         return self.n_active
 
     def _tick_event(self, kind: str, t0: float, dur: float,
@@ -977,10 +1177,16 @@ class EngineModel:
             active = self._ensure_blocks(active)
             if not active:
                 return "decode", 0
-        n_eff = max(1, min(
-            self.config.ticks_per_step,
-            max(self._slots[i].gen_len - self._slots[i].emitted
-                for i in active)))
+        if self.config.spec_k > 0 and not self._spec_on:
+            # brownout level 3 parked the draft: plain decode, one
+            # token per tick (the engine forces n_eff=1 whenever a
+            # draft tenant exists, to hold the lockstep write frontier)
+            n_eff = 1
+        else:
+            n_eff = max(1, min(
+                self.config.ticks_per_step,
+                max(self._slots[i].gen_len - self._slots[i].emitted
+                    for i in active)))
         work = 0
         for i in active:
             row = self._slots[i]
@@ -1033,7 +1239,7 @@ class EngineModel:
         self.budget_ticks += 1
         work = per_row * len(decode_rows) + sum(c for _, c in chunks)
         self.budget_tokens_used += work
-        k = self.config.spec_k
+        k = self.config.spec_k if self._spec_on else 0
         for i in decode_rows:
             row = self._slots[i]
             if k > 0:

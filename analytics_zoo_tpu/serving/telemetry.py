@@ -716,6 +716,43 @@ class Telemetry:
             "zoo_serving_backpressure_rejections_total",
             "admissions refused with 429 under a full backlog").inc()
 
+    def deadline_shed(self, uri: str) -> None:
+        """A request's deadline passed while it waited in the queue, so
+        admission shed it BEFORE prefill (terminal ``deadline_exceeded``
+        error).  Distinct from the supervisor's in-flight give-up
+        (``zoo_router_requests_given_up_total``): this request never
+        cost a single engine tick."""
+        with self._lock:
+            self._clocks.pop(uri, None)
+        if self.watchdog is not None:
+            self.watchdog.drop(uri)
+        self.metrics.counter(
+            "zoo_engine_deadline_admission_sheds_total",
+            "requests shed at admission because their deadline had "
+            "already passed (never reached prefill)").inc()
+        self.events.instant("deadline_shed", None, EventLog.TID_QUEUE,
+                            {"uri": uri})
+
+    def brownout_shed(self, priority: str) -> None:
+        """The front door refused an admission because the brownout
+        ladder browned its class out (429 + level-scaled Retry-After)."""
+        self.metrics.counter(
+            f"zoo_brownout_shed_total_{priority}",
+            f"admissions refused with 429 because the brownout ladder "
+            f"browned the {priority} class out").inc()
+
+    def brownout_transition(self, level: int, prev: int) -> None:
+        """The brownout controller moved the ladder — a trace instant
+        (one per transition, not per tick) plus the transition
+        counter; the current level rides the flight ring / metrics
+        gauge, not this hook."""
+        self.metrics.counter(
+            "zoo_brownout_transitions_total",
+            "brownout ladder level changes (either direction)").inc()
+        self.events.instant(
+            "brownout_level", None, EventLog.TID_QUEUE,
+            {"level": int(level), "prev": int(prev)})
+
     # -- engine loop -------------------------------------------------
 
     def tick(self, start: float, dur: float,

@@ -2396,6 +2396,127 @@ def _smoke_chaos():
     print("CHAOS_OK")
 
 
+def _smoke_overload():
+    """overload-smoke leg (docs/serving_qos.md "Overload & brownout"):
+    a live 2-replica fleet under a saturating mixed-class burst with a
+    deliberately tiny brownout ladder (queue_high=4, 50ms controller
+    interval) plus a handful of batch requests whose deadline already
+    passed at enqueue.  Asserts on the real /metrics scrape that the
+    ladder ascended AND fully unwound (transitions >= 2, final level
+    0 — no stuck-degraded end-state), that the expired requests were
+    shed at admission (deadline_shed counter, terminal
+    ``deadline_exceeded`` errors on the wire), and that every
+    interactive request finished normally through the spike."""
+    import urllib.request
+
+    import jax
+
+    from analytics_zoo_tpu.learn.inference_model import InferenceModel
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.serving import (
+        ClusterServing, HttpFrontend, InputQueue, OutputQueue,
+        ServingConfig)
+    from analytics_zoo_tpu.serving.frontdoor import (encode_deadline,
+                                                     encode_priority)
+
+    model = TransformerLM(vocab_size=8192, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=512,
+                          max_position=64)
+    variables = model.init(jax.random.key(0), np.zeros((1, 16), np.int32))
+    im = InferenceModel(batch_buckets=(1, 2))
+    im.load_flax_generator(model, variables, max_new_tokens=12,
+                           prompt_buckets=(16,))
+    cfg = ServingConfig(
+        prompt_col="tokens", continuous_batching=True,
+        engine_slots=2, n_replicas=2,
+        brownout=True, brownout_queue_high=4,
+        brownout_enter_ticks=2, brownout_exit_ticks=2,
+        brownout_interval_s=0.05, brownout_standard_max_new=6,
+        # generous SLO targets: a cold jit compile's TTFT must not
+        # pin windowed goodput at 0 and hold the ladder up — this
+        # smoke exercises the queue-depth axis deterministically
+        slo_ttft_s_interactive=600.0, slo_ttft_s_standard=600.0,
+        slo_ttft_s_batch=600.0, slo_tpot_s_interactive=600.0,
+        slo_tpot_s_standard=600.0, slo_tpot_s_batch=600.0,
+        slo_queue_wait_s_interactive=600.0,
+        slo_queue_wait_s_standard=600.0, slo_queue_wait_s_batch=600.0)
+    serving = ClusterServing(im, cfg, embedded_broker=True).start()
+    fe = HttpFrontend(redis_port=serving.port, timeout=600,
+                      serving=serving).start()
+    inq = InputQueue(port=serving.port)
+    outq = OutputQueue(port=serving.port)
+
+    def scrape():
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{fe.port}/metrics", timeout=30
+        ).read().decode()
+        out = {}
+        for line in body.splitlines():
+            if line.startswith(("zoo_brownout_",
+                                "zoo_engine_deadline_")):
+                name, val = line.split()
+                out[name] = float(val)
+        return out
+
+    try:
+        rng = np.random.default_rng(37)
+        burst = ([("interactive", f"i{k}") for k in range(6)]
+                 + [("standard", f"s{k}") for k in range(6)]
+                 + [("batch", f"b{k}") for k in range(6)])
+        for cls, u in burst:
+            inq.enqueue(u, tokens=rng.integers(
+                1, 8192, int(rng.integers(6, 14))).astype(np.int32),
+                priority=encode_priority(cls))
+        # already expired at enqueue: must shed at ADMISSION — before
+        # prefill, before a slot — as terminal deadline_exceeded
+        dead = [f"d{k}" for k in range(3)]
+        for u in dead:
+            inq.enqueue(u, tokens=rng.integers(
+                1, 8192, 8).astype(np.int32),
+                priority=encode_priority("batch"),
+                deadline=encode_deadline(1))
+        # every non-expired request must finish normally — including
+        # the batch class the ladder held during the spike
+        for cls, u in burst:
+            r = outq.query(u, timeout=600)
+            assert r is not None, f"{u} ({cls}) lost"
+        shed_errors = 0
+        for u in dead:
+            try:
+                outq.query(u, timeout=600)
+            except RuntimeError as e:
+                assert "deadline_exceeded" in str(e), (u, e)
+                shed_errors += 1
+        assert shed_errors == len(dead), \
+            f"only {shed_errors}/{len(dead)} expired requests shed"
+        # the ladder must have ascended AND fully unwound — poll the
+        # scrape until the controller walks back to level 0
+        deadline = time.time() + 120
+        while True:
+            m = scrape()
+            if m.get("zoo_brownout_level", -1) == 0 and \
+                    m.get("zoo_brownout_transitions_total", 0) >= 2:
+                break
+            assert time.time() < deadline, \
+                f"ladder never unwound to level 0: {m}"
+            time.sleep(0.1)
+        assert m.get("zoo_brownout_deadline_shed_total", 0) >= \
+            len(dead), m
+        print(json.dumps({
+            "leg": "overload", "served": len(burst),
+            "deadline_shed": len(dead),
+            "transitions": m["zoo_brownout_transitions_total"],
+            "final_level": m["zoo_brownout_level"],
+            "sheds": {k: v for k, v in sorted(m.items())
+                      if k.startswith("zoo_brownout_shed_total")}}))
+    finally:
+        fe.stop()
+        serving.stop()
+        inq.close()
+        outq.close()
+    print("OVERLOAD_OK")
+
+
 def _smoke_tiered():
     """serve-smoke tiered-KV leg (docs/serving_memory.md "Tiered KV"):
     a paged engine with a deliberately tiny block pool plus a host-DRAM
@@ -2607,9 +2728,13 @@ def _smoke():
     KV-handoff fleet via ``_smoke_disagg``, the host-DRAM spill-store
     eviction/re-admission loop via ``_smoke_tiered``, the fused
     Pallas kernel reading a tp=2-sharded int8 pool via
-    ``_smoke_fused_tp``, and the crash-tolerance chaos leg (pump
+    ``_smoke_fused_tp``, the crash-tolerance chaos leg (pump
     crash + dropped handoff under fault injection) via
-    ``_smoke_chaos`` (also standalone: ``make chaos-smoke``)."""
+    ``_smoke_chaos`` (also standalone: ``make chaos-smoke``), and the
+    brownout-ladder overload leg (saturating mixed-class burst with
+    expired deadlines sheds at admission, ladder ascends and fully
+    unwinds) via ``_smoke_overload`` (also standalone:
+    ``make overload-smoke``)."""
     r = run_poisson_scenario(True, rate_per_s=20.0, n_requests=20,
                              slots=4, prefix_mode="full", paged=True,
                              chunked=True)
@@ -2629,6 +2754,7 @@ def _smoke():
     _smoke_tiered()
     _smoke_fused_tp()
     _smoke_chaos()
+    _smoke_overload()
     print("SMOKE_OK")
 
 
@@ -2639,6 +2765,8 @@ if __name__ == "__main__":
         _probe_main()
     elif "--chaos-smoke" in sys.argv:
         _smoke_chaos()
+    elif "--overload-smoke" in sys.argv:
+        _smoke_overload()
     elif "--smoke" in sys.argv:
         _smoke()
     elif "--fused-tp" in sys.argv:

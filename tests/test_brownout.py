@@ -1,0 +1,314 @@
+"""Overload brownout (docs/serving_qos.md "Overload & brownout"):
+the pure ladder controller (serving/policy.py ``plan_brownout``) —
+hysteresis gates, one-level-per-decision, axis semantics — plus the
+admission helpers, EDF-within-class queueing, and the live engine's
+side of the contract: expired-at-admission requests shed BEFORE
+prefill, held shed-class work admits work-conservingly on idle slots,
+and the level-2 token clamp lands at slot install."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.lm import TransformerLM, generate
+from analytics_zoo_tpu.serving.continuous import (ContinuousEngine,
+                                                  DeadlineExceeded)
+from analytics_zoo_tpu.serving.policy import (
+    BROWNOUT_MAX_LEVEL, BrownoutPolicy, BrownoutState, QosPolicy,
+    WeightedWaitQueue, brownout_admit, brownout_classes,
+    brownout_max_new, brownout_spec_enabled, plan_brownout)
+
+
+# ---------------------------------------------------------------------------
+# pure controller
+# ---------------------------------------------------------------------------
+
+def _pol(**kw):
+    base = dict(queue_high=10, enter_ticks=2, exit_ticks=3)
+    base.update(kw)
+    return BrownoutPolicy(**base)
+
+
+def _run(policy, state, ticks, **sig):
+    for _ in range(ticks):
+        state = plan_brownout(policy, state, **sig)
+    return state
+
+
+class TestPolicyValidation:
+    @pytest.mark.parametrize("bad", [
+        dict(goodput_floor=0.0), dict(goodput_floor=1.5),
+        dict(queue_high=0), dict(queue_recover_frac=-0.1),
+        dict(queue_recover_frac=1.1), dict(enter_ticks=0),
+        dict(exit_ticks=0)])
+    def test_rejects_nonsense_knobs(self, bad):
+        with pytest.raises(ValueError):
+            BrownoutPolicy(**bad)
+
+
+class TestLadderHysteresis:
+    def test_enter_needs_consecutive_breaches(self):
+        p = _pol(enter_ticks=3)
+        st = BrownoutState()
+        st = _run(p, st, 2, queue_depth=100)
+        assert st.level == 0 and st.breach_streak == 2
+        # one calm tick inside the recovery band resets the count —
+        # two more breaches still aren't three CONSECUTIVE ones
+        st = plan_brownout(p, st, queue_depth=0)
+        st = _run(p, st, 2, queue_depth=100)
+        assert st.level == 0
+        st = plan_brownout(p, st, queue_depth=100)
+        assert st.level == 1
+
+    def test_one_level_per_decision_capped_at_max(self):
+        p = _pol(enter_ticks=2)
+        st = BrownoutState()
+        levels = []
+        for _ in range(20):
+            st = plan_brownout(p, st, queue_depth=100)
+            levels.append(st.level)
+        # ascends exactly one level every enter_ticks, then saturates
+        assert levels[:8] == [0, 1, 1, 2, 2, 3, 3, 4]
+        assert st.level == BROWNOUT_MAX_LEVEL == 4
+        assert max(levels) == BROWNOUT_MAX_LEVEL
+
+    def test_exit_needs_consecutive_recovered_ticks(self):
+        p = _pol(enter_ticks=1, exit_ticks=3)
+        st = _run(p, BrownoutState(), 2, queue_depth=100)
+        assert st.level == 2
+        st = _run(p, st, 2, queue_depth=0)
+        assert st.level == 2 and st.clear_streak == 2
+        st = plan_brownout(p, st, queue_depth=0)
+        assert st.level == 1 and st.clear_streak == 0
+
+    def test_recovery_band_is_stricter_than_not_breached(self):
+        # depth 7: below queue_high (10) so not a breach, above
+        # recover_frac * queue_high (5) so not recovered either —
+        # the hysteresis band holds the level and resets BOTH streaks
+        p = _pol(enter_ticks=1, exit_ticks=1)
+        st = _run(p, BrownoutState(), 1, queue_depth=100)
+        assert st.level == 1
+        st = _run(p, st, 50, queue_depth=7)
+        assert st.level == 1
+        assert st.breach_streak == 0 and st.clear_streak == 0
+
+    def test_mixed_tick_resets_breach_streak(self):
+        p = _pol(enter_ticks=3)
+        st = _run(p, BrownoutState(), 2, queue_depth=100)
+        assert st.breach_streak == 2
+        st = plan_brownout(p, st, queue_depth=7)      # in-band tick
+        assert st.breach_streak == 0 and st.clear_streak == 0
+
+    def test_shed_class_goodput_does_not_hold_the_ladder_up(self):
+        # at level 1 batch is already shed: its collapsed goodput must
+        # not block recovery (the shedding already handled it) — but
+        # the SAME signal at level 0 is a breach
+        p = _pol(enter_ticks=1, exit_ticks=1)
+        g = {"interactive": 1.0, "standard": 1.0, "batch": 0.0}
+        st = plan_brownout(p, BrownoutState(), goodput=g, queue_depth=100)
+        assert st.level == 1
+        st = plan_brownout(p, st, goodput=g, queue_depth=0)
+        assert st.level == 0
+        st = plan_brownout(p, st, goodput=g, queue_depth=0)
+        assert st.level == 1        # admitted again -> judged again
+
+    def test_alloc_streak_axis(self):
+        p = _pol(enter_ticks=1, alloc_streak_high=4)
+        st = plan_brownout(p, BrownoutState(), alloc_fail_streak=4)
+        assert st.level == 1
+        # recovery demands ZERO streak, not merely sub-threshold
+        st2 = plan_brownout(_pol(enter_ticks=1, exit_ticks=1), st,
+                            alloc_fail_streak=1)
+        assert st2.level == 1
+
+    def test_tick_duration_axis_gated_on_threshold(self):
+        st = plan_brownout(_pol(enter_ticks=1), BrownoutState(),
+                           tick_s=99.0)
+        assert st.level == 0        # tick_s_high=0 disables the axis
+        st = plan_brownout(_pol(enter_ticks=1, tick_s_high=0.5),
+                           BrownoutState(), tick_s=0.6)
+        assert st.level == 1
+
+
+class TestAdmissionHelpers:
+    def test_classes_shed_worst_first(self):
+        assert brownout_classes(0) == ("interactive", "standard",
+                                       "batch")
+        for lv in (1, 2, 3):
+            assert brownout_classes(lv) == ("interactive", "standard")
+        assert brownout_classes(4) == ("interactive",)
+        assert brownout_classes(99) == ("interactive",)
+
+    def test_admit_unknown_priority_ranks_as_standard(self):
+        assert brownout_admit(1, "weird") and brownout_admit(1, None)
+        assert not brownout_admit(4, "weird")
+        assert not brownout_admit(1, "batch")
+        assert brownout_admit(4, "interactive")
+
+    def test_max_new_clamp_standard_only_never_raised(self):
+        assert brownout_max_new(1, "standard", 64, 16) == 64
+        assert brownout_max_new(2, "standard", 64, 16) == 16
+        assert brownout_max_new(2, "standard", 8, 16) == 8
+        assert brownout_max_new(2, "interactive", 64, 16) == 64
+        assert brownout_max_new(2, "standard", 64, 0) == 64
+        assert brownout_max_new(4, None, 64, 16) == 16
+
+    def test_spec_parked_from_level_3(self):
+        assert all(brownout_spec_enabled(lv) for lv in (0, 1, 2))
+        assert not brownout_spec_enabled(3)
+        assert not brownout_spec_enabled(4)
+
+
+class _FakeReq:
+    def __init__(self, uri, deadline_t=0.0, priority="standard"):
+        self.uri = uri
+        self.deadline_t = deadline_t
+        self.priority = priority
+        self.tenant = ""
+        self.enq_t = time.monotonic()
+
+
+class TestEdfWithinClass:
+    def test_deadline_carriers_rank_edf_fifo_behind_none(self):
+        q = WeightedWaitQueue(QosPolicy())
+        now = time.monotonic()
+        q.append(_FakeReq("plain1"))
+        q.append(_FakeReq("late", deadline_t=now + 60))
+        q.append(_FakeReq("soon", deadline_t=now + 5))
+        q.append(_FakeReq("plain2"))
+        order = [q.popleft().uri for _ in range(4)]
+        # EDF among carriers, both ahead of the deadline-less tail,
+        # which keeps its FIFO order
+        assert order == ["soon", "late", "plain1", "plain2"]
+
+    def test_no_deadlines_is_plain_fifo(self):
+        q = WeightedWaitQueue(QosPolicy())
+        for i in range(4):
+            q.append(_FakeReq(f"r{i}"))
+        assert [q.popleft().uri for i in range(4)] == \
+            ["r0", "r1", "r2", "r3"]
+
+
+# ---------------------------------------------------------------------------
+# live engine: shed-before-prefill, work-conserving hold, level-2 clamp
+# ---------------------------------------------------------------------------
+
+def _tiny_lm():
+    return TransformerLM(vocab_size=32, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_position=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = _tiny_lm()
+    variables = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    return model, variables
+
+
+def _solo(model, variables, prompt, n):
+    return np.asarray(generate(model, variables,
+                               jnp.asarray(prompt[None]), n))[0]
+
+
+class TestEngineDeadlines:
+    def test_expired_at_admission_sheds_before_prefill(self, lm):
+        model, variables = lm
+        eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                               max_slots=2, prompt_buckets=(8,))
+        errors, results = {}, {}
+        p = np.asarray([5, 9, 11], np.int32)
+        eng.submit("dead", p, deadline_t=time.monotonic() - 1.0,
+                   on_done=lambda u, t: results.__setitem__(u, t),
+                   on_error=lambda u, e: errors.__setitem__(u, e))
+        eng.submit("live", p, deadline_t=time.monotonic() + 60.0,
+                   on_done=lambda u, t: results.__setitem__(u, t),
+                   on_error=lambda u, e: errors.__setitem__(u, e))
+        eng.drain()
+        # the expired request terminated without ever owning a slot
+        assert isinstance(errors["dead"], DeadlineExceeded)
+        assert str(errors["dead"]).startswith("deadline_exceeded")
+        assert "dead" not in results
+        assert eng.deadline_sheds == 1
+        # its neighbour with budget to spare is untouched
+        np.testing.assert_array_equal(
+            results["live"], _solo(model, variables, p, 4))
+
+    def test_expired_only_queue_never_prefills(self, lm):
+        model, variables = lm
+        eng = ContinuousEngine(model, variables, max_new_tokens=4,
+                               max_slots=2, prompt_buckets=(8,))
+        errors = {}
+        p = np.asarray([7, 3], np.int32)
+        for i in range(3):
+            eng.submit(f"d{i}", p, deadline_t=time.monotonic() - 0.5,
+                       on_error=lambda u, e: errors.__setitem__(u, e))
+        eng.step()
+        # one admission pass sheds the whole expired backlog: no slot
+        # was claimed, no prefill ran
+        assert eng.n_active == 0 and eng.n_waiting == 0
+        assert eng.deadline_sheds == 3
+        assert sorted(errors) == ["d0", "d1", "d2"]
+
+
+class TestEngineBrownout:
+    def test_held_batch_admits_work_conservingly_after_admitted_work(
+            self, lm):
+        model, variables = lm
+        eng = ContinuousEngine(model, variables, max_new_tokens=3,
+                               max_slots=1, prompt_buckets=(8,))
+        eng.set_brownout(1)
+        results, order = {}, []
+        pb = np.asarray([5, 9, 11], np.int32)
+        pi = np.asarray([7, 3], np.int32)
+        done = lambda u, t: (results.__setitem__(u, t), order.append(u))
+        # batch submitted FIRST: FIFO would admit it first, but level 1
+        # defers it behind the interactive arrival...
+        eng.submit("b", pb, priority="batch", on_done=done)
+        eng.submit("i", pi, priority="interactive", on_done=done)
+        eng.drain()
+        # ...and once admissible demand is gone and the slot idles, the
+        # work-conserving second pass serves the held request instead
+        # of stranding it (drain() completing at all proves that)
+        assert order == ["i", "b"]
+        np.testing.assert_array_equal(
+            results["i"], _solo(model, variables, pi, 3))
+        np.testing.assert_array_equal(
+            results["b"], _solo(model, variables, pb, 3))
+
+    def test_level_zero_admits_batch_unchanged(self, lm):
+        model, variables = lm
+        eng = ContinuousEngine(model, variables, max_new_tokens=3,
+                               max_slots=1, prompt_buckets=(8,))
+        eng.set_brownout(0)
+        results = {}
+        p = np.asarray([5, 9, 11], np.int32)
+        eng.submit("b", p, priority="batch",
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        eng.drain()
+        np.testing.assert_array_equal(
+            results["b"], _solo(model, variables, p, 3))
+
+    def test_level_2_clamps_standard_tokens_at_install(self, lm):
+        model, variables = lm
+        eng = ContinuousEngine(model, variables, max_new_tokens=6,
+                               max_slots=2, prompt_buckets=(8,))
+        eng.set_brownout(2, standard_max_new=2)
+        results = {}
+        p = np.asarray([5, 9, 11], np.int32)
+        eng.submit("s", p, priority="standard",
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        eng.submit("i", p, priority="interactive",
+                   on_done=lambda u, t: results.__setitem__(u, t))
+        eng.drain()
+        # standard truncates to the clamp (prefix of its solo run);
+        # interactive keeps its full budget at every level
+        assert len(results["s"]) == 2
+        np.testing.assert_array_equal(
+            results["s"], _solo(model, variables, p, 6)[:2])
+        assert len(results["i"]) == 6
+        np.testing.assert_array_equal(
+            results["i"], _solo(model, variables, p, 6))

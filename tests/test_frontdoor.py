@@ -38,8 +38,9 @@ from analytics_zoo_tpu.serving import (
     WeightedWaitQueue, retry_after_s)
 from analytics_zoo_tpu.serving.continuous import ContinuousEngine
 from analytics_zoo_tpu.serving.frontdoor import (
-    ThroughputEstimator, decode_priority, decode_str_field,
-    encode_priority, encode_str_field, sse_event)
+    MAX_DEADLINE_MS, ThroughputEstimator, decode_deadline,
+    decode_priority, decode_str_field, encode_deadline, encode_priority,
+    encode_str_field, sse_event, validate_deadline_ms)
 
 
 class _R:
@@ -213,6 +214,33 @@ class TestCodecs:
         for s in ("", "tenant-a", "uniçode"):
             assert decode_str_field(encode_str_field(s)) == s
 
+    def test_deadline_codec_round_trip(self):
+        # header path and body path share ONE validator, so a budget
+        # validated either way encodes/decodes identically
+        for raw in (1500, 1500.0, "1500"):
+            assert validate_deadline_ms(raw) == 1500
+        wire = encode_deadline(1500, now_wall=1000.0)
+        assert wire.dtype == np.int64
+        assert int(wire) == 1_001_500
+        # decode lands in the consumer's monotonic domain
+        t = decode_deadline(wire, now_wall=1000.2, now_mono=50.0)
+        assert t == pytest.approx(50.0 + 1.3)
+        assert decode_deadline(np.int64(0)) == 0.0
+
+    @pytest.mark.parametrize("bad", [
+        -5, 0, float("nan"), float("inf"), -float("inf"),
+        MAX_DEADLINE_MS + 1, True, False, "soon", None, [1500],
+    ])
+    def test_deadline_validation_rejects_with_pointed_message(self, bad):
+        with pytest.raises(ValueError, match="deadline_ms"):
+            validate_deadline_ms(bad)
+
+    def test_deadline_ceiling_message_names_the_unit_bug(self):
+        # an absolute epoch-ms timestamp where a budget belongs is the
+        # classic client bug — the message must say so
+        with pytest.raises(ValueError, match="24h ceiling"):
+            validate_deadline_ms(1.7e12)
+
     def test_sse_event_format(self):
         b = sse_event("token", {"index": 0, "token": 5})
         assert b.startswith(b"event: token\ndata: ")
@@ -244,6 +272,19 @@ class TestBackpressure:
         assert retry_after_s(40, 4.0) == 10
         assert retry_after_s(10 ** 9, 0.001) == 120     # hi clamp
         assert retry_after_s(5, 0.0) == 120             # rate=0 finite
+
+    def test_retry_after_monotone_with_brownout_level(self):
+        # satellite: the hint must grow (never shrink) as the ladder
+        # deepens, stay finite at every level, and keep the clamps
+        hints = [retry_after_s(40, 4.0, level=lv) for lv in range(5)]
+        assert hints == sorted(hints)
+        assert hints[0] == 10 and hints[1] == 20
+        assert all(1 <= h <= 120 for h in hints)
+        assert retry_after_s(10 ** 9, 4.0, level=4) == 120   # hi clamp
+        assert retry_after_s(0, 4.0, level=4) == 1           # lo clamp
+        # a negative level is treated as 0, not a discount
+        assert retry_after_s(40, 4.0, level=-3) == \
+            retry_after_s(40, 4.0, level=0)
 
     def test_throughput_estimator_ewma(self):
         est = ThroughputEstimator(fallback_rate=4.0)
@@ -279,6 +320,196 @@ class TestBackpressure:
                 resp.close()
             assert codes[-1] == 429, codes
             assert fe.c_rejected.value >= 1
+        finally:
+            fe.stop()
+            broker.stop()
+
+
+class _StubServing:
+    """The minimal fleet surface the front door's admission matrix
+    reads: live-pump count, brownout ladder level, and the healthz
+    mode flags.  Every other attribute access raises, which the
+    frontend's guards must absorb (a half-dead fleet must not take
+    the HTTP path down with it)."""
+
+    def __init__(self, live=1, level=0):
+        self._live = live
+        self._level = level
+
+    def accepting_replicas(self):
+        return self._live
+
+    def brownout_level(self):
+        return self._level
+
+    def mode_flags(self):
+        return {}
+
+
+def _post_generate(fe, body, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", fe.port, timeout=30)
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    conn.request("POST", "/v1/generate", json.dumps(body), h)
+    resp = conn.getresponse()
+    out = (resp.status, dict(resp.getheaders()),
+           json.loads(resp.read() or b"{}"))
+    conn.close()
+    return out
+
+
+class TestAdmissionMatrix:
+    """429-vs-503 contract (satellite: the codes are a protocol, not a
+    mood): 429 means "the fleet is alive but won't take THIS request
+    now — honor Retry-After"; 503 is reserved for zero live replicas.
+    A browned-out class with live replicas must therefore see 429, and
+    a dead fleet must see 503 even for a class the ladder admits."""
+
+    def _stack(self, live, level):
+        broker = RespServer(port=0).start()
+        fe = HttpFrontend(redis_port=broker.port, timeout=1,
+                          max_backlog=8).start()
+        fe.serving = _StubServing(live=live, level=level)
+        return broker, fe
+
+    def test_brownout_shed_is_429_while_fleet_live(self):
+        broker, fe = self._stack(live=1, level=1)
+        try:
+            status, headers, body = _post_generate(
+                fe, {"prompt": [1, 2, 3], "priority": "batch"})
+            assert status == 429
+            assert "brownout level 1" in body["error"]
+            assert "batch" in body["error"]
+            ra = headers.get("Retry-After")
+            assert ra is not None and 1 <= int(ra) <= 120
+            # header and body carry the SAME hint by construction
+            assert body["retry_after_s"] == int(ra)
+        finally:
+            fe.stop()
+            broker.stop()
+
+    def test_brownout_retry_after_monotone_with_level(self):
+        hints = []
+        for level in (1, 4):
+            broker, fe = self._stack(live=1, level=level)
+            try:
+                status, headers, body = _post_generate(
+                    fe, {"prompt": [1, 2, 3], "priority": "batch"})
+                assert status == 429
+                hints.append(int(headers["Retry-After"]))
+            finally:
+                fe.stop()
+                broker.stop()
+        assert hints[1] > hints[0], hints
+
+    def test_admitted_class_passes_the_gate_under_brownout(self):
+        # interactive survives every level; with no consumer behind
+        # the broker the request times out at 504 — which PROVES it
+        # was admitted (neither 429-shed nor 503-refused)
+        broker, fe = self._stack(live=1, level=4)
+        try:
+            status, _, body = _post_generate(
+                fe, {"prompt": [1, 2, 3], "priority": "interactive"})
+            assert status == 504, body
+        finally:
+            fe.stop()
+            broker.stop()
+
+    def test_zero_live_replicas_is_503_even_for_admitted_class(self):
+        for level in (0, 4):
+            broker, fe = self._stack(live=0, level=level)
+            try:
+                status, headers, body = _post_generate(
+                    fe, {"prompt": [1, 2, 3],
+                         "priority": "interactive"})
+                assert status == 503, (level, body)
+                assert "no live replicas" in body["error"]
+                ra = headers.get("Retry-After")
+                assert ra is not None and 1 <= int(ra) <= 120
+                assert body["retry_after_s"] == int(ra)
+            finally:
+                fe.stop()
+                broker.stop()
+
+    def test_healthz_carries_brownout_block(self):
+        broker, fe = self._stack(live=1, level=2)
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", fe.port, timeout=15)
+            conn.request("GET", "/healthz")
+            h = json.loads(conn.getresponse().read())
+            conn.close()
+            assert h["brownout"]["level"] == 2
+            assert h["brownout"]["admitting"] == \
+                ["interactive", "standard"]
+        finally:
+            fe.stop()
+            broker.stop()
+
+
+class TestDeadlineHttpPaths:
+    """The deadline budget's HTTP surface (satellite: codec
+    hardening): header and body are ONE validated field — agreeing
+    duplicates pass, disagreement and malformed values are a pointed
+    400, and a valid budget reaches the wire (the request then times
+    out at 504 against a consumer-less broker, proving admission)."""
+
+    def _stack(self):
+        broker = RespServer(port=0).start()
+        fe = HttpFrontend(redis_port=broker.port, timeout=1,
+                          max_backlog=8).start()
+        return broker, fe
+
+    @pytest.mark.parametrize("send", ["header", "body", "both"])
+    def test_valid_budget_admits_via_either_path(self, send):
+        broker, fe = self._stack()
+        try:
+            body = {"prompt": [1, 2, 3]}
+            headers = {}
+            if send in ("header", "both"):
+                headers["X-Request-Deadline-Ms"] = "30000"
+            if send in ("body", "both"):
+                body["deadline_ms"] = 30000
+            status, _, resp = _post_generate(fe, body, headers)
+            assert status == 504, (send, resp)
+        finally:
+            fe.stop()
+            broker.stop()
+
+    def test_disagreeing_header_and_body_is_400(self):
+        broker, fe = self._stack()
+        try:
+            status, _, resp = _post_generate(
+                fe, {"prompt": [1, 2, 3], "deadline_ms": 5000},
+                {"X-Request-Deadline-Ms": "6000"})
+            assert status == 400
+            assert "disagree" in resp["error"]
+        finally:
+            fe.stop()
+            broker.stop()
+
+    @pytest.mark.parametrize("bad", ["-5", "0", "nan", "inf", "soon",
+                                     str(MAX_DEADLINE_MS + 1)])
+    def test_malformed_header_budget_is_400(self, bad):
+        broker, fe = self._stack()
+        try:
+            status, _, resp = _post_generate(
+                fe, {"prompt": [1, 2, 3]},
+                {"X-Request-Deadline-Ms": bad})
+            assert status == 400, (bad, resp)
+            assert "deadline_ms" in resp["error"]
+        finally:
+            fe.stop()
+            broker.stop()
+
+    def test_malformed_body_budget_is_400(self):
+        broker, fe = self._stack()
+        try:
+            for bad in (-5, 0, "soon", MAX_DEADLINE_MS + 1, True):
+                status, _, resp = _post_generate(
+                    fe, {"prompt": [1, 2, 3], "deadline_ms": bad})
+                assert status == 400, (bad, resp)
+                assert "deadline_ms" in resp["error"]
         finally:
             fe.stop()
             broker.stop()
@@ -654,7 +885,10 @@ class TestStreamingStack:
             eng = h["engine"]
             assert eng == {"continuous": True, "paged": True,
                            "chunked": True, "speculative": True,
-                           "qos": True}
+                           "qos": True, "brownout": False}
+            assert h["brownout"] == {
+                "level": 0,
+                "admitting": ["interactive", "standard", "batch"]}
         finally:
             fe.stop()
             serving.stop()

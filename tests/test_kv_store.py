@@ -364,7 +364,7 @@ def test_engine_spill_publishes_host_tier_and_eviction_retracts(lm):
 # ---------------------------------------------------------------------------
 
 def test_route_request_ranks_prefix_locality_between_role_and_pressure():
-    assert SCHEDULER_POLICY_VERSION == 3
+    assert SCHEDULER_POLICY_VERSION == 4
     # locality outranks queue depth AND pool pressure...
     rs = [ReplicaSignals(replica=0),
           ReplicaSignals(replica=1, prefix_blocks=3, queue_depth=5,

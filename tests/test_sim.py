@@ -853,6 +853,38 @@ class TestFleetModel:
             violations = check_envelopes(summary, sub["envelopes"])
             assert violations == [], (sub["name"], violations)
 
+    def test_golden_brownout_gate_trips_without_hysteresis(self):
+        # the ISSUE-20 acceptance criterion: the golden-brownout
+        # transitions ceiling exists to pin the enter/exit hysteresis.
+        # Strip it (enter=exit=1 tick, recovery threshold == entry
+        # threshold) and the ladder flaps an order of magnitude past
+        # the bound — the gate MUST trip, or it guards nothing.
+        doc = copy.deepcopy(load_scenario(GOLDEN))
+        sub = next(d for d in doc["extra_scenarios"]
+                   if d["name"] == "golden-brownout")
+        sub["brownout"]["enter_ticks"] = 1
+        sub["brownout"]["exit_ticks"] = 1
+        sub["brownout"]["queue_recover_frac"] = 1.0
+        summary = run_scenario(sub)
+        violations = check_envelopes(summary, sub["envelopes"])
+        assert any(v["metric"] == "brownout_transitions"
+                   for v in violations), (violations, summary.get(
+                       "brownout_transitions"))
+
+    def test_golden_brownout_off_summary_has_no_brownout_keys(self):
+        # key-stability contract (like tiered-KV/chaos): a scenario
+        # without `brownout` must summarize bit-identically to PR-19 —
+        # no brownout_* or deadline_sheds keys appear at all
+        doc = copy.deepcopy(load_scenario(GOLDEN))
+        sub = next(d for d in doc["extra_scenarios"]
+                   if d["name"] == "golden-brownout")
+        del sub["brownout"]
+        del sub["trace"]["deadlines"]
+        summary = run_scenario(sub)
+        assert not any(k.startswith("brownout_") for k in summary), \
+            sorted(summary)
+        assert "deadline_sheds" not in summary
+
     def test_golden_disagg_gate_trips_without_role_routing(self):
         # the negative direction: strip the roles and the pinned
         # handoff envelope must break (the gate is a real tripwire)

@@ -145,6 +145,15 @@ def test_cli_stale_baseline_fails(tmp_path):
     # baseline everything the fixture produces -> clean run
     w = _cli(BAD102, "--baseline", bp, "--write-baseline")
     assert w.returncode == 0, w.stderr
+    # freshly written entries carry the "TODO: justify" placeholder:
+    # unfiltered runs fail CLOSED until a human writes the real reason
+    todo = _cli(BAD102, "--baseline", bp)
+    assert todo.returncode == 1
+    assert "UNJUSTIFIED" in todo.stderr
+    data = json.load(open(bp))
+    for e in data["entries"]:
+        e["reason"] = "fixture keeps the blocking call on purpose"
+    json.dump(data, open(bp, "w"))
     assert _cli(BAD102, "--baseline", bp).returncode == 0
     # inject an entry whose line no longer exists: the CLI must fail
     # loudly instead of letting the dead entry shadow future findings
